@@ -1,0 +1,90 @@
+"""Reed-Solomon over GF(2^8) as GF(2) bit-plane matmuls — the XLA/MXU path.
+
+Key idea: multiplying a byte by a GF(2^8) constant is linear over GF(2), so an
+RS encode `parity[m, B] = G_parity[m, k] ∘GF∘ data[k, B]` lowers exactly to
+
+    parity_bits[8m, B] = (Gbits[8m, 8k] @ data_bits[8k, B]) mod 2
+
+where `data_bits` are the LSB-first bit-planes of the data bytes and `Gbits`
+is `rs_matrix.bit_matrix` of the parity rows.  The matmul contracts over 8k
+(80 for RS(10,4), 224 for RS(28,4)) with the huge byte axis B on the lanes —
+exactly the systolic-array-friendly shape.  The mod-2 comes free: the operands
+are 0/1 so partial sums are <= 8k <= 2040, exact in any f32/int32 accumulator
+(do NOT narrow the accumulator below that); mask the low bit at the end.
+
+This replaces the reference's AVX2 SIMD inner loop
+(klauspost/reedsolomon galois_amd64.s, driven from
+weed/storage/erasure_coding/ec_encoder.go:179 `enc.Encode(buffers)`),
+and `reconstruct` replaces enc.Reconstruct (ec_encoder.go:270).  Unlike the
+reference, (k, m) and the decode matrix are runtime *inputs*, so one compiled
+kernel serves every missing-shard pattern — no recompile per mask.
+
+All functions are shape-polymorphic over a leading batch (volume) axis via
+vmap; `ops.codec.RSCodec` is the user-facing wrapper and
+`parallel.sharded_codec` the multi-chip version.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def unpack_bits(data: jax.Array) -> jax.Array:
+    """[..., S, B] uint8 -> [..., 8S, B] uint8 bit-planes, LSB-first.
+
+    Plane 8*s + j holds bit j of shard-row s.
+    """
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (data[..., :, None, :] >> shifts[:, None]) & jnp.uint8(1)
+    return bits.reshape(*data.shape[:-2], data.shape[-2] * 8, data.shape[-1])
+
+
+def pack_bits(bits: jax.Array) -> jax.Array:
+    """Inverse of unpack_bits: [..., 8S, B] {0,1} uint8 -> [..., S, B] uint8."""
+    s8, b = bits.shape[-2], bits.shape[-1]
+    v = bits.reshape(*bits.shape[:-2], s8 // 8, 8, b)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    return jnp.sum(v << shifts[:, None], axis=-2, dtype=jnp.uint8)
+
+
+def gf_matmul_bits(bitmat: jax.Array, data: jax.Array, *,
+                   dot_dtype=jnp.bfloat16) -> jax.Array:
+    """GF(2^8) matrix-multiply via the bit-plane formulation.
+
+    bitmat: [8M, 8K] uint8 {0,1} (from rs_matrix.bit_matrix)
+    data:   [..., K, B] uint8
+    returns [..., M, B] uint8
+
+    The contraction runs on the MXU in `dot_dtype` (bf16 default; int8 also
+    exact: operands are 0/1, partial sums <= 8K <= 2040, accumulated f32/int32).
+    """
+    planes = unpack_bits(data).astype(dot_dtype)
+    w = bitmat.astype(dot_dtype)
+    acc = jnp.einsum("ij,...jb->...ib", w, planes,
+                     preferred_element_type=jnp.float32
+                     if dot_dtype != jnp.int8 else jnp.int32)
+    out_bits = (acc.astype(jnp.int32) & 1).astype(jnp.uint8)
+    return pack_bits(out_bits)
+
+
+@functools.partial(jax.jit, static_argnames=("dot_dtype",))
+def encode(parity_bits: jax.Array, data: jax.Array, *,
+           dot_dtype=jnp.bfloat16) -> jax.Array:
+    """parity[..., M, B] from data[..., K, B]; parity_bits is [8M, 8K]."""
+    return gf_matmul_bits(parity_bits, data, dot_dtype=dot_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("dot_dtype",))
+def reconstruct(decode_bits: jax.Array, present: jax.Array, *,
+                dot_dtype=jnp.bfloat16) -> jax.Array:
+    """targets[..., T, B] = D ∘GF∘ present[..., K, B].
+
+    decode_bits: [8T, 8K] bit-expansion of rs_matrix.decode_matrix — a runtime
+    input, so any missing-shard mask reuses the same executable.
+    present: the K chosen surviving shards, in the row order D was built for.
+    """
+    return gf_matmul_bits(decode_bits, present, dot_dtype=dot_dtype)

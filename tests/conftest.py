@@ -1,0 +1,11 @@
+"""Test harness: force an 8-device virtual CPU mesh so every multi-chip code
+path (shard_map over jax.sharding.Mesh) compiles and runs without TPU hardware,
+mirroring how the driver's dryrun validates sharding."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,94 @@
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import gf256
+from seaweedfs_tpu.ops.gf256 import EXP_TABLE, LOG_TABLE, MUL_TABLE, div, gf_pow, inv, mat_inv, matmul, mul
+
+rng = np.random.default_rng(0)
+
+
+def test_known_table_values():
+    # Generator 2, poly 0x11D: the canonical Backblaze/klauspost table heads.
+    assert list(EXP_TABLE[:9]) == [1, 2, 4, 8, 16, 32, 64, 128, 29]
+    assert LOG_TABLE[2] == 1 and LOG_TABLE[29] == 8
+    # 2-periodicity for exp wraparound
+    assert EXP_TABLE[255] == EXP_TABLE[0] == 1
+
+
+def test_mul_matches_polynomial_mul():
+    def slow_mul(a, b):
+        p = 0
+        for _ in range(8):
+            if b & 1:
+                p ^= a
+            hi = a & 0x80
+            a = (a << 1) & 0xFF
+            if hi:
+                a ^= 0x1D  # 0x11D without the x^8 term
+            b >>= 1
+        return p
+
+    a = rng.integers(0, 256, 200)
+    b = rng.integers(0, 256, 200)
+    for x, y in zip(a, b):
+        assert mul(x, y) == slow_mul(int(x), int(y)), (x, y)
+
+
+def test_field_axioms():
+    a = rng.integers(0, 256, 500, dtype=np.uint8)
+    b = rng.integers(0, 256, 500, dtype=np.uint8)
+    c = rng.integers(0, 256, 500, dtype=np.uint8)
+    assert np.array_equal(mul(a, b), mul(b, a))
+    assert np.array_equal(mul(a, mul(b, c)), mul(mul(a, b), c))
+    # distributive over XOR (characteristic-2 addition)
+    assert np.array_equal(mul(a, b ^ c), mul(a, b) ^ mul(a, c))
+    nz = a[a != 0]
+    assert np.array_equal(mul(nz, inv(nz)), np.ones_like(nz))
+
+
+def test_div_inverse_of_mul():
+    a = rng.integers(0, 256, 300, dtype=np.uint8)
+    b = rng.integers(1, 256, 300, dtype=np.uint8)
+    assert np.array_equal(div(mul(a, b), b), a)
+    with pytest.raises(ZeroDivisionError):
+        div(np.uint8(3), np.uint8(0))
+
+
+def test_gf_pow():
+    assert gf_pow(np.uint8(0), 0) == 1  # klauspost galExp(0, 0) == 1
+    assert gf_pow(np.uint8(0), 5) == 0
+    assert gf_pow(np.uint8(2), 8) == 29
+    a = rng.integers(1, 256, 50, dtype=np.uint8)
+    p3 = mul(mul(a, a), a)
+    assert np.array_equal(gf_pow(a, 3), p3)
+
+
+def test_mat_inv_roundtrip():
+    for n in (1, 2, 5, 10, 16):
+        while True:
+            A = rng.integers(0, 256, (n, n), dtype=np.uint8)
+            try:
+                Ainv = mat_inv(A)
+                break
+            except np.linalg.LinAlgError:
+                continue
+        assert np.array_equal(matmul(A, Ainv), np.eye(n, dtype=np.uint8))
+        assert np.array_equal(matmul(Ainv, A), np.eye(n, dtype=np.uint8))
+
+
+def test_mat_inv_singular_raises():
+    A = np.zeros((3, 3), dtype=np.uint8)
+    A[0] = [1, 2, 3]
+    A[1] = [2, 4, 6]  # 2 * row0 in GF? (2*1=2, 2*2=4, 2*3=6) yes
+    A[2] = [5, 7, 9]
+    with pytest.raises(np.linalg.LinAlgError):
+        mat_inv(A)
+
+
+def test_mul_table_consistency():
+    a = rng.integers(0, 256, 1000, dtype=np.uint8)
+    b = rng.integers(0, 256, 1000, dtype=np.uint8)
+    assert np.array_equal(MUL_TABLE[a, b], mul(a, b))
+    assert np.all(MUL_TABLE[0, :] == 0) and np.all(MUL_TABLE[:, 0] == 0)
+    # every nonzero row is a permutation of 1..255 over nonzero cols
+    assert sorted(MUL_TABLE[7, 1:].tolist()) == list(range(1, 256))
