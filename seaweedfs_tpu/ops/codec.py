@@ -1,0 +1,162 @@
+"""High-level Reed-Solomon codec API — the TPU replacement for the reference's
+`reedsolomon.Encoder` (created at weed/storage/erasure_coding/ec_encoder.go:198,
+used via enc.Encode / enc.Reconstruct / enc.ReconstructData).
+
+    codec = RSCodec(10, 4)                       # ec_encoder.go:17-19 geometry
+    parity = codec.encode(data_blocks)           # enc.Encode
+    codec.reconstruct(shards)                    # enc.Reconstruct (fills None)
+    codec.reconstruct(shards, data_only=True)    # enc.ReconstructData
+
+Accepts/returns numpy uint8; shapes are [k, B] or batched [V, k, B].  Three
+backends:
+  - "pallas": fused TPU kernel (ops/rs_pallas.py) — the fast path
+  - "jax":    pure-XLA bit-plane matmul (ops/rs_jax.py) — runs anywhere
+  - "numpy":  gf256 table matmul — tiny, the correctness oracle
+"auto" picks pallas on TPU, else jax.  B is padded to the lane/block multiple
+internally (zero columns encode independently, so padding is exact) and
+stripped on return.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import gf256, rs_jax, rs_matrix, rs_pallas
+
+
+def _tpu_available() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except RuntimeError:
+        return False
+
+
+class RSCodec:
+    def __init__(self, data_shards: int = rs_matrix.DEFAULT_DATA_SHARDS,
+                 parity_shards: int = rs_matrix.DEFAULT_PARITY_SHARDS,
+                 *, kind: str = "vandermonde", backend: str = "auto",
+                 block_b: int = rs_pallas.DEFAULT_BLOCK_B,
+                 interpret: bool = False):
+        if backend == "auto":
+            backend = "pallas" if _tpu_available() else "jax"
+        if backend not in ("pallas", "jax", "numpy"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.k = data_shards
+        self.m = parity_shards
+        self.n = data_shards + parity_shards
+        self.kind = kind
+        self.backend = backend
+        self.block_b = block_b
+        self.interpret = interpret
+        self.gen = rs_matrix.generator_matrix(self.k, self.m, kind)
+        self._parity_bits = rs_matrix.parity_bit_matrix(self.k, self.m, kind)
+        self._parity_bits_dev = None  # lazy device constant
+
+    # -- helpers ---------------------------------------------------------
+    def _pad(self, arr: np.ndarray) -> tuple[np.ndarray, int]:
+        b = arr.shape[-1]
+        mult = self.block_b if self.backend == "pallas" else 128
+        pad = (-b) % mult
+        if pad:
+            arr = np.pad(arr, [(0, 0)] * (arr.ndim - 1) + [(0, pad)])
+        return arr, b
+
+    def _matmul(self, bits_shard_major: np.ndarray, mo: int,
+                inputs: np.ndarray) -> np.ndarray:
+        """Dispatch out = M ∘GF∘ inputs[..., KI, B] to the chosen backend."""
+        squeeze = inputs.ndim == 2
+        if squeeze:
+            inputs = inputs[None]
+        if self.backend == "numpy":
+            M = np.asarray(bits_shard_major)  # here: the GF matrix itself
+            out = np.stack([gf256.matmul(M, x) for x in inputs])
+            return out[0] if squeeze else out
+        padded, b = self._pad(inputs)
+        if self.backend == "pallas":
+            ki = padded.shape[-2]
+            if bits_shard_major is self._parity_bits:  # hot path: cached device constant
+                pm = self._parity_bits_pm()
+            else:
+                pm = jnp.asarray(
+                    rs_pallas.to_plane_major(bits_shard_major, mo, ki),
+                    dtype=jnp.bfloat16)
+            out = rs_pallas.gf_matmul_bits_pallas(
+                pm, jnp.asarray(padded), block_b=self.block_b,
+                interpret=self.interpret)
+        else:
+            out = rs_jax.gf_matmul_bits(jnp.asarray(bits_shard_major),
+                                        jnp.asarray(padded))
+        out = np.asarray(jax.device_get(out))[..., :b]
+        return out[0] if squeeze else out
+
+    def _parity_bits_pm(self):
+        """Cached device-resident plane-major parity bit-matrix (pallas only)."""
+        assert self.backend == "pallas"
+        if self._parity_bits_dev is None:
+            self._parity_bits_dev = jnp.asarray(
+                rs_pallas.to_plane_major(self._parity_bits, self.m, self.k),
+                dtype=jnp.bfloat16)
+        return self._parity_bits_dev
+
+    # -- public API ------------------------------------------------------
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """data [.., k, B] uint8 -> parity [.., m, B] uint8."""
+        data = np.asarray(data, dtype=np.uint8)
+        assert data.shape[-2] == self.k, f"expected {self.k} data shards"
+        if self.backend == "numpy":
+            return self._matmul(self.gen[self.k:], self.m, data)
+        return self._matmul(self._parity_bits, self.m, data)
+
+    def encode_jax(self, data: jax.Array) -> jax.Array:
+        """Device-resident encode for jit/shard_map composition (jax arrays
+        in/out, no host copies).  B must already be lane-aligned."""
+        if self._parity_bits_dev is None:
+            if self.backend == "pallas":
+                self._parity_bits_dev = jnp.asarray(
+                    rs_pallas.to_plane_major(self._parity_bits, self.m, self.k),
+                    dtype=jnp.bfloat16)
+            else:
+                self._parity_bits_dev = jnp.asarray(self._parity_bits)
+        if self.backend == "pallas":
+            return rs_pallas.gf_matmul_bits_pallas(
+                self._parity_bits_dev, data, block_b=self.block_b,
+                interpret=self.interpret)
+        return rs_jax.gf_matmul_bits(self._parity_bits_dev, data)
+
+    def reconstruct(self, shards: list[np.ndarray | None], *,
+                    data_only: bool = False) -> list[np.ndarray]:
+        """Fill in missing (None) shards in place of the reference's
+        enc.Reconstruct / enc.ReconstructData (ec_encoder.go:270,
+        store_ec.go:360).  `shards` has length k+m; present entries must share
+        one [B] or [V, B] shape."""
+        if len(shards) != self.n:
+            raise ValueError(f"expected {self.n} shard slots, got {len(shards)}")
+        present = [i for i, s in enumerate(shards) if s is not None]
+        targets = [i for i, s in enumerate(shards) if s is None
+                   and (not data_only or i < self.k)]
+        if len(present) < self.k:
+            raise ValueError(
+                f"too few shards to reconstruct: {len(present)} < {self.k}")
+        if not targets:
+            return list(shards)
+        D = rs_matrix.decode_matrix(self.gen, present, targets)
+        chosen = np.stack([np.asarray(shards[i], dtype=np.uint8)
+                           for i in present[:self.k]], axis=-2)
+        if self.backend == "numpy":
+            rec = self._matmul(D, len(targets), chosen)
+        else:
+            rec = self._matmul(rs_matrix.bit_matrix(D), len(targets), chosen)
+        out = list(shards)
+        for row, t in enumerate(targets):
+            out[t] = np.ascontiguousarray(rec[..., row, :])
+        return out
+
+    def verify(self, shards: list[np.ndarray]) -> bool:
+        """Check parity consistency (reference enc.Verify)."""
+        data = np.stack(shards[:self.k], axis=-2)
+        parity = np.stack(shards[self.k:], axis=-2)
+        return bool(np.array_equal(self.encode(data), parity))

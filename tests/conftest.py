@@ -1,10 +1,18 @@
 """Test harness: force an 8-device virtual CPU mesh so every multi-chip code
 path (shard_map over jax.sharding.Mesh) compiles and runs without TPU hardware,
-mirroring how the driver's dryrun validates sharding."""
+mirroring how the driver's dryrun validates sharding.
+
+The image's sitecustomize registers the tunneled TPU ('axon') backend and jax
+reads JAX_PLATFORMS at interpreter start, so mutating os.environ here is too
+late for the platform choice — use jax.config instead.  XLA_FLAGS is read
+lazily at CPU client creation, so setting it here still works.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
