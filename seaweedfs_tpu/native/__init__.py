@@ -1,0 +1,79 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference ships as a single Go binary whose only "native" hot path is the
+vendored SIMD Reed-Solomon codec; here the TPU owns the codec and this package
+owns the host-side hot loops: CRC32C needle checksums, the compact needle map,
+and streaming IO. Everything has a pure-Python fallback so the framework runs
+unbuilt; `build()` compiles the .so on demand with g++ (no pip deps — plain
+ctypes ABI).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libseaweed_native.so")
+_SOURCES = ["crc32c.cpp", "needle_map.cpp"]
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def build(force: bool = False) -> str | None:
+    """Compile the native library if missing/stale. Returns path or None."""
+    srcs = [os.path.join(_DIR, s) for s in _SOURCES if os.path.exists(os.path.join(_DIR, s))]
+    if not srcs:
+        return None
+    if not force and os.path.exists(_SO):
+        so_mtime = os.path.getmtime(_SO)
+        if all(os.path.getmtime(s) <= so_mtime for s in srcs):
+            return _SO
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           "-o", _SO] + srcs
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except Exception:
+        return None
+    return _SO
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        so = build()
+        if so is None:
+            return None
+        try:
+            _lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        _lib.sw_crc32c.restype = ctypes.c_uint32
+        _lib.sw_crc32c.argtypes = [ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t]
+        return _lib
+
+
+def _crc32c(data: bytes, crc: int = 0) -> int:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return lib.sw_crc32c(crc, data, len(data))
+
+
+def _crc_available() -> bool:
+    return _load() is not None
+
+
+# public handles (None when unavailable -> callers fall back to Python)
+crc32c = _crc32c if _crc_available() else None
+
+
+def lib():
+    """The raw ctypes CDLL, or None."""
+    return _load()

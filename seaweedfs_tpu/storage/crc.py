@@ -1,0 +1,78 @@
+"""Needle checksum: CRC32-Castagnoli with the masked final value
+`rot15(crc) + 0xa282ead8` the reference uses (weed/storage/needle/crc.go:12-26,
+the snappy/"masked CRC" construction), so .dat files interoperate byte-for-byte.
+
+Fast path is the native extension (seaweedfs_tpu/native — SSE4.2 crc32q on
+x86, table slice-by-8 otherwise); fallback is a numpy-free pure-Python
+slice-by-8 that is fine for small needles.
+"""
+
+from __future__ import annotations
+
+import struct
+
+CASTAGNOLI_POLY = 0x82F63B78  # reflected 0x1EDC6F41
+
+
+def _make_tables(n: int = 8) -> list[list[int]]:
+    t0 = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ CASTAGNOLI_POLY if c & 1 else c >> 1
+        t0.append(c)
+    tables = [t0]
+    for k in range(1, n):
+        prev = tables[k - 1]
+        tables.append([t0[prev[i] & 0xFF] ^ (prev[i] >> 8) for i in range(256)])
+    return tables
+
+
+_TABLES = _make_tables()
+
+
+def _crc32c_py(data: bytes, crc: int = 0) -> int:
+    c = crc ^ 0xFFFFFFFF
+    t = _TABLES
+    n8 = len(data) // 8 * 8
+    for i in range(0, n8, 8):
+        c ^= struct.unpack_from("<I", data, i)[0]
+        hi = struct.unpack_from("<I", data, i + 4)[0]
+        c = (t[7][c & 0xFF] ^ t[6][(c >> 8) & 0xFF]
+             ^ t[5][(c >> 16) & 0xFF] ^ t[4][(c >> 24) & 0xFF]
+             ^ t[3][hi & 0xFF] ^ t[2][(hi >> 8) & 0xFF]
+             ^ t[1][(hi >> 16) & 0xFF] ^ t[0][(hi >> 24) & 0xFF])
+    for b in data[n8:]:
+        c = t[0][(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+_native = None
+
+
+def _get_native():
+    global _native
+    if _native is None:
+        try:
+            from seaweedfs_tpu import native
+            _native = native.crc32c or False
+        except Exception:
+            _native = False
+    return _native
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    fn = _get_native()
+    if fn:
+        return fn(data, crc)
+    return _crc32c_py(data, crc)
+
+
+def masked_value(crc: int) -> int:
+    """The stored checksum: rot17-left + magic (needle/crc.go:24-26)."""
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def needle_checksum(data: bytes) -> int:
+    """Checksum as written into the needle trailer (NewCRC(data).Value())."""
+    return masked_value(crc32c(data))

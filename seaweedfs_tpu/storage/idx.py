@@ -1,0 +1,52 @@
+""".idx file walking — 16-byte entries: key(8) offset(4) size(4), big-endian
+(weed/storage/idx/walk.go:12-55, needle_types.go:36-38).
+
+walk_index parses with numpy in one vectorized pass instead of a
+1024-rows-at-a-time scalar loop — a 30 GB volume's idx is ~tens of MB, and
+this is the load path for every volume at startup.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator
+
+import numpy as np
+
+from . import types as t
+
+
+def parse_index_bytes(raw: bytes) -> np.ndarray:
+    """-> structured array with fields key(u8), offset(i8 actual bytes),
+    size(i4). Truncates any torn trailing partial entry."""
+    n = len(raw) // t.NEEDLE_MAP_ENTRY_SIZE
+    raw = raw[:n * t.NEEDLE_MAP_ENTRY_SIZE]
+    rows = np.frombuffer(raw, dtype=np.uint8).reshape(n, t.NEEDLE_MAP_ENTRY_SIZE)
+    key = rows[:, :8].copy().view(">u8").reshape(n)
+    off_scaled = rows[:, 8:12].copy().view(">u4").reshape(n)
+    size = rows[:, 12:16].copy().view(">i4").reshape(n)
+    out = np.empty(n, dtype=[("key", "u8"), ("offset", "i8"), ("size", "i4")])
+    out["key"] = key
+    out["offset"] = off_scaled.astype(np.int64) * t.NEEDLE_PADDING_SIZE
+    out["size"] = size
+    return out
+
+
+def idx_entry_bytes(key: int, actual_offset: int, size: int) -> bytes:
+    return (t.needle_id_to_bytes(key)
+            + t.offset_to_bytes(actual_offset)
+            + t.size_to_bytes(size))
+
+
+def walk_index_file(path: str,
+                    fn: Callable[[int, int, int], None]) -> None:
+    """Call fn(key, actual_offset, size) per entry in file order."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    for key, offset, size in iter_index_bytes(raw):
+        fn(key, offset, size)
+
+
+def iter_index_bytes(raw: bytes) -> Iterator[tuple[int, int, int]]:
+    arr = parse_index_bytes(raw)
+    for row in arr:
+        yield int(row["key"]), int(row["offset"]), int(row["size"])

@@ -1,0 +1,232 @@
+"""DiskLocation + Store: the per-server façade over all volumes
+(weed/storage/disk_location.go, store.go:34-52).
+
+A DiskLocation owns one data directory (vid -> Volume, vid -> EcVolume);
+the Store routes needle ops by volume id and builds heartbeat summaries
+(store.go:216 CollectHeartbeat).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+
+from . import types as t
+from .needle import Needle
+from .needle_map import KIND_MEMORY
+from .super_block import ReplicaPlacement
+from .ttl import TTL, EMPTY_TTL
+from .volume import (NotFoundError, Volume, VolumeInfo, VolumeError,
+                     parse_volume_base_name, volume_file_name)
+
+
+class DiskLocation:
+    def __init__(self, directory: str, max_volume_count: int = 7,
+                 min_free_space_ratio: float = 0.01,
+                 needle_map_kind: str = KIND_MEMORY,
+                 disk_type: str = "hdd"):
+        self.directory = os.path.abspath(directory)
+        self.max_volume_count = max_volume_count
+        self.min_free_space_ratio = min_free_space_ratio
+        self.needle_map_kind = needle_map_kind
+        self.disk_type = disk_type
+        self.volumes: dict[int, Volume] = {}
+        self.ec_volumes: dict[int, object] = {}  # vid -> EcVolume (storage.ec)
+        self._lock = threading.RLock()
+        os.makedirs(self.directory, exist_ok=True)
+        self.load_existing_volumes()
+
+    def load_existing_volumes(self) -> None:
+        """Concurrent per-volume load in the reference
+        (disk_location.go loadExistingVolumes); serial here — map replay is
+        already vectorized."""
+        for fname in sorted(os.listdir(self.directory)):
+            if not fname.endswith(".dat"):
+                continue
+            base = fname[:-4]
+            try:
+                collection, vid = parse_volume_base_name(base)
+            except ValueError:
+                continue
+            if vid in self.volumes:
+                continue
+            try:
+                self.volumes[vid] = Volume(
+                    self.directory, collection, vid,
+                    needle_map_kind=self.needle_map_kind)
+            except Exception:
+                continue
+        self.load_ec_shards()
+
+    def load_ec_shards(self) -> None:
+        """Pick up .ec00-.ecNN shard files (disk_location_ec.go:118)."""
+        try:
+            from .ec import ec_volume as ecv  # lazy: avoids cycle at import
+        except ImportError:
+            return
+        shards: dict[int, list[tuple[str, int]]] = {}
+        for fname in os.listdir(self.directory):
+            root, ext = os.path.splitext(fname)
+            if len(ext) == 5 and ext.startswith(".ec") and ext[3:].isdigit():
+                try:
+                    collection, vid = parse_volume_base_name(root)
+                except ValueError:
+                    continue
+                shards.setdefault(vid, []).append((collection, int(ext[3:])))
+        for vid, pairs in shards.items():
+            collection = pairs[0][0]
+            if vid in self.ec_volumes:
+                continue
+            try:
+                vol = ecv.EcVolume(self.directory, collection, vid)
+                for _, shard_id in pairs:
+                    vol.load_shard(shard_id)
+                self.ec_volumes[vid] = vol
+            except Exception:
+                continue
+
+    def add_volume(self, collection: str, vid: int,
+                   replica_placement: ReplicaPlacement | None = None,
+                   ttl: TTL = EMPTY_TTL,
+                   needle_map_kind: str | None = None) -> Volume:
+        with self._lock:
+            if vid in self.volumes:
+                raise VolumeError(f"volume {vid} already exists")
+            v = Volume(self.directory, collection, vid,
+                       needle_map_kind=needle_map_kind or self.needle_map_kind,
+                       replica_placement=replica_placement, ttl=ttl)
+            self.volumes[vid] = v
+            return v
+
+    def delete_volume(self, vid: int) -> None:
+        with self._lock:
+            v = self.volumes.pop(vid, None)
+            if v is not None:
+                v.destroy()
+
+    def unload_volume(self, vid: int) -> None:
+        with self._lock:
+            v = self.volumes.pop(vid, None)
+            if v is not None:
+                v.close()
+
+    def has_free_space(self) -> bool:
+        st = os.statvfs(self.directory)
+        free_ratio = st.f_bavail / max(st.f_blocks, 1)
+        return free_ratio > self.min_free_space_ratio
+
+
+@dataclass
+class HeartbeatSnapshot:
+    """What the volume server reports to the master each pulse
+    (store.go:216 CollectHeartbeat + store_ec.go:25)."""
+    volumes: list[VolumeInfo] = field(default_factory=list)
+    ec_shards: list[dict] = field(default_factory=list)
+    max_volume_count: int = 0
+    max_file_key: int = 0
+
+
+class Store:
+    def __init__(self, directories: list[str],
+                 max_volume_counts: list[int] | None = None,
+                 needle_map_kind: str = KIND_MEMORY,
+                 ip: str = "", port: int = 0, public_url: str = ""):
+        counts = max_volume_counts or [7] * len(directories)
+        self.locations = [
+            DiskLocation(d, max_volume_count=c, needle_map_kind=needle_map_kind)
+            for d, c in zip(directories, counts)]
+        self.ip = ip
+        self.port = port
+        self.public_url = public_url or (f"{ip}:{port}" if ip else "")
+
+    # -- volume routing ---------------------------------------------------
+    def find_volume(self, vid: int) -> Volume | None:
+        for loc in self.locations:
+            v = loc.volumes.get(vid)
+            if v is not None:
+                return v
+        return None
+
+    def find_ec_volume(self, vid: int):
+        for loc in self.locations:
+            v = loc.ec_volumes.get(vid)
+            if v is not None:
+                return v
+        return None
+
+    def has_volume(self, vid: int) -> bool:
+        return self.find_volume(vid) is not None
+
+    def add_volume(self, vid: int, collection: str = "",
+                   replica_placement: str = "000", ttl: str = "",
+                   preallocate: int = 0) -> Volume:
+        if self.find_volume(vid) is not None:
+            raise VolumeError(f"volume {vid} already exists")
+        loc = self._pick_location()
+        return loc.add_volume(collection, vid,
+                              replica_placement=ReplicaPlacement.parse(replica_placement),
+                              ttl=TTL.parse(ttl))
+
+    def _pick_location(self) -> DiskLocation:
+        best, best_free = None, -1
+        for loc in self.locations:
+            free = loc.max_volume_count - len(loc.volumes)
+            if free > best_free and loc.has_free_space():
+                best, best_free = loc, free
+        if best is None:
+            raise VolumeError("no disk location with free space")
+        return best
+
+    def delete_volume(self, vid: int) -> None:
+        for loc in self.locations:
+            if vid in loc.volumes:
+                loc.delete_volume(vid)
+                return
+
+    # -- needle ops (store.go:341,365) ------------------------------------
+    def write_volume_needle(self, vid: int, n: Needle,
+                            fsync: bool = False) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        return v.write_needle(n, fsync=fsync)
+
+    def read_volume_needle(self, vid: int, n_id: int,
+                           cookie: int | None = None) -> Needle:
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        return v.read_needle(n_id, cookie)
+
+    def delete_volume_needle(self, vid: int, n_id: int,
+                             cookie: int | None = None) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            return 0
+        return v.delete_needle(n_id, cookie)
+
+    # -- heartbeat --------------------------------------------------------
+    def collect_heartbeat(self) -> HeartbeatSnapshot:
+        hb = HeartbeatSnapshot()
+        max_key = 0
+        for loc in self.locations:
+            hb.max_volume_count += loc.max_volume_count
+            for v in loc.volumes.values():
+                hb.volumes.append(v.info())
+                max_key = max(max_key, v.max_file_key())
+            for vid, ecv in loc.ec_volumes.items():
+                hb.ec_shards.append({
+                    "id": vid,
+                    "collection": ecv.collection,
+                    "ec_index_bits": ecv.shard_bits(),
+                })
+        hb.max_file_key = max_key
+        return hb
+
+    def close(self) -> None:
+        for loc in self.locations:
+            for v in loc.volumes.values():
+                v.close()
+            for ecv in loc.ec_volumes.values():
+                ecv.close()

@@ -1,0 +1,132 @@
+"""Scalar type encodings: offsets, sizes, file ids, padding math, CRC mask.
+
+Mirrors the reference's storage/needle round-trip unit tests (SURVEY §4);
+padding quirk (8 when aligned) is asserted explicitly for byte-compat.
+"""
+
+import pytest
+
+from seaweedfs_tpu.storage import crc, types as t
+from seaweedfs_tpu.storage.ttl import TTL
+from seaweedfs_tpu.storage.super_block import ReplicaPlacement, SuperBlock
+
+
+def test_offset_round_trip():
+    for actual in (0, 8, 1024, t.MAX_POSSIBLE_VOLUME_SIZE - 8):
+        b = t.offset_to_bytes(actual)
+        assert len(b) == 4
+        assert t.bytes_to_offset(b) == actual
+
+
+def test_offset_5_byte():
+    big = 5 * 1024 * 1024 * 1024 * 1024  # 5 TB
+    b = t.offset_to_bytes(big, width=5)
+    assert t.bytes_to_offset(b, width=5) == big
+
+
+def test_size_tombstone_round_trip():
+    b = t.size_to_bytes(t.TOMBSTONE_FILE_SIZE)
+    assert t.bytes_to_size(b) == -1
+    assert t.size_is_deleted(-1)
+    assert not t.size_is_valid(-1)
+    assert t.size_is_valid(1)
+    assert not t.size_is_valid(0)
+
+
+def test_padding_is_8_when_aligned():
+    # v3 record layout: 16 + size + 4 + 8; size=4 -> 32, aligned -> pad 8
+    assert t.padding_length(4, t.VERSION3) == 8
+    assert t.get_actual_size(4, t.VERSION3) == 40
+    # v2: 16 + size + 4; size=4 -> 24 aligned -> pad 8
+    assert t.padding_length(4, t.VERSION2) == 8
+    for size in range(0, 64):
+        total = t.get_actual_size(size, t.VERSION3)
+        assert total % t.NEEDLE_PADDING_SIZE == 0
+        assert total > t.NEEDLE_HEADER_SIZE + size
+
+
+def test_file_id_format():
+    # leading zero bytes of the key are stripped (file_id.go:63-72)
+    fid = t.FileId(3, 0x01, 0xDEADBEEF)
+    assert str(fid) == "3,01deadbeef"
+    back = t.FileId.parse(str(fid))
+    assert back == fid
+
+    fid2 = t.FileId(12, 0x0102030405060708, 1)
+    assert str(fid2) == "12,010203040506070800000001"
+    assert t.FileId.parse(str(fid2)) == fid2
+
+
+def test_file_id_parse_errors():
+    with pytest.raises(ValueError):
+        t.FileId.parse("nocomma")
+    with pytest.raises(ValueError):
+        t.FileId.parse("3,ab")  # too short
+
+
+def test_crc32c_vectors():
+    # canonical CRC32C check vector
+    assert crc.crc32c(b"123456789") == 0xE3069283
+    assert crc.crc32c(b"") == 0
+    # incremental == one-shot
+    a = crc.crc32c(b"hello, ")
+    assert crc.crc32c(b"world", a) == crc.crc32c(b"hello, world")
+
+
+def test_crc_python_fallback_matches_native():
+    data = bytes(range(256)) * 33 + b"tail"
+    assert crc.crc32c(data) == crc._crc32c_py(data)
+
+
+def test_needle_checksum_mask():
+    # masked value = rot17(crc) + 0xa282ead8 (needle/crc.go:24-26)
+    c = crc.crc32c(b"abc")
+    expect = (((c >> 15) | (c << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+    assert crc.needle_checksum(b"abc") == expect
+
+
+def test_ttl_round_trip():
+    for s, minutes in [("3m", 3), ("4h", 240), ("5d", 5 * 1440),
+                       ("6w", 6 * 7 * 1440), ("7M", 7 * 31 * 1440),
+                       ("8y", 8 * 365 * 1440), ("90", 90)]:
+        ttl = TTL.parse(s)
+        assert ttl.minutes() == minutes
+        assert TTL.from_bytes(ttl.to_bytes()) == ttl
+        assert TTL.from_uint32(ttl.to_uint32()) == ttl
+    assert TTL.parse("") .count == 0
+    assert str(TTL.parse("3m")) == "3m"
+    assert str(TTL.parse("90")) == "90m"
+
+
+def test_replica_placement():
+    rp = ReplicaPlacement.parse("012")
+    assert rp.diff_data_center_count == 0
+    assert rp.diff_rack_count == 1
+    assert rp.same_rack_count == 2
+    assert rp.copy_count() == 4
+    assert str(rp) == "012"
+    assert ReplicaPlacement.from_byte(rp.to_byte()) == rp
+    with pytest.raises(ValueError):
+        ReplicaPlacement.parse("5")
+
+
+def test_super_block_round_trip():
+    sb = SuperBlock(version=t.VERSION3,
+                    replica_placement=ReplicaPlacement.parse("001"),
+                    ttl=TTL.parse("3h"),
+                    compaction_revision=7)
+    raw = sb.to_bytes()
+    assert len(raw) == 8
+    back = SuperBlock.from_bytes(raw + b"garbage")
+    assert back.version == t.VERSION3
+    assert str(back.replica_placement) == "001"
+    assert str(back.ttl) == "3h"
+    assert back.compaction_revision == 7
+
+
+def test_super_block_extra():
+    sb = SuperBlock(extra=b"\x08\x01")
+    raw = sb.to_bytes()
+    assert len(raw) == 10
+    back = SuperBlock.from_bytes(raw)
+    assert back.extra == b"\x08\x01"

@@ -1,0 +1,206 @@
+"""Volume engine: write/read/delete, dedup, torn-tail healing, vacuum,
+needle-map replay — the analogue of volume_vacuum_test.go and
+volume_checking.go behavior."""
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.needle_map import (KIND_LEVELDB, KIND_MEMORY,
+                                              LevelDbNeedleMap,
+                                              MemoryNeedleMap)
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.storage.super_block import ReplicaPlacement
+from seaweedfs_tpu.storage.volume import NotFoundError, Volume
+
+
+def put(v, nid, data, cookie=0x11):
+    n = Needle(cookie=cookie, id=nid, data=data)
+    v.write_needle(n)
+    return n
+
+
+def test_volume_write_read_delete(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    put(v, 1, b"hello")
+    put(v, 2, b"world" * 100)
+    assert v.read_needle(1).data == b"hello"
+    assert v.read_needle(2).data == b"world" * 100
+    assert v.nm.file_count() == 2
+
+    freed = v.delete_needle(1)
+    assert freed > 0
+    with pytest.raises(NotFoundError):
+        v.read_needle(1)
+    assert v.read_needle(2).data == b"world" * 100
+    assert v.delete_needle(99) == 0
+    v.close()
+
+
+def test_volume_cookie_check(tmp_path):
+    from seaweedfs_tpu.storage.volume import CookieMismatchError
+    v = Volume(str(tmp_path), "", 1)
+    put(v, 1, b"data", cookie=0xAA)
+    assert v.read_needle(1, cookie=0xAA).data == b"data"
+    with pytest.raises(CookieMismatchError):
+        v.read_needle(1, cookie=0xBB)
+    v.close()
+
+
+def test_volume_duplicate_write_skipped(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    put(v, 1, b"same-bytes")
+    size_before = v.content_size()
+    put(v, 1, b"same-bytes")  # identical rewrite -> no growth
+    assert v.content_size() == size_before
+    put(v, 1, b"different!")  # changed content -> appended
+    assert v.content_size() > size_before
+    assert v.read_needle(1).data == b"different!"
+    v.close()
+
+
+def test_volume_reload_replays_index(tmp_path):
+    v = Volume(str(tmp_path), "col", 5)
+    put(v, 10, b"aaa")
+    put(v, 11, b"bbb")
+    v.delete_needle(10)
+    v.close()
+
+    v2 = Volume(str(tmp_path), "col", 5)
+    with pytest.raises(NotFoundError):
+        v2.read_needle(10)
+    assert v2.read_needle(11).data == b"bbb"
+    assert v2.nm.deleted_count() >= 1
+    assert v2.max_file_key() == 11
+    v2.close()
+
+
+def test_volume_torn_tail_healed(tmp_path):
+    v = Volume(str(tmp_path), "", 2)
+    put(v, 1, b"first")
+    put(v, 2, b"second")
+    v.close()
+    # tear the last .dat record mid-way
+    dat = str(tmp_path / "2.dat")
+    size = os.path.getsize(dat)
+    with open(dat, "r+b") as f:
+        f.truncate(size - 7)
+    v2 = Volume(str(tmp_path), "", 2)
+    assert v2.read_needle(1).data == b"first"
+    with pytest.raises(NotFoundError):
+        v2.read_needle(2)
+    v2.close()
+
+
+def test_volume_vacuum_reclaims(tmp_path):
+    v = Volume(str(tmp_path), "", 3)
+    for i in range(20):
+        put(v, i + 1, bytes([i]) * 1000)
+    for i in range(10):
+        v.delete_needle(i + 1)
+    assert v.garbage_level() > 0.3
+    before = v.content_size()
+    reclaimed = v.vacuum()
+    assert reclaimed > 0
+    assert v.content_size() < before
+    assert v.garbage_level() == 0.0
+    assert v.super_block.compaction_revision == 1
+    for i in range(10, 20):
+        assert v.read_needle(i + 1).data == bytes([i]) * 1000
+    with pytest.raises(NotFoundError):
+        v.read_needle(1)
+    # survives reload
+    v.close()
+    v2 = Volume(str(tmp_path), "", 3)
+    assert v2.read_needle(15).data == bytes([14]) * 1000
+    v2.close()
+
+
+def test_volume_ttl_and_info(tmp_path):
+    from seaweedfs_tpu.storage.ttl import TTL
+    v = Volume(str(tmp_path), "c", 4,
+               replica_placement=ReplicaPlacement.parse("010"),
+               ttl=TTL.parse("1h"))
+    put(v, 1, b"x")
+    info = v.info()
+    assert info.id == 4
+    assert info.collection == "c"
+    assert info.file_count == 1
+    assert info.replica_placement == 10
+    assert info.ttl == TTL.parse("1h").to_uint32()
+    v.close()
+
+
+@pytest.mark.parametrize("cls,args", [
+    (MemoryNeedleMap, ()),
+])
+def test_needle_map_metrics(tmp_path, cls, args):
+    nm = cls(str(tmp_path / "m.idx"), *args)
+    nm.put(1, 8, 100)
+    nm.put(2, 108, 50)
+    nm.put(1, 200, 80)  # overwrite -> old counts as deleted
+    assert nm.file_count() == 3
+    assert nm.deleted_count() == 1
+    assert nm.deleted_size() == 100
+    assert nm.max_file_key() == 2
+    nm.delete(2, 108)
+    assert nm.deleted_count() == 2
+    assert nm.get(2) is None
+    assert nm.get(1).offset == 200
+    nm.close()
+
+
+def test_leveldb_needle_map(tmp_path):
+    nm = LevelDbNeedleMap(str(tmp_path / "v.ldb"), str(tmp_path / "v.idx"))
+    for i in range(100):
+        nm.put(i, 8 + i * 16, 10)
+    nm.delete(50, 0)
+    assert nm.get(50) is None
+    assert nm.get(99).size == 10
+    nm.close()
+    # reload from the idx log (fresh db replay path)
+    os.remove(str(tmp_path / "v.ldb"))
+    nm2 = LevelDbNeedleMap(str(tmp_path / "v.ldb"), str(tmp_path / "v.idx"))
+    assert nm2.get(50) is None
+    assert nm2.get(99).size == 10
+    assert nm2.max_file_key() == 99
+    nm2.close()
+
+
+def test_volume_leveldb_kind(tmp_path):
+    v = Volume(str(tmp_path), "", 7, needle_map_kind=KIND_LEVELDB)
+    put(v, 1, b"ldb-data")
+    v.close()
+    v2 = Volume(str(tmp_path), "", 7, needle_map_kind=KIND_LEVELDB)
+    assert v2.read_needle(1).data == b"ldb-data"
+    v2.close()
+
+
+def test_store_routing_and_heartbeat(tmp_path):
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    store = Store([d1, d2], ip="localhost", port=8080)
+    store.add_volume(1)
+    store.add_volume(2, collection="pics", replica_placement="001")
+    n = Needle(cookie=5, id=77, data=b"via-store")
+    store.write_volume_needle(1, n)
+    assert store.read_volume_needle(1, 77).data == b"via-store"
+
+    hb = store.collect_heartbeat()
+    assert len(hb.volumes) == 2
+    assert hb.max_volume_count == 14
+    assert hb.max_file_key == 77
+    cols = {v.collection for v in hb.volumes}
+    assert cols == {"", "pics"}
+
+    store.delete_volume_needle(1, 77)
+    with pytest.raises(NotFoundError):
+        store.read_volume_needle(1, 77)
+    store.close()
+
+    # reload picks volumes back up
+    store2 = Store([d1, d2])
+    assert store2.find_volume(1) is not None
+    assert store2.find_volume(2).collection == "pics"
+    store2.close()
