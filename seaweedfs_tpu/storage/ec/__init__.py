@@ -1,0 +1,66 @@
+"""Erasure coding subsystem — RS(k,m) striping of sealed volumes onto shard
+files, with TPU-batched encode/rebuild and degraded reads.
+
+File family per volume (reference weed/storage/erasure_coding/):
+  .ec00-.ec13  shard files (data 0..k-1, parity k..n-1)
+  .ecx         sorted copy of the needle index
+  .ecj         deletion journal (8-byte needle ids)
+  .vif         volume info (version) — JSON, like the reference's jsonpb
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .decoder import (find_dat_file_size, read_ec_volume_version,
+                      write_dat_file, write_idx_file_from_ec_index)
+from .ec_volume import (EcNotFoundError, EcShardUnavailableError, EcVolume,
+                        EcVolumeShard, rebuild_ecx_file)
+from .encoder import (rebuild_ec_files, write_ec_files,
+                      write_sorted_file_from_idx)
+from .layout import (DATA_SHARDS_COUNT, DEFAULT_GEOMETRY, LARGE_BLOCK_SIZE,
+                     PARITY_SHARDS_COUNT, SMALL_BLOCK_SIZE,
+                     TOTAL_SHARDS_COUNT, EcGeometry, Interval, locate_data,
+                     to_ext)
+from .shard_bits import ShardBits
+
+
+def save_volume_info(base_path: str, version: int, **extra) -> None:
+    """.vif sidecar (reference pb.SaveVolumeInfo writes jsonpb of
+    VolumeInfo, weed/pb/volume_info.go)."""
+    info = {"version": version, **extra}
+    with open(base_path + ".vif", "w") as f:
+        json.dump(info, f)
+
+
+def load_volume_info(base_path: str) -> dict:
+    path = base_path + ".vif"
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def encode_volume_to_ec(base_path: str, version: int,
+                        geo: EcGeometry = DEFAULT_GEOMETRY, codec=None
+                        ) -> None:
+    """The full VolumeEcShardsGenerate flow
+    (weed/server/volume_grpc_erasure_coding.go:38-80): shards + .ecx + .vif."""
+    write_sorted_file_from_idx(base_path)
+    write_ec_files(base_path, geo, codec)
+    save_volume_info(base_path, version)
+
+
+def decode_ec_to_volume(base_path: str,
+                        geo: EcGeometry = DEFAULT_GEOMETRY) -> None:
+    """The VolumeEcShardsToVolume flow
+    (volume_grpc_erasure_coding.go VolumeEcShardsToVolume): rebuild missing
+    data shards if needed, then stitch .dat and .idx back."""
+    missing_data = [s for s in range(geo.data_shards)
+                    if not os.path.exists(base_path + to_ext(s))]
+    if missing_data:
+        rebuild_ec_files(base_path, geo)
+    dat_size = find_dat_file_size(base_path)
+    write_dat_file(base_path, dat_size, geo)
+    write_idx_file_from_ec_index(base_path)
