@@ -46,10 +46,14 @@ def encode_volume_to_ec(base_path: str, version: int,
                         geo: EcGeometry = DEFAULT_GEOMETRY, codec=None
                         ) -> None:
     """The full VolumeEcShardsGenerate flow
-    (weed/server/volume_grpc_erasure_coding.go:38-80): shards + .ecx + .vif."""
+    (weed/server/volume_grpc_erasure_coding.go:38-80): shards + .ecx + .vif.
+
+    The exact .dat size goes into .vif: shard size alone cannot recover the
+    large/small row split at row boundaries (layout.n_large_block_rows)."""
     write_sorted_file_from_idx(base_path)
     write_ec_files(base_path, geo, codec)
-    save_volume_info(base_path, version)
+    save_volume_info(base_path, version,
+                     dat_size=os.path.getsize(base_path + ".dat"))
 
 
 def decode_ec_to_volume(base_path: str,

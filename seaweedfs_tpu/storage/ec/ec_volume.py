@@ -26,6 +26,7 @@ from ...ops.codec import RSCodec
 from .. import types as t
 from ..idx import idx_entry_bytes, parse_index_bytes
 from ..needle import Needle
+from .decoder import iterate_ecj_keys
 from .layout import DEFAULT_GEOMETRY, EcGeometry, Interval, locate_data, to_ext
 from .shard_bits import ShardBits
 
@@ -62,8 +63,9 @@ class EcVolumeShard:
         return os.path.join(self.directory, str(self.volume_id))
 
     def read_at(self, size: int, offset: int) -> bytes:
-        self._f.seek(offset)
-        return self._f.read(size)
+        # positional IO: concurrent readers must never seek-race (same rule
+        # as storage/backend.py LocalFile)
+        return os.pread(self._f.fileno(), size, offset)
 
     def close(self) -> None:
         self._f.close()
@@ -101,8 +103,13 @@ class EcVolume:
         self._offsets = np.ascontiguousarray(arr["offset"])
         self._sizes = np.ascontiguousarray(arr["size"]).astype(np.int64)
         self._ecx_rw = open(self._ecx_path, "r+b")
+        # true original-volume size from the .vif sidecar; k*shard_size is
+        # ambiguous at large-row boundaries (see layout.n_large_block_rows)
+        from . import load_volume_info
+        self._vif_dat_size: "int | None" = \
+            load_volume_info(base).get("dat_size")
         # replay any existing journal so restarts see prior deletes
-        for key in self._iter_ecj_keys():
+        for key in iterate_ecj_keys(base):
             self._tombstone_in_memory(key)
 
     def _base(self) -> str:
@@ -134,8 +141,18 @@ class EcVolume:
         return next(iter(self.shards.values())).size
 
     def dat_size(self) -> int:
-        """Logical original-volume size the locate math runs against
-        (ec_volume.go:218 uses k * shardFileSize)."""
+        """Logical original-volume size the locate math runs against.
+
+        Prefers the exact size recorded in .vif at encode time; falls back
+        to k * shardFileSize (the reference's derivation, ec_volume.go:218)
+        which over-counts by the final row's zero padding and is ambiguous
+        when the tail lands in the last small-row window of a large row."""
+        if self._vif_dat_size is not None:
+            return self._vif_dat_size
+        if not self.shards:
+            raise EcShardUnavailableError(
+                f"vol {self.volume_id}: no .vif dat_size and no local shard "
+                f"to derive the volume size from")
         return self.geo.data_shards * self.shard_size()
 
     # -- ecx lookup (SearchNeedleFromSortedIndex ec_volume.go:227-251) -----
@@ -186,15 +203,6 @@ class EcVolume:
             self._ecx_rw.flush()
             with open(self._ecj_path, "ab") as j:
                 j.write(t.needle_id_to_bytes(needle_id))
-
-    def _iter_ecj_keys(self):
-        if not os.path.exists(self._ecj_path):
-            return
-        with open(self._ecj_path, "rb") as f:
-            raw = f.read()
-        n = len(raw) // t.NEEDLE_ID_SIZE
-        for k in np.frombuffer(raw[:n * t.NEEDLE_ID_SIZE], dtype=">u8"):
-            yield int(k)
 
     # -- interval reads (store_ec.go:188-382) ------------------------------
     def _read_local_or_remote(self, shard_id: int, offset: int, size: int
@@ -283,13 +291,9 @@ def rebuild_ecx_file(base_path: str) -> None:
     with open(base_path + ".ecx", "rb") as f:
         arr = parse_index_bytes(f.read())
     keys = np.ascontiguousarray(arr["key"])
-    with open(ecj, "rb") as f:
-        raw = f.read()
-    n = len(raw) // t.NEEDLE_ID_SIZE
-    deleted = np.frombuffer(raw[:n * t.NEEDLE_ID_SIZE], dtype=">u8")
     with open(base_path + ".ecx", "r+b") as f:
-        for key in deleted:
-            i = int(np.searchsorted(keys, key))
+        for key in iterate_ecj_keys(base_path):
+            i = int(np.searchsorted(keys, np.uint64(key)))
             if i < len(keys) and keys[i] == key:
                 f.seek(i * t.NEEDLE_MAP_ENTRY_SIZE
                        + t.NEEDLE_ID_SIZE + t.OFFSET_SIZE)

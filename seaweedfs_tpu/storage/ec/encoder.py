@@ -27,7 +27,7 @@ import os
 import numpy as np
 
 from ...ops.codec import RSCodec
-from ..idx import idx_entry_bytes, parse_index_bytes
+from ..idx import index_array_to_bytes, parse_index_bytes
 from ..types import TOMBSTONE_FILE_SIZE
 from .layout import DEFAULT_GEOMETRY, EcGeometry, to_ext
 
@@ -172,6 +172,4 @@ def write_sorted_file_from_idx(base_path: str, ext: str = ".ecx") -> None:
     else:
         live = arr
     with open(base_path + ext, "wb") as out:
-        for e in live:
-            out.write(idx_entry_bytes(int(e["key"]), int(e["offset"]),
-                                      int(e["size"])))
+        out.write(index_array_to_bytes(live))

@@ -51,12 +51,20 @@ class EcGeometry:
         return self.small_block_size * self.data_shards
 
     def n_large_block_rows(self, dat_size: int) -> int:
-        """Row count derivable from a shard file's size (ec_locate.go:19)."""
-        return (dat_size + self.small_row_size()) // self.large_row_size()
+        """Large-row count for a TRUE dat size — the same `//` the encoder
+        walks, so locate and encode always agree.
+
+        The reference instead derives rows from k*shardFileSize with a
+        fudge term (ec_locate.go:19), which is ambiguous: a shard of
+        L large + 1024 small blocks has the same SIZE as L+1 large blocks
+        but a different layout, corrupting reads for dat sizes in the last
+        small-row window below a large-row multiple.  We persist the true
+        dat size in .vif instead (see EcVolume.dat_size)."""
+        return dat_size // self.large_row_size()
 
     def shard_file_size(self, dat_size: int) -> int:
         """Size of each .ecNN file for a dat of dat_size bytes."""
-        large_rows = dat_size // self.large_row_size()
+        large_rows = self.n_large_block_rows(dat_size)
         rem = dat_size - large_rows * self.large_row_size()
         small_rows = (rem + self.small_row_size() - 1) // self.small_row_size()
         return (large_rows * self.large_block_size

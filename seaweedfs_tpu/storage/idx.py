@@ -37,6 +37,18 @@ def idx_entry_bytes(key: int, actual_offset: int, size: int) -> bytes:
             + t.size_to_bytes(size))
 
 
+def index_array_to_bytes(arr: np.ndarray) -> bytes:
+    """Inverse of parse_index_bytes: structured array (key, offset actual
+    bytes, size) -> packed big-endian 16-byte entries, one vectorized pass."""
+    n = len(arr)
+    rows = np.empty((n, t.NEEDLE_MAP_ENTRY_SIZE), dtype=np.uint8)
+    rows[:, :8] = arr["key"].astype(">u8").view(np.uint8).reshape(n, 8)
+    scaled = (arr["offset"] // t.NEEDLE_PADDING_SIZE).astype(">u4")
+    rows[:, 8:12] = scaled.view(np.uint8).reshape(n, 4)
+    rows[:, 12:16] = arr["size"].astype(">i4").view(np.uint8).reshape(n, 4)
+    return rows.tobytes()
+
+
 def walk_index_file(path: str,
                     fn: Callable[[int, int, int], None]) -> None:
     """Call fn(key, actual_offset, size) per entry in file order."""

@@ -1,0 +1,9 @@
+"""Cluster topology model: node tree, volume layouts, rack-aware growth.
+
+The master's control-plane brain (reference weed/topology/)."""
+
+from .node import DataCenter, DataNode, Node, Rack
+from .topology import Topology, from_topology_dict
+from .volume_growth import (NoFreeSlotError, find_empty_slots_for_one_volume,
+                            grow_volumes, targets_for_replication)
+from .volume_layout import VolumeGrowOption, VolumeLayout

@@ -1,0 +1,167 @@
+"""Topology node tree: DataCenter -> Rack -> DataNode, with free/used volume
+slot accounting used by placement.
+
+Capability-equivalent to weed/topology/node.go + data_node.go + rack.go +
+data_center.go.  The reference threads a NodeImpl interface with reservation
+counters through four structs; here one Node base class with typed children
+keeps the same slot math (max - volumes - ec-shard slots) without the
+interface machinery.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Iterator, Optional
+
+from ..storage.ec.layout import TOTAL_SHARDS_COUNT
+from ..storage.ec.shard_bits import ShardBits
+from ..storage.volume import VolumeInfo
+
+
+class Node:
+    node_type = "Node"
+
+    def __init__(self, node_id: str):
+        self.id = node_id
+        self.parent: Optional[Node] = None
+        self.children: dict[str, Node] = {}
+        self._lock = threading.RLock()
+
+    # -- slot accounting (node.go AvailableSpaceFor / UpAdjust*) ----------
+    def max_volume_count(self) -> int:
+        return sum(c.max_volume_count() for c in self.children.values())
+
+    def volume_count(self) -> int:
+        return sum(c.volume_count() for c in self.children.values())
+
+    def ec_shard_count(self) -> int:
+        return sum(c.ec_shard_count() for c in self.children.values())
+
+    def free_space(self) -> int:
+        """Free volume slots; EC shards consume fractional slots rounded up
+        (node.go:42-48 availableSpace minus ecShardCount/EcTotal)."""
+        return (self.max_volume_count() - self.volume_count()
+                - math.ceil(self.ec_shard_count() / TOTAL_SHARDS_COUNT))
+
+    # -- tree -------------------------------------------------------------
+    def link_child(self, child: "Node") -> "Node":
+        with self._lock:
+            if child.id not in self.children:
+                child.parent = self
+                self.children[child.id] = child
+            return self.children[child.id]
+
+    def unlink_child(self, node_id: str) -> None:
+        with self._lock:
+            child = self.children.pop(node_id, None)
+            if child:
+                child.parent = None
+
+    def get_or_create(self, node_id: str, factory) -> "Node":
+        with self._lock:
+            if node_id not in self.children:
+                self.link_child(factory(node_id))
+            return self.children[node_id]
+
+    def data_nodes(self) -> Iterator["DataNode"]:
+        for c in self.children.values():
+            if isinstance(c, DataNode):
+                yield c
+            else:
+                yield from c.data_nodes()
+
+    def __repr__(self) -> str:
+        return f"<{self.node_type} {self.id}>"
+
+
+class DataNode(Node):
+    """One volume server (weed/topology/data_node.go)."""
+    node_type = "DataNode"
+
+    def __init__(self, node_id: str, ip: str = "", port: int = 0,
+                 grpc_port: int = 0, public_url: str = "",
+                 max_volumes: int = 7):
+        super().__init__(node_id)
+        self.ip = ip
+        self.port = port
+        self.grpc_port = grpc_port
+        self.public_url = public_url or f"{ip}:{port}"
+        self.max_volumes = max_volumes
+        self.volumes: dict[int, VolumeInfo] = {}
+        self.ec_shards: dict[int, ShardBits] = {}  # vid -> bits
+        self.last_seen = time.time()
+        self.is_active = True
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def max_volume_count(self) -> int:
+        return self.max_volumes
+
+    def volume_count(self) -> int:
+        return len(self.volumes)
+
+    def ec_shard_count(self) -> int:
+        return sum(b.shard_id_count() for b in self.ec_shards.values())
+
+    # -- registration (data_node.go UpdateVolumes / data_node_ec.go) ------
+    def update_volumes(self, infos: list[VolumeInfo]
+                       ) -> tuple[list[VolumeInfo], list[VolumeInfo]]:
+        """Full sync; returns (new, deleted)."""
+        with self._lock:
+            incoming = {v.id: v for v in infos}
+            new = [v for vid, v in incoming.items() if vid not in self.volumes]
+            deleted = [v for vid, v in self.volumes.items()
+                       if vid not in incoming]
+            self.volumes = incoming
+            return new, deleted
+
+    def add_or_update_volume(self, v: VolumeInfo) -> bool:
+        with self._lock:
+            is_new = v.id not in self.volumes
+            self.volumes[v.id] = v
+            return is_new
+
+    def delete_volume_by_id(self, vid: int) -> Optional[VolumeInfo]:
+        with self._lock:
+            return self.volumes.pop(vid, None)
+
+    def update_ec_shards(self, shards: dict[int, ShardBits]
+                         ) -> tuple[dict[int, ShardBits], dict[int, ShardBits]]:
+        """Full EC sync; returns (new_bits, deleted_bits) per vid."""
+        with self._lock:
+            new: dict[int, ShardBits] = {}
+            deleted: dict[int, ShardBits] = {}
+            for vid, bits in shards.items():
+                old = self.ec_shards.get(vid, ShardBits(0))
+                if bits.minus(old):
+                    new[vid] = bits.minus(old)
+            for vid, old in self.ec_shards.items():
+                gone = old.minus(shards.get(vid, ShardBits(0)))
+                if gone:
+                    deleted[vid] = gone
+            self.ec_shards = {vid: b for vid, b in shards.items() if b}
+            return new, deleted
+
+    def rack(self) -> "Rack":
+        return self.parent  # type: ignore[return-value]
+
+    def data_center(self) -> "DataCenter":
+        return self.parent.parent  # type: ignore[union-attr,return-value]
+
+
+class Rack(Node):
+    node_type = "Rack"
+
+    def get_or_create_data_node(self, node_id: str, **kw) -> DataNode:
+        return self.get_or_create(node_id, lambda i: DataNode(i, **kw))  # type: ignore[return-value]
+
+
+class DataCenter(Node):
+    node_type = "DataCenter"
+
+    def get_or_create_rack(self, rack_id: str) -> Rack:
+        return self.get_or_create(rack_id, Rack)  # type: ignore[return-value]

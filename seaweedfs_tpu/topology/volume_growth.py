@@ -1,0 +1,167 @@
+"""Rack-aware replica placement + volume growth.
+
+Capability-equivalent to weed/topology/volume_growth.go:
+- find_empty_slots_for_one_volume (:123): pick a main DC/rack/server plus
+  `xyz` replica counterparts (other-DC / other-rack / same-rack copies per
+  super_block.ReplicaPlacement), randomly weighted by free slots.
+- grow_by_count (:221 grow): allocate the same new vid on every chosen
+  server via an `allocate` callback (the AllocateVolume RPC seam).
+- target counts per replication (master_server.go:93-96): more replicas ->
+  fewer volumes per growth request.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Sequence
+
+from .node import DataCenter, DataNode, Node, Rack
+from .volume_layout import VolumeGrowOption
+
+
+class NoFreeSlotError(Exception):
+    pass
+
+
+def targets_for_replication(copy_count: int) -> int:
+    """How many volumes one growth request creates
+    (master_server.go:93-96 defaults)."""
+    return {1: 7, 2: 6, 3: 3}.get(copy_count, 1)
+
+
+def _weighted_pick(nodes: Sequence[Node], count: int, rng: random.Random,
+                   filter_fn: Callable[[Node], bool]) -> list[Node]:
+    """Pick `count` distinct nodes weighted by free_space
+    (the RandomlyPickNodes reservoir in volume_growth.go:142-188)."""
+    eligible = [n for n in nodes if filter_fn(n) and n.free_space() > 0]
+    if len(eligible) < count:
+        raise NoFreeSlotError(
+            f"need {count} nodes, only {len(eligible)} with free slots")
+    picked: list[Node] = []
+    pool = list(eligible)
+    for _ in range(count):
+        weights = [n.free_space() for n in pool]
+        total = sum(weights)
+        r = rng.uniform(0, total)
+        acc = 0.0
+        chosen = pool[-1]
+        for n, w in zip(pool, weights):
+            acc += w
+            if r <= acc:
+                chosen = n
+                break
+        picked.append(chosen)
+        pool.remove(chosen)
+    return picked
+
+
+def find_empty_slots_for_one_volume(topo_root: Node,
+                                    option: VolumeGrowOption,
+                                    rng: random.Random | None = None
+                                    ) -> list[DataNode]:
+    """Choose rp.copy_count() servers satisfying the placement grammar
+    (findEmptySlotsForOneVolume volume_growth.go:123-219).
+
+    xyz = DiffDataCenterCount / DiffRackCount / SameRackCount."""
+    rng = rng or random.Random()
+    rp = option.replica_placement
+
+    # main DC: enough racks and slots for the same-DC copies
+    same_dc_copies = rp.same_rack_count + rp.diff_rack_count + 1
+
+    def dc_ok(dc: Node) -> bool:
+        if option.preferred_data_center and dc.id != option.preferred_data_center:
+            return False
+        if len(dc.children) < rp.diff_rack_count + 1:
+            return False
+        return dc.free_space() >= same_dc_copies
+
+    dcs = list(topo_root.children.values())
+    main_dc = _weighted_pick(dcs, 1, rng, dc_ok)[0]
+    other_dcs = []
+    if rp.diff_data_center_count:
+        other_dcs = _weighted_pick(
+            [d for d in dcs if d.id != main_dc.id],
+            rp.diff_data_center_count, rng, lambda d: d.free_space() >= 1)
+
+    # main rack in main DC
+    def rack_ok(rack: Node) -> bool:
+        if option.preferred_rack and rack.id != option.preferred_rack:
+            return False
+        if len(rack.children) < rp.same_rack_count + 1:
+            return False
+        return rack.free_space() >= rp.same_rack_count + 1
+
+    racks = list(main_dc.children.values())
+    main_rack = _weighted_pick(racks, 1, rng, rack_ok)[0]
+    other_racks = []
+    if rp.diff_rack_count:
+        other_racks = _weighted_pick(
+            [r for r in racks if r.id != main_rack.id],
+            rp.diff_rack_count, rng, lambda r: r.free_space() >= 1)
+
+    # main server in main rack + same-rack copies
+    def server_ok(dn: Node) -> bool:
+        if option.preferred_data_node and dn.id != option.preferred_data_node:
+            return False
+        return dn.free_space() >= 1
+
+    servers = list(main_rack.children.values())
+    main_server = _weighted_pick(servers, 1, rng, server_ok)[0]
+    same_rack_servers = []
+    if rp.same_rack_count:
+        same_rack_servers = _weighted_pick(
+            [s for s in servers if s.id != main_server.id],
+            rp.same_rack_count, rng, lambda s: s.free_space() >= 1)
+
+    result: list[DataNode] = [main_server]  # type: ignore[list-item]
+    result += same_rack_servers  # type: ignore[arg-type]
+    # one server from each other rack / other DC (weighted)
+    for rack in other_racks:
+        result += _weighted_pick(list(rack.data_nodes()), 1, rng,
+                                 lambda s: s.free_space() >= 1)  # type: ignore[arg-type]
+    for dc in other_dcs:
+        result += _weighted_pick(list(dc.data_nodes()), 1, rng,
+                                 lambda s: s.free_space() >= 1)  # type: ignore[arg-type]
+    return result  # type: ignore[return-value]
+
+
+def grow_volumes(topo, option: VolumeGrowOption, count: int,
+                 allocate: Callable[[DataNode, int, VolumeGrowOption], None],
+                 rng: random.Random | None = None) -> list[int]:
+    """Create `count` new volumes; per volume: pick servers, call
+    `allocate(server, vid, option)` on each, then register the volume in the
+    topology (grow volume_growth.go:221-260).
+
+    Returns the vids actually created: when slots run out partway the
+    partial list is returned (the reference's Grow also reports the grown
+    count alongside the error); NoFreeSlotError is raised only if nothing
+    could be grown."""
+    rng = rng or random.Random()
+    grown: list[int] = []
+    for _ in range(count):
+        try:
+            servers = find_empty_slots_for_one_volume(topo.root, option, rng)
+        except NoFreeSlotError:
+            if grown:
+                return grown
+            raise
+        vid = topo.next_volume_id()
+        for dn in servers:
+            allocate(dn, vid, option)
+            topo.register_volume(_new_volume_info(vid, option), dn)
+        grown.append(vid)
+    return grown
+
+
+def _new_volume_info(vid: int, option: VolumeGrowOption):
+    from ..storage.ttl import TTL
+    from ..storage.volume import VolumeInfo
+    return VolumeInfo(
+        id=vid, size=0, collection=option.collection,
+        file_count=0, delete_count=0, deleted_byte_count=0,
+        read_only=False,
+        replica_placement=option.replica_placement.to_byte(),
+        version=3, ttl=TTL.parse(option.ttl_str).to_uint32()
+        if option.ttl_str else 0,
+        compact_revision=0)

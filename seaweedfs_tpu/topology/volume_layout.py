@@ -1,0 +1,165 @@
+"""VolumeLayout — per (collection, replica placement, ttl, disk) view of
+which volume ids are writable and where every replica lives.
+
+Capability-equivalent to weed/topology/volume_layout.go:127-420:
+- vid -> [DataNode] location list, enough-copies tracking
+- writable set: registered with full replica count, not read-only, not
+  oversized (volumeSizeLimit), not crowded
+- pick_for_write: random writable volume honoring DC/rack/node filters
+- set_volume_unavailable on node death (volume_layout.go:396)
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..storage.super_block import ReplicaPlacement
+from ..storage.volume import VolumeInfo
+from .node import DataNode
+
+
+@dataclass
+class VolumeGrowOption:
+    """Constraints a write/growth request carries
+    (topology/volume_growth.go:33-46)."""
+    collection: str = ""
+    replica_placement: ReplicaPlacement = field(
+        default_factory=ReplicaPlacement)
+    ttl_str: str = ""
+    disk_type: str = "hdd"
+    preferred_data_center: str = ""
+    preferred_rack: str = ""
+    preferred_data_node: str = ""
+
+
+class VolumeLayout:
+    def __init__(self, rp: ReplicaPlacement, ttl_str: str = "",
+                 disk_type: str = "hdd",
+                 volume_size_limit: int = 30 * 1024 * 1024 * 1024):
+        self.rp = rp
+        self.ttl_str = ttl_str
+        self.disk_type = disk_type
+        self.volume_size_limit = volume_size_limit
+        self.vid_to_locations: dict[int, list[DataNode]] = {}
+        self.writables: set[int] = set()
+        self.readonly: set[int] = set()
+        self.oversized: set[int] = set()
+        self._lock = threading.RLock()
+
+    # -- registration (volume_layout.go RegisterVolume:170) ----------------
+    def register_volume(self, v: VolumeInfo, dn: DataNode) -> None:
+        with self._lock:
+            locs = self.vid_to_locations.setdefault(v.id, [])
+            if dn not in locs:
+                locs.append(dn)
+            if v.read_only:
+                self.readonly.add(v.id)
+            else:
+                self.readonly.discard(v.id)
+            if v.size >= self.volume_size_limit:
+                self.oversized.add(v.id)
+            else:
+                # vacuum can shrink a volume back under the limit
+                self.oversized.discard(v.id)
+            self._refresh_writable(v.id)
+
+    def unregister_volume(self, v: VolumeInfo, dn: DataNode) -> None:
+        with self._lock:
+            locs = self.vid_to_locations.get(v.id, [])
+            if dn in locs:
+                locs.remove(dn)
+            if not locs:
+                self.vid_to_locations.pop(v.id, None)
+                self.writables.discard(v.id)
+                self.readonly.discard(v.id)
+                self.oversized.discard(v.id)
+            else:
+                self._refresh_writable(v.id)
+
+    def _refresh_writable(self, vid: int) -> None:
+        locs = self.vid_to_locations.get(vid, [])
+        ok = (len(locs) >= self.rp.copy_count()
+              and vid not in self.readonly
+              and vid not in self.oversized)
+        if ok:
+            self.writables.add(vid)
+        else:
+            self.writables.discard(vid)
+
+    # -- state changes -----------------------------------------------------
+    def set_volume_unavailable(self, vid: int, dn: DataNode) -> None:
+        """A replica's server died (volume_layout.go:396)."""
+        with self._lock:
+            locs = self.vid_to_locations.get(vid, [])
+            if dn in locs:
+                locs.remove(dn)
+            self._refresh_writable(vid)
+
+    def set_volume_readonly(self, vid: int) -> None:
+        with self._lock:
+            self.readonly.add(vid)
+            self.writables.discard(vid)
+
+    def set_volume_writable(self, vid: int) -> None:
+        with self._lock:
+            self.readonly.discard(vid)
+            self._refresh_writable(vid)
+
+    def set_oversized_if(self, v: VolumeInfo) -> None:
+        if v.size >= self.volume_size_limit:
+            with self._lock:
+                self.oversized.add(v.id)
+                self.writables.discard(v.id)
+
+    # -- queries -----------------------------------------------------------
+    def lookup(self, vid: int) -> list[DataNode]:
+        return list(self.vid_to_locations.get(vid, []))
+
+    def active_volume_count(self, option: Optional[VolumeGrowOption] = None
+                            ) -> int:
+        return len(self._candidates(option))
+
+    def _candidates(self, option: Optional[VolumeGrowOption]) -> list[int]:
+        out = []
+        for vid in self.writables:
+            locs = self.vid_to_locations.get(vid, [])
+            if not locs:
+                continue
+            if option:
+                if option.preferred_data_center and not any(
+                        dn.data_center().id == option.preferred_data_center
+                        for dn in locs):
+                    continue
+                if option.preferred_rack and not any(
+                        dn.rack().id == option.preferred_rack
+                        for dn in locs):
+                    continue
+                if option.preferred_data_node and not any(
+                        dn.id == option.preferred_data_node for dn in locs):
+                    continue
+            out.append(vid)
+        return out
+
+    def pick_for_write(self, option: Optional[VolumeGrowOption] = None,
+                       rng: random.Random | None = None
+                       ) -> tuple[int, list[DataNode]]:
+        """-> (vid, replica locations); raises LookupError when nothing is
+        writable (PickForWrite volume_layout.go:280)."""
+        with self._lock:
+            candidates = self._candidates(option)
+            if not candidates:
+                raise LookupError("no writable volumes")
+            vid = (rng or random).choice(candidates)
+            return vid, list(self.vid_to_locations[vid])
+
+    def to_dict(self) -> dict:
+        return {
+            "replication": str(self.rp),
+            "ttl": self.ttl_str,
+            "writables": sorted(self.writables),
+            "locations": {vid: [dn.id for dn in locs]
+                          for vid, locs in self.vid_to_locations.items()},
+        }

@@ -110,6 +110,55 @@ def test_shard_file_size_matches(tmp_path, codec):
                     == GEO.shard_file_size(dat_size)), dat_size
 
 
+@pytest.mark.parametrize("tail", [
+    0,                                   # exact large-row multiple
+    -1,                                  # 1 byte below a large-row multiple
+    -GEO.small_block_size // 2,          # inside the last small-row window
+    -GEO.small_row_size() + 1,           # just inside the window
+    GEO.small_row_size() - 1,            # just past a multiple
+])
+def test_boundary_window_roundtrip(tmp_path, codec, tail):
+    """Regression: dat sizes near a large-row multiple are ambiguous from
+    shard size alone (L large + 1024 small == L+1 large in SIZE).  With the
+    true dat size recorded in .vif every window must read back exactly."""
+    dat_size = 2 * GEO.large_row_size() + tail
+    rng = np.random.default_rng(tail & 0xFFFF)
+    data = rng.integers(0, 256, dat_size, dtype=np.uint8)
+    base = str(tmp_path / "9")
+    with open(base + ".dat", "wb") as f:
+        f.write(data.tobytes())
+    ec.write_ec_files(base, GEO, codec)
+    shard_mm = [np.memmap(base + ec.to_ext(s), dtype=np.uint8, mode="r")
+                for s in range(GEO.data_shards)]
+    for off, size in [(0, 512), (dat_size - 700, 700),
+                      (GEO.large_row_size() - 100, 300),
+                      (2 * GEO.large_row_size() - 600,
+                       min(900, dat_size - (2 * GEO.large_row_size() - 600)))]:
+        if off < 0 or size <= 0 or off + size > dat_size:
+            continue
+        out = bytearray()
+        for iv in locate_data(dat_size, off, size, GEO):
+            sid, soff = iv.to_shard_id_and_offset(GEO)
+            out += shard_mm[sid][soff:soff + iv.size].tobytes()
+        assert bytes(out) == data[off:off + size].tobytes(), (tail, off)
+
+
+def test_dat_size_requires_vif_or_shard(volume_dir, codec):
+    needles = make_volume(volume_dir)
+    base = encode(volume_dir, codec=codec)
+    # with .vif present, dat_size is exact even with zero local shards
+    ev = ec.EcVolume(volume_dir, "", 7, GEO, codec)
+    assert ev.dat_size() == os.path.getsize(base + ".dat")
+    ev.close()
+    os.remove(base + ".vif")
+    ev2 = ec.EcVolume(volume_dir, "", 7, GEO, codec)
+    with pytest.raises(ec.EcShardUnavailableError):
+        ev2.dat_size()  # no vif, no shards -> must refuse, not guess
+    ev2.add_shard(0)
+    assert ev2.dat_size() == GEO.data_shards * ev2.shard_size()
+    ev2.close()
+
+
 # -- encode / read / reconstruct ------------------------------------------
 
 def test_ec_roundtrip_all_shards(volume_dir, codec):

@@ -1,0 +1,269 @@
+"""Topology / placement tests, modeled on the reference's pattern of
+unit-testing distributed algorithms on serialized cluster state
+(shell/command_volume_balance_test.go, volume_growth tests)."""
+
+import random
+
+import pytest
+
+from seaweedfs_tpu.storage.ec.shard_bits import ShardBits
+from seaweedfs_tpu.storage.super_block import ReplicaPlacement
+from seaweedfs_tpu.storage.volume import VolumeInfo
+from seaweedfs_tpu.topology import (NoFreeSlotError, Topology,
+                                    VolumeGrowOption,
+                                    find_empty_slots_for_one_volume,
+                                    from_topology_dict, grow_volumes,
+                                    targets_for_replication)
+
+
+def vinfo(vid, collection="", size=0, rp=0, read_only=False, ttl=0):
+    return VolumeInfo(id=vid, size=size, collection=collection,
+                      file_count=0, delete_count=0, deleted_byte_count=0,
+                      read_only=read_only, replica_placement=rp, version=3,
+                      ttl=ttl, compact_revision=0)
+
+
+def build_topo(n_dc=2, n_rack=2, n_node=3, max_volumes=10):
+    topo = Topology(seed=42)
+    for d in range(n_dc):
+        for r in range(n_rack):
+            for n in range(n_node):
+                topo.get_or_create_data_node(
+                    f"dc{d}", f"rack{r}", f"dn-{d}-{r}-{n}",
+                    ip="127.0.0.1", port=8000 + d * 100 + r * 10 + n,
+                    max_volumes=max_volumes)
+    return topo
+
+
+# -- placement -------------------------------------------------------------
+
+def test_placement_000_single_copy():
+    topo = build_topo()
+    opt = VolumeGrowOption(replica_placement=ReplicaPlacement.parse("000"))
+    servers = find_empty_slots_for_one_volume(topo.root, opt,
+                                              random.Random(1))
+    assert len(servers) == 1
+
+
+def test_placement_001_same_rack():
+    topo = build_topo()
+    opt = VolumeGrowOption(replica_placement=ReplicaPlacement.parse("001"))
+    for seed in range(10):
+        servers = find_empty_slots_for_one_volume(topo.root, opt,
+                                                  random.Random(seed))
+        assert len(servers) == 2
+        assert servers[0].rack() is servers[1].rack()
+        assert servers[0] is not servers[1]
+
+
+def test_placement_010_diff_rack():
+    topo = build_topo()
+    opt = VolumeGrowOption(replica_placement=ReplicaPlacement.parse("010"))
+    for seed in range(10):
+        servers = find_empty_slots_for_one_volume(topo.root, opt,
+                                                  random.Random(seed))
+        assert len(servers) == 2
+        assert servers[0].rack() is not servers[1].rack()
+        assert servers[0].data_center() is servers[1].data_center()
+
+
+def test_placement_100_diff_dc():
+    topo = build_topo()
+    opt = VolumeGrowOption(replica_placement=ReplicaPlacement.parse("100"))
+    for seed in range(10):
+        servers = find_empty_slots_for_one_volume(topo.root, opt,
+                                                  random.Random(seed))
+        assert len(servers) == 2
+        assert servers[0].data_center() is not servers[1].data_center()
+
+
+def test_placement_110_mixed():
+    topo = build_topo()
+    opt = VolumeGrowOption(replica_placement=ReplicaPlacement.parse("110"))
+    servers = find_empty_slots_for_one_volume(topo.root, opt,
+                                              random.Random(3))
+    assert len(servers) == 3
+    dcs = {s.data_center().id for s in servers}
+    assert len(dcs) == 2
+    main_dc_servers = [s for s in servers
+                       if s.data_center() is servers[0].data_center()]
+    assert len({s.rack().id for s in main_dc_servers}) == 2
+
+
+def test_placement_preferred_dc():
+    topo = build_topo()
+    opt = VolumeGrowOption(replica_placement=ReplicaPlacement.parse("000"),
+                           preferred_data_center="dc1")
+    for seed in range(5):
+        servers = find_empty_slots_for_one_volume(topo.root, opt,
+                                                  random.Random(seed))
+        assert servers[0].data_center().id == "dc1"
+
+
+def test_placement_insufficient_slots():
+    topo = build_topo(n_dc=1, n_rack=1, n_node=1)
+    opt = VolumeGrowOption(replica_placement=ReplicaPlacement.parse("001"))
+    with pytest.raises(NoFreeSlotError):
+        find_empty_slots_for_one_volume(topo.root, opt, random.Random(1))
+
+
+def test_placement_full_nodes_excluded():
+    topo = build_topo(n_dc=1, n_rack=1, n_node=3, max_volumes=1)
+    # fill two of the three nodes
+    nodes = topo.data_nodes()
+    for dn in nodes[:2]:
+        topo.register_volume(vinfo(topo.next_volume_id()), dn)
+    opt = VolumeGrowOption(replica_placement=ReplicaPlacement.parse("000"))
+    for seed in range(10):
+        servers = find_empty_slots_for_one_volume(topo.root, opt,
+                                                  random.Random(seed))
+        assert servers[0] is nodes[2]
+
+
+# -- growth ---------------------------------------------------------------
+
+def test_grow_volumes_allocates_and_registers():
+    topo = build_topo()
+    opt = VolumeGrowOption(replica_placement=ReplicaPlacement.parse("010"),
+                           collection="c1")
+    calls = []
+    vids = grow_volumes(topo, opt, 3,
+                        lambda dn, vid, o: calls.append((dn.id, vid)),
+                        random.Random(5))
+    assert len(vids) == 3 and len(set(vids)) == 3
+    assert len(calls) == 6  # 2 replicas x 3 volumes
+    layout = topo.get_volume_layout("c1", ReplicaPlacement.parse("010"))
+    assert set(vids) <= layout.writables
+    vid, locs = topo.pick_for_write(opt)
+    assert vid in vids and len(locs) == 2
+
+
+def test_targets_for_replication():
+    assert targets_for_replication(1) == 7
+    assert targets_for_replication(2) == 6
+    assert targets_for_replication(3) == 3
+
+
+# -- layout writability ----------------------------------------------------
+
+def test_layout_needs_enough_replicas():
+    topo = build_topo()
+    rp = ReplicaPlacement.parse("001")
+    layout = topo.get_volume_layout("", rp)
+    dn1, dn2 = topo.data_nodes()[:2]
+    v = vinfo(1, rp=rp.to_byte())
+    topo.register_volume(v, dn1)
+    assert 1 not in layout.writables  # one of two replicas
+    topo.register_volume(v, dn2)
+    assert 1 in layout.writables
+    layout.set_volume_unavailable(1, dn2)
+    assert 1 not in layout.writables
+
+
+def test_layout_oversized_and_readonly():
+    topo = Topology(volume_size_limit=1000)
+    dn = topo.get_or_create_data_node("dc", "r", "n1", max_volumes=5)
+    layout = topo.get_volume_layout("", ReplicaPlacement.parse("000"))
+    topo.register_volume(vinfo(1, size=2000), dn)
+    assert 1 not in layout.writables
+    topo.register_volume(vinfo(2, read_only=True), dn)
+    assert 2 not in layout.writables
+    topo.register_volume(vinfo(3), dn)
+    assert 3 in layout.writables
+
+
+def test_oversized_clears_after_shrink():
+    """Regression: vacuum shrinks a volume below the limit; the next
+    heartbeat must make it writable again."""
+    topo = Topology(volume_size_limit=1000)
+    dn = topo.get_or_create_data_node("dc", "r", "n1", max_volumes=5)
+    layout = topo.get_volume_layout("", ReplicaPlacement.parse("000"))
+    topo.register_volume(vinfo(1, size=2000), dn)
+    assert 1 not in layout.writables
+    topo.register_volume(vinfo(1, size=100), dn)
+    assert 1 in layout.writables
+
+
+def test_grow_partial_on_exhaustion():
+    topo = build_topo(n_dc=1, n_rack=1, n_node=1, max_volumes=2)
+    opt = VolumeGrowOption(replica_placement=ReplicaPlacement.parse("000"))
+    vids = grow_volumes(topo, opt, 7, lambda dn, vid, o: None,
+                        random.Random(1))
+    assert len(vids) == 2  # slots ran out; partial result, no exception
+    with pytest.raises(NoFreeSlotError):
+        grow_volumes(topo, opt, 1, lambda dn, vid, o: None, random.Random(1))
+
+
+def test_pick_for_write_no_writable():
+    topo = build_topo()
+    opt = VolumeGrowOption(replica_placement=ReplicaPlacement.parse("000"))
+    with pytest.raises(LookupError):
+        topo.pick_for_write(opt)
+
+
+# -- heartbeat sync --------------------------------------------------------
+
+def test_sync_data_node_deltas():
+    topo = build_topo()
+    dn = topo.data_nodes()[0]
+    topo.sync_data_node(dn, [vinfo(1), vinfo(2)])
+    assert topo.lookup("", 1) == [dn]
+    assert topo.max_volume_id == 2
+    # next sync drops volume 1
+    topo.sync_data_node(dn, [vinfo(2)])
+    assert topo.lookup("", 1) == []
+    assert topo.lookup("", 2) == [dn]
+
+
+def test_unregister_data_node():
+    topo = build_topo()
+    rp = ReplicaPlacement.parse("001")
+    dn1, dn2 = topo.data_nodes()[:2]
+    v = vinfo(5, rp=rp.to_byte())
+    topo.register_volume(v, dn1)
+    topo.register_volume(v, dn2)
+    topo.sync_ec_shards(dn1, {9: ShardBits.from_ids([0, 1])})
+    topo.unregister_data_node(dn1)
+    layout = topo.get_volume_layout("", rp)
+    assert 5 not in layout.writables
+    assert topo.lookup_ec_shards(9) == {}
+    assert dn1.id not in [d.id for d in topo.data_nodes()]
+
+
+# -- EC shard map ----------------------------------------------------------
+
+def test_ec_shard_registration_and_staleness():
+    topo = build_topo()
+    dn1, dn2 = topo.data_nodes()[:2]
+    topo.sync_ec_shards(dn1, {7: ShardBits.from_ids([0, 1, 2])})
+    topo.sync_ec_shards(dn2, {7: ShardBits.from_ids([3, 4])})
+    locs = topo.lookup_ec_shards(7)
+    assert locs[0] == [dn1] and locs[3] == [dn2]
+    # dn1 loses shard 2
+    topo.sync_ec_shards(dn1, {7: ShardBits.from_ids([0, 1])})
+    locs = topo.lookup_ec_shards(7)
+    assert 2 not in locs
+    # ec shards consume slots
+    assert dn1.ec_shard_count() == 2
+    assert dn1.free_space() < dn1.max_volumes
+
+
+# -- serialization ---------------------------------------------------------
+
+def test_topology_dict_roundtrip():
+    topo = build_topo()
+    rp = ReplicaPlacement.parse("010")
+    opt = VolumeGrowOption(replica_placement=rp, collection="pix")
+    grow_volumes(topo, opt, 2, lambda dn, vid, o: None, random.Random(9))
+    dn = topo.data_nodes()[0]
+    topo.sync_ec_shards(dn, {99: ShardBits.from_ids([0, 5])})
+
+    d = topo.to_dict()
+    topo2 = from_topology_dict(d)
+    assert topo2.max_volume_id == topo.max_volume_id
+    assert sorted(dn2.id for dn2 in topo2.data_nodes()) == \
+        sorted(dn1.id for dn1 in topo.data_nodes())
+    layout2 = topo2.get_volume_layout("pix", rp)
+    layout1 = topo.get_volume_layout("pix", rp)
+    assert layout2.writables == layout1.writables
+    assert set(topo2.lookup_ec_shards(99)) == {0, 5}
