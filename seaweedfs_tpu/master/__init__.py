@@ -1,0 +1,4 @@
+"""Master: cluster control plane (reference weed/server/master_* + weed/sequence)."""
+
+from .sequencer import MemorySequencer, SnowflakeSequencer
+from .server import MasterServer

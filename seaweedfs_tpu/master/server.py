@@ -1,0 +1,361 @@
+"""Master server — volume placement, id assignment, cluster bookkeeping.
+
+Capability-equivalent to weed/server/master_server.go + master_grpc_server*.go:
+- gRPC `Seaweed` service: SendHeartbeat (bidi; full sync + deltas, dead-node
+  cleanup on stream end), Assign (grows volumes when nothing is writable,
+  master_grpc_server_volume.go:102-170), LookupVolume, LookupEcVolume,
+  KeepConnected (volume-location delta pub-sub, master_grpc_server.go:185),
+  LeaseAdminToken/ReleaseAdminToken (cluster maintenance lock,
+  wdclient/exclusive_locks), GetMasterConfiguration, VolumeList.
+- HTTP: /dir/assign, /dir/lookup, /cluster/status, /vol/grow
+  (master_server_handlers.go).
+
+Single-master here; the raft seam is the `is_leader` flag + max-volume-id
+counter in Topology (the reference's whole replicated state machine is just
+that counter + sequencer, topology/cluster_commands.go).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import random
+import threading
+import time
+
+from ..pb.rpc import POOL, RpcError, RpcServer
+from ..storage.super_block import ReplicaPlacement
+from ..storage.volume import VolumeInfo
+from ..storage.ec.shard_bits import ShardBits
+from ..topology import (Topology, VolumeGrowOption, grow_volumes,
+                        targets_for_replication)
+from ..topology.node import DataNode
+from ..topology.volume_growth import NoFreeSlotError
+from ..util.http import HttpServer, Request, Response
+from .sequencer import MemorySequencer
+
+
+def _volume_info_from_dict(d: dict) -> VolumeInfo:
+    return VolumeInfo(
+        id=d["id"], size=d.get("size", 0),
+        collection=d.get("collection", ""),
+        file_count=d.get("file_count", 0),
+        delete_count=d.get("delete_count", 0),
+        deleted_byte_count=d.get("deleted_byte_count", 0),
+        read_only=d.get("read_only", False),
+        replica_placement=d.get("replica_placement", 0),
+        version=d.get("version", 3), ttl=d.get("ttl", 0),
+        compact_revision=d.get("compact_revision", 0),
+        modified_at_second=d.get("modified_at_second", 0))
+
+
+class MasterServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 grpc_port: int = 0,
+                 volume_size_limit_mb: int = 30 * 1024,
+                 default_replication: str = "000",
+                 garbage_threshold: float = 0.3,
+                 seed: int | None = None):
+        self.topo = Topology(
+            volume_size_limit=volume_size_limit_mb * 1024 * 1024, seed=seed)
+        self.sequencer = MemorySequencer()
+        self.default_replication = default_replication
+        self.garbage_threshold = garbage_threshold
+        self.is_leader = True
+        self._rng = random.Random(seed)
+        self._grow_lock = threading.Lock()
+        # admin maintenance lock (LeaseAdminToken)
+        self._admin_lock = threading.Lock()
+        self._admin_token: int = 0
+        self._admin_client: str = ""
+        self._admin_ts: float = 0.0
+        # KeepConnected subscribers: name -> queue of location deltas
+        self._subscribers: dict[int, queue.Queue] = {}
+        self._sub_seq = 0
+        self._sub_lock = threading.Lock()
+
+        self.http = HttpServer(host, port)
+        self.rpc = RpcServer(host, grpc_port)
+        self._register_http()
+        self._register_rpc()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.http.start()
+        self.rpc.start()
+
+    def stop(self) -> None:
+        self.http.stop()
+        self.rpc.stop()
+
+    @property
+    def address(self) -> str:
+        return self.http.address
+
+    @property
+    def grpc_address(self) -> str:
+        return self.rpc.address
+
+    # -- assignment core (master_grpc_server_volume.go:102-170) ------------
+    def _grow_option(self, req: dict) -> VolumeGrowOption:
+        rp = ReplicaPlacement.parse(
+            req.get("replication") or self.default_replication)
+        return VolumeGrowOption(
+            collection=req.get("collection", ""),
+            replica_placement=rp,
+            ttl_str=req.get("ttl", ""),
+            preferred_data_center=req.get("data_center", ""),
+            preferred_rack=req.get("rack", ""),
+            preferred_data_node=req.get("data_node", ""))
+
+    def assign(self, req: dict) -> dict:
+        if not self.is_leader:
+            raise RpcError("not the leader")
+        count = int(req.get("count") or 1)
+        option = self._grow_option(req)
+        if not self.topo.has_writable_volume(option):
+            with self._grow_lock:
+                if not self.topo.has_writable_volume(option):
+                    self._grow(option)
+        try:
+            vid, nodes = self.topo.pick_for_write(option)
+        except LookupError as e:
+            raise RpcError(f"no writable volumes: {e}") from None
+        key = self.sequencer.next_file_id(count)
+        cookie = self._rng.getrandbits(32)
+        from ..storage.types import format_needle_id_cookie
+        fid = f"{vid},{format_needle_id_cookie(key, cookie)}"
+        main = nodes[0]
+        return {
+            "fid": fid, "count": count,
+            "url": main.url, "public_url": main.public_url,
+            "replicas": [{"url": dn.url, "public_url": dn.public_url}
+                         for dn in nodes[1:]],
+        }
+
+    def _grow(self, option: VolumeGrowOption) -> None:
+        """Synchronous growth (the reference queues into vgCh and blocks the
+        assign up to 10s; same effect inline under _grow_lock)."""
+        count = targets_for_replication(
+            option.replica_placement.copy_count())
+
+        def allocate(dn: DataNode, vid: int, opt: VolumeGrowOption) -> None:
+            client = POOL.client(f"{dn.ip}:{dn.grpc_port}", "VolumeServer")
+            client.call("AllocateVolume", {
+                "volume_id": vid, "collection": opt.collection,
+                "replication": str(opt.replica_placement),
+                "ttl": opt.ttl_str})
+
+        grown = grow_volumes(self.topo, option, count, allocate, self._rng)
+        for vid in grown:
+            self._publish_volume_location(vid, option.collection)
+
+    # -- lookup -------------------------------------------------------------
+    def lookup(self, vid: int, collection: str = "") -> list[dict]:
+        locs = self.topo.lookup(collection, vid)
+        if not locs:
+            # EC volumes are located by shard
+            by_shard = self.topo.lookup_ec_shards(vid)
+            seen: dict[str, dict] = {}
+            for nodes in by_shard.values():
+                for dn in nodes:
+                    seen[dn.url] = {"url": dn.url,
+                                    "public_url": dn.public_url}
+            return list(seen.values())
+        return [{"url": dn.url, "public_url": dn.public_url}
+                for dn in locs]
+
+    # -- heartbeat (master_grpc_server.go:21-183) ---------------------------
+    def _handle_heartbeat_stream(self, requests):
+        dn: DataNode | None = None
+        try:
+            for hb in requests:
+                dn = self._ingest_heartbeat(hb, dn)
+                yield {
+                    "volume_size_limit": self.topo.volume_size_limit,
+                    "leader": self.grpc_address,
+                }
+        finally:
+            if dn is not None:
+                self.topo.unregister_data_node(dn)
+                self._publish_node_change(dn, is_add=False)
+
+    def _ingest_heartbeat(self, hb: dict, dn: DataNode | None) -> DataNode:
+        if dn is None:
+            dn = self.topo.get_or_create_data_node(
+                hb.get("data_center", ""), hb.get("rack", ""),
+                f"{hb['ip']}:{hb['port']}",
+                ip=hb["ip"], port=hb["port"],
+                grpc_port=hb.get("grpc_port", 0),
+                public_url=hb.get("public_url", ""),
+                max_volumes=hb.get("max_volume_count", 7))
+            self._publish_node_change(dn, is_add=True)
+        dn.last_seen = time.time()
+        dn.max_volumes = hb.get("max_volume_count", dn.max_volumes)
+        if "volumes" in hb:  # full sync
+            infos = [_volume_info_from_dict(v) for v in hb["volumes"]]
+            self.topo.sync_data_node(dn, infos)
+            self.sequencer.set_max(hb.get("max_file_key", 0))
+        for v in hb.get("new_volumes", []):
+            self.topo.register_volume(_volume_info_from_dict(v), dn)
+        for v in hb.get("deleted_volumes", []):
+            self.topo.unregister_volume(_volume_info_from_dict(v), dn)
+        if "ec_shards" in hb:  # full EC sync
+            bits = {int(e["id"]): ShardBits(e["ec_index_bits"])
+                    for e in hb["ec_shards"]}
+            colls = {int(e["id"]): e.get("collection", "")
+                     for e in hb["ec_shards"]}
+            self.topo.sync_ec_shards(dn, bits, colls)
+        return dn
+
+    # -- KeepConnected pub-sub (master_grpc_server.go:185-252) --------------
+    def _handle_keep_connected(self, requests):
+        first = next(iter(requests), None)  # client announces itself
+        q: queue.Queue = queue.Queue()
+        with self._sub_lock:
+            self._sub_seq += 1
+            sid = self._sub_seq
+            self._subscribers[sid] = q
+        try:
+            # initial snapshot: every known volume location
+            for dn in self.topo.data_nodes():
+                yield self._node_location_msg(dn, is_add=True)
+            while True:
+                try:
+                    msg = q.get(timeout=0.5)
+                    yield msg
+                except queue.Empty:
+                    yield {"ping": 1}
+        finally:
+            with self._sub_lock:
+                self._subscribers.pop(sid, None)
+
+    def _publish(self, msg: dict) -> None:
+        with self._sub_lock:
+            for q in self._subscribers.values():
+                q.put(msg)
+
+    def _node_location_msg(self, dn: DataNode, is_add: bool) -> dict:
+        return {"volume_location": {
+            "url": dn.url, "public_url": dn.public_url,
+            "grpc_port": dn.grpc_port,
+            "new_vids" if is_add else "deleted_vids":
+                sorted(dn.volumes.keys()) + sorted(dn.ec_shards.keys()),
+        }}
+
+    def _publish_node_change(self, dn: DataNode, is_add: bool) -> None:
+        self._publish(self._node_location_msg(dn, is_add))
+
+    def _publish_volume_location(self, vid: int, collection: str) -> None:
+        for dn in self.topo.lookup(collection, vid):
+            self._publish({"volume_location": {
+                "url": dn.url, "public_url": dn.public_url,
+                "grpc_port": dn.grpc_port, "new_vids": [vid]}})
+
+    # -- admin lock (LeaseAdminToken, master_grpc_server_admin.go) ----------
+    def _lease_admin_token(self, req: dict) -> dict:
+        now = time.time()
+        with self._admin_lock:
+            prev = int(req.get("previous_token") or 0)
+            client = req.get("client_name", "")
+            expired = now - self._admin_ts > 10.0
+            if (self._admin_token == 0 or expired
+                    or prev == self._admin_token
+                    or client == self._admin_client):
+                self._admin_token = self._rng.getrandbits(63) or 1
+                self._admin_client = client
+                self._admin_ts = now
+                return {"token": self._admin_token,
+                        "lock_ts_ns": int(now * 1e9)}
+            raise RpcError(
+                f"admin lock held by {self._admin_client}")
+
+    def _release_admin_token(self, req: dict) -> dict:
+        with self._admin_lock:
+            if int(req.get("previous_token") or 0) == self._admin_token:
+                self._admin_token = 0
+                self._admin_client = ""
+        return {}
+
+    # -- service registration -----------------------------------------------
+    def _register_rpc(self) -> None:
+        self.rpc.add_service(
+            "Seaweed",
+            unary={
+                "Assign": self.assign,
+                "LookupVolume": self._rpc_lookup_volume,
+                "LookupEcVolume": self._rpc_lookup_ec_volume,
+                "Statistics": lambda req: {"used_size": 0},
+                "GetMasterConfiguration": lambda req: {
+                    "volume_size_limit_m_b":
+                        self.topo.volume_size_limit // (1024 * 1024),
+                    "leader": self.grpc_address},
+                "LeaseAdminToken": self._lease_admin_token,
+                "ReleaseAdminToken": self._release_admin_token,
+                "VolumeList": lambda req: {"topology": self.topo.to_dict()},
+            },
+            stream={
+                "SendHeartbeat": self._handle_heartbeat_stream,
+                "KeepConnected": self._handle_keep_connected,
+            })
+
+    def _rpc_lookup_volume(self, req: dict) -> dict:
+        out = {}
+        for vid_s in req.get("volume_or_file_ids", []):
+            vid = int(str(vid_s).split(",")[0])
+            out[str(vid_s)] = {
+                "locations": self.lookup(vid, req.get("collection", ""))}
+        return {"volume_id_locations": out}
+
+    def _rpc_lookup_ec_volume(self, req: dict) -> dict:
+        vid = int(req["volume_id"])
+        by_shard = self.topo.lookup_ec_shards(vid)
+        if not by_shard:
+            raise RpcError(f"ec volume {vid} not found")
+        return {"volume_id": vid, "shard_id_locations": [
+            {"shard_id": sid,
+             "locations": [{"url": dn.url, "public_url": dn.public_url,
+                            "grpc_port": dn.grpc_port} for dn in nodes]}
+            for sid, nodes in sorted(by_shard.items())]}
+
+    # -- HTTP (master_server_handlers.go:34-146) -----------------------------
+    def _register_http(self) -> None:
+        self.http.route("*", "/dir/assign", self._http_assign)
+        self.http.route("*", "/dir/lookup", self._http_lookup)
+        self.http.route("GET", "/cluster/status", self._http_cluster_status)
+        self.http.route("GET", "/vol/status", self._http_vol_status)
+
+    def _http_assign(self, req: Request) -> Response:
+        try:
+            out = self.assign({
+                "count": req.qs("count", "1"),
+                "replication": req.qs("replication"),
+                "collection": req.qs("collection"),
+                "ttl": req.qs("ttl"),
+                "data_center": req.qs("dataCenter"),
+                "rack": req.qs("rack")})
+            return Response.json(out)
+        except RpcError as e:
+            return Response.json({"error": str(e)}, status=406)
+
+    def _http_lookup(self, req: Request) -> Response:
+        vid_s = req.qs("volumeId")
+        if not vid_s:
+            return Response.error("missing volumeId", 400)
+        vid = int(vid_s.split(",")[0])
+        locs = self.lookup(vid, req.qs("collection"))
+        if not locs:
+            return Response.json(
+                {"volumeId": vid_s, "error": "volume id not found"},
+                status=404)
+        return Response.json({"volumeId": vid_s, "locations": locs})
+
+    def _http_cluster_status(self, req: Request) -> Response:
+        return Response.json({
+            "IsLeader": self.is_leader,
+            "Leader": self.address,
+            "MaxVolumeId": self.topo.max_volume_id,
+            "Topology": self.topo.to_dict()})
+
+    def _http_vol_status(self, req: Request) -> Response:
+        return Response.json({"Topology": self.topo.to_dict()})

@@ -1,0 +1,91 @@
+"""Client-side operations against master + volume servers.
+
+Capability-equivalent to weed/operation/: Assign (assign_file_id.go:37),
+upload (upload_content.go:81), lookup with vid cache (lookup.go),
+batch delete (delete_content.go), and the one-call convenience
+assign_and_upload (the `weed upload` flow).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..pb.rpc import POOL, RpcError
+from ..util.http import http_request
+
+
+@dataclass
+class AssignResult:
+    fid: str
+    url: str
+    public_url: str
+    count: int
+    replicas: list[dict] = field(default_factory=list)
+
+
+def assign(master_grpc: str, count: int = 1, replication: str = "",
+           collection: str = "", ttl: str = "",
+           data_center: str = "") -> AssignResult:
+    client = POOL.client(master_grpc, "Seaweed")
+    out = client.call("Assign", {
+        "count": count, "replication": replication,
+        "collection": collection, "ttl": ttl, "data_center": data_center})
+    return AssignResult(fid=out["fid"], url=out["url"],
+                        public_url=out["public_url"], count=out["count"],
+                        replicas=out.get("replicas", []))
+
+
+def upload_data(url_or_server: str, fid: str, data: bytes,
+                name: str = "", mime: str = "", ttl: str = "") -> dict:
+    qs = "&".join(f"{k}={v}" for k, v in
+                  (("name", name), ("mime", mime), ("ttl", ttl)) if v)
+    target = f"http://{url_or_server}/{fid}" + (f"?{qs}" if qs else "")
+    status, body, _ = http_request(target, method="POST", body=data)
+    if status >= 300:
+        raise RuntimeError(f"upload {fid} to {url_or_server}: HTTP {status} "
+                           f"{body[:200]!r}")
+    import json
+    return json.loads(body) if body else {}
+
+
+def assign_and_upload(master_grpc: str, data: bytes, **kw) -> str:
+    """-> fid (the one-call `weed upload` path)."""
+    r = assign(master_grpc, **kw)
+    upload_data(r.url, r.fid, data)
+    return r.fid
+
+
+def lookup_volume(master_grpc: str, vid: int,
+                  collection: str = "") -> list[dict]:
+    client = POOL.client(master_grpc, "Seaweed")
+    out = client.call("LookupVolume", {
+        "volume_or_file_ids": [str(vid)], "collection": collection})
+    return out["volume_id_locations"][str(vid)]["locations"]
+
+
+def read_file(master_grpc: str, fid: str) -> bytes:
+    vid = int(fid.split(",")[0])
+    locs = lookup_volume(master_grpc, vid)
+    if not locs:
+        raise RuntimeError(f"volume {vid} has no locations")
+    last_err = ""
+    for loc in locs:
+        status, body, _ = http_request(f"http://{loc['url']}/{fid}")
+        if status == 200:
+            return body
+        last_err = f"{loc['url']}: HTTP {status}"
+    raise RuntimeError(f"read {fid} failed: {last_err}")
+
+
+def delete_file(master_grpc: str, fid: str) -> None:
+    vid = int(fid.split(",")[0])
+    for loc in lookup_volume(master_grpc, vid):
+        http_request(f"http://{loc['url']}/{fid}", method="DELETE")
+        return
+
+
+def delete_files(volume_server_grpc: str, fids: list[str]) -> list[dict]:
+    """BatchDelete on one volume server (delete_content.go)."""
+    client = POOL.client(volume_server_grpc, "VolumeServer")
+    return client.call("BatchDelete", {"file_ids": fids})["results"]

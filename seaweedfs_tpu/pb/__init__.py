@@ -1,0 +1,11 @@
+"""Wire layer: gRPC control mesh + server address conventions.
+
+The reference generates Go stubs from 6 .proto files (weed/pb/*.proto) and
+keeps a global connection cache (pb/grpc_client_server.go).  Here the same
+service/method shapes run over grpc generic handlers with JSON bodies
+(bytes fields base64) — no codegen step, same RPC surface.
+"""
+
+from .rpc import (GrpcConnectionPool, RpcClient, RpcError, RpcServer,
+                  from_b64, to_b64)
+from .server_address import ServerAddress

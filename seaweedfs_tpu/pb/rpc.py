@@ -1,0 +1,176 @@
+"""JSON-over-gRPC: the control-plane mesh without protoc codegen.
+
+Capability-equivalent to the reference's generated stubs + connection cache
+(weed/pb/grpc_client_server.go): every service is a name -> handler map
+registered through grpc generic handlers; payloads are JSON dicts (bytes
+fields travel base64 via to_b64/from_b64).  Unary and bidi-streaming methods
+cover everything the reference's 6 protos use (heartbeat streams, shard
+copy streams, metadata subscribe streams).
+
+Error convention: a handler raising RpcError(msg) (or any Exception) aborts
+the call with the message in the gRPC status details; clients re-raise it
+as RpcError.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from concurrent import futures
+from typing import Callable, Iterator
+
+import grpc
+
+
+class RpcError(Exception):
+    pass
+
+
+def to_b64(raw: bytes) -> str:
+    return base64.b64encode(raw).decode("ascii")
+
+
+def from_b64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+def _ser(d: dict) -> bytes:
+    return json.dumps(d, separators=(",", ":")).encode()
+
+
+def _de(b: bytes) -> dict:
+    return json.loads(b) if b else {}
+
+
+class RpcServer:
+    """One grpc.Server hosting one or more named services."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_workers: int = 16):
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[("grpc.max_receive_message_length", 256 << 20),
+                     ("grpc.max_send_message_length", 256 << 20)])
+        self.host = host
+        self._requested_port = port
+        self.port = 0
+
+    def add_service(self, service: str,
+                    unary: dict[str, Callable[[dict], dict]] | None = None,
+                    stream: dict[str, Callable[[Iterator[dict]],
+                                               Iterator[dict]]] | None = None
+                    ) -> None:
+        handlers = {}
+        for name, fn in (unary or {}).items():
+            handlers[name] = grpc.unary_unary_rpc_method_handler(
+                self._wrap_unary(fn),
+                request_deserializer=_de, response_serializer=_ser)
+        for name, fn in (stream or {}).items():
+            handlers[name] = grpc.stream_stream_rpc_method_handler(
+                self._wrap_stream(fn),
+                request_deserializer=_de, response_serializer=_ser)
+        self._server.add_generic_rpc_handlers(
+            [grpc.method_handlers_generic_handler(service, handlers)])
+
+    @staticmethod
+    def _wrap_unary(fn):
+        def h(request: dict, context) -> dict:
+            try:
+                return fn(request) or {}
+            except RpcError as e:
+                context.abort(grpc.StatusCode.UNKNOWN, str(e))
+            except Exception as e:  # surface the message to the caller
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(e).__name__}: {e}")
+        return h
+
+    @staticmethod
+    def _wrap_stream(fn):
+        def h(request_iterator, context):
+            try:
+                yield from fn(request_iterator)
+            except RpcError as e:
+                context.abort(grpc.StatusCode.UNKNOWN, str(e))
+            except Exception as e:
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(e).__name__}: {e}")
+        return h
+
+    def start(self) -> int:
+        self.port = self._server.add_insecure_port(
+            f"{self.host}:{self._requested_port}")
+        self._server.start()
+        return self.port
+
+    def stop(self, grace: float = 0.2) -> None:
+        self._server.stop(grace)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+class RpcClient:
+    """Per-(address, service) client over a shared channel."""
+
+    def __init__(self, address: str, service: str,
+                 channel: grpc.Channel | None = None):
+        self.address = address
+        self.service = service
+        self._channel = channel or grpc.insecure_channel(
+            address,
+            options=[("grpc.max_receive_message_length", 256 << 20),
+                     ("grpc.max_send_message_length", 256 << 20)])
+
+    def call(self, method: str, payload: dict | None = None,
+             timeout: float = 30.0) -> dict:
+        fn = self._channel.unary_unary(
+            f"/{self.service}/{method}",
+            request_serializer=_ser, response_deserializer=_de)
+        try:
+            return fn(payload or {}, timeout=timeout)
+        except grpc.RpcError as e:
+            raise RpcError(e.details() or str(e.code())) from None
+
+    def stream(self, method: str, requests: Iterator[dict],
+               timeout: float | None = None) -> Iterator[dict]:
+        fn = self._channel.stream_stream(
+            f"/{self.service}/{method}",
+            request_serializer=_ser, response_deserializer=_de)
+        try:
+            yield from fn(requests, timeout=timeout)
+        except grpc.RpcError as e:
+            raise RpcError(e.details() or str(e.code())) from None
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class GrpcConnectionPool:
+    """Global channel cache, one per target address
+    (pb/grpc_client_server.go connection cache)."""
+
+    def __init__(self):
+        self._channels: dict[str, grpc.Channel] = {}
+        self._lock = threading.Lock()
+
+    def client(self, address: str, service: str) -> RpcClient:
+        with self._lock:
+            ch = self._channels.get(address)
+            if ch is None:
+                ch = grpc.insecure_channel(
+                    address,
+                    options=[("grpc.max_receive_message_length", 256 << 20),
+                             ("grpc.max_send_message_length", 256 << 20)])
+                self._channels[address] = ch
+        return RpcClient(address, service, ch)
+
+    def close(self) -> None:
+        with self._lock:
+            for ch in self._channels.values():
+                ch.close()
+            self._channels.clear()
+
+
+POOL = GrpcConnectionPool()

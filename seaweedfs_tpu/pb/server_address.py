@@ -1,0 +1,43 @@
+"""ServerAddress — one string, two ports.
+
+The reference's convention (weed/pb/server_address.go): a server is
+addressed as "host:port[.grpcPort]"; when the gRPC port is not explicit it
+is httpPort + 10000.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+GRPC_PORT_DELTA = 10000
+
+
+@dataclass(frozen=True)
+class ServerAddress:
+    host: str
+    port: int
+    grpc_port: int = 0
+
+    @classmethod
+    def parse(cls, s: str) -> "ServerAddress":
+        grpc_port = 0
+        if "." in s.rsplit(":", 1)[-1]:
+            hostport, g = s.rsplit(".", 1)
+            grpc_port = int(g)
+        else:
+            hostport = s
+        host, port = hostport.rsplit(":", 1)
+        return cls(host, int(port), grpc_port)
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @property
+    def grpc(self) -> str:
+        return f"{self.host}:{self.grpc_port or self.port + GRPC_PORT_DELTA}"
+
+    def __str__(self) -> str:
+        if self.grpc_port and self.grpc_port != self.port + GRPC_PORT_DELTA:
+            return f"{self.host}:{self.port}.{self.grpc_port}"
+        return self.url

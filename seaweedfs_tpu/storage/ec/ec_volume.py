@@ -126,6 +126,10 @@ class EcVolume:
                     self.directory, self.collection, self.volume_id, shard_id)
             return self.shards[shard_id]
 
+    # disk_location_ec.go loads shards via this name
+    def load_shard(self, shard_id: int) -> EcVolumeShard:
+        return self.add_shard(shard_id)
+
     def delete_shard(self, shard_id: int) -> None:
         with self._lock:
             s = self.shards.pop(shard_id, None)
@@ -270,16 +274,20 @@ class EcVolume:
             self.shards.clear()
 
     def destroy(self) -> None:
-        """Remove every local file of this EC volume (ec_volume.go Destroy)."""
+        """Remove every local file of this EC volume — including shard files
+        never loaded into this process (ec_volume.go Destroy removes the
+        whole file family)."""
         with self._lock:
             self._ecx_rw.close()
             for s in list(self.shards.values()):
-                s.destroy()
+                s.close()
             self.shards.clear()
-            for ext in (".ecx", ".ecj", ".vif"):
-                p = self._base() + ext
-                if os.path.exists(p):
-                    os.remove(p)
+            base = self._base()
+            exts = [".ecx", ".ecj", ".vif"] + [
+                to_ext(s) for s in range(self.geo.total_shards)]
+            for ext in exts:
+                if os.path.exists(base + ext):
+                    os.remove(base + ext)
 
 
 def rebuild_ecx_file(base_path: str) -> None:

@@ -206,6 +206,69 @@ class Store:
             return 0
         return v.delete_needle(n_id, cookie)
 
+    # -- EC ops (store_ec.go) ---------------------------------------------
+    def mount_ec_shards(self, vid: int, collection: str,
+                        shard_ids: list[int]):
+        """Open local .ecNN files and serve them (MountEcShards)."""
+        from .ec import ec_volume as ecv
+        from .ec.layout import to_ext
+        loc = None
+        for l in self.locations:
+            if vid in l.ec_volumes:
+                loc = l
+                break
+            base = volume_file_name(l.directory, collection, vid)
+            if any(os.path.exists(base + to_ext(s)) for s in shard_ids):
+                loc = l
+                break
+        if loc is None:
+            raise NotFoundError(f"no local shard files for ec volume {vid}")
+        base = volume_file_name(loc.directory, collection, vid)
+        missing = [s for s in shard_ids
+                   if not os.path.exists(base + to_ext(s))]
+        if missing or not os.path.exists(base + ".ecx"):
+            raise NotFoundError(
+                f"ec volume {vid}: missing "
+                f"{'.ecx' if not missing else [to_ext(s) for s in missing]}")
+        vol = loc.ec_volumes.get(vid)
+        created = vol is None
+        if created:
+            vol = ecv.EcVolume(loc.directory, collection, vid)
+        try:
+            for s in shard_ids:
+                vol.load_shard(s)
+        except Exception:
+            if created:
+                vol.close()
+            raise
+        if created:
+            loc.ec_volumes[vid] = vol
+        return vol
+
+    def unmount_ec_shards(self, vid: int, shard_ids: list[int]) -> None:
+        for loc in self.locations:
+            vol = loc.ec_volumes.get(vid)
+            if vol is None:
+                continue
+            for s in shard_ids:
+                vol.delete_shard(s)
+            if not vol.shards:
+                vol.close()
+                del loc.ec_volumes[vid]
+
+    def read_ec_needle(self, vid: int, n_id: int,
+                       cookie: int | None = None) -> Needle:
+        vol = self.find_ec_volume(vid)
+        if vol is None:
+            raise NotFoundError(f"ec volume {vid} not found")
+        return vol.read_needle(n_id, cookie)
+
+    def destroy_ec_volume(self, vid: int) -> None:
+        for loc in self.locations:
+            vol = loc.ec_volumes.pop(vid, None)
+            if vol is not None:
+                vol.destroy()
+
     # -- heartbeat --------------------------------------------------------
     def collect_heartbeat(self) -> HeartbeatSnapshot:
         hb = HeartbeatSnapshot()

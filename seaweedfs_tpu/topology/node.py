@@ -137,8 +137,9 @@ class DataNode(Node):
             deleted: dict[int, ShardBits] = {}
             for vid, bits in shards.items():
                 old = self.ec_shards.get(vid, ShardBits(0))
-                if bits.minus(old):
-                    new[vid] = bits.minus(old)
+                delta = bits.minus(old)
+                if delta:
+                    new[vid] = delta
             for vid, old in self.ec_shards.items():
                 gone = old.minus(shards.get(vid, ShardBits(0)))
                 if gone:

@@ -1,0 +1,152 @@
+"""Minimal HTTP plumbing for the public data path.
+
+The reference serves its data plane over net/http muxes
+(weed/server/*_handlers*.go).  Here: a ThreadingHTTPServer with a prefix
+router (handlers get a Request and return Response) plus tiny urllib client
+helpers — no external web framework.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+
+@dataclass
+class Request:
+    method: str
+    path: str            # path without query string
+    query: dict[str, list[str]]
+    headers: dict[str, str]
+    body: bytes
+
+    def qs(self, key: str, default: str = "") -> str:
+        vals = self.query.get(key)
+        return vals[0] if vals else default
+
+
+@dataclass
+class Response:
+    status: int = 200
+    body: bytes = b""
+    content_type: str = "application/octet-stream"
+    headers: dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def json(cls, obj, status: int = 200) -> "Response":
+        return cls(status=status, body=json.dumps(obj).encode(),
+                   content_type="application/json")
+
+    @classmethod
+    def error(cls, msg: str, status: int = 500) -> "Response":
+        return cls.json({"error": msg}, status=status)
+
+
+Handler = Callable[[Request], Response]
+
+
+class HttpServer:
+    """Routes are (method, path_prefix) -> handler; longest prefix wins.
+    A fallback handler (prefix "") catches file-id style paths."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.routes: list[tuple[str, str, Handler]] = []
+        outer = self
+
+        class _H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _dispatch(self):
+                parsed = urllib.parse.urlparse(self.path)
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                req = Request(
+                    method=self.command, path=parsed.path,
+                    query=urllib.parse.parse_qs(parsed.query),
+                    headers={k: v for k, v in self.headers.items()},
+                    body=body)
+                handler = outer._match(self.command, parsed.path)
+                if handler is None:
+                    resp = Response.error("not found", 404)
+                else:
+                    try:
+                        resp = handler(req)
+                    except Exception as e:
+                        resp = Response.error(f"{type(e).__name__}: {e}")
+                try:
+                    self.send_response(resp.status)
+                    self.send_header("Content-Type", resp.content_type)
+                    self.send_header("Content-Length", str(len(resp.body)))
+                    for k, v in resp.headers.items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    if self.command != "HEAD":
+                        self.wfile.write(resp.body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _dispatch
+
+        self._httpd = ThreadingHTTPServer((host, port), _H)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def route(self, method: str, prefix: str, handler: Handler) -> None:
+        self.routes.append((method, prefix, handler))
+        self.routes.sort(key=lambda r: len(r[1]), reverse=True)
+
+    def _match(self, method: str, path: str) -> Optional[Handler]:
+        for m, prefix, h in self.routes:
+            if m in (method, "*") and path.startswith(prefix):
+                return h
+        return None
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+# -- client helpers ---------------------------------------------------------
+
+def http_request(url: str, method: str = "GET", body: bytes | None = None,
+                 headers: dict | None = None, timeout: float = 30.0
+                 ) -> tuple[int, bytes, dict]:
+    """-> (status, body, headers); non-2xx does NOT raise."""
+    if not url.startswith("http"):
+        url = "http://" + url
+    req = urllib.request.Request(url, data=body, method=method,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def http_get_json(url: str, timeout: float = 30.0) -> dict:
+    status, body, _ = http_request(url, timeout=timeout)
+    out = json.loads(body) if body else {}
+    if status >= 400:
+        raise RuntimeError(out.get("error", f"HTTP {status}"))
+    return out

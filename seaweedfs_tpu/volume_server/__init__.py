@@ -1,0 +1,3 @@
+"""Volume server: blob data plane (reference weed/server/volume_*)."""
+
+from .server import VolumeServer

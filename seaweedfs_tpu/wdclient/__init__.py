@@ -1,0 +1,85 @@
+"""MasterClient — long-lived client with an in-process vid->locations cache
+fed by the master's KeepConnected stream.
+
+Capability-equivalent to weed/wdclient/masterclient.go:84-182 + vid_map.go:
+a background thread holds the stream open, applies location deltas to the
+cache, and reconnects on error; lookups hit the cache first and fall back
+to a LookupVolume RPC.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..pb.rpc import POOL, RpcError
+
+
+class MasterClient:
+    def __init__(self, master_grpc: str, client_name: str = "client"):
+        self.master_grpc = master_grpc
+        self.client_name = client_name
+        self._vid_map: dict[int, list[dict]] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._keep_connected_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- vid cache (wdclient/vid_map.go:37-131) ---------------------------
+    def _apply(self, msg: dict) -> None:
+        loc = msg.get("volume_location")
+        if not loc:
+            return
+        entry = {"url": loc["url"], "public_url": loc.get("public_url", ""),
+                 "grpc_port": loc.get("grpc_port", 0)}
+        with self._lock:
+            for vid in loc.get("new_vids", []):
+                lst = self._vid_map.setdefault(int(vid), [])
+                if entry not in lst:
+                    lst.append(entry)
+            for vid in loc.get("deleted_vids", []):
+                lst = self._vid_map.get(int(vid), [])
+                self._vid_map[int(vid)] = [e for e in lst
+                                           if e["url"] != loc["url"]]
+
+    def _keep_connected_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client = POOL.client(self.master_grpc, "Seaweed")
+                for msg in client.stream(
+                        "KeepConnected",
+                        iter([{"client_type": "client",
+                               "client_name": self.client_name}])):
+                    self._apply(msg)
+                    if self._stop.is_set():
+                        break
+            except RpcError:
+                pass
+            self._stop.wait(1.0)
+
+    def lookup(self, vid: int) -> list[dict]:
+        with self._lock:
+            cached = self._vid_map.get(vid)
+        if cached:
+            return list(cached)
+        try:
+            client = POOL.client(self.master_grpc, "Seaweed")
+            out = client.call("LookupVolume",
+                              {"volume_or_file_ids": [str(vid)]})
+            locs = out["volume_id_locations"][str(vid)]["locations"]
+        except (RpcError, KeyError):
+            return []
+        with self._lock:
+            if locs:
+                self._vid_map[vid] = locs
+        return locs
+
+    def lookup_file_id(self, fid: str) -> list[str]:
+        vid = int(fid.split(",")[0])
+        return [f"http://{l['url']}/{fid}" for l in self.lookup(vid)]
