@@ -258,10 +258,11 @@ class MasterServer:
         with self._admin_lock:
             prev = int(req.get("previous_token") or 0)
             client = req.get("client_name", "")
+            # grant only on token match or expiry — matching client_name is
+            # NOT sufficient (two operators may both run a default "shell")
             expired = now - self._admin_ts > 10.0
             if (self._admin_token == 0 or expired
-                    or prev == self._admin_token
-                    or client == self._admin_client):
+                    or prev == self._admin_token):
                 self._admin_token = self._rng.getrandbits(63) or 1
                 self._admin_client = client
                 self._admin_ts = now
@@ -293,11 +294,18 @@ class MasterServer:
                 "LeaseAdminToken": self._lease_admin_token,
                 "ReleaseAdminToken": self._release_admin_token,
                 "VolumeList": lambda req: {"topology": self.topo.to_dict()},
+                "Vacuum": self._rpc_vacuum,
             },
             stream={
                 "SendHeartbeat": self._handle_heartbeat_stream,
                 "KeepConnected": self._handle_keep_connected,
             })
+
+    def _rpc_vacuum(self, req: dict) -> dict:
+        from . import vacuum as vacuum_mod
+        threshold = float(req.get("garbage_threshold")
+                          or self.garbage_threshold)
+        return {"vacuumed": vacuum_mod.vacuum(self.topo, threshold)}
 
     def _rpc_lookup_volume(self, req: dict) -> dict:
         out = {}
@@ -324,6 +332,7 @@ class MasterServer:
         self.http.route("*", "/dir/lookup", self._http_lookup)
         self.http.route("GET", "/cluster/status", self._http_cluster_status)
         self.http.route("GET", "/vol/status", self._http_vol_status)
+        self.http.route("*", "/vol/vacuum", self._http_vol_vacuum)
 
     def _http_assign(self, req: Request) -> Response:
         try:
@@ -359,3 +368,12 @@ class MasterServer:
 
     def _http_vol_status(self, req: Request) -> Response:
         return Response.json({"Topology": self.topo.to_dict()})
+
+    def _http_vol_vacuum(self, req: Request) -> Response:
+        """Trigger a cluster vacuum sweep (master_server_handlers_admin.go
+        /vol/vacuum)."""
+        from . import vacuum as vacuum_mod
+        threshold = float(req.qs("garbageThreshold")
+                          or self.garbage_threshold)
+        vids = vacuum_mod.vacuum(self.topo, threshold)
+        return Response.json({"vacuumed": vids})

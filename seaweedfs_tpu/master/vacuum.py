@@ -1,0 +1,56 @@
+"""Master-driven vacuum orchestration (weed/topology/topology_vacuum.go:19-187):
+scan every layout's volumes; for each volume whose replicas all report a
+garbage ratio over the threshold, run compact on every replica, then verify
+and reinstate it as writable.
+"""
+
+from __future__ import annotations
+
+from ..pb.rpc import POOL, RpcError
+from ..topology import Topology
+
+
+def _vs_client(dn):
+    return POOL.client(f"{dn.ip}:{dn.grpc_port}", "VolumeServer")
+
+
+def vacuum_one_volume(topo: Topology, vid: int, locations,
+                      garbage_threshold: float) -> bool:
+    """Check → compact → commit across all replicas
+    (batchVacuumVolumeCheck/Compact/Commit)."""
+    # phase 1: all replicas must agree the volume is dirty enough
+    for dn in locations:
+        try:
+            out = _vs_client(dn).call("VacuumVolumeCheck",
+                                      {"volume_id": vid})
+        except RpcError:
+            return False
+        if out.get("garbage_ratio", 0) < garbage_threshold:
+            return False
+    # phase 2: freeze writes by marking unwritable in every layout
+    for layout in topo.layouts.values():
+        layout.freeze_writable(vid)
+    # phase 3: compact each replica; on any failure leave readonly=safe
+    compacted = True
+    for dn in locations:
+        try:
+            _vs_client(dn).call("VacuumVolumeCompact", {"volume_id": vid},
+                                timeout=600)
+        except RpcError:
+            compacted = False
+    # phase 4: commit/reinstate
+    for layout in topo.layouts.values():
+        layout.refresh_writable(vid)
+    return compacted
+
+
+def vacuum(topo: Topology, garbage_threshold: float = 0.3) -> list[int]:
+    """Returns the vids vacuumed."""
+    done = []
+    for layout in list(topo.layouts.values()):
+        for vid, locations in list(layout.vid_to_locations.items()):
+            if not locations:
+                continue
+            if vacuum_one_volume(topo, vid, locations, garbage_threshold):
+                done.append(vid)
+    return done

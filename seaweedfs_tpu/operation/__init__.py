@@ -38,8 +38,10 @@ def assign(master_grpc: str, count: int = 1, replication: str = "",
 
 def upload_data(url_or_server: str, fid: str, data: bytes,
                 name: str = "", mime: str = "", ttl: str = "") -> dict:
-    qs = "&".join(f"{k}={v}" for k, v in
-                  (("name", name), ("mime", mime), ("ttl", ttl)) if v)
+    import urllib.parse
+    qs = urllib.parse.urlencode(
+        [(k, v) for k, v in (("name", name), ("mime", mime), ("ttl", ttl))
+         if v])
     target = f"http://{url_or_server}/{fid}" + (f"?{qs}" if qs else "")
     status, body, _ = http_request(target, method="POST", body=data)
     if status >= 300:

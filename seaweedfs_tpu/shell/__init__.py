@@ -1,0 +1,5 @@
+"""Interactive maintenance shell (reference weed/shell): commands register
+into the COMMANDS map; CommandEnv holds the master connection + admin lock."""
+
+from . import command_ec, command_volume  # noqa: F401  (register commands)
+from .commands import COMMANDS, CommandEnv, ShellError, run_command

@@ -1,0 +1,309 @@
+"""EC maintenance commands — capability-equivalent to
+weed/shell/command_ec_encode.go / _rebuild.go / _balance.go / _decode.go.
+
+ec.encode is the SURVEY §3.5 north-star flow: freeze -> TPU-encode ->
+spread shards -> drop source replicas.  Planning (which volumes, which
+servers get which shards) is pure over the topology dump for unit testing;
+execution drives the VolumeServer EC RPCs.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ..pb.rpc import RpcError
+from ..storage.ec.layout import TOTAL_SHARDS_COUNT
+from ..storage.ec.shard_bits import ShardBits
+from .commands import (CommandEnv, ShellError, command, iter_data_nodes,
+                       node_grpc, parse_flags)
+
+
+# -- planning (pure) -------------------------------------------------------
+
+def collect_volume_ids_for_ec_encode(topo: dict, volume_size_limit: int,
+                                     full_percent: float = 95.0,
+                                     quiet_seconds: float = 3600.0,
+                                     now: float | None = None,
+                                     collection: str = "") -> list[int]:
+    """Full + quiet volumes (collectVolumeIdsForEcEncode
+    command_ec_encode.go:267)."""
+    now = time.time() if now is None else now
+    vids = set()
+    for _, _, dn in iter_data_nodes(topo):
+        for v in dn["volumes"]:
+            if collection and v.get("collection", "") != collection:
+                continue
+            if v.get("size", 0) < volume_size_limit * full_percent / 100.0:
+                continue
+            if now - v.get("modified_at_second", 0) < quiet_seconds:
+                continue
+            vids.add(v["id"])
+    return sorted(vids)
+
+
+def plan_shard_distribution(topo: dict, vid: int,
+                            source_id: str) -> dict[str, list[int]]:
+    """node_id -> shard ids, most-free-slots first, round-robin
+    (balancedEcDistribution command_ec_encode.go:249)."""
+    nodes = []
+    for _, _, dn in iter_data_nodes(topo):
+        free = (dn.get("max_volumes", 7) - len(dn["volumes"])
+                - sum(ShardBits(int(b)).shard_id_count()
+                      for b in dn.get("ec_shards", {}).values())
+                / TOTAL_SHARDS_COUNT)
+        nodes.append((free, dn["id"]))
+    if not nodes:
+        raise ShellError("no data nodes")
+    nodes.sort(reverse=True)
+    out: dict[str, list[int]] = {nid: [] for _, nid in nodes}
+    order = [nid for _, nid in nodes]
+    for shard in range(TOTAL_SHARDS_COUNT):
+        out[order[shard % len(order)]].append(shard)
+    return {nid: shards for nid, shards in out.items() if shards}
+
+
+def collect_ec_shard_map(topo: dict) -> dict[int, dict[str, list[int]]]:
+    """vid -> node_id -> shard ids present."""
+    out: dict[int, dict[str, list[int]]] = {}
+    for _, _, dn in iter_data_nodes(topo):
+        for vid_s, bits in dn.get("ec_shards", {}).items():
+            vid = int(vid_s)
+            ids = ShardBits(int(bits)).shard_ids()
+            if ids:
+                out.setdefault(vid, {})[dn["id"]] = ids
+    return out
+
+
+def plan_ec_balance(topo: dict) -> list[dict]:
+    """Move shards from over-loaded holders to nodes with none of that
+    volume's shards, evening the per-node count (command_ec_balance.go)."""
+    all_nodes = [dn["id"] for _, _, dn in iter_data_nodes(topo)]
+    grpc = {dn["id"]: node_grpc(dn) for _, _, dn in iter_data_nodes(topo)}
+    moves = []
+    for vid, holders in sorted(collect_ec_shard_map(topo).items()):
+        counts = {nid: len(holders.get(nid, [])) for nid in all_nodes}
+        target = -(-TOTAL_SHARDS_COUNT // max(len(all_nodes), 1))  # ceil
+        for _ in range(TOTAL_SHARDS_COUNT):
+            src = max(counts, key=counts.get)
+            dst = min(counts, key=counts.get)
+            if counts[src] <= target or counts[src] - counts[dst] <= 1:
+                break
+            shard = sorted(holders[src])[-1]
+            moves.append({"volume_id": vid, "shard_id": shard,
+                          "from": src, "from_grpc": grpc[src],
+                          "to": dst, "to_grpc": grpc[dst]})
+            holders[src].remove(shard)
+            holders.setdefault(dst, []).append(shard)
+            counts[src] -= 1
+            counts[dst] += 1
+    return moves
+
+
+# -- execution helpers -----------------------------------------------------
+
+def _volume_locations(env: CommandEnv, vid: int) -> list[dict]:
+    out = env.master().call("LookupVolume",
+                            {"volume_or_file_ids": [str(vid)]})
+    return out["volume_id_locations"][str(vid)]["locations"]
+
+
+def _grpc_of_location(topo: dict, url: str) -> str:
+    for _, _, dn in iter_data_nodes(topo):
+        if dn["id"] == url or f"{dn['ip']}:{dn['port']}" == url:
+            return node_grpc(dn)
+    raise ShellError(f"no grpc address for {url}")
+
+
+def do_ec_encode(env: CommandEnv, vid: int, collection: str = "") -> dict:
+    """Full doEcEncode flow (command_ec_encode.go:95-188)."""
+    topo = env.topology()
+    locations = _volume_locations(env, vid)
+    if not locations:
+        raise ShellError(f"volume {vid} not found")
+    src_grpc = _grpc_of_location(topo, locations[0]["url"])
+    # freeze every replica
+    for loc in locations:
+        env.volume_server(_grpc_of_location(topo, loc["url"])).call(
+            "VolumeMarkReadonly", {"volume_id": vid})
+    # generate shards on one replica (the TPU hot loop)
+    env.volume_server(src_grpc).call(
+        "VolumeEcShardsGenerate",
+        {"volume_id": vid, "collection": collection}, timeout=3600)
+    # spread + mount
+    plan = plan_shard_distribution(topo, vid, locations[0]["url"])
+    grpc_by_id = {dn["id"]: node_grpc(dn)
+                  for _, _, dn in iter_data_nodes(topo)}
+    src_id = None
+    for _, _, dn in iter_data_nodes(topo):
+        if f"{dn['ip']}:{dn['port']}" == locations[0]["url"] \
+                or dn["id"] == locations[0]["url"]:
+            src_id = dn["id"]
+    for node_id, shard_ids in plan.items():
+        target = env.volume_server(grpc_by_id[node_id])
+        if node_id != src_id:
+            target.call("VolumeEcShardsCopy", {
+                "volume_id": vid, "collection": collection,
+                "shard_ids": shard_ids, "copy_ecx_files": True,
+                "source_data_node": src_grpc}, timeout=3600)
+        target.call("VolumeEcShardsMount",
+                    {"volume_id": vid, "collection": collection,
+                     "shard_ids": shard_ids})
+    # drop non-local shard files from the source, delete original volume
+    src = env.volume_server(src_grpc)
+    keep = set(plan.get(src_id, []))
+    drop = [s for s in range(TOTAL_SHARDS_COUNT) if s not in keep]
+    if drop:
+        src.call("VolumeEcShardsUnmount", {"volume_id": vid,
+                                           "shard_ids": drop})
+        src.call("VolumeEcShardsDelete", {"volume_id": vid,
+                                          "collection": collection,
+                                          "shard_ids": drop})
+    for loc in locations:
+        env.volume_server(_grpc_of_location(topo, loc["url"])).call(
+            "VolumeDelete", {"volume_id": vid})
+    return {"volume_id": vid, "distribution": plan}
+
+
+def do_ec_rebuild(env: CommandEnv, vid: int, collection: str = "") -> dict:
+    """Pick a rebuilder, gather >=k shards on it, rebuild + mount the
+    missing ones (command_ec_rebuild.go:58-230)."""
+    topo = env.topology()
+    shard_map = collect_ec_shard_map(topo).get(vid, {})
+    present = {s for ids in shard_map.values() for s in ids}
+    missing = [s for s in range(TOTAL_SHARDS_COUNT) if s not in present]
+    if not missing:
+        return {"volume_id": vid, "rebuilt": []}
+    grpc_by_id = {dn["id"]: node_grpc(dn)
+                  for _, _, dn in iter_data_nodes(topo)}
+    # rebuilder: most local shards already
+    rebuilder_id = max(shard_map, key=lambda nid: len(shard_map[nid]))
+    rebuilder = env.volume_server(grpc_by_id[rebuilder_id])
+    local = set(shard_map[rebuilder_id])
+    copied = []
+    for node_id, ids in shard_map.items():
+        if node_id == rebuilder_id:
+            continue
+        need = [s for s in ids if s not in local]
+        if need:
+            rebuilder.call("VolumeEcShardsCopy", {
+                "volume_id": vid, "collection": collection,
+                "shard_ids": need, "copy_ecx_files": False,
+                "source_data_node": grpc_by_id[node_id]}, timeout=3600)
+            local |= set(need)
+            copied += need
+    out = rebuilder.call("VolumeEcShardsRebuild",
+                         {"volume_id": vid, "collection": collection},
+                         timeout=3600)
+    rebuilt = out.get("rebuilt_shard_ids", [])
+    rebuilder.call("VolumeEcShardsMount",
+                   {"volume_id": vid, "collection": collection,
+                    "shard_ids": rebuilt})
+    # drop the temp copies that still live elsewhere
+    stale = [s for s in copied if s not in rebuilt]
+    if stale:
+        rebuilder.call("VolumeEcShardsDelete",
+                       {"volume_id": vid, "collection": collection,
+                        "shard_ids": stale})
+    return {"volume_id": vid, "rebuilt": rebuilt,
+            "rebuilder": rebuilder_id}
+
+
+# -- commands --------------------------------------------------------------
+
+@command("ec.encode", "erasure-code volumes: -volumeId N | -collection c -fullPercent p -quietFor s")
+def cmd_ec_encode(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    env.confirm_is_locked()
+    if "volumeId" in flags:
+        vids = [int(flags["volumeId"])]
+    else:
+        cfg = env.master().call("GetMasterConfiguration")
+        limit = cfg.get("volume_size_limit_m_b", 30 * 1024) * 1024 * 1024
+        vids = collect_volume_ids_for_ec_encode(
+            env.topology(), limit,
+            full_percent=float(flags.get("fullPercent", 95)),
+            quiet_seconds=float(flags.get("quietFor", 3600)),
+            collection=flags.get("collection", ""))
+    results = [do_ec_encode(env, vid, flags.get("collection", ""))
+               for vid in vids]
+    return json.dumps({"encoded": results})
+
+
+@command("ec.rebuild", "rebuild missing ec shards (-volumeId N | all)")
+def cmd_ec_rebuild(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    env.confirm_is_locked()
+    if "volumeId" in flags:
+        vids = [int(flags["volumeId"])]
+    else:
+        vids = sorted(collect_ec_shard_map(env.topology()))
+    return json.dumps({"rebuilt": [
+        do_ec_rebuild(env, vid, flags.get("collection", ""))
+        for vid in vids]})
+
+
+@command("ec.balance", "even ec shards across servers (-force applies)")
+def cmd_ec_balance(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    moves = plan_ec_balance(env.topology())
+    if flags.get("force") != "true":
+        return json.dumps({"planned_moves": moves})
+    env.confirm_is_locked()
+    for mv in moves:
+        dst = env.volume_server(mv["to_grpc"])
+        dst.call("VolumeEcShardsCopy", {
+            "volume_id": mv["volume_id"], "shard_ids": [mv["shard_id"]],
+            "copy_ecx_files": True, "source_data_node": mv["from_grpc"]},
+            timeout=3600)
+        dst.call("VolumeEcShardsMount",
+                 {"volume_id": mv["volume_id"], "collection": "",
+                  "shard_ids": [mv["shard_id"]]})
+        src = env.volume_server(mv["from_grpc"])
+        src.call("VolumeEcShardsUnmount",
+                 {"volume_id": mv["volume_id"],
+                  "shard_ids": [mv["shard_id"]]})
+        src.call("VolumeEcShardsDelete",
+                 {"volume_id": mv["volume_id"], "collection": "",
+                  "shard_ids": [mv["shard_id"]]})
+    return json.dumps({"moved": len(moves)})
+
+
+@command("ec.decode", "decode an ec volume back to a normal volume: -volumeId N")
+def cmd_ec_decode(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    env.confirm_is_locked()
+    vid = int(flags["volumeId"])
+    collection = flags.get("collection", "")
+    topo = env.topology()
+    shard_map = collect_ec_shard_map(topo).get(vid, {})
+    if not shard_map:
+        raise ShellError(f"ec volume {vid} not found")
+    grpc_by_id = {dn["id"]: node_grpc(dn)
+                  for _, _, dn in iter_data_nodes(topo)}
+    # gather all shards onto the node with the most
+    target_id = max(shard_map, key=lambda nid: len(shard_map[nid]))
+    target = env.volume_server(grpc_by_id[target_id])
+    local = set(shard_map[target_id])
+    for node_id, ids in shard_map.items():
+        if node_id == target_id:
+            continue
+        need = [s for s in ids if s not in local]
+        if need:
+            target.call("VolumeEcShardsCopy", {
+                "volume_id": vid, "collection": collection,
+                "shard_ids": need, "copy_ecx_files": False,
+                "source_data_node": grpc_by_id[node_id]}, timeout=3600)
+            local |= set(need)
+    target.call("VolumeEcShardsToVolume",
+                {"volume_id": vid, "collection": collection}, timeout=3600)
+    # remove ec shards everywhere else
+    for node_id, ids in shard_map.items():
+        vs = env.volume_server(grpc_by_id[node_id])
+        if node_id != target_id:
+            vs.call("VolumeEcShardsUnmount",
+                    {"volume_id": vid, "shard_ids": ids})
+            vs.call("VolumeEcShardsDelete",
+                    {"volume_id": vid, "collection": collection,
+                     "shard_ids": ids})
+    return json.dumps({"volume_id": vid, "decoded_on": target_id})

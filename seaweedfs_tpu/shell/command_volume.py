@@ -1,0 +1,195 @@
+"""Volume maintenance commands: list, balance, fix.replication, vacuum,
+move/delete/mount — capability-equivalent to weed/shell/command_volume_*.go.
+
+Planning is separated from execution: plan_* functions are pure over the
+VolumeList topology dump (the reference unit-tests balancing on
+sample.topo.txt the same way, command_volume_balance_test.go)."""
+
+from __future__ import annotations
+
+import json
+
+from ..storage.super_block import ReplicaPlacement
+from .commands import (CommandEnv, command, iter_data_nodes, node_grpc,
+                       parse_flags)
+
+
+# -- planning (pure) -------------------------------------------------------
+
+def plan_volume_balance(topo: dict, collection: str | None = None
+                        ) -> list[dict]:
+    """Even out volume counts: repeatedly move a volume from the fullest
+    node to the emptiest that doesn't already hold a replica of it
+    (command_volume_balance.go balanceVolumeServers)."""
+    nodes = [(f"{dc}|{rack}", dn) for dc, rack, dn in iter_data_nodes(topo)]
+    counts = {dn["id"]: len(dn["volumes"]) for _, dn in nodes}
+    holdings = {dn["id"]: {v["id"] for v in dn["volumes"]} for _, dn in nodes}
+    by_id = {dn["id"]: dn for _, dn in nodes}
+    vol_meta = {}
+    for _, dn in nodes:
+        for v in dn["volumes"]:
+            vol_meta[v["id"]] = v
+    moves = []
+    for _ in range(1000):
+        src = max(counts, key=counts.get)
+        dst = min(counts, key=counts.get)
+        if counts[src] - counts[dst] <= 1:
+            break
+        movable = [vid for vid in holdings[src]
+                   if vid not in holdings[dst]
+                   and (collection is None
+                        or vol_meta[vid].get("collection", "") == collection)]
+        if not movable:
+            break
+        vid = sorted(movable)[0]
+        moves.append({"volume_id": vid,
+                      "collection": vol_meta[vid].get("collection", ""),
+                      "from": src, "from_grpc": node_grpc(by_id[src]),
+                      "to": dst, "to_grpc": node_grpc(by_id[dst])})
+        holdings[src].discard(vid)
+        holdings[dst].add(vid)
+        counts[src] -= 1
+        counts[dst] += 1
+    return moves
+
+
+def plan_fix_replication(topo: dict) -> list[dict]:
+    """Find under-replicated volumes and pick a target server per missing
+    replica (command_volume_fix_replication.go).  Targets prefer nodes in
+    other racks that don't hold the volume, emptiest first."""
+    nodes = [(dc, rack, dn) for dc, rack, dn in iter_data_nodes(topo)]
+    replicas: dict[int, list[tuple[str, str, dict]]] = {}
+    meta: dict[int, dict] = {}
+    for dc, rack, dn in nodes:
+        for v in dn["volumes"]:
+            replicas.setdefault(v["id"], []).append((dc, rack, dn))
+            meta[v["id"]] = v
+    fixes = []
+    for vid, holders in sorted(replicas.items()):
+        rp = ReplicaPlacement.from_byte(
+            meta[vid].get("replica_placement", 0))
+        missing = rp.copy_count() - len(holders)
+        if missing <= 0:
+            continue
+        holder_ids = {dn["id"] for _, _, dn in holders}
+        holder_racks = {(dc, rack) for dc, rack, _ in holders}
+        candidates = [(dc, rack, dn) for dc, rack, dn in nodes
+                      if dn["id"] not in holder_ids
+                      and len(dn["volumes"]) < dn.get("max_volumes", 7)]
+        # other-rack first, then emptiest
+        candidates.sort(key=lambda c: (
+            (c[0], c[1]) in holder_racks, len(c[2]["volumes"])))
+        for _ in range(missing):
+            if not candidates:
+                break
+            dc, rack, dn = candidates.pop(0)
+            src = holders[0][2]
+            fixes.append({"volume_id": vid,
+                          "collection": meta[vid].get("collection", ""),
+                          "from_grpc": node_grpc(src),
+                          "to": dn["id"], "to_grpc": node_grpc(dn)})
+    return fixes
+
+
+# -- commands --------------------------------------------------------------
+
+@command("volume.list", "list all volumes grouped by topology")
+def cmd_volume_list(env: CommandEnv, args: list[str]) -> str:
+    return json.dumps(env.topology(), indent=2, default=str)
+
+
+@command("volume.balance", "balance volume counts across servers (-force applies)")
+def cmd_volume_balance(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    topo = env.topology()
+    moves = plan_volume_balance(topo, flags.get("collection"))
+    if flags.get("force") != "true":
+        return json.dumps({"planned_moves": moves})
+    env.confirm_is_locked()
+    applied = []
+    for mv in moves:
+        _move_volume(env, mv)
+        applied.append(mv["volume_id"])
+    return json.dumps({"moved": applied})
+
+
+def _move_volume(env: CommandEnv, mv: dict) -> None:
+    """copy to target -> mount -> delete from source
+    (command_volume_move.go LiveMoveVolume)."""
+    dst = env.volume_server(mv["to_grpc"])
+    dst.call("VolumeCopy", {"volume_id": mv["volume_id"],
+                            "collection": mv.get("collection", ""),
+                            "source_data_node": mv["from_grpc"]},
+             timeout=600)
+    src = env.volume_server(mv["from_grpc"])
+    src.call("VolumeDelete", {"volume_id": mv["volume_id"]})
+
+
+@command("volume.fix.replication", "re-replicate under-replicated volumes (-force applies)")
+def cmd_fix_replication(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    fixes = plan_fix_replication(env.topology())
+    if flags.get("force") != "true":
+        return json.dumps({"planned_fixes": fixes})
+    env.confirm_is_locked()
+    applied = []
+    for fx in fixes:
+        dst = env.volume_server(fx["to_grpc"])
+        dst.call("VolumeCopy", {"volume_id": fx["volume_id"],
+                                "collection": fx.get("collection", ""),
+                                "source_data_node": fx["from_grpc"]},
+                 timeout=600)
+        applied.append(fx["volume_id"])
+    return json.dumps({"fixed": applied})
+
+
+@command("volume.vacuum", "compact volumes above the garbage threshold")
+def cmd_volume_vacuum(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    payload = {}
+    if "garbageThreshold" in flags:
+        payload["garbage_threshold"] = float(flags["garbageThreshold"])
+    # orchestrated by the master (topology_vacuum.go)
+    out = env.master().call("Vacuum", payload, timeout=600)
+    return json.dumps(out)
+
+
+@command("volume.delete", "delete a volume from a server: -volumeId N -node grpcAddr")
+def cmd_volume_delete(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    env.confirm_is_locked()
+    env.volume_server(flags["node"]).call(
+        "VolumeDelete", {"volume_id": int(flags["volumeId"])})
+    return "deleted"
+
+
+@command("volume.move", "move a volume: -volumeId N -source grpc -target grpc")
+def cmd_volume_move(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    env.confirm_is_locked()
+    _move_volume(env, {"volume_id": int(flags["volumeId"]),
+                       "collection": flags.get("collection", ""),
+                       "from_grpc": flags["source"],
+                       "to_grpc": flags["target"]})
+    return "moved"
+
+
+@command("volume.mark", "mark volume readonly/writable: -volumeId N -node grpc [-writable]")
+def cmd_volume_mark(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    method = ("VolumeMarkWritable" if flags.get("writable") == "true"
+              else "VolumeMarkReadonly")
+    env.volume_server(flags["node"]).call(
+        method, {"volume_id": int(flags["volumeId"])})
+    return "ok"
+
+
+@command("cluster.ps", "show cluster processes/topology summary")
+def cmd_cluster_ps(env: CommandEnv, args: list[str]) -> str:
+    topo = env.topology()
+    lines = []
+    for dc, rack, dn in iter_data_nodes(topo):
+        lines.append(f"volume server {dn['id']} dc:{dc} rack:{rack} "
+                     f"volumes:{len(dn['volumes'])} "
+                     f"ec_shards:{sum(bin(int(b)).count('1') for b in dn.get('ec_shards', {}).values())}")
+    return "\n".join(lines)

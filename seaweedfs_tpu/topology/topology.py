@@ -190,6 +190,8 @@ class Topology:
                     assert isinstance(dn, DataNode)
                     rd["data_nodes"].append({
                         "id": dn.id, "ip": dn.ip, "port": dn.port,
+                        "grpc_port": dn.grpc_port,
+                        "public_url": dn.public_url,
                         "max_volumes": dn.max_volumes,
                         "volumes": [vars(v) for v in dn.volumes.values()],
                         "ec_shards": {str(vid): int(bits)
@@ -210,6 +212,8 @@ def from_topology_dict(d: dict, **topo_kw) -> Topology:
                 dn = topo.get_or_create_data_node(
                     dcd["id"], rd["id"], nd["id"], ip=nd.get("ip", ""),
                     port=nd.get("port", 0),
+                    grpc_port=nd.get("grpc_port", 0),
+                    public_url=nd.get("public_url", ""),
                     max_volumes=nd.get("max_volumes", 7))
                 volumes = [VolumeInfo(**v) for v in nd.get("volumes", [])]
                 shards = {int(vid): ShardBits(bits)

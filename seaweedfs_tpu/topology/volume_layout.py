@@ -108,6 +108,16 @@ class VolumeLayout:
             self.readonly.discard(vid)
             self._refresh_writable(vid)
 
+    def freeze_writable(self, vid: int) -> None:
+        """Temporarily pull a volume from the writable set (vacuum)."""
+        with self._lock:
+            self.writables.discard(vid)
+
+    def refresh_writable(self, vid: int) -> None:
+        with self._lock:
+            if vid in self.vid_to_locations:
+                self._refresh_writable(vid)
+
     def set_oversized_if(self, v: VolumeInfo) -> None:
         if v.size >= self.volume_size_limit:
             with self._lock:

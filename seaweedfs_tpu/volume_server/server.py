@@ -24,6 +24,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+import urllib.parse
 
 from ..pb.rpc import POOL, RpcError, RpcServer, from_b64, to_b64
 from ..storage import ec as ec_pkg
@@ -55,8 +56,14 @@ class VolumeServer:
         self.volume_size_limit = 0
         self._stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
+        self._hb_wake = threading.Event()
+        self._hb_gen = 0        # bumped by heartbeat_now callers
+        self._hb_acked_gen = 0  # generation of the last acked payload
+        self._hb_inflight: list[int] = []  # gens of yielded payloads, FIFO
         # vid -> (ts, {shard_id: [grpc addresses]})
         self._ec_locations: dict[int, tuple[float, dict[int, list[str]]]] = {}
+        # vid -> (ts, [location dicts]) — replica urls for write fan-out
+        self._vol_locations: dict[int, tuple[float, list[dict]]] = {}
         self._register_http()
         self._register_rpc()
         self._public_url = public_url
@@ -109,25 +116,37 @@ class VolumeServer:
 
                 def requests():
                     while not self._stop.is_set():
+                        # stamp which generation this payload reflects so
+                        # heartbeat_now can wait for a POST-mutation ack
+                        self._hb_inflight.append(self._hb_gen)
                         yield self._heartbeat_payload()
-                        self._stop.wait(self.pulse_seconds)
+                        self._hb_wake.wait(self.pulse_seconds)
+                        self._hb_wake.clear()
 
                 for reply in client.stream("SendHeartbeat", requests()):
+                    if self._hb_inflight:
+                        self._hb_acked_gen = self._hb_inflight.pop(0)
                     if reply.get("volume_size_limit"):
                         self.volume_size_limit = reply["volume_size_limit"]
                     if self._stop.is_set():
                         break
             except RpcError:
-                pass
+                self._hb_inflight.clear()
             self._stop.wait(1.0)
 
-    def heartbeat_now(self) -> None:
-        """One synchronous heartbeat (tests / after admin ops; the reference
-        triggers this via New/DeletedVolumesChan deltas)."""
-        client = POOL.client(self.master_grpc, "Seaweed")
-        for _ in client.stream("SendHeartbeat",
-                               iter([self._heartbeat_payload()])):
-            break
+    def heartbeat_now(self, timeout: float = 5.0) -> None:
+        """Push a fresh snapshot through the PERSISTENT stream and wait for
+        the master to ack a payload built AFTER this call (the reference's
+        New/DeletedVolumesChan delta trigger).  A separate one-shot stream
+        would be wrong: the master unregisters a node when its heartbeat
+        stream ends."""
+        self._hb_gen += 1
+        want = self._hb_gen
+        self._hb_wake.set()
+        deadline = time.time() + timeout
+        while self._hb_acked_gen < want and time.time() < deadline:
+            self._hb_wake.set()
+            time.sleep(0.01)
 
     # -- HTTP data path ----------------------------------------------------
     def _register_http(self) -> None:
@@ -223,6 +242,15 @@ class VolumeServer:
                                                    fid.cookie)
         elif self.store.find_ec_volume(fid.volume_id) is not None:
             vol = self.store.find_ec_volume(fid.volume_id)
+            # same cookie gate as the normal-volume path: read the needle
+            # header to validate before tombstoning
+            try:
+                self._ensure_ec_remote_reader(fid.volume_id)
+                n = vol.read_needle(fid.key)
+            except ec_pkg.EcNotFoundError:
+                return Response.json({"size": 0}, status=202)
+            if n.cookie != fid.cookie:
+                return Response.error("cookie mismatch", 400)
             vol.delete_needle(fid.key)
             size = 0
         else:
@@ -233,22 +261,34 @@ class VolumeServer:
                 return Response.error(f"replication failed: {err}", 500)
         return Response.json({"size": size}, status=202)
 
+    def _replica_locations(self, vid: int) -> list[dict]:
+        """Master lookup with the same staleness window as EC locations —
+        the write hot path must not pay a master round-trip per request
+        (the reference consults the cached vid map)."""
+        now = time.time()
+        cached = self._vol_locations.get(vid)
+        if cached and now - cached[0] < EC_LOCATION_STALENESS:
+            return cached[1]
+        try:
+            client = POOL.client(self.master_grpc, "Seaweed")
+            out = client.call("LookupVolume",
+                              {"volume_or_file_ids": [str(vid)]})
+            locs = out["volume_id_locations"][str(vid)]["locations"]
+        except (RpcError, KeyError):
+            return []  # not registered yet (e.g. pre-heartbeat tests)
+        self._vol_locations[vid] = (now, locs)
+        return locs
+
     def _replicate(self, fid: FileId, req: Request, method: str,
                    body: bytes | None) -> str:
         """Synchronous fan-out to the other replicas
         (topology/store_replicate.go DistributedOperation:160)."""
-        try:
-            client = POOL.client(self.master_grpc, "Seaweed")
-            out = client.call("LookupVolume",
-                              {"volume_or_file_ids": [str(fid.volume_id)]})
-            locs = out["volume_id_locations"][str(fid.volume_id)]["locations"]
-        except (RpcError, KeyError):
-            return ""  # not registered yet (e.g. pre-heartbeat tests)
+        locs = self._replica_locations(fid.volume_id)
         errors = []
         qs = "type=replicate"
         for arg in ("name", "mime", "ttl"):
             if req.qs(arg):
-                qs += f"&{arg}={req.qs(arg)}"
+                qs += f"&{arg}={urllib.parse.quote(req.qs(arg), safe='')}"
         threads = []
 
         def send(url):
@@ -330,6 +370,7 @@ class VolumeServer:
                 "ReadVolumeFileStatus": self._rpc_volume_file_status,
                 "VolumeServerStatus": self._rpc_server_status,
                 "Ping": lambda req: {"ok": True},
+                "VolumeCopy": self._rpc_volume_copy,
                 "VolumeEcShardsGenerate": self._rpc_ec_generate,
                 "VolumeEcShardsRebuild": self._rpc_ec_rebuild,
                 "VolumeEcShardsCopy": self._rpc_ec_copy,
@@ -382,6 +423,37 @@ class VolumeServer:
         for loc in self.store.locations:
             loc.unload_volume(int(req["volume_id"]))
         return {}
+
+    def _rpc_volume_copy(self, req: dict) -> dict:
+        """Pull a whole volume (.dat/.idx) from another server and mount it
+        (volume_grpc_copy.go VolumeCopy)."""
+        vid = int(req["volume_id"])
+        collection = req.get("collection", "")
+        if self.store.has_volume(vid):
+            raise RpcError(f"volume {vid} already exists here")
+        loc = self.store.locations[0]
+        base = volume_file_name(loc.directory, collection, vid)
+        src = POOL.client(req["source_data_node"], "VolumeServer")
+        # stream into .tmp files; only rename the pair once BOTH completed,
+        # so a dead source never leaves a loadable truncated volume
+        try:
+            for ext in (".dat", ".idx"):
+                with open(base + ext + ".tmp", "wb") as f:
+                    for r in src.stream("CopyFile", iter([{
+                            "volume_id": vid, "collection": collection,
+                            "ext": ext}])):
+                        f.write(from_b64(r["file_content"]))
+        except Exception:
+            for ext in (".dat", ".idx"):
+                if os.path.exists(base + ext + ".tmp"):
+                    os.remove(base + ext + ".tmp")
+            raise
+        for ext in (".dat", ".idx"):
+            os.replace(base + ext + ".tmp", base + ext)
+        loc.load_existing_volumes()
+        if not self.store.has_volume(vid):
+            raise RpcError(f"volume {vid} failed to load after copy")
+        return {"last_append_at_ns": 0}
 
     # vacuum
     def _rpc_vacuum_check(self, req: dict) -> dict:
@@ -469,19 +541,22 @@ class VolumeServer:
         if req.get("copy_ecx_files", True):
             exts += [".ecx", ".ecj", ".vif"]
         for ext in exts:
-            chunks = []
+            # stream to a .tmp and rename on success: constant memory for
+            # multi-GB shards, and never a partial file under the real name
+            tmp = base + ext + ".tmp"
             try:
-                for r in src.stream("CopyFile", iter([{
-                        "volume_id": vid, "collection": collection,
-                        "ext": ext}])):
-                    chunks.append(from_b64(r["file_content"]))
-            except RpcError as e:
+                with open(tmp, "wb") as f:
+                    for r in src.stream("CopyFile", iter([{
+                            "volume_id": vid, "collection": collection,
+                            "ext": ext}])):
+                        f.write(from_b64(r["file_content"]))
+            except RpcError:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
                 if ext == ".ecj":  # journal may not exist yet
                     continue
                 raise
-            with open(base + ext, "wb") as f:
-                for c in chunks:
-                    f.write(c)
+            os.replace(tmp, base + ext)
         return {}
 
     def _rpc_ec_delete(self, req: dict) -> dict:

@@ -1,0 +1,255 @@
+"""Shell command tests.  Planning functions are tested on serialized
+topology state (the reference's sample.topo.txt pattern); command execution
+is tested against a live in-process cluster."""
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation, shell
+from seaweedfs_tpu.master import MasterServer
+from seaweedfs_tpu.pb.rpc import RpcError
+from seaweedfs_tpu.shell.command_ec import (collect_ec_shard_map,
+                                            collect_volume_ids_for_ec_encode,
+                                            do_ec_rebuild, plan_ec_balance,
+                                            plan_shard_distribution)
+from seaweedfs_tpu.shell.command_volume import (plan_fix_replication,
+                                                plan_volume_balance)
+from seaweedfs_tpu.storage.ec.layout import TOTAL_SHARDS_COUNT
+from seaweedfs_tpu.storage.ec.shard_bits import ShardBits
+from seaweedfs_tpu.volume_server import VolumeServer
+
+
+def fake_topo():
+    """A serialized cluster dump: 2 racks x 2 nodes, uneven volumes."""
+    def node(nid, rack, vols, ec=None):
+        return {"id": nid, "ip": "127.0.0.1", "port": 80, "grpc_port": 81,
+                "public_url": nid, "max_volumes": 20,
+                "volumes": [{"id": v, "size": s, "collection": "",
+                             "replica_placement": rp,
+                             "modified_at_second": m}
+                            for v, s, rp, m in vols],
+                "ec_shards": ec or {}}
+    return {"max_volume_id": 10, "data_centers": [{
+        "id": "dc1", "racks": [
+            {"id": "r1", "data_nodes": [
+                node("n1", "r1", [(1, 100, 0, 0), (2, 100, 0, 0),
+                                  (3, 100, 0, 0), (4, 100, 0, 0)]),
+                node("n2", "r1", [(5, 100, 1, 0)]),
+            ]},
+            {"id": "r2", "data_nodes": [
+                node("n3", "r2", []),
+                node("n4", "r2", [(6, 2_000_000, 0, 0)]),
+            ]},
+        ]}]}
+
+
+def test_plan_volume_balance_evens_counts():
+    moves = plan_volume_balance(fake_topo())
+    assert moves
+    # n1 has 4, others 1/0/1 -> after moves every node within 1
+    counts = {"n1": 4, "n2": 1, "n3": 0, "n4": 1}
+    for mv in moves:
+        counts[mv["from"]] -= 1
+        counts[mv["to"]] += 1
+    assert max(counts.values()) - min(counts.values()) <= 1
+
+
+def test_plan_fix_replication_finds_under_replicated():
+    fixes = plan_fix_replication(fake_topo())
+    # volume 5 has replica_placement=001 (2 copies) but 1 holder
+    assert any(f["volume_id"] == 5 for f in fixes)
+    fix = next(f for f in fixes if f["volume_id"] == 5)
+    assert fix["to"] != "n2"
+
+
+def test_collect_volume_ids_for_ec_encode():
+    topo = fake_topo()
+    vids = collect_volume_ids_for_ec_encode(
+        topo, volume_size_limit=1_000_000, full_percent=95,
+        quiet_seconds=10, now=1000.0)
+    assert vids == [6]  # only the 2MB volume is "full"; all are quiet
+    # nothing qualifies if quiet window not met
+    assert collect_volume_ids_for_ec_encode(
+        topo, 1_000_000, 95, quiet_seconds=2000, now=1000.0) == []
+
+
+def test_plan_shard_distribution_covers_all_shards():
+    plan = plan_shard_distribution(fake_topo(), 6, "n4")
+    got = sorted(s for ids in plan.values() for s in ids)
+    assert got == list(range(TOTAL_SHARDS_COUNT))
+    # spread over all 4 nodes, max 4 shards each (14/4 -> 3.5)
+    assert len(plan) == 4
+    assert max(len(ids) for ids in plan.values()) <= 4
+
+
+def test_plan_ec_balance():
+    topo = fake_topo()
+    # all 14 shards of vid 9 on n1
+    topo["data_centers"][0]["racks"][0]["data_nodes"][0]["ec_shards"] = {
+        "9": int(ShardBits.from_ids(range(TOTAL_SHARDS_COUNT)))}
+    moves = plan_ec_balance(topo)
+    assert moves
+    counts = {"n1": TOTAL_SHARDS_COUNT, "n2": 0, "n3": 0, "n4": 0}
+    for mv in moves:
+        assert mv["volume_id"] == 9
+        counts[mv["from"]] -= 1
+        counts[mv["to"]] += 1
+    assert max(counts.values()) <= 4  # ceil(14/4)
+
+
+# -- live cluster ----------------------------------------------------------
+
+@pytest.fixture()
+def cluster(tmp_path):
+    master = MasterServer(seed=3)
+    master.start()
+    servers = []
+    for i in range(4):
+        d = tmp_path / f"vol{i}"
+        d.mkdir()
+        vs = VolumeServer(master.grpc_address, [str(d)],
+                          rack=f"rack{i % 2}", pulse_seconds=0.5,
+                          max_volume_counts=[30])
+        vs.start()
+        servers.append(vs)
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topo.data_nodes()) < 4:
+        time.sleep(0.05)
+    env = shell.CommandEnv(master.grpc_address)
+    yield master, servers, env
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def write_blobs(master, n=8, size=1500):
+    import os
+    fids = {}
+    for i in range(n):
+        data = os.urandom(size + i)
+        fid = operation.assign_and_upload(master.grpc_address, data)
+        fids[fid] = data
+    return fids
+
+
+def test_shell_lock_required(cluster):
+    master, servers, env = cluster
+    with pytest.raises(shell.ShellError):
+        shell.run_command(env, "ec.encode -volumeId 1")
+    assert shell.run_command(env, "lock") == "locked"
+    assert shell.run_command(env, "unlock") == "unlocked"
+
+
+def test_shell_volume_list_and_cluster_ps(cluster):
+    master, servers, env = cluster
+    write_blobs(master, 2)
+    out = json.loads(shell.run_command(env, "volume.list"))
+    assert out["data_centers"]
+    ps = shell.run_command(env, "cluster.ps")
+    assert ps.count("volume server") == 4
+
+
+def test_shell_ec_encode_rebuild_balance(cluster):
+    master, servers, env = cluster
+    fids = write_blobs(master, 10)
+    vid = int(next(iter(fids)).split(",")[0])
+    in_vol = {f: d for f, d in fids.items()
+              if int(f.split(",")[0]) == vid}
+    for vs in servers:
+        vs.heartbeat_now()
+    shell.run_command(env, "lock")
+    out = json.loads(shell.run_command(env, f"ec.encode -volumeId {vid}"))
+    assert out["encoded"][0]["volume_id"] == vid
+    for vs in servers:
+        vs.heartbeat_now()
+    # reads work through EC from any holder
+    for f, data in in_vol.items():
+        assert operation.read_file(master.grpc_address, f) == data
+    # knock out one holder's shards on disk, then rebuild
+    shard_map = collect_ec_shard_map(env.topology())[vid]
+    victim_id = sorted(shard_map)[0]
+    victim = next(vs for vs in servers
+                  if f"{vs.http.host}:{vs.http.port}" == victim_id)
+    lost = shard_map[victim_id]
+    victim.store.unmount_ec_shards(vid, lost)
+    c = env.volume_server(victim.grpc_address)
+    c.call("VolumeEcShardsDelete", {"volume_id": vid, "shard_ids": lost})
+    victim.heartbeat_now()
+    out = json.loads(shell.run_command(env, f"ec.rebuild -volumeId {vid}"))
+    assert sorted(out["rebuilt"][0]["rebuilt"]) == sorted(lost)
+    for vs in servers:
+        vs.heartbeat_now()
+    shard_map = collect_ec_shard_map(env.topology())[vid]
+    present = sorted({s for ids in shard_map.values() for s in ids})
+    assert present == list(range(TOTAL_SHARDS_COUNT))
+    # balance evens out the distribution
+    json.loads(shell.run_command(env, "ec.balance -force"))
+    for vs in servers:
+        vs.heartbeat_now()
+    shard_map = collect_ec_shard_map(env.topology())[vid]
+    assert max(len(ids) for ids in shard_map.values()) <= 5
+    # reads still fine after all the shuffling
+    for f, data in in_vol.items():
+        assert operation.read_file(master.grpc_address, f) == data
+    shell.run_command(env, "unlock")
+
+
+def test_shell_ec_decode(cluster):
+    master, servers, env = cluster
+    fids = write_blobs(master, 6)
+    vid = int(next(iter(fids)).split(",")[0])
+    in_vol = {f: d for f, d in fids.items()
+              if int(f.split(",")[0]) == vid}
+    for vs in servers:
+        vs.heartbeat_now()
+    shell.run_command(env, "lock")
+    shell.run_command(env, f"ec.encode -volumeId {vid}")
+    for vs in servers:
+        vs.heartbeat_now()
+    out = json.loads(shell.run_command(env, f"ec.decode -volumeId {vid}"))
+    assert out["volume_id"] == vid
+    for vs in servers:
+        vs.heartbeat_now()
+    # volume is back to normal; reads hit the .dat path
+    for f, data in in_vol.items():
+        assert operation.read_file(master.grpc_address, f) == data
+    shell.run_command(env, "unlock")
+
+
+def test_shell_volume_balance_and_fix_replication(cluster):
+    master, servers, env = cluster
+    write_blobs(master, 4)
+    for vs in servers:
+        vs.heartbeat_now()
+    shell.run_command(env, "lock")
+    out = json.loads(shell.run_command(env, "volume.balance"))
+    assert "planned_moves" in out
+    json.loads(shell.run_command(env, "volume.balance -force"))
+    for vs in servers:
+        vs.heartbeat_now()
+    topo = env.topology()
+    counts = [len(dn["volumes"])
+              for _, _, dn in shell.commands.iter_data_nodes(topo)]
+    assert max(counts) - min(counts) <= 1
+    # drop one replica of a 001 volume, fix.replication restores it
+    out = json.loads(shell.run_command(env, "volume.fix.replication"))
+    assert out["planned_fixes"] == []
+    shell.run_command(env, "unlock")
+
+
+def test_shell_vacuum(cluster):
+    master, servers, env = cluster
+    fids = write_blobs(master, 6, size=3000)
+    for f in list(fids)[:5]:
+        operation.delete_file(master.grpc_address, f)
+    for vs in servers:
+        vs.heartbeat_now()
+    out = json.loads(shell.run_command(
+        env, "volume.vacuum -garbageThreshold 0.3"))
+    assert isinstance(out["vacuumed"], list)
+    # remaining blob still readable after compaction
+    for f, data in fids.items():
+        if f not in list(fids)[:5]:
+            assert operation.read_file(master.grpc_address, f) == data
