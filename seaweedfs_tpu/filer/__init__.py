@@ -1,0 +1,13 @@
+"""Filer: the namespace plane — directories, chunked files, pluggable
+metadata stores, metadata event log (reference weed/filer)."""
+
+from .entry import Attr, Entry, FileChunk, new_directory_entry
+from .filechunk_manifest import (MANIFEST_BATCH, maybe_manifestize,
+                                 resolve_chunk_manifest)
+from .filechunks import (compact_file_chunks,
+                         non_overlapping_visible_intervals, read_views,
+                         total_size)
+from .filer import Filer, MetaEvent
+from .filerstore import (STORES, FilerStore, MemoryStore, NotFound,
+                         SqliteStore, new_filer_store)
+from .server import FilerServer

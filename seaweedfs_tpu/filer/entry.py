@@ -1,0 +1,106 @@
+"""Filer entry model — directories and chunked files.
+
+Capability-equivalent to weed/filer/entry.go + pb FileChunk
+(weed/pb/filer.proto): an Entry is attributes + an ordered chunk list;
+chunks carry (file_id, offset, size, mtime, etag) and MVCC-resolve by
+modified time on read.  Entries serialize to/from plain dicts (the JSON
+analogue of the reference's protobuf EntryAttributes).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class FileChunk:
+    file_id: str = ""
+    offset: int = 0          # logical offset in the file
+    size: int = 0
+    modified_ts_ns: int = 0  # MVCC tie-break (filer.proto FileChunk.mtime)
+    etag: str = ""
+    is_chunk_manifest: bool = False
+
+    def to_dict(self) -> dict:
+        return {"file_id": self.file_id, "offset": self.offset,
+                "size": self.size, "modified_ts_ns": self.modified_ts_ns,
+                "etag": self.etag,
+                "is_chunk_manifest": self.is_chunk_manifest}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FileChunk":
+        return cls(file_id=d["file_id"], offset=d.get("offset", 0),
+                   size=d.get("size", 0),
+                   modified_ts_ns=d.get("modified_ts_ns", 0),
+                   etag=d.get("etag", ""),
+                   is_chunk_manifest=d.get("is_chunk_manifest", False))
+
+
+@dataclass
+class Attr:
+    mtime: float = 0.0
+    crtime: float = 0.0
+    mode: int = 0o660
+    uid: int = 0
+    gid: int = 0
+    mime: str = ""
+    ttl_sec: int = 0
+    user_name: str = ""
+    symlink_target: str = ""
+    md5: str = ""
+
+    def is_directory(self) -> bool:
+        return bool(self.mode & 0o40000)  # os.ModeDir analogue
+
+
+@dataclass
+class Entry:
+    full_path: str = "/"
+    attr: Attr = field(default_factory=Attr)
+    chunks: list[FileChunk] = field(default_factory=list)
+    extended: dict[str, str] = field(default_factory=dict)
+    hard_link_id: str = ""
+    hard_link_counter: int = 0
+
+    @property
+    def name(self) -> str:
+        return self.full_path.rstrip("/").rsplit("/", 1)[-1]
+
+    @property
+    def parent_dir(self) -> str:
+        p = self.full_path.rstrip("/").rsplit("/", 1)[0]
+        return p or "/"
+
+    def is_directory(self) -> bool:
+        return self.attr.is_directory()
+
+    def file_size(self) -> int:
+        from .filechunks import total_size
+        return total_size(self.chunks)
+
+    def to_dict(self) -> dict:
+        return {
+            "full_path": self.full_path,
+            "attr": vars(self.attr).copy(),
+            "chunks": [c.to_dict() for c in self.chunks],
+            "extended": self.extended,
+            "hard_link_id": self.hard_link_id,
+            "hard_link_counter": self.hard_link_counter,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Entry":
+        return cls(
+            full_path=d["full_path"],
+            attr=Attr(**d.get("attr", {})),
+            chunks=[FileChunk.from_dict(c) for c in d.get("chunks", [])],
+            extended=d.get("extended", {}),
+            hard_link_id=d.get("hard_link_id", ""),
+            hard_link_counter=d.get("hard_link_counter", 0))
+
+
+def new_directory_entry(path: str, now: float | None = None) -> Entry:
+    now = time.time() if now is None else now
+    return Entry(full_path=path,
+                 attr=Attr(mtime=now, crtime=now, mode=0o40000 | 0o770))

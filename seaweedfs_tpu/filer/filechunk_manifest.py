@@ -1,0 +1,72 @@
+"""Recursive chunk manifests — fold thousands of chunks into one blob so a
+single entry can describe tens-of-TB files.
+
+Capability-equivalent to weed/filer/filechunk_manifest.go: when an entry
+accumulates more than MANIFEST_BATCH chunks, the chunk list is serialized,
+stored as a blob, and replaced by ONE chunk flagged is_chunk_manifest;
+the fold recurses (manifests of manifests).  Reads resolve manifests back
+to data chunks transparently.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable
+
+from .entry import FileChunk
+
+MANIFEST_BATCH = 10000  # filechunk_manifest.go:22 ManifestBatch
+
+# save_fn(data) -> (file_id, etag); read_fn(file_id) -> bytes
+SaveFn = Callable[[bytes], tuple[str, str]]
+ReadFn = Callable[[str], bytes]
+
+
+def has_chunk_manifest(chunks: list[FileChunk]) -> bool:
+    return any(c.is_chunk_manifest for c in chunks)
+
+
+def separate_manifest_chunks(chunks: list[FileChunk]
+                             ) -> tuple[list[FileChunk], list[FileChunk]]:
+    manifests = [c for c in chunks if c.is_chunk_manifest]
+    data = [c for c in chunks if not c.is_chunk_manifest]
+    return manifests, data
+
+
+def resolve_chunk_manifest(read_fn: ReadFn, chunks: list[FileChunk]
+                           ) -> list[FileChunk]:
+    """Expand manifest chunks (recursively) into data chunks
+    (filechunk_manifest.go ResolveChunkManifest)."""
+    out: list[FileChunk] = []
+    for c in chunks:
+        if not c.is_chunk_manifest:
+            out.append(c)
+            continue
+        payload = json.loads(read_fn(c.file_id))
+        nested = [FileChunk.from_dict(d) for d in payload["chunks"]]
+        out.extend(resolve_chunk_manifest(read_fn, nested))
+    return out
+
+
+def maybe_manifestize(save_fn: SaveFn, chunks: list[FileChunk],
+                      batch: int = MANIFEST_BATCH) -> list[FileChunk]:
+    """Fold data chunks into manifest chunks in batches of `batch`
+    (filechunk_manifest.go MaybeManifestize:207).  Recurses until the list
+    is short enough; existing manifest chunks pass through untouched."""
+    manifests, data = separate_manifest_chunks(chunks)
+    if len(data) < batch:
+        return chunks
+    folded: list[FileChunk] = list(manifests)
+    for i in range(0, len(data) - len(data) % batch, batch):
+        group = sorted(data[i:i + batch], key=lambda c: c.offset)
+        payload = json.dumps(
+            {"chunks": [c.to_dict() for c in group]}).encode()
+        fid, etag = save_fn(payload)
+        start = min(c.offset for c in group)
+        stop = max(c.offset + c.size for c in group)
+        folded.append(FileChunk(
+            file_id=fid, offset=start, size=stop - start,
+            modified_ts_ns=max(c.modified_ts_ns for c in group),
+            etag=etag, is_chunk_manifest=True))
+    folded.extend(data[len(data) - len(data) % batch:])
+    return maybe_manifestize(save_fn, folded, batch)

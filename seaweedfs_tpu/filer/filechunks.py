@@ -1,0 +1,98 @@
+"""Chunk interval resolution — which chunk serves which byte range.
+
+Capability-equivalent to weed/filer/filechunks.go: overlapping writes are
+MVCC-resolved by modified time (later chunk wins the overlap), producing a
+minimal list of ChunkViews to read.  The reference builds a visible-interval
+list (readResolvedChunks); same algorithm here, kept O(n log n + overlaps).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .entry import FileChunk
+
+
+@dataclass
+class VisibleInterval:
+    start: int
+    stop: int
+    file_id: str
+    chunk_offset: int      # where `start` falls inside the chunk
+    modified_ts_ns: int
+
+
+@dataclass
+class ChunkView:
+    file_id: str
+    offset_in_chunk: int   # first byte of the chunk to read
+    size: int
+    logic_offset: int      # position in the file
+
+
+def non_overlapping_visible_intervals(chunks: list[FileChunk]
+                                      ) -> list[VisibleInterval]:
+    """Apply chunks in mtime order; later chunks shadow earlier ranges
+    (filechunks.go NonOverlappingVisibleIntervals)."""
+    visibles: list[VisibleInterval] = []
+    for c in sorted(chunks, key=lambda c: (c.modified_ts_ns, c.file_id)):
+        new_start, new_stop = c.offset, c.offset + c.size
+        out: list[VisibleInterval] = []
+        for v in visibles:
+            if v.stop <= new_start or v.start >= new_stop:
+                out.append(v)          # no overlap
+                continue
+            if v.start < new_start:    # left remnant survives
+                out.append(VisibleInterval(
+                    v.start, new_start, v.file_id, v.chunk_offset,
+                    v.modified_ts_ns))
+            if v.stop > new_stop:      # right remnant survives
+                out.append(VisibleInterval(
+                    new_stop, v.stop, v.file_id,
+                    v.chunk_offset + (new_stop - v.start),
+                    v.modified_ts_ns))
+        out.append(VisibleInterval(new_start, new_stop, c.file_id, 0,
+                                   c.modified_ts_ns))
+        out.sort(key=lambda v: v.start)
+        visibles = out
+    return visibles
+
+
+def view_from_visibles(visibles: list[VisibleInterval], offset: int,
+                       size: int) -> list[ChunkView]:
+    """Chunk reads covering [offset, offset+size)
+    (filechunks.go ViewFromVisibleIntervals)."""
+    stop = offset + size
+    views: list[ChunkView] = []
+    for v in visibles:
+        if v.stop <= offset or v.start >= stop:
+            continue
+        s = max(offset, v.start)
+        e = min(stop, v.stop)
+        views.append(ChunkView(
+            file_id=v.file_id,
+            offset_in_chunk=v.chunk_offset + (s - v.start),
+            size=e - s, logic_offset=s))
+    return views
+
+
+def read_views(chunks: list[FileChunk], offset: int,
+               size: int) -> list[ChunkView]:
+    return view_from_visibles(
+        non_overlapping_visible_intervals(chunks), offset, size)
+
+
+def total_size(chunks: list[FileChunk]) -> int:
+    return max((c.offset + c.size for c in chunks), default=0)
+
+
+def compact_file_chunks(chunks: list[FileChunk]
+                        ) -> tuple[list[FileChunk], list[FileChunk]]:
+    """-> (still-visible chunks, fully-shadowed garbage chunks)
+    (filechunks.go CompactFileChunks) — garbage feeds the deletion
+    pipeline."""
+    visible_fids = {v.file_id
+                    for v in non_overlapping_visible_intervals(chunks)}
+    compacted = [c for c in chunks if c.file_id in visible_fids]
+    garbage = [c for c in chunks if c.file_id not in visible_fids]
+    return compacted, garbage

@@ -1,0 +1,211 @@
+"""Filer core — the namespace layer over a FilerStore.
+
+Capability-equivalent to weed/filer/filer.go:33-240 + filer_notify.go +
+filer_delete_entry.go:
+- create_entry auto-creates parent directories (filer.go:154)
+- recursive delete feeds every dead chunk to the deletion pipeline
+- every mutation emits a metadata event (old_entry, new_entry) into an
+  in-memory log with monotonically increasing ts; subscribers replay from
+  any ts and then tail live events (the LogBuffer + SubscribeMetadata
+  mechanism, util/log_buffer/log_buffer.go + filer_grpc_server_sub_meta.go)
+- rename = move entry + children (filer_rename.go), emitted as
+  delete+create events like the reference
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from .entry import Attr, Entry, FileChunk, new_directory_entry
+from .filechunk_manifest import resolve_chunk_manifest
+from .filerstore import FilerStore, NotFound
+
+META_LOG_CAPACITY = 10000
+
+
+class MetaEvent:
+    __slots__ = ("ts_ns", "directory", "old_entry", "new_entry")
+
+    def __init__(self, ts_ns: int, directory: str,
+                 old_entry: Entry | None, new_entry: Entry | None):
+        self.ts_ns = ts_ns
+        self.directory = directory
+        self.old_entry = old_entry
+        self.new_entry = new_entry
+
+    def to_dict(self) -> dict:
+        return {"ts_ns": self.ts_ns, "directory": self.directory,
+                "old_entry": self.old_entry.to_dict()
+                if self.old_entry else None,
+                "new_entry": self.new_entry.to_dict()
+                if self.new_entry else None}
+
+
+class Filer:
+    def __init__(self, store: FilerStore,
+                 delete_chunks_fn: Callable[[list[FileChunk]], None]
+                 | None = None):
+        self.store = store
+        self.delete_chunks_fn = delete_chunks_fn or (lambda chunks: None)
+        self._log: list[MetaEvent] = []
+        self._log_lock = threading.Lock()
+        self._last_ts = 0
+        self._subscribers: list[Callable[[MetaEvent], None]] = []
+
+    # -- meta event log ----------------------------------------------------
+    def _notify(self, old: Entry | None, new: Entry | None) -> None:
+        directory = (new or old).parent_dir if (new or old) else "/"
+        with self._log_lock:
+            ts = max(time.time_ns(), self._last_ts + 1)
+            self._last_ts = ts
+            ev = MetaEvent(ts, directory, old, new)
+            self._log.append(ev)
+            if len(self._log) > META_LOG_CAPACITY:
+                self._log = self._log[-META_LOG_CAPACITY:]
+            subs = list(self._subscribers)
+        for fn in subs:
+            fn(ev)
+
+    def subscribe(self, fn: Callable[[MetaEvent], None],
+                  since_ts_ns: int = 0) -> Callable[[], None]:
+        """Replay events after since_ts_ns, then tail live, with backlog
+        guaranteed to be delivered before any concurrent live event.
+        Returns an unsubscribe function."""
+        state = {"live": False, "buffer": []}
+
+        def proxy(ev: MetaEvent) -> None:
+            with self._log_lock:
+                if not state["live"]:
+                    state["buffer"].append(ev)
+                    return
+            fn(ev)
+
+        with self._log_lock:
+            backlog = [ev for ev in self._log if ev.ts_ns > since_ts_ns]
+            self._subscribers.append(proxy)
+        for ev in backlog:
+            fn(ev)
+        # flip to live under the lock; flush anything buffered meanwhile
+        with self._log_lock:
+            buffered = state["buffer"]
+            state["buffer"] = []
+            state["live"] = True
+        for ev in buffered:
+            fn(ev)
+
+        def unsubscribe():
+            with self._log_lock:
+                if proxy in self._subscribers:
+                    self._subscribers.remove(proxy)
+        return unsubscribe
+
+    # -- CRUD --------------------------------------------------------------
+    def create_entry(self, entry: Entry) -> None:
+        self._ensure_parents(entry.parent_dir)
+        old = None
+        try:
+            old = self.store.find_entry(entry.full_path)
+        except NotFound:
+            pass
+        if old is not None and old.is_directory() \
+                and not entry.is_directory():
+            # a file may not bury a directory's children (filer.go:175)
+            raise ValueError(
+                f"{entry.full_path} is a directory; delete it first")
+        if old is not None and not old.is_directory() \
+                and not entry.is_directory():
+            # overwrite: chunks unique to the old version are garbage
+            new_fids = {c.file_id for c in entry.chunks}
+            dead = [c for c in old.chunks if c.file_id not in new_fids]
+            if dead:
+                self.delete_chunks_fn(dead)
+        self.store.insert_entry(entry)
+        self._notify(old, entry)
+
+    def _ensure_parents(self, dir_path: str) -> None:
+        if dir_path in ("", "/"):
+            return
+        try:
+            e = self.store.find_entry(dir_path)
+            if not e.is_directory():
+                raise ValueError(f"{dir_path} is a file, not a directory")
+            return
+        except NotFound:
+            pass
+        self._ensure_parents(dir_path.rsplit("/", 1)[0] or "/")
+        d = new_directory_entry(dir_path)
+        self.store.insert_entry(d)
+        self._notify(None, d)
+
+    def update_entry(self, entry: Entry) -> None:
+        old = None
+        try:
+            old = self.store.find_entry(entry.full_path)
+        except NotFound:
+            pass
+        self.store.update_entry(entry)
+        self._notify(old, entry)
+
+    def find_entry(self, full_path: str) -> Entry:
+        if full_path in ("", "/"):
+            return new_directory_entry("/")
+        return self.store.find_entry(full_path.rstrip("/") or "/")
+
+    def list_entries(self, dir_path: str, start_name: str = "",
+                     include_start: bool = False, limit: int = 1024,
+                     prefix: str = "") -> list[Entry]:
+        return self.store.list_directory_entries(
+            dir_path.rstrip("/") or "/", start_name, include_start, limit,
+            prefix)
+
+    def delete_entry(self, full_path: str, recursive: bool = False,
+                     ignore_recursive_error: bool = False) -> None:
+        """Delete + collect chunks of every removed file
+        (filer_delete_entry.go DeleteEntryMetaAndData)."""
+        entry = self.store.find_entry(full_path)
+        dead: list[FileChunk] = []
+        if entry.is_directory():
+            children = self.store.list_directory_entries(full_path,
+                                                         limit=1 << 30)
+            if children and not recursive:
+                raise ValueError(f"{full_path}: folder not empty")
+            for child in children:
+                try:
+                    self.delete_entry(child.full_path, recursive=True)
+                except Exception:
+                    if not ignore_recursive_error:
+                        raise
+        else:
+            dead = list(entry.chunks)
+        self.store.delete_entry(full_path)
+        self._notify(entry, None)
+        if dead:
+            self.delete_chunks_fn(dead)
+
+    # -- rename (filer_rename.go; emitted as delete+create) ---------------
+    def rename_entry(self, old_path: str, new_path: str) -> None:
+        entry = self.store.find_entry(old_path)
+        if entry.is_directory():
+            for child in self.store.list_directory_entries(old_path,
+                                                           limit=1 << 30):
+                self.rename_entry(
+                    child.full_path,
+                    new_path.rstrip("/") + "/" + child.name)
+        moved = Entry(full_path=new_path, attr=entry.attr,
+                      chunks=entry.chunks, extended=entry.extended,
+                      hard_link_id=entry.hard_link_id,
+                      hard_link_counter=entry.hard_link_counter)
+        self._ensure_parents(moved.parent_dir)
+        # an overwritten destination's chunks are garbage — go through
+        # create_entry so they reach the deletion pipeline
+        self.create_entry(moved)
+        self.store.delete_entry(old_path)
+        self._notify(entry, None)
+
+    # -- helpers -----------------------------------------------------------
+    def resolve_chunks(self, entry: Entry,
+                       read_fn: Callable[[str], bytes]) -> list[FileChunk]:
+        """Expand manifest chunks for reading."""
+        return resolve_chunk_manifest(read_fn, entry.chunks)

@@ -1,0 +1,255 @@
+"""FilerStore — the pluggable metadata backend API + two built-ins.
+
+Capability-equivalent to weed/filer/filerstore.go:19-42 (9-method CRUD +
+list + KV + txn interface) with the registration pattern of the per-backend
+packages (blank imports, server/filer_server.go:24-40) replaced by a
+STORES registry dict.
+
+Backends here: "memory" (sorted dict, the test store) and "sqlite"
+(sqlite3, the durable single-node store mirroring abstract_sql's
+one-table-schema: directory, name, meta).  The API shape matches the
+reference so leveldb/redis/mysql ports slot in later.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import sqlite3
+import threading
+from .entry import Entry
+
+
+class FilerStoreError(Exception):
+    pass
+
+
+class NotFound(FilerStoreError):
+    pass
+
+
+class FilerStore:
+    name = "abstract"
+
+    def insert_entry(self, entry: Entry) -> None:
+        raise NotImplementedError
+
+    def update_entry(self, entry: Entry) -> None:
+        raise NotImplementedError
+
+    def find_entry(self, full_path: str) -> Entry:
+        raise NotImplementedError
+
+    def delete_entry(self, full_path: str) -> None:
+        raise NotImplementedError
+
+    def delete_folder_children(self, full_path: str) -> None:
+        raise NotImplementedError
+
+    def list_directory_entries(self, dir_path: str, start_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        raise NotImplementedError
+
+    # KV (filerstore KvPut/KvGet/KvDelete)
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def kv_get(self, key: bytes) -> bytes:
+        raise NotImplementedError
+
+    def kv_delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryStore(FilerStore):
+    """Sorted in-memory store (the reference tests against leveldb in a
+    temp dir; a sorted dict gives the same ordered-listing semantics)."""
+    name = "memory"
+
+    def __init__(self):
+        self._by_dir: dict[str, list[str]] = {}   # dir -> sorted names
+        self._entries: dict[str, Entry] = {}      # full_path -> entry
+        self._kv: dict[bytes, bytes] = {}
+        self._lock = threading.RLock()
+
+    def insert_entry(self, entry: Entry) -> None:
+        with self._lock:
+            path = entry.full_path
+            if path not in self._entries:
+                names = self._by_dir.setdefault(entry.parent_dir, [])
+                bisect.insort(names, entry.name)
+            self._entries[path] = entry
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        e = self._entries.get(full_path)
+        if e is None:
+            raise NotFound(full_path)
+        return e
+
+    def delete_entry(self, full_path: str) -> None:
+        with self._lock:
+            e = self._entries.pop(full_path, None)
+            if e is not None:
+                names = self._by_dir.get(e.parent_dir, [])
+                i = bisect.bisect_left(names, e.name)
+                if i < len(names) and names[i] == e.name:
+                    names.pop(i)
+
+    def delete_folder_children(self, full_path: str) -> None:
+        with self._lock:
+            for name in list(self._by_dir.get(full_path, [])):
+                child = full_path.rstrip("/") + "/" + name
+                e = self._entries.get(child)
+                if e and e.is_directory():
+                    self.delete_folder_children(child)
+                self.delete_entry(child)
+
+    def list_directory_entries(self, dir_path: str, start_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        with self._lock:
+            names = self._by_dir.get(dir_path, [])
+            i = bisect.bisect_left(names, start_name) if start_name else 0
+            out = []
+            while i < len(names) and len(out) < limit:
+                name = names[i]
+                i += 1
+                if start_name and name == start_name and not include_start:
+                    continue
+                if prefix and not name.startswith(prefix):
+                    continue
+                out.append(self._entries[
+                    dir_path.rstrip("/") + "/" + name])
+            return out
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self._kv[key] = value
+
+    def kv_get(self, key: bytes) -> bytes:
+        if key not in self._kv:
+            raise NotFound(repr(key))
+        return self._kv[key]
+
+    def kv_delete(self, key: bytes) -> None:
+        self._kv.pop(key, None)
+
+
+class SqliteStore(FilerStore):
+    """Durable store over sqlite3 — the abstract_sql one-table schema
+    (filer/abstract_sql/abstract_sql_store.go; sqlite variant
+    filer/sqlite)."""
+    name = "sqlite"
+
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.RLock()
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS filemeta ("
+                " directory TEXT NOT NULL, name TEXT NOT NULL,"
+                " meta TEXT NOT NULL, PRIMARY KEY (directory, name))")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS filer_kv ("
+                " k BLOB PRIMARY KEY, v BLOB NOT NULL)")
+            self._conn.commit()
+
+    def _split(self, full_path: str) -> tuple[str, str]:
+        p = full_path.rstrip("/") or "/"
+        if p == "/":
+            return "", "/"
+        d, n = p.rsplit("/", 1)
+        return d or "/", n
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, n = self._split(entry.full_path)
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO filemeta (directory, name, meta)"
+                " VALUES (?, ?, ?)",
+                (d, n, json.dumps(entry.to_dict())))
+            self._conn.commit()
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        d, n = self._split(full_path)
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT meta FROM filemeta WHERE directory=? AND name=?",
+                (d, n)).fetchone()
+        if row is None:
+            raise NotFound(full_path)
+        return Entry.from_dict(json.loads(row[0]))
+
+    def delete_entry(self, full_path: str) -> None:
+        d, n = self._split(full_path)
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM filemeta WHERE directory=? AND name=?", (d, n))
+            self._conn.commit()
+
+    def delete_folder_children(self, full_path: str) -> None:
+        base = full_path.rstrip("/")
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM filemeta WHERE directory=? OR directory LIKE ?",
+                (base or "/", base + "/%"))
+            self._conn.commit()
+
+    def list_directory_entries(self, dir_path: str, start_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        d = dir_path.rstrip("/") or "/"
+        op = ">=" if include_start else ">"
+        # escape LIKE metacharacters so a literal '%'/'_' in the prefix
+        # doesn't change the match (MemoryStore uses startswith)
+        esc = (prefix.replace("\\", "\\\\").replace("%", "\\%")
+               .replace("_", "\\_"))
+        sql = (f"SELECT meta FROM filemeta WHERE directory=? AND name {op} ?"
+               " AND name LIKE ? ESCAPE '\\' ORDER BY name LIMIT ?")
+        with self._lock:
+            rows = self._conn.execute(
+                sql, (d, start_name, esc + "%", limit)).fetchall()
+        return [Entry.from_dict(json.loads(r[0])) for r in rows]
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO filer_kv (k, v) VALUES (?, ?)",
+                (key, value))
+            self._conn.commit()
+
+    def kv_get(self, key: bytes) -> bytes:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT v FROM filer_kv WHERE k=?", (key,)).fetchone()
+        if row is None:
+            raise NotFound(repr(key))
+        return row[0]
+
+    def kv_delete(self, key: bytes) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM filer_kv WHERE k=?", (key,))
+            self._conn.commit()
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+STORES = {"memory": MemoryStore, "sqlite": SqliteStore}
+
+
+def new_filer_store(kind: str, *args, **kw) -> FilerStore:
+    if kind not in STORES:
+        raise FilerStoreError(f"unknown filer store {kind!r}; "
+                              f"have {sorted(STORES)}")
+    return STORES[kind](*args, **kw)

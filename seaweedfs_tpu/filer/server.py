@@ -1,0 +1,377 @@
+"""Filer server — HTTP namespace API + SeaweedFiler gRPC service.
+
+Capability-equivalent to weed/server/filer_server*.go:
+- HTTP POST/PUT /path: stream the body in 8MB chunks; per chunk
+  AssignVolume at the master then upload to the volume server; entry saved
+  with the chunk list; >MANIFEST_BATCH chunks fold into manifests
+  (filer_server_handlers_write_autochunk.go:24-258).
+- HTTP GET /path: files stream resolved chunk views with Range support
+  (filer_server_handlers_read.go:83, filer/stream.go); directories return
+  a JSON listing (filer_server_handlers_read_dir.go).
+- HTTP DELETE /path[?recursive=true] (filer_server_handlers_write.go).
+- gRPC SeaweedFiler: LookupDirectoryEntry / ListEntries / CreateEntry /
+  UpdateEntry / DeleteEntry / AtomicRenameEntry / AssignVolume /
+  LookupVolume / SubscribeMetadata / KvGet / KvPut (pb/filer.proto:13-72).
+- dead chunks go to an async deletion queue drained by a background thread
+  (filer_deletion.go).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import urllib.parse
+
+from .. import operation
+from ..pb.rpc import POOL, RpcError, RpcServer
+from ..util.http import HttpServer, Request, Response
+from .entry import Attr, Entry, FileChunk
+from .filechunk_manifest import MANIFEST_BATCH, maybe_manifestize
+from .filechunks import read_views, total_size
+from .filer import Filer
+from .filerstore import NotFound, new_filer_store
+
+CHUNK_SIZE = 8 * 1024 * 1024  # autochunk size (filer_server.go option)
+
+
+def _parse_range(spec: str, size: int) -> "tuple[int, int] | None":
+    """One RFC 7233 byte-range -> [start, stop) clamped to size, or None if
+    unsatisfiable.  Multi-range requests fall back to the full body."""
+    if "," in spec:
+        return (0, size)  # multi-range: serve 200 with everything
+    try:
+        first, _, last = spec.partition("-")
+        if first == "":            # suffix form: last N bytes
+            n = int(last)
+            if n <= 0:
+                return None
+            return (max(0, size - n), size)
+        start = int(first)
+        stop = int(last) + 1 if last else size
+    except ValueError:
+        return None
+    if start >= size or start < 0 or stop <= start:
+        return None
+    return (start, min(stop, size))
+
+
+class FilerServer:
+    def __init__(self, master_grpc: str, host: str = "127.0.0.1",
+                 port: int = 0, grpc_port: int = 0,
+                 store_kind: str = "memory", store_path: str = ":memory:",
+                 collection: str = "", replication: str = "",
+                 chunk_size: int = CHUNK_SIZE):
+        self.master_grpc = master_grpc
+        self.collection = collection
+        self.replication = replication
+        self.chunk_size = chunk_size
+        store = (new_filer_store(store_kind, store_path)
+                 if store_kind == "sqlite" else new_filer_store(store_kind))
+        self.filer = Filer(store, delete_chunks_fn=self._enqueue_deletion)
+        self.http = HttpServer(host, port)
+        self.rpc = RpcServer(host, grpc_port)
+        self._del_queue: "queue.Queue[str]" = queue.Queue()
+        self._stop = threading.Event()
+        self._register_http()
+        self._register_rpc()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.http.start()
+        self.rpc.start()
+        threading.Thread(target=self._deletion_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.http.stop()
+        self.rpc.stop()
+        self.filer.store.close()
+
+    @property
+    def address(self) -> str:
+        return self.http.address
+
+    @property
+    def grpc_address(self) -> str:
+        return self.rpc.address
+
+    # -- deletion pipeline (filer_deletion.go) -----------------------------
+    def _enqueue_deletion(self, chunks: list[FileChunk]) -> None:
+        for c in chunks:
+            if c.is_chunk_manifest:
+                # resolve nested chunks (recursively) BEFORE deleting the
+                # manifest blob itself, or the deletion thread can win the
+                # race and strand every nested blob
+                try:
+                    payload = json.loads(self._read_chunk_blob(c.file_id))
+                    nested = [FileChunk.from_dict(d)
+                              for d in payload.get("chunks", [])]
+                    self._enqueue_deletion(nested)
+                except Exception:
+                    pass
+            self._del_queue.put(c.file_id)
+
+    def _deletion_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                fid = self._del_queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                operation.delete_file(self.master_grpc, fid)
+            except Exception:
+                pass
+
+    def drain_deletions(self, timeout: float = 5.0) -> None:
+        """Block until the deletion queue empties (tests)."""
+        deadline = time.time() + timeout
+        while not self._del_queue.empty() and time.time() < deadline:
+            time.sleep(0.02)
+
+    # -- chunk IO ----------------------------------------------------------
+    def _save_chunk(self, data: bytes, ts_ns: int,
+                    offset: int) -> FileChunk:
+        r = operation.assign(self.master_grpc,
+                             replication=self.replication,
+                             collection=self.collection)
+        out = operation.upload_data(r.url, r.fid, data)
+        return FileChunk(file_id=r.fid, offset=offset, size=len(data),
+                         modified_ts_ns=ts_ns, etag=out.get("eTag", ""))
+
+    def _save_manifest_blob(self, data: bytes) -> tuple[str, str]:
+        r = operation.assign(self.master_grpc,
+                             replication=self.replication,
+                             collection=self.collection)
+        out = operation.upload_data(r.url, r.fid, data)
+        return r.fid, out.get("eTag", "")
+
+    def _read_chunk_blob(self, fid: str) -> bytes:
+        return operation.read_file(self.master_grpc, fid)
+
+    # -- HTTP --------------------------------------------------------------
+    def _register_http(self) -> None:
+        self.http.route("*", "/", self._http_dispatch)
+
+    def _http_dispatch(self, req: Request) -> Response:
+        path = urllib.parse.unquote(req.path) or "/"
+        if req.method in ("POST", "PUT"):
+            return self._http_write(path, req)
+        if req.method in ("GET", "HEAD"):
+            return self._http_read(path, req)
+        if req.method == "DELETE":
+            return self._http_delete(path, req)
+        return Response.error("method not allowed", 405)
+
+    def _http_write(self, path: str, req: Request) -> Response:
+        """Auto-chunked upload (doPostAutoChunk)."""
+        if path.endswith("/") and not req.body:
+            # explicit directory creation
+            from .entry import new_directory_entry
+            self.filer.create_entry(new_directory_entry(path.rstrip("/")))
+            return Response.json({"name": path}, status=201)
+        ts_ns = time.time_ns()
+        chunks: list[FileChunk] = []
+        body = req.body
+        for off in range(0, len(body), self.chunk_size) or [0]:
+            piece = body[off:off + self.chunk_size]
+            if piece or off == 0:
+                chunks.append(self._save_chunk(piece, ts_ns, off))
+        chunks = maybe_manifestize(self._save_manifest_blob, chunks)
+        now = time.time()
+        entry = Entry(
+            full_path=path.rstrip("/"),
+            attr=Attr(mtime=now, crtime=now, mode=0o660,
+                      mime=req.headers.get("Content-Type", "")),
+            chunks=chunks)
+        self.filer.create_entry(entry)
+        return Response.json({"name": entry.name,
+                              "size": total_size(chunks)}, status=201)
+
+    def _http_read(self, path: str, req: Request) -> Response:
+        try:
+            entry = self.filer.find_entry(path)
+        except NotFound:
+            return Response.error("not found", 404)
+        if entry.is_directory():
+            limit = int(req.qs("limit", "1024"))
+            entries = self.filer.list_entries(
+                path, start_name=req.qs("lastFileName"), limit=limit)
+            return Response.json({
+                "Path": path,
+                "Entries": [e.to_dict() for e in entries],
+                "ShouldDisplayLoadMore": len(entries) == limit})
+        chunks = self.filer.resolve_chunks(entry, self._read_chunk_blob)
+        size = total_size(chunks)
+        offset, length, status = 0, size, 200
+        rng = req.headers.get("Range", "")
+        if rng.startswith("bytes=") and size > 0:
+            parsed = _parse_range(rng[6:], size)
+            if parsed is None:
+                return Response(416, b"", headers={
+                    "Content-Range": f"bytes */{size}"})
+            if parsed != (0, size):
+                offset, end = parsed
+                length, status = end - offset, 206
+        data = self._stream_content(chunks, offset, length)
+        headers = {"Accept-Ranges": "bytes"}
+        if status == 206:
+            headers["Content-Range"] = \
+                f"bytes {offset}-{offset + length - 1}/{size}"
+        return Response(status, data,
+                        content_type=entry.attr.mime
+                        or "application/octet-stream",
+                        headers=headers)
+
+    def _stream_content(self, chunks: list[FileChunk], offset: int,
+                        length: int) -> bytes:
+        """Gather chunk views; zero-fill sparse gaps (filer/stream.go)."""
+        out = bytearray(length)
+        for view in read_views(chunks, offset, length):
+            blob = self._read_chunk_blob(view.file_id)
+            piece = blob[view.offset_in_chunk:
+                         view.offset_in_chunk + view.size]
+            at = view.logic_offset - offset
+            out[at:at + len(piece)] = piece
+        return bytes(out)
+
+    def _http_delete(self, path: str, req: Request) -> Response:
+        try:
+            self.filer.delete_entry(
+                path.rstrip("/") or "/",
+                recursive=req.qs("recursive") == "true",
+                ignore_recursive_error=req.qs("ignoreRecursiveError")
+                == "true")
+        except NotFound:
+            return Response.error("not found", 404)
+        except ValueError as e:
+            return Response.error(str(e), 400)
+        return Response(204, b"")
+
+    # -- gRPC SeaweedFiler --------------------------------------------------
+    def _register_rpc(self) -> None:
+        self.rpc.add_service(
+            "SeaweedFiler",
+            unary={
+                "LookupDirectoryEntry": self._rpc_lookup,
+                "CreateEntry": self._rpc_create_entry,
+                "UpdateEntry": self._rpc_update_entry,
+                "DeleteEntry": self._rpc_delete_entry,
+                "AtomicRenameEntry": self._rpc_rename,
+                "AssignVolume": self._rpc_assign_volume,
+                "LookupVolume": self._rpc_lookup_volume,
+                "KvGet": self._rpc_kv_get,
+                "KvPut": self._rpc_kv_put,
+                "Statistics": lambda req: {},
+            },
+            stream={
+                "ListEntries": self._rpc_list_entries,
+                "SubscribeMetadata": self._rpc_subscribe_metadata,
+            })
+
+    def _rpc_lookup(self, req: dict) -> dict:
+        directory = req.get("directory", "/").rstrip("/") or "/"
+        name = req["name"]
+        path = directory + "/" + name if directory != "/" else "/" + name
+        try:
+            return {"entry": self.filer.find_entry(path).to_dict()}
+        except NotFound:
+            raise RpcError(f"{path} not found") from None
+
+    def _rpc_create_entry(self, req: dict) -> dict:
+        self.filer.create_entry(Entry.from_dict(req["entry"]))
+        return {}
+
+    def _rpc_update_entry(self, req: dict) -> dict:
+        self.filer.update_entry(Entry.from_dict(req["entry"]))
+        return {}
+
+    def _rpc_delete_entry(self, req: dict) -> dict:
+        directory = req.get("directory", "/").rstrip("/") or "/"
+        name = req.get("name", "")
+        path = (directory + "/" + name) if name else directory
+        try:
+            self.filer.delete_entry(
+                path, recursive=req.get("is_recursive", False),
+                ignore_recursive_error=req.get("ignore_recursive_error",
+                                               False))
+        except NotFound:
+            if not req.get("ignore_recursive_error"):
+                raise RpcError(f"{path} not found") from None
+        return {}
+
+    def _rpc_rename(self, req: dict) -> dict:
+        old = (req["old_directory"].rstrip("/") or "") + "/" + req["old_name"]
+        new = (req["new_directory"].rstrip("/") or "") + "/" + req["new_name"]
+        self.filer.rename_entry(old, new)
+        return {}
+
+    def _rpc_assign_volume(self, req: dict) -> dict:
+        r = operation.assign(
+            self.master_grpc, count=req.get("count", 1),
+            replication=req.get("replication") or self.replication,
+            collection=req.get("collection") or self.collection,
+            ttl=req.get("ttl_sec") and str(req["ttl_sec"]) + "s" or "",
+            data_center=req.get("data_center", ""))
+        return {"file_id": r.fid, "url": r.url,
+                "public_url": r.public_url, "count": r.count}
+
+    def _rpc_lookup_volume(self, req: dict) -> dict:
+        out = {}
+        for vid_s in req.get("volume_ids", []):
+            locs = operation.lookup_volume(self.master_grpc,
+                                           int(str(vid_s).split(",")[0]))
+            out[str(vid_s)] = {"locations": locs}
+        return {"locations_map": out}
+
+    def _rpc_list_entries(self, requests):
+        for req in requests:
+            entries = self.filer.list_entries(
+                req.get("directory", "/"),
+                start_name=req.get("start_from_file_name", ""),
+                include_start=req.get("inclusive_start_from", False),
+                limit=req.get("limit", 1024),
+                prefix=req.get("prefix", ""))
+            for e in entries:
+                yield {"entry": e.to_dict()}
+
+    def _rpc_subscribe_metadata(self, requests):
+        """Replay from since_ns then tail live events
+        (filer_grpc_server_sub_meta.go)."""
+        req = next(iter(requests), {}) or {}
+        since = req.get("since_ns", 0)
+        path_prefix = req.get("path_prefix", "/")
+        q: "queue.Queue[dict]" = queue.Queue()
+
+        prefix = path_prefix.rstrip("/")
+
+        def on_event(ev):
+            # path-boundary match: /app covers /app and /app/x, not /apple
+            if (not prefix or ev.directory == prefix
+                    or ev.directory.startswith(prefix + "/")):
+                q.put(ev.to_dict())
+
+        unsubscribe = self.filer.subscribe(on_event, since_ts_ns=since)
+        try:
+            while True:
+                try:
+                    yield q.get(timeout=0.5)
+                except queue.Empty:
+                    yield {"ping": 1}
+        finally:
+            unsubscribe()
+
+    def _rpc_kv_get(self, req: dict) -> dict:
+        from ..pb.rpc import to_b64, from_b64
+        try:
+            val = self.filer.store.kv_get(from_b64(req["key"]))
+        except NotFound:
+            return {"error": "not found"}
+        return {"value": to_b64(val)}
+
+    def _rpc_kv_put(self, req: dict) -> dict:
+        from ..pb.rpc import from_b64
+        self.filer.store.kv_put(from_b64(req["key"]),
+                                from_b64(req["value"]))
+        return {}

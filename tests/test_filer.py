@@ -1,0 +1,285 @@
+"""Filer tests: chunk interval math + store CRUD (unit, modeled on
+filer/filechunks_test.go and the per-store tests), and the filer server
+against a live mini-cluster (integration)."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.filer import (Entry, FileChunk, Filer, MemoryStore,
+                                 NotFound, SqliteStore, maybe_manifestize,
+                                 new_directory_entry,
+                                 non_overlapping_visible_intervals,
+                                 read_views, resolve_chunk_manifest,
+                                 total_size)
+from seaweedfs_tpu.filer.entry import Attr
+from seaweedfs_tpu.master import MasterServer
+from seaweedfs_tpu.util.http import http_request
+from seaweedfs_tpu.volume_server import VolumeServer
+
+
+def chunk(fid, offset, size, ts):
+    return FileChunk(file_id=fid, offset=offset, size=size,
+                     modified_ts_ns=ts)
+
+
+# -- interval math (filechunks_test.go patterns) ---------------------------
+
+def test_visible_intervals_sequential():
+    vis = non_overlapping_visible_intervals(
+        [chunk("a", 0, 100, 1), chunk("b", 100, 100, 2)])
+    assert [(v.start, v.stop, v.file_id) for v in vis] == \
+        [(0, 100, "a"), (100, 200, "b")]
+
+
+def test_visible_intervals_full_overwrite():
+    vis = non_overlapping_visible_intervals(
+        [chunk("a", 0, 100, 1), chunk("b", 0, 100, 2)])
+    assert [(v.start, v.stop, v.file_id) for v in vis] == [(0, 100, "b")]
+
+
+def test_visible_intervals_partial_overwrite():
+    vis = non_overlapping_visible_intervals(
+        [chunk("a", 0, 100, 1), chunk("b", 50, 100, 2)])
+    assert [(v.start, v.stop, v.file_id) for v in vis] == \
+        [(0, 50, "a"), (50, 150, "b")]
+
+
+def test_visible_intervals_middle_overwrite():
+    vis = non_overlapping_visible_intervals(
+        [chunk("a", 0, 300, 1), chunk("b", 100, 100, 2)])
+    assert [(v.start, v.stop, v.file_id, v.chunk_offset) for v in vis] == \
+        [(0, 100, "a", 0), (100, 200, "b", 0), (200, 300, "a", 200)]
+
+
+def test_visible_intervals_older_loses_regardless_of_order():
+    newer_first = [chunk("b", 0, 100, 2), chunk("a", 0, 100, 1)]
+    vis = non_overlapping_visible_intervals(newer_first)
+    assert [v.file_id for v in vis] == ["b"]
+
+
+def test_read_views_with_range():
+    chunks = [chunk("a", 0, 100, 1), chunk("b", 100, 100, 2)]
+    views = read_views(chunks, 50, 100)
+    assert [(v.file_id, v.offset_in_chunk, v.size, v.logic_offset)
+            for v in views] == [("a", 50, 50, 50), ("b", 0, 50, 100)]
+
+
+def test_total_size_and_sparse():
+    chunks = [chunk("a", 0, 10, 1), chunk("b", 100, 10, 2)]
+    assert total_size(chunks) == 110
+    views = read_views(chunks, 0, 110)
+    covered = sum(v.size for v in views)
+    assert covered == 20  # the sparse hole is not read
+
+
+# -- manifests -------------------------------------------------------------
+
+def test_manifestize_roundtrip():
+    blobs = {}
+
+    def save(data):
+        fid = f"m{len(blobs)}"
+        blobs[fid] = data
+        return fid, "etag"
+
+    chunks = [chunk(f"c{i}", i * 10, 10, 1) for i in range(25)]
+    folded = maybe_manifestize(save, chunks, batch=10)
+    assert len(folded) == 7  # 2 manifests of 10 + 5 loose
+    assert sum(c.is_chunk_manifest for c in folded) == 2
+    resolved = resolve_chunk_manifest(lambda fid: blobs[fid], folded)
+    assert sorted(c.file_id for c in resolved) == \
+        sorted(c.file_id for c in chunks)
+    assert total_size(resolved) == 250
+
+
+# -- stores ----------------------------------------------------------------
+
+@pytest.mark.parametrize("make_store", [MemoryStore,
+                                        lambda: SqliteStore(":memory:")])
+def test_store_crud_and_listing(make_store):
+    s = make_store()
+    f = Filer(s)
+    now = time.time()
+    for name in ("b", "a", "c"):
+        f.create_entry(Entry(full_path=f"/dir/{name}",
+                             attr=Attr(mtime=now, crtime=now)))
+    assert [e.name for e in f.list_entries("/dir")] == ["a", "b", "c"]
+    # auto-created parent
+    d = f.find_entry("/dir")
+    assert d.is_directory()
+    # pagination
+    page = f.list_entries("/dir", start_name="a", limit=1)
+    assert [e.name for e in page] == ["b"]
+    # prefix
+    assert [e.name for e in f.list_entries("/dir", prefix="c")] == ["c"]
+    # delete file then dir
+    f.delete_entry("/dir/b")
+    with pytest.raises(NotFound):
+        f.find_entry("/dir/b")
+    with pytest.raises(ValueError):
+        f.delete_entry("/dir")  # not empty
+    f.delete_entry("/dir", recursive=True)
+    with pytest.raises(NotFound):
+        f.find_entry("/dir/a")
+    # kv
+    s.kv_put(b"k", b"v")
+    assert s.kv_get(b"k") == b"v"
+    s.kv_delete(b"k")
+    with pytest.raises(NotFound):
+        s.kv_get(b"k")
+    s.close()
+
+
+def test_filer_rename_and_events():
+    f = Filer(MemoryStore())
+    events = []
+    f.subscribe(lambda ev: events.append(ev))
+    f.create_entry(Entry(full_path="/x/old", attr=Attr()))
+    f.rename_entry("/x/old", "/y/new")
+    with pytest.raises(NotFound):
+        f.find_entry("/x/old")
+    assert f.find_entry("/y/new").name == "new"
+    kinds = [(ev.old_entry is not None, ev.new_entry is not None)
+             for ev in events]
+    # create /x, create old, delete old, (+mkdir /y), create new
+    assert (True, False) in kinds and (False, True) in kinds
+    # replay from ts 0 sees the full history
+    replayed = []
+    f.subscribe(lambda ev: replayed.append(ev), since_ts_ns=0)
+    assert len(replayed) == len(events)
+
+
+def test_overwrite_collects_dead_chunks():
+    dead = []
+    f = Filer(MemoryStore(), delete_chunks_fn=lambda cs: dead.extend(cs))
+    f.create_entry(Entry(full_path="/f", attr=Attr(),
+                         chunks=[chunk("old1", 0, 10, 1)]))
+    f.create_entry(Entry(full_path="/f", attr=Attr(),
+                         chunks=[chunk("new1", 0, 10, 2)]))
+    assert [c.file_id for c in dead] == ["old1"]
+    f.delete_entry("/f")
+    assert [c.file_id for c in dead] == ["old1", "new1"]
+
+
+# -- live integration ------------------------------------------------------
+
+@pytest.fixture()
+def stack(tmp_path):
+    from seaweedfs_tpu.filer import FilerServer
+    master = MasterServer(seed=5)
+    master.start()
+    servers = []
+    for i in range(2):
+        d = tmp_path / f"vol{i}"
+        d.mkdir()
+        vs = VolumeServer(master.grpc_address, [str(d)], pulse_seconds=0.5,
+                          max_volume_counts=[30])
+        vs.start()
+        servers.append(vs)
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topo.data_nodes()) < 2:
+        time.sleep(0.05)
+    filer = FilerServer(master.grpc_address, chunk_size=1024)  # tiny chunks
+    filer.start()
+    yield master, servers, filer
+    filer.stop()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def test_filer_http_write_read_delete(stack):
+    master, servers, filer = stack
+    data = os.urandom(5000)  # 5 chunks at chunk_size=1024
+    status, body, _ = http_request(
+        f"http://{filer.address}/docs/report.bin", method="POST", body=data)
+    assert status == 201, body
+    assert json.loads(body)["size"] == len(data)
+    status, got, _ = http_request(f"http://{filer.address}/docs/report.bin")
+    assert status == 200 and got == data
+    # range read across chunk boundaries
+    req = urllib.request.Request(
+        f"http://{filer.address}/docs/report.bin",
+        headers={"Range": "bytes=1000-3499"})
+    with urllib.request.urlopen(req) as r:
+        assert r.status == 206
+        assert r.read() == data[1000:3500]
+    # directory listing
+    status, body, _ = http_request(f"http://{filer.address}/docs")
+    listing = json.loads(body)
+    assert [e["full_path"] for e in listing["Entries"]] == \
+        ["/docs/report.bin"]
+    # delete file -> chunks go to the deletion pipeline
+    status, _, _ = http_request(f"http://{filer.address}/docs/report.bin",
+                                method="DELETE")
+    assert status == 204
+    filer.drain_deletions()
+    status, _, _ = http_request(f"http://{filer.address}/docs/report.bin")
+    assert status == 404
+
+
+def test_filer_overwrite_updates_content(stack):
+    master, servers, filer = stack
+    url = f"http://{filer.address}/f.txt"
+    http_request(url, method="POST", body=b"version one")
+    http_request(url, method="POST", body=b"v2")
+    status, got, _ = http_request(url)
+    assert got == b"v2"
+
+
+def test_filer_grpc_api(stack):
+    from seaweedfs_tpu.pb.rpc import POOL
+    master, servers, filer = stack
+    c = POOL.client(filer.grpc_address, "SeaweedFiler")
+    # assign + create entry via gRPC (the FUSE/S3 path)
+    out = c.call("AssignVolume", {"count": 1})
+    operation.upload_data(out["url"], out["file_id"], b"grpc-chunk")
+    c.call("CreateEntry", {"entry": {
+        "full_path": "/via/grpc.bin",
+        "attr": {"mtime": time.time(), "crtime": time.time(), "mode": 0o660},
+        "chunks": [{"file_id": out["file_id"], "offset": 0, "size": 10,
+                    "modified_ts_ns": time.time_ns()}]}})
+    got = c.call("LookupDirectoryEntry", {"directory": "/via",
+                                          "name": "grpc.bin"})
+    assert got["entry"]["chunks"][0]["file_id"] == out["file_id"]
+    status, body, _ = http_request(f"http://{filer.address}/via/grpc.bin")
+    assert body == b"grpc-chunk"
+    # list entries stream
+    entries = [r["entry"]["full_path"] for r in
+               c.stream("ListEntries", iter([{"directory": "/via"}]))]
+    assert entries == ["/via/grpc.bin"]
+    # rename
+    c.call("AtomicRenameEntry", {"old_directory": "/via",
+                                 "old_name": "grpc.bin",
+                                 "new_directory": "/via",
+                                 "new_name": "renamed.bin"})
+    status, body, _ = http_request(f"http://{filer.address}/via/renamed.bin")
+    assert body == b"grpc-chunk"
+    # kv
+    from seaweedfs_tpu.pb.rpc import to_b64, from_b64
+    c.call("KvPut", {"key": to_b64(b"cfg"), "value": to_b64(b"42")})
+    assert from_b64(c.call("KvGet", {"key": to_b64(b"cfg")})["value"]) \
+        == b"42"
+
+
+def test_filer_metadata_subscription(stack):
+    from seaweedfs_tpu.pb.rpc import POOL
+    master, servers, filer = stack
+    http_request(f"http://{filer.address}/watched/a.txt", method="POST",
+                 body=b"one")
+    c = POOL.client(filer.grpc_address, "SeaweedFiler")
+    got = []
+    for msg in c.stream("SubscribeMetadata",
+                        iter([{"since_ns": 0,
+                               "path_prefix": "/watched"}])):
+        if "ping" in msg:
+            break
+        got.append(msg)
+    paths = [m["new_entry"]["full_path"] for m in got
+             if m.get("new_entry")]
+    assert "/watched/a.txt" in paths
