@@ -74,26 +74,31 @@ class Filer:
         guaranteed to be delivered before any concurrent live event.
         Returns an unsubscribe function."""
         state = {"live": False, "buffer": []}
+        deliver_lock = threading.Lock()  # serializes delivery to fn
 
         def proxy(ev: MetaEvent) -> None:
             with self._log_lock:
                 if not state["live"]:
                     state["buffer"].append(ev)
                     return
-            fn(ev)
+            with deliver_lock:
+                fn(ev)
 
         with self._log_lock:
             backlog = [ev for ev in self._log if ev.ts_ns > since_ts_ns]
             self._subscribers.append(proxy)
         for ev in backlog:
             fn(ev)
-        # flip to live under the lock; flush anything buffered meanwhile
-        with self._log_lock:
-            buffered = state["buffer"]
-            state["buffer"] = []
-            state["live"] = True
-        for ev in buffered:
-            fn(ev)
+        # flush the buffer and flip live while HOLDING deliver_lock: a
+        # concurrent _notify that sees live=True must wait here, so it can
+        # never deliver ahead of the buffered (older) events
+        with deliver_lock:
+            with self._log_lock:
+                buffered = state["buffer"]
+                state["buffer"] = []
+                state["live"] = True
+            for ev in buffered:
+                fn(ev)
 
         def unsubscribe():
             with self._log_lock:
@@ -186,6 +191,8 @@ class Filer:
 
     # -- rename (filer_rename.go; emitted as delete+create) ---------------
     def rename_entry(self, old_path: str, new_path: str) -> None:
+        if old_path.rstrip("/") == new_path.rstrip("/"):
+            return  # no-op move; deleting old_path would destroy the entry
         entry = self.store.find_entry(old_path)
         if entry.is_directory():
             for child in self.store.list_directory_entries(old_path,

@@ -180,11 +180,13 @@ class FilerServer:
                 chunks.append(self._save_chunk(piece, ts_ns, off))
         chunks = maybe_manifestize(self._save_manifest_blob, chunks)
         now = time.time()
+        import hashlib
         entry = Entry(
             full_path=path.rstrip("/"),
             attr=Attr(mtime=now, crtime=now, mode=0o660,
                       mime=req.headers.get("Content-Type", "")),
-            chunks=chunks)
+            chunks=chunks,
+            extended={"etag": hashlib.md5(body).hexdigest()})
         self.filer.create_entry(entry)
         return Response.json({"name": entry.name,
                               "size": total_size(chunks)}, status=201)
@@ -214,8 +216,14 @@ class FilerServer:
             if parsed != (0, size):
                 offset, end = parsed
                 length, status = end - offset, 206
-        data = self._stream_content(chunks, offset, length)
-        headers = {"Accept-Ranges": "bytes"}
+        # HEAD needs only the size/headers, not a full cluster read
+        if req.method == "HEAD":
+            data = b""
+            headers = {"Accept-Ranges": "bytes",
+                       "Content-Length": str(length)}
+        else:
+            data = self._stream_content(chunks, offset, length)
+            headers = {"Accept-Ranges": "bytes"}
         if status == 206:
             headers["Content-Range"] = \
                 f"bytes {offset}-{offset + length - 1}/{size}"

@@ -1,0 +1,6 @@
+"""S3 gateway: SigV4-authenticated REST over the filer (reference weed/s3api)."""
+
+from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_TAGGING,
+                   ACTION_WRITE, Identity, IdentityAccessManagement,
+                   S3AuthError, presign_url, sign_v4)
+from .server import S3ApiServer

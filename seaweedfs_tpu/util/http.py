@@ -18,12 +18,34 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 
+class CIDict(dict):
+    """Case-insensitive header map (HTTP header names are
+    case-insensitive; aws-sdk-js sends lowercase names)."""
+
+    def __init__(self, items=None):
+        super().__init__()
+        for k, v in dict(items or {}).items():
+            self[k] = v
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key.lower(), value)
+
+    def __getitem__(self, key):
+        return super().__getitem__(key.lower())
+
+    def get(self, key, default=None):
+        return super().get(key.lower(), default)
+
+    def __contains__(self, key):
+        return super().__contains__(key.lower())
+
+
 @dataclass
 class Request:
     method: str
     path: str            # path without query string
     query: dict[str, list[str]]
-    headers: dict[str, str]
+    headers: CIDict
     body: bytes
 
     def qs(self, key: str, default: str = "") -> str:
@@ -71,8 +93,9 @@ class HttpServer:
                 body = self.rfile.read(length) if length else b""
                 req = Request(
                     method=self.command, path=parsed.path,
-                    query=urllib.parse.parse_qs(parsed.query),
-                    headers={k: v for k, v in self.headers.items()},
+                    query=urllib.parse.parse_qs(parsed.query,
+                                                keep_blank_values=True),
+                    headers=CIDict(self.headers.items()),
                     body=body)
                 handler = outer._match(self.command, parsed.path)
                 if handler is None:
@@ -85,7 +108,11 @@ class HttpServer:
                 try:
                     self.send_response(resp.status)
                     self.send_header("Content-Type", resp.content_type)
-                    self.send_header("Content-Length", str(len(resp.body)))
+                    # a handler may override Content-Length (HEAD replies
+                    # advertise the real size with an empty body)
+                    explicit_cl = resp.headers.pop("Content-Length", None)
+                    self.send_header("Content-Length",
+                                     explicit_cl or str(len(resp.body)))
                     for k, v in resp.headers.items():
                         self.send_header(k, v)
                     self.end_headers()

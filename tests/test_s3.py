@@ -1,0 +1,299 @@
+"""S3 gateway tests — a SigV4-signing client drives the full API against a
+live master+volume+filer+s3 stack (the reference's test/s3/basic pattern,
+request-level like s3api handler tests)."""
+
+import hashlib
+import json
+import time
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.filer import FilerServer
+from seaweedfs_tpu.master import MasterServer
+from seaweedfs_tpu.s3 import (IdentityAccessManagement, S3ApiServer,
+                              presign_url, sign_v4)
+from seaweedfs_tpu.util.http import http_request
+from seaweedfs_tpu.volume_server import VolumeServer
+
+ACCESS, SECRET = "AKIDEXAMPLE", "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY"
+
+
+class S3Client:
+    """Minimal SigV4 client (the test-side signer)."""
+
+    def __init__(self, endpoint: str, access_key: str = ACCESS,
+                 secret_key: str = SECRET, region: str = "us-east-1"):
+        self.endpoint = endpoint
+        self.access = access_key
+        self.secret = secret_key
+        self.region = region
+
+    def request(self, method: str, path: str, body: bytes = b"",
+                query: dict | None = None, headers: dict | None = None):
+        query = query or {}
+        amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        date = amz_date[:8]
+        payload_hash = hashlib.sha256(body).hexdigest()
+        headers = dict(headers or {})
+        headers.update({
+            "Host": self.endpoint,
+            "X-Amz-Date": amz_date,
+            "X-Amz-Content-Sha256": payload_hash})
+        signed = sorted(h.lower() for h in headers)
+        # sign the on-the-wire (percent-encoded) path, like real SDKs
+        epath = urllib.parse.quote(path, safe="/-_.~")
+        sig = sign_v4(method, epath, query, headers, signed, payload_hash,
+                      amz_date, date, self.region, "s3", self.secret)
+        scope = f"{self.access}/{date}/{self.region}/s3/aws4_request"
+        headers["Authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+        qs = urllib.parse.urlencode(
+            [(k, v if not isinstance(v, list) else v[0])
+             for k, v in query.items()])
+        url = f"http://{self.endpoint}{epath}" + (f"?{qs}" if qs else "")
+        return http_request(url, method=method, body=body or None,
+                            headers=headers)
+
+
+@pytest.fixture()
+def s3stack(tmp_path):
+    master = MasterServer(seed=9)
+    master.start()
+    servers = []
+    for i in range(2):
+        d = tmp_path / f"vol{i}"
+        d.mkdir()
+        vs = VolumeServer(master.grpc_address, [str(d)], pulse_seconds=0.5,
+                          max_volume_counts=[30])
+        vs.start()
+        servers.append(vs)
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topo.data_nodes()) < 2:
+        time.sleep(0.05)
+    filer = FilerServer(master.grpc_address, chunk_size=1 << 20)
+    filer.start()
+    iam = IdentityAccessManagement.from_config({"identities": [
+        {"name": "admin",
+         "credentials": [{"accessKey": ACCESS, "secretKey": SECRET}],
+         "actions": ["Admin"]},
+        {"name": "reader",
+         "credentials": [{"accessKey": "READER", "secretKey": "rsecret"}],
+         "actions": ["Read", "List"]},
+    ]})
+    s3 = S3ApiServer(filer.address, filer.grpc_address, iam=iam)
+    s3.start()
+    client = S3Client(s3.address)
+    yield master, servers, filer, s3, client
+    s3.stop()
+    filer.stop()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def xml_root(body: bytes) -> ET.Element:
+    return ET.fromstring(body)
+
+
+def test_bucket_lifecycle(s3stack):
+    *_, client = s3stack
+    status, _, _ = client.request("PUT", "/mybucket")
+    assert status == 200
+    status, _, _ = client.request("HEAD", "/mybucket")
+    assert status == 200
+    status, body, _ = client.request("GET", "/")
+    names = [b.text for b in xml_root(body).iter("Name")]
+    assert "mybucket" in names
+    status, _, _ = client.request("DELETE", "/mybucket")
+    assert status == 204
+    status, _, _ = client.request("HEAD", "/mybucket")
+    assert status == 404
+
+
+def test_object_put_get_delete(s3stack):
+    *_, client = s3stack
+    client.request("PUT", "/b1")
+    data = b"hello s3 world" * 1000
+    status, _, headers = client.request("PUT", "/b1/dir/hello.txt", data)
+    assert status == 200
+    assert headers["ETag"] == f'"{hashlib.md5(data).hexdigest()}"'
+    status, got, _ = client.request("GET", "/b1/dir/hello.txt")
+    assert status == 200 and got == data
+    # range
+    status, got, _ = client.request("GET", "/b1/dir/hello.txt",
+                                    headers={"Range": "bytes=0-4"})
+    assert status == 206 and got == data[:5]
+    status, _, _ = client.request("DELETE", "/b1/dir/hello.txt")
+    assert status == 204
+    status, body, _ = client.request("GET", "/b1/dir/hello.txt")
+    assert status == 404
+    assert xml_root(body).find("Code").text == "NoSuchKey"
+
+
+def test_list_objects_v1_v2_delimiter(s3stack):
+    *_, client = s3stack
+    client.request("PUT", "/lb")
+    for key in ("a.txt", "docs/x.txt", "docs/y.txt", "pics/cat.jpg"):
+        client.request("PUT", f"/lb/{key}", b"d")
+    # v1 flat
+    status, body, _ = client.request("GET", "/lb")
+    keys = [k.text for k in xml_root(body).iter("Key")]
+    assert keys == ["a.txt", "docs/x.txt", "docs/y.txt", "pics/cat.jpg"]
+    # v2 with delimiter
+    status, body, _ = client.request(
+        "GET", "/lb", query={"list-type": "2", "delimiter": "/"})
+    root = xml_root(body)
+    keys = [k.text for k in root.iter("Key")]
+    prefixes = [p.find("Prefix").text
+                for p in root.iter("CommonPrefixes")]
+    assert keys == ["a.txt"]
+    assert prefixes == ["docs/", "pics/"]
+    # prefix
+    status, body, _ = client.request("GET", "/lb",
+                                     query={"prefix": "docs/"})
+    keys = [k.text for k in xml_root(body).iter("Key")]
+    assert keys == ["docs/x.txt", "docs/y.txt"]
+    # pagination
+    status, body, _ = client.request("GET", "/lb",
+                                     query={"max-keys": "2"})
+    root = xml_root(body)
+    assert root.find("IsTruncated").text == "true"
+    assert len(list(root.iter("Key"))) == 2
+
+
+def test_multipart_upload(s3stack):
+    *_, client = s3stack
+    client.request("PUT", "/mp")
+    status, body, _ = client.request("POST", "/mp/big.bin",
+                                     query={"uploads": ""})
+    upload_id = xml_root(body).find("UploadId").text
+    part1, part2 = b"A" * (2 << 20), b"B" * (1 << 20)
+    for num, part in ((1, part1), (2, part2)):
+        status, _, _ = client.request(
+            "PUT", "/mp/big.bin", part,
+            query={"partNumber": str(num), "uploadId": upload_id})
+        assert status == 200
+    # list parts
+    status, body, _ = client.request("GET", "/mp/big.bin",
+                                     query={"uploadId": upload_id})
+    nums = [int(p.find("PartNumber").text)
+            for p in xml_root(body).iter("Part")]
+    assert nums == [1, 2]
+    status, body, _ = client.request("POST", "/mp/big.bin",
+                                     query={"uploadId": upload_id})
+    assert status == 200
+    status, got, _ = client.request("GET", "/mp/big.bin")
+    assert got == part1 + part2
+    # staging dir gone
+    status, body, _ = client.request("GET", "/mp",
+                                     query={"uploads": ""})
+    assert len(list(xml_root(body).iter("Upload"))) == 0
+
+
+def test_multipart_abort(s3stack):
+    *_, client = s3stack
+    client.request("PUT", "/ab")
+    _, body, _ = client.request("POST", "/ab/x", query={"uploads": ""})
+    upload_id = xml_root(body).find("UploadId").text
+    client.request("PUT", "/ab/x", b"data",
+                   query={"partNumber": "1", "uploadId": upload_id})
+    status, _, _ = client.request("DELETE", "/ab/x",
+                                  query={"uploadId": upload_id})
+    assert status == 204
+    _, body, _ = client.request("GET", "/ab", query={"uploads": ""})
+    assert len(list(xml_root(body).iter("Upload"))) == 0
+
+
+def test_copy_and_multi_delete(s3stack):
+    *_, client = s3stack
+    client.request("PUT", "/cp")
+    client.request("PUT", "/cp/src.txt", b"copy me")
+    status, body, _ = client.request(
+        "PUT", "/cp/dst.txt",
+        headers={"X-Amz-Copy-Source": "/cp/src.txt"})
+    assert status == 200
+    assert xml_root(body).tag == "CopyObjectResult"
+    _, got, _ = client.request("GET", "/cp/dst.txt")
+    assert got == b"copy me"
+    # multi-object delete
+    payload = (b'<Delete><Object><Key>src.txt</Key></Object>'
+               b'<Object><Key>dst.txt</Key></Object></Delete>')
+    status, body, _ = client.request("POST", "/cp", payload,
+                                     query={"delete": ""})
+    deleted = [d.find("Key").text
+               for d in xml_root(body).iter("Deleted")]
+    assert sorted(deleted) == ["dst.txt", "src.txt"]
+    status, _, _ = client.request("GET", "/cp/src.txt")
+    assert status == 404
+
+
+def test_tagging(s3stack):
+    *_, client = s3stack
+    client.request("PUT", "/tg")
+    client.request("PUT", "/tg/o.txt", b"x")
+    tags = (b"<Tagging><TagSet>"
+            b"<Tag><Key>env</Key><Value>prod</Value></Tag>"
+            b"<Tag><Key>team</Key><Value>ml</Value></Tag>"
+            b"</TagSet></Tagging>")
+    status, _, _ = client.request("PUT", "/tg/o.txt", tags,
+                                  query={"tagging": ""})
+    assert status == 200
+    status, body, _ = client.request("GET", "/tg/o.txt",
+                                     query={"tagging": ""})
+    got = {t.find("Key").text: t.find("Value").text
+           for t in xml_root(body).iter("Tag")}
+    assert got == {"env": "prod", "team": "ml"}
+    status, _, _ = client.request("DELETE", "/tg/o.txt",
+                                  query={"tagging": ""})
+    assert status == 204
+    status, body, _ = client.request("GET", "/tg/o.txt",
+                                     query={"tagging": ""})
+    assert len(list(xml_root(body).iter("Tag"))) == 0
+
+
+def test_auth_enforcement(s3stack):
+    *_, s3, client = s3stack[-3], s3stack[-2], s3stack[-1]
+    client.request("PUT", "/auth")
+    client.request("PUT", "/auth/f.txt", b"secret")
+    # bad signature
+    bad = S3Client(s3.address, secret_key="wrong")
+    status, body, _ = bad.request("GET", "/auth/f.txt")
+    assert status == 403
+    assert xml_root(body).find("Code").text == "SignatureDoesNotMatch"
+    # unknown access key
+    unknown = S3Client(s3.address, access_key="NOPE")
+    status, body, _ = unknown.request("GET", "/auth/f.txt")
+    assert xml_root(body).find("Code").text == "InvalidAccessKeyId"
+    # anonymous (no auth header at all) denied
+    status, body, _ = http_request(f"http://{s3.address}/auth/f.txt")
+    assert status == 403
+    # read-only identity can read but not write
+    reader = S3Client(s3.address, access_key="READER",
+                      secret_key="rsecret")
+    status, _, _ = reader.request("GET", "/auth/f.txt")
+    assert status == 200
+    status, body, _ = reader.request("PUT", "/auth/g.txt", b"nope")
+    assert status == 403
+    assert xml_root(body).find("Code").text == "AccessDenied"
+    # reader cannot create buckets (Admin only)
+    status, _, _ = reader.request("PUT", "/newbucket")
+    assert status == 403
+
+
+def test_presigned_url(s3stack):
+    *_, s3, client = s3stack[-3], s3stack[-2], s3stack[-1]
+    client.request("PUT", "/ps")
+    client.request("PUT", "/ps/doc.txt", b"presigned!")
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    url = presign_url(f"http://{s3.address}", "GET", "/ps/doc.txt",
+                      ACCESS, SECRET, amz_date)
+    status, got, _ = http_request(url)
+    assert status == 200 and got == b"presigned!"
+    # tampered signature fails
+    bad = url.replace("X-Amz-Signature=", "X-Amz-Signature=0")
+    status, _, _ = http_request(bad)
+    assert status == 403
