@@ -1,0 +1,331 @@
+"""CLI — `python -m seaweedfs_tpu <command>` (the reference's `weed` binary,
+weed/command/command.go:10-43).
+
+Implemented commands: master, volume, filer, s3, server (all-in-one),
+shell (interactive + -c one-shot), upload, download, delete, benchmark,
+scaffold, version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+
+
+def _wait_forever():
+    try:
+        signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+
+
+def cmd_master(args) -> int:
+    from ..master import MasterServer
+    m = MasterServer(host=args.ip, port=args.port, grpc_port=args.grpc_port,
+                     volume_size_limit_mb=args.volume_size_limit_mb,
+                     default_replication=args.default_replication,
+                     jwt_signing_key=args.jwt_key)
+    m.start()
+    print(f"master http {m.address} grpc {m.grpc_address}")
+    _wait_forever()
+    m.stop()
+    return 0
+
+
+def cmd_volume(args) -> int:
+    from ..volume_server import VolumeServer
+    vs = VolumeServer(args.mserver, args.dir.split(","),
+                      host=args.ip, port=args.port,
+                      grpc_port=args.grpc_port,
+                      data_center=args.data_center, rack=args.rack,
+                      max_volume_counts=[int(c) for c in
+                                         args.max.split(",")],
+                      jwt_signing_key=args.jwt_key)
+    vs.start()
+    print(f"volume server http {vs.url} grpc {vs.grpc_address}")
+    _wait_forever()
+    vs.stop()
+    return 0
+
+
+def cmd_filer(args) -> int:
+    from ..filer import FilerServer
+    f = FilerServer(args.master, host=args.ip, port=args.port,
+                    grpc_port=args.grpc_port,
+                    store_kind=args.store, store_path=args.store_path,
+                    collection=args.collection,
+                    replication=args.default_replication)
+    f.start()
+    print(f"filer http {f.address} grpc {f.grpc_address}")
+    _wait_forever()
+    f.stop()
+    return 0
+
+
+def cmd_s3(args) -> int:
+    from ..s3 import IdentityAccessManagement, S3ApiServer
+    iam = IdentityAccessManagement()
+    if args.config:
+        with open(args.config) as fh:
+            iam = IdentityAccessManagement.from_config(json.load(fh))
+    from ..pb import ServerAddress
+    filer = ServerAddress.parse(args.filer)
+    s3 = S3ApiServer(filer.url, filer.grpc, host=args.ip, port=args.port,
+                     iam=iam)
+    s3.start()
+    print(f"s3 api {s3.address}")
+    _wait_forever()
+    s3.stop()
+    return 0
+
+
+def cmd_server(args) -> int:
+    """All-in-one master + volume + filer (+ s3) (command/server.go)."""
+    from ..filer import FilerServer
+    from ..master import MasterServer
+    from ..s3 import S3ApiServer
+    from ..volume_server import VolumeServer
+    # gRPC rides the http port + 10000 convention (pb/server_address.go)
+    m = MasterServer(host=args.ip, port=args.master_port,
+                     grpc_port=args.master_port + 10000,
+                     jwt_signing_key=args.jwt_key)
+    m.start()
+    vs = VolumeServer(m.grpc_address, args.dir.split(","), host=args.ip,
+                      port=args.volume_port,
+                      max_volume_counts=[int(c) for c in
+                                         args.max.split(",")],
+                      jwt_signing_key=args.jwt_key)
+    vs.start()
+    f = FilerServer(m.grpc_address, host=args.ip, port=args.filer_port,
+                    store_kind=args.filer_store,
+                    store_path=args.filer_store_path)
+    f.start()
+    parts = [f"master {m.address} (grpc {m.grpc_address})",
+             f"volume {vs.url}", f"filer {f.address}"]
+    s3srv = None
+    if args.s3:
+        s3srv = S3ApiServer(f.address, f.grpc_address, host=args.ip,
+                            port=args.s3_port)
+        s3srv.start()
+        parts.append(f"s3 {s3srv.address}")
+    print("server started: " + ", ".join(parts))
+    _wait_forever()
+    if s3srv:
+        s3srv.stop()
+    f.stop()
+    vs.stop()
+    m.stop()
+    return 0
+
+
+def cmd_shell(args) -> int:
+    from ..shell import CommandEnv, ShellError, run_command
+    env = CommandEnv(args.master)
+    if args.command:
+        try:
+            print(run_command(env, args.command))
+            return 0
+        except ShellError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    print("seaweedfs-tpu shell; `help` lists commands, `exit` quits")
+    while True:
+        try:
+            line = input("> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            break
+        if line in ("exit", "quit"):
+            break
+        if not line:
+            continue
+        try:
+            print(run_command(env, line))
+        except ShellError as e:
+            print(f"error: {e}")
+        except Exception as e:
+            print(f"error: {type(e).__name__}: {e}")
+    env.unlock()
+    return 0
+
+
+def cmd_upload(args) -> int:
+    from .. import operation
+    for path in args.files:
+        with open(path, "rb") as fh:
+            data = fh.read()
+        fid = operation.assign_and_upload(
+            args.master, data, replication=args.replication,
+            collection=args.collection, ttl=args.ttl)
+        print(json.dumps({"fileName": path, "fid": fid,
+                          "size": len(data)}))
+    return 0
+
+
+def cmd_download(args) -> int:
+    from .. import operation
+    for fid in args.fids:
+        data = operation.read_file(args.master, fid)
+        out = args.output or fid.replace(",", "_")
+        with open(out, "wb") as fh:
+            fh.write(data)
+        print(f"{fid} -> {out} ({len(data)} bytes)")
+    return 0
+
+
+def cmd_delete(args) -> int:
+    from .. import operation
+    for fid in args.fids:
+        operation.delete_file(args.master, fid)
+        print(f"deleted {fid}")
+    return 0
+
+
+def cmd_benchmark(args) -> int:
+    from .benchmark import run_benchmark
+    run_benchmark(args.master, n_files=args.n, file_size=args.size,
+                  concurrency=args.c, collection=args.collection,
+                  write_only=args.write_only)
+    return 0
+
+
+def cmd_scaffold(args) -> int:
+    """Print sample configs (command/scaffold.go)."""
+    samples = {
+        "s3": {"identities": [{
+            "name": "admin",
+            "credentials": [{"accessKey": "ACCESS_KEY",
+                             "secretKey": "SECRET_KEY"}],
+            "actions": ["Admin"]}]},
+        "filer": {"store": "sqlite", "store_path": "./filer.db"},
+        "security": {"jwt_signing_key": "", "white_list": []},
+    }
+    print(json.dumps(samples.get(args.config, samples), indent=2))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="seaweedfs_tpu",
+        description="TPU-native distributed object store "
+                    "(SeaweedFS-capability framework)")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    m = sub.add_parser("master", help="start a master server")
+    m.add_argument("-ip", default="127.0.0.1")
+    m.add_argument("-port", type=int, default=9333)
+    m.add_argument("-grpc_port", dest="grpc_port", type=int, default=19333)
+    m.add_argument("-volumeSizeLimitMB", dest="volume_size_limit_mb",
+                   type=int, default=30 * 1024)
+    m.add_argument("-defaultReplication", dest="default_replication",
+                   default="000")
+    m.add_argument("-jwtKey", dest="jwt_key", default="",
+                   help="HS256 signing key gating volume writes")
+    m.set_defaults(fn=cmd_master)
+
+    v = sub.add_parser("volume", help="start a volume server")
+    v.add_argument("-ip", default="127.0.0.1")
+    v.add_argument("-port", type=int, default=8080)
+    v.add_argument("-grpc_port", dest="grpc_port", type=int, default=18080)
+    v.add_argument("-dir", default="./data")
+    v.add_argument("-max", default="7")
+    v.add_argument("-mserver", default="127.0.0.1:19333")
+    v.add_argument("-dataCenter", dest="data_center", default="")
+    v.add_argument("-rack", dest="rack", default="")
+    v.add_argument("-jwtKey", dest="jwt_key", default="",
+                   help="HS256 signing key (must match the master's)")
+    v.set_defaults(fn=cmd_volume)
+
+    f = sub.add_parser("filer", help="start a filer server")
+    f.add_argument("-ip", default="127.0.0.1")
+    f.add_argument("-port", type=int, default=8888)
+    f.add_argument("-grpc_port", dest="grpc_port", type=int, default=18888)
+    f.add_argument("-master", default="127.0.0.1:19333")
+    f.add_argument("-store", default="sqlite")
+    f.add_argument("-store_path", dest="store_path", default="./filer.db")
+    f.add_argument("-collection", default="")
+    f.add_argument("-defaultReplication", dest="default_replication",
+                   default="")
+    f.set_defaults(fn=cmd_filer)
+
+    s = sub.add_parser("s3", help="start an S3 gateway")
+    s.add_argument("-ip", default="127.0.0.1")
+    s.add_argument("-port", type=int, default=8333)
+    s.add_argument("-filer", default="127.0.0.1:8888.18888")
+    s.add_argument("-config", default="")
+    s.set_defaults(fn=cmd_s3)
+
+    srv = sub.add_parser("server", help="master + volume + filer (+ s3)")
+    srv.add_argument("-ip", default="127.0.0.1")
+    srv.add_argument("-master.port", dest="master_port", type=int,
+                     default=9333)
+    srv.add_argument("-volume.port", dest="volume_port", type=int,
+                     default=8080)
+    srv.add_argument("-filer.port", dest="filer_port", type=int,
+                     default=8888)
+    srv.add_argument("-s3", action="store_true")
+    srv.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
+    srv.add_argument("-dir", default="./data")
+    srv.add_argument("-max", default="7")
+    srv.add_argument("-filer.store", dest="filer_store", default="sqlite")
+    srv.add_argument("-filer.store_path", dest="filer_store_path",
+                     default="./filer.db")
+    srv.add_argument("-jwtKey", dest="jwt_key", default="")
+    srv.set_defaults(fn=cmd_server)
+
+    sh = sub.add_parser("shell", help="maintenance shell")
+    sh.add_argument("-master", default="127.0.0.1:19333",
+                    help="master gRPC address")
+    sh.add_argument("-c", dest="command", default="",
+                    help="run one command and exit")
+    sh.set_defaults(fn=cmd_shell)
+
+    up = sub.add_parser("upload", help="upload files")
+    up.add_argument("-master", default="127.0.0.1:19333")
+    up.add_argument("-replication", default="")
+    up.add_argument("-collection", default="")
+    up.add_argument("-ttl", default="")
+    up.add_argument("files", nargs="+")
+    up.set_defaults(fn=cmd_upload)
+
+    dl = sub.add_parser("download", help="download files by fid")
+    dl.add_argument("-master", default="127.0.0.1:19333")
+    dl.add_argument("-o", dest="output", default="")
+    dl.add_argument("fids", nargs="+")
+    dl.set_defaults(fn=cmd_download)
+
+    rm = sub.add_parser("delete", help="delete files by fid")
+    rm.add_argument("-master", default="127.0.0.1:19333")
+    rm.add_argument("fids", nargs="+")
+    rm.set_defaults(fn=cmd_delete)
+
+    b = sub.add_parser("benchmark",
+                       help="load-test a cluster (command/benchmark.go)")
+    b.add_argument("-master", default="127.0.0.1:19333")
+    b.add_argument("-n", type=int, default=10000)
+    b.add_argument("-size", type=int, default=1024)
+    b.add_argument("-c", type=int, default=16)
+    b.add_argument("-collection", default="")
+    b.add_argument("-writeOnly", dest="write_only", action="store_true")
+    b.set_defaults(fn=cmd_benchmark)
+
+    sc = sub.add_parser("scaffold", help="print sample configs")
+    sc.add_argument("-config", default="")
+    sc.set_defaults(fn=cmd_scaffold)
+
+    ver = sub.add_parser("version")
+    ver.set_defaults(fn=lambda a: print("seaweedfs-tpu 0.1 "
+                                        "(capability target SeaweedFS 2.96)")
+                     or 0)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args) or 0

@@ -1,0 +1,136 @@
+"""Built-in load generator — capability-equivalent to `weed benchmark`
+(weed/command/benchmark.go:75-590): write N small files with C concurrent
+workers, then read them back randomly; report throughput and latency
+percentiles in the reference's output shape.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import numpy as np
+
+from .. import operation
+
+
+class _Stats:
+    def __init__(self):
+        self.latencies: list[float] = []
+        self.bytes = 0
+        self.failed = 0
+        self._lock = threading.Lock()
+
+    def add(self, latency: float, nbytes: int) -> None:
+        with self._lock:
+            self.latencies.append(latency)
+            self.bytes += nbytes
+
+    def fail(self) -> None:
+        with self._lock:
+            self.failed += 1
+
+    def report(self, label: str, wall: float) -> dict:
+        lats = np.array(self.latencies) * 1000.0  # ms
+        n = len(lats)
+        out = {
+            "label": label, "requests": n, "failed": self.failed,
+            "seconds": round(wall, 2),
+            "req_per_sec": round(n / wall, 1) if wall else 0.0,
+            "mb_per_sec": round(self.bytes / wall / 1e6, 2) if wall else 0.0,
+        }
+        if n:
+            out.update({
+                "avg_ms": round(float(lats.mean()), 2),
+                "p50_ms": round(float(np.percentile(lats, 50)), 2),
+                "p95_ms": round(float(np.percentile(lats, 95)), 2),
+                "p99_ms": round(float(np.percentile(lats, 99)), 2),
+                "max_ms": round(float(lats.max()), 2),
+            })
+        return out
+
+
+def _run_workers(n_workers: int, task) -> None:
+    threads = [threading.Thread(target=task, args=(w,), daemon=True)
+               for w in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def run_benchmark(master_grpc: str, n_files: int = 10000,
+                  file_size: int = 1024, concurrency: int = 16,
+                  collection: str = "", write_only: bool = False,
+                  quiet: bool = False) -> dict:
+    rng = random.Random(0)
+    payload = bytes(rng.getrandbits(8) for _ in range(file_size))
+    fids: list[str] = []
+    fid_lock = threading.Lock()
+    results: dict = {}
+
+    stats = _Stats()
+    counter = iter(range(n_files))
+    counter_lock = threading.Lock()
+
+    def writer(w: int) -> None:
+        while True:
+            with counter_lock:
+                i = next(counter, None)
+            if i is None:
+                return
+            t0 = time.time()
+            try:
+                fid = operation.assign_and_upload(
+                    master_grpc, payload, collection=collection)
+                stats.add(time.time() - t0, file_size)
+                with fid_lock:
+                    fids.append(fid)
+            except Exception:
+                stats.fail()
+
+    t0 = time.time()
+    _run_workers(concurrency, writer)
+    results["write"] = stats.report("write", time.time() - t0)
+    if not quiet:
+        _print_report(results["write"], file_size, concurrency)
+
+    if not write_only and fids:
+        stats = _Stats()
+        reads = iter(range(len(fids)))
+        read_lock = threading.Lock()
+
+        def reader(w: int) -> None:
+            r = random.Random(w)
+            while True:
+                with read_lock:
+                    i = next(reads, None)
+                if i is None:
+                    return
+                fid = r.choice(fids)
+                t0 = time.time()
+                try:
+                    data = operation.read_file(master_grpc, fid)
+                    stats.add(time.time() - t0, len(data))
+                except Exception:
+                    stats.fail()
+
+        t0 = time.time()
+        _run_workers(concurrency, reader)
+        results["read"] = stats.report("read", time.time() - t0)
+        if not quiet:
+            _print_report(results["read"], file_size, concurrency)
+    return results
+
+
+def _print_report(r: dict, file_size: int, concurrency: int) -> None:
+    print(f"\n--- {r['label']} ({r['requests']} x {file_size}B, "
+          f"c={concurrency}) ---")
+    print(f"Requests per second: {r['req_per_sec']} "
+          f"({r['mb_per_sec']} MB/s)")
+    if "avg_ms" in r:
+        print(f"Avg latency: {r['avg_ms']}ms   p50 {r['p50_ms']}ms   "
+              f"p95 {r['p95_ms']}ms   p99 {r['p99_ms']}ms   "
+              f"max {r['max_ms']}ms")
+    print(f"Failed: {r['failed']}")

@@ -136,7 +136,7 @@ class FilerServer:
         r = operation.assign(self.master_grpc,
                              replication=self.replication,
                              collection=self.collection)
-        out = operation.upload_data(r.url, r.fid, data)
+        out = operation.upload_data(r.url, r.fid, data, jwt=r.auth)
         return FileChunk(file_id=r.fid, offset=offset, size=len(data),
                          modified_ts_ns=ts_ns, etag=out.get("eTag", ""))
 
@@ -144,7 +144,7 @@ class FilerServer:
         r = operation.assign(self.master_grpc,
                              replication=self.replication,
                              collection=self.collection)
-        out = operation.upload_data(r.url, r.fid, data)
+        out = operation.upload_data(r.url, r.fid, data, jwt=r.auth)
         return r.fid, out.get("eTag", "")
 
     def _read_chunk_blob(self, fid: str) -> bytes:

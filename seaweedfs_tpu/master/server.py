@@ -55,12 +55,16 @@ class MasterServer:
                  volume_size_limit_mb: int = 30 * 1024,
                  default_replication: str = "000",
                  garbage_threshold: float = 0.3,
+                 jwt_signing_key: str = "",
+                 jwt_expires_seconds: int = 10,
                  seed: int | None = None):
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024, seed=seed)
         self.sequencer = MemorySequencer()
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
+        self.jwt_signing_key = jwt_signing_key
+        self.jwt_expires_seconds = jwt_expires_seconds
         self.is_leader = True
         self._rng = random.Random(seed)
         self._grow_lock = threading.Lock()
@@ -123,15 +127,23 @@ class MasterServer:
             raise RpcError(f"no writable volumes: {e}") from None
         key = self.sequencer.next_file_id(count)
         cookie = self._rng.getrandbits(32)
+        from ..stats import MASTER_ASSIGN_COUNTER
         from ..storage.types import format_needle_id_cookie
+        MASTER_ASSIGN_COUNTER.inc()
         fid = f"{vid},{format_needle_id_cookie(key, cookie)}"
         main = nodes[0]
-        return {
+        out = {
             "fid": fid, "count": count,
             "url": main.url, "public_url": main.public_url,
             "replicas": [{"url": dn.url, "public_url": dn.public_url}
                          for dn in nodes[1:]],
         }
+        if self.jwt_signing_key:
+            # sign the write authorization (master_server_handlers.go:146)
+            from ..security import gen_jwt
+            out["auth"] = gen_jwt(self.jwt_signing_key,
+                                  self.jwt_expires_seconds, fid)
+        return out
 
     def _grow(self, option: VolumeGrowOption) -> None:
         """Synchronous growth (the reference queues into vgCh and blocks the
@@ -308,11 +320,21 @@ class MasterServer:
         return {"vacuumed": vacuum_mod.vacuum(self.topo, threshold)}
 
     def _rpc_lookup_volume(self, req: dict) -> dict:
+        from ..stats import MASTER_LOOKUP_COUNTER
+        MASTER_LOOKUP_COUNTER.inc()
         out = {}
         for vid_s in req.get("volume_or_file_ids", []):
             vid = int(str(vid_s).split(",")[0])
-            out[str(vid_s)] = {
-                "locations": self.lookup(vid, req.get("collection", ""))}
+            entry = {"locations": self.lookup(vid,
+                                              req.get("collection", ""))}
+            if self.jwt_signing_key and "," in str(vid_s):
+                # writes/deletes against a looked-up fid need a token too
+                # (the reference signs on lookup for the delete path)
+                from ..security import gen_jwt
+                entry["auth"] = gen_jwt(self.jwt_signing_key,
+                                        self.jwt_expires_seconds,
+                                        str(vid_s))
+            out[str(vid_s)] = entry
         return {"volume_id_locations": out}
 
     def _rpc_lookup_ec_volume(self, req: dict) -> dict:
@@ -333,6 +355,7 @@ class MasterServer:
         self.http.route("GET", "/cluster/status", self._http_cluster_status)
         self.http.route("GET", "/vol/status", self._http_vol_status)
         self.http.route("*", "/vol/vacuum", self._http_vol_vacuum)
+        self.http.route("GET", "/metrics", self._http_metrics)
 
     def _http_assign(self, req: Request) -> Response:
         try:
@@ -368,6 +391,11 @@ class MasterServer:
 
     def _http_vol_status(self, req: Request) -> Response:
         return Response.json({"Topology": self.topo.to_dict()})
+
+    def _http_metrics(self, req: Request) -> Response:
+        from ..stats import REGISTRY
+        return Response(200, REGISTRY.render().encode(),
+                        content_type="text/plain; version=0.0.4")
 
     def _http_vol_vacuum(self, req: Request) -> Response:
         """Trigger a cluster vacuum sweep (master_server_handlers_admin.go

@@ -22,6 +22,7 @@ class AssignResult:
     public_url: str
     count: int
     replicas: list[dict] = field(default_factory=list)
+    auth: str = ""  # master-signed write JWT (security/jwt.go)
 
 
 def assign(master_grpc: str, count: int = 1, replication: str = "",
@@ -33,14 +34,17 @@ def assign(master_grpc: str, count: int = 1, replication: str = "",
         "collection": collection, "ttl": ttl, "data_center": data_center})
     return AssignResult(fid=out["fid"], url=out["url"],
                         public_url=out["public_url"], count=out["count"],
-                        replicas=out.get("replicas", []))
+                        replicas=out.get("replicas", []),
+                        auth=out.get("auth", ""))
 
 
 def upload_data(url_or_server: str, fid: str, data: bytes,
-                name: str = "", mime: str = "", ttl: str = "") -> dict:
+                name: str = "", mime: str = "", ttl: str = "",
+                jwt: str = "") -> dict:
     import urllib.parse
     qs = urllib.parse.urlencode(
-        [(k, v) for k, v in (("name", name), ("mime", mime), ("ttl", ttl))
+        [(k, v) for k, v in (("name", name), ("mime", mime), ("ttl", ttl),
+                             ("jwt", jwt))
          if v])
     target = f"http://{url_or_server}/{fid}" + (f"?{qs}" if qs else "")
     status, body, _ = http_request(target, method="POST", body=data)
@@ -54,7 +58,7 @@ def upload_data(url_or_server: str, fid: str, data: bytes,
 def assign_and_upload(master_grpc: str, data: bytes, **kw) -> str:
     """-> fid (the one-call `weed upload` path)."""
     r = assign(master_grpc, **kw)
-    upload_data(r.url, r.fid, data)
+    upload_data(r.url, r.fid, data, jwt=r.auth)
     return r.fid
 
 
@@ -81,10 +85,21 @@ def read_file(master_grpc: str, fid: str) -> bytes:
 
 
 def delete_file(master_grpc: str, fid: str) -> None:
-    vid = int(fid.split(",")[0])
-    for loc in lookup_volume(master_grpc, vid):
-        http_request(f"http://{loc['url']}/{fid}", method="DELETE")
-        return
+    """Delete via the first replica holder (the holder fans out).  Looks up
+    by FULL fid so a JWT-secured master issues a delete token."""
+    client = POOL.client(master_grpc, "Seaweed")
+    out = client.call("LookupVolume", {"volume_or_file_ids": [fid]})
+    entry = out["volume_id_locations"].get(fid, {})
+    locs = entry.get("locations", [])
+    jwt = entry.get("auth", "")
+    if not locs:
+        raise RuntimeError(f"delete {fid}: no locations")
+    url = f"http://{locs[0]['url']}/{fid}"
+    if jwt:
+        url += f"?jwt={jwt}"
+    status, body, _ = http_request(url, method="DELETE")
+    if status >= 300 and status != 404:
+        raise RuntimeError(f"delete {fid}: HTTP {status} {body[:120]!r}")
 
 
 def delete_files(volume_server_grpc: str, fids: list[str]) -> list[dict]:

@@ -1,0 +1,107 @@
+"""Security — HS256 JWT for volume writes + IP guard.
+
+Capability-equivalent to weed/security/jwt.go:16-50 + guard.go: the master
+signs a short-lived token scoped to a file id when it assigns it
+(master_server_handlers.go:146); the volume server requires a valid token
+on write/delete when a signing key is configured
+(volume_server_handlers_write.go:41).  JWTs are hand-rolled HS256
+(header.payload.signature, base64url) — same wire format as the reference's
+golang-jwt tokens.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from dataclasses import dataclass, field
+
+
+def _b64url(raw: bytes) -> str:
+    return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+
+
+def _unb64url(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def gen_jwt(signing_key: str, expires_seconds: int, fid: str) -> str:
+    """GenJwt (security/jwt.go:34-50); empty key -> no token."""
+    if not signing_key:
+        return ""
+    header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"},
+                                separators=(",", ":")).encode())
+    claims = {"Fid": fid}
+    if expires_seconds > 0:
+        claims["exp"] = int(time.time()) + expires_seconds
+    payload = _b64url(json.dumps(claims, separators=(",", ":")).encode())
+    msg = f"{header}.{payload}".encode()
+    sig = _b64url(hmac.new(signing_key.encode(), msg,
+                           hashlib.sha256).digest())
+    return f"{header}.{payload}.{sig}"
+
+
+class JwtError(Exception):
+    pass
+
+
+def decode_jwt(signing_key: str, token: str) -> dict:
+    """-> claims; raises JwtError on bad signature/expiry
+    (security/jwt.go DecodeJwt)."""
+    try:
+        header, payload, sig = token.split(".")
+    except ValueError:
+        raise JwtError("malformed token") from None
+    msg = f"{header}.{payload}".encode()
+    want = _b64url(hmac.new(signing_key.encode(), msg,
+                            hashlib.sha256).digest())
+    if not hmac.compare_digest(want, sig):
+        raise JwtError("bad signature")
+    claims = json.loads(_unb64url(payload))
+    if "exp" in claims and time.time() > claims["exp"]:
+        raise JwtError("token expired")
+    return claims
+
+
+def verify_fid_jwt(signing_key: str, token: str, fid: str) -> None:
+    """The volume-server write gate: token must be valid AND scoped to this
+    fid (or a whole-volume token, vid only)."""
+    claims = decode_jwt(signing_key, token)
+    claimed = claims.get("Fid", "")
+    if claimed and claimed != fid and claimed != fid.split(",")[0]:
+        raise JwtError(f"token is for {claimed}, not {fid}")
+
+
+@dataclass
+class Guard:
+    """IP white-list + signing keys for a server role
+    (security/guard.go)."""
+    white_list: list[str] = field(default_factory=list)
+    signing_key: str = ""
+    expires_seconds: int = 10
+    read_signing_key: str = ""
+    read_expires_seconds: int = 60
+
+    def is_secured(self) -> bool:
+        return bool(self.white_list or self.signing_key)
+
+    def check_white_list(self, peer_ip: str) -> bool:
+        if not self.white_list:
+            return True
+        import ipaddress
+        try:
+            ip = ipaddress.ip_address(peer_ip)
+        except ValueError:
+            return False
+        for allowed in self.white_list:
+            try:
+                if "/" in allowed:
+                    if ip in ipaddress.ip_network(allowed, strict=False):
+                        return True
+                elif ip == ipaddress.ip_address(allowed):
+                    return True
+            except ValueError:
+                continue
+        return False

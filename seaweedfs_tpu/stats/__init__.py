@@ -1,0 +1,146 @@
+"""Metrics — prometheus-style counters/gauges/histograms with a text
+exposition endpoint.
+
+Capability-equivalent to weed/stats/metrics.go:23-160: per-subsystem
+request counters and latency histograms, volume/disk gauges, served at
+GET /metrics in the standard text format (pull model; the reference also
+supports push-gateway, which is a cron posting this same text).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+_BUCKETS = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0]
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._values: dict[tuple, float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def inc(self, *labels, value: float = 1.0) -> None:
+        with self._lock:
+            self._values[labels] += value
+
+    def value(self, *labels) -> float:
+        return self._values.get(labels, 0.0)
+
+    def render(self, label_names: list[str]) -> str:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for labels, v in items:
+            sel = ",".join(f'{n}="{l}"'
+                           for n, l in zip(label_names, labels))
+            out.append(f"{self.name}{{{sel}}} {v}" if sel
+                       else f"{self.name} {v}")
+        return "\n".join(out)
+
+
+class Gauge(Counter):
+    def set(self, *labels, value: float) -> None:
+        with self._lock:
+            self._values[labels] = value
+
+    def render(self, label_names: list[str]) -> str:
+        return super().render(label_names).replace(" counter", " gauge", 1)
+
+
+class Histogram:
+    def __init__(self, name: str, help_text: str,
+                 buckets: list[float] | None = None):
+        self.name = name
+        self.help = help_text
+        self.buckets = buckets or _BUCKETS
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = defaultdict(float)
+        self._totals: dict[tuple, int] = defaultdict(int)
+        self._lock = threading.Lock()
+
+    def observe(self, *labels, value: float) -> None:
+        with self._lock:
+            counts = self._counts.setdefault(
+                labels, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[labels] += value
+            self._totals[labels] += 1
+
+    def render(self, label_names: list[str]) -> str:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            items = [(labels, list(counts), self._sums[labels],
+                      self._totals[labels])
+                     for labels, counts in sorted(self._counts.items())]
+        for labels, counts, label_sum, label_total in items:
+            base = ",".join(f'{n}="{l}"'
+                            for n, l in zip(label_names, labels))
+            for b, c in zip(self.buckets, counts):
+                sel = (base + "," if base else "") + f'le="{b}"'
+                out.append(f"{self.name}_bucket{{{sel}}} {c}")
+            sel_inf = (base + "," if base else "") + 'le="+Inf"'
+            out.append(f"{self.name}_bucket{{{sel_inf}}} {label_total}")
+            sfx = f"{{{base}}}" if base else ""
+            out.append(f"{self.name}_sum{sfx} {label_sum}")
+            out.append(f"{self.name}_count{sfx} {label_total}")
+        return "\n".join(out)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list[tuple[object, list[str]]] = []
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_text: str,
+                label_names: list[str] | None = None) -> Counter:
+        c = Counter(name, help_text)
+        with self._lock:
+            self._metrics.append((c, label_names or []))
+        return c
+
+    def gauge(self, name: str, help_text: str,
+              label_names: list[str] | None = None) -> Gauge:
+        g = Gauge(name, help_text)
+        with self._lock:
+            self._metrics.append((g, label_names or []))
+        return g
+
+    def histogram(self, name: str, help_text: str,
+                  label_names: list[str] | None = None) -> Histogram:
+        h = Histogram(name, help_text)
+        with self._lock:
+            self._metrics.append((h, label_names or []))
+        return h
+
+    def render(self) -> str:
+        with self._lock:
+            return "\n".join(m.render(names)
+                             for m, names in self._metrics) + "\n"
+
+
+# the global registry + the reference's metric families (stats/metrics.go)
+REGISTRY = Registry()
+
+MASTER_ASSIGN_COUNTER = REGISTRY.counter(
+    "seaweedfs_master_assign_total", "master assign requests")
+MASTER_LOOKUP_COUNTER = REGISTRY.counter(
+    "seaweedfs_master_lookup_total", "master lookup requests")
+VOLUME_REQUEST_COUNTER = REGISTRY.counter(
+    "seaweedfs_volume_request_total", "volume server requests", ["type"])
+VOLUME_REQUEST_HISTOGRAM = REGISTRY.histogram(
+    "seaweedfs_volume_request_seconds", "volume request latency", ["type"])
+FILER_REQUEST_COUNTER = REGISTRY.counter(
+    "seaweedfs_filer_request_total", "filer requests", ["type"])
+FILER_REQUEST_HISTOGRAM = REGISTRY.histogram(
+    "seaweedfs_filer_request_seconds", "filer request latency", ["type"])
+S3_REQUEST_COUNTER = REGISTRY.counter(
+    "seaweedfs_s3_request_total", "s3 requests", ["action"])
+VOLUME_COUNT_GAUGE = REGISTRY.gauge(
+    "seaweedfs_volume_server_volumes", "volumes on this server")

@@ -45,10 +45,12 @@ class VolumeServer:
                  host: str = "127.0.0.1", port: int = 0, grpc_port: int = 0,
                  public_url: str = "", data_center: str = "", rack: str = "",
                  max_volume_counts: list[int] | None = None,
-                 pulse_seconds: float = PULSE_SECONDS):
+                 pulse_seconds: float = PULSE_SECONDS,
+                 jwt_signing_key: str = ""):
         self.master_grpc = master_grpc
         self.data_center = data_center
         self.rack = rack
+        self.jwt_signing_key = jwt_signing_key
         self.pulse_seconds = pulse_seconds
         self.store = Store(directories, max_volume_counts)
         self.http = HttpServer(host, port)
@@ -151,7 +153,33 @@ class VolumeServer:
     # -- HTTP data path ----------------------------------------------------
     def _register_http(self) -> None:
         self.http.route("GET", "/status", self._http_status)
+        self.http.route("GET", "/metrics", self._http_metrics)
         self.http.route("*", "/", self._http_data)
+
+    def _http_metrics(self, req: Request) -> Response:
+        from ..stats import REGISTRY, VOLUME_COUNT_GAUGE
+        total = sum(len(loc.volumes) for loc in self.store.locations)
+        VOLUME_COUNT_GAUGE.set(value=total)
+        return Response(200, REGISTRY.render().encode(),
+                        content_type="text/plain; version=0.0.4")
+
+    def _check_jwt(self, req: Request, fid: FileId) -> "Response | None":
+        """Write gate (volume_server_handlers_write.go:41): when a signing
+        key is configured, writes/deletes need a master-issued token."""
+        if not self.jwt_signing_key:
+            return None
+        from ..security import JwtError, verify_fid_jwt
+        token = req.qs("jwt")
+        auth = req.headers.get("Authorization", "")
+        if not token and auth.startswith("BEARER "):
+            token = auth[7:]
+        if not token and auth.startswith("Bearer "):
+            token = auth[7:]
+        try:
+            verify_fid_jwt(self.jwt_signing_key, token, str(fid))
+        except JwtError as e:
+            return Response.error(f"jwt: {e}", 401)
+        return None
 
     def _http_status(self, req: Request) -> Response:
         hb = self.store.collect_heartbeat()
@@ -180,6 +208,10 @@ class VolumeServer:
         return Response.error("method not allowed", 405)
 
     def _read_needle(self, fid: FileId, req: Request) -> Response:
+        from ..stats import (VOLUME_REQUEST_COUNTER,
+                             VOLUME_REQUEST_HISTOGRAM)
+        t0 = time.time()
+        VOLUME_REQUEST_COUNTER.inc("read")
         try:
             if self.store.has_volume(fid.volume_id):
                 n = self.store.read_volume_needle(fid.volume_id, fid.key,
@@ -199,6 +231,7 @@ class VolumeServer:
             headers["X-File-Name"] = n.name.decode(errors="replace")
         mime = (n.mime.decode(errors="replace")
                 if n.has_mime() else "application/octet-stream")
+        VOLUME_REQUEST_HISTOGRAM.observe("read", value=time.time() - t0)
         return Response(200, bytes(n.data), content_type=mime,
                         headers=headers)
 
@@ -217,6 +250,12 @@ class VolumeServer:
             "Location": f"http://{locs[0]['public_url']}/{fid}"})
 
     def _write_needle(self, fid: FileId, req: Request) -> Response:
+        from ..stats import (VOLUME_REQUEST_COUNTER,
+                             VOLUME_REQUEST_HISTOGRAM)
+        t0 = time.time()
+        denied = self._check_jwt(req, fid)
+        if denied is not None:
+            return denied
         v = self.store.find_volume(fid.volume_id)
         if v is None:
             return Response.error(f"volume {fid.volume_id} not local", 404)
@@ -233,10 +272,17 @@ class VolumeServer:
             err = self._replicate(fid, req, "POST", req.body)
             if err:
                 return Response.error(f"replication failed: {err}", 500)
+        VOLUME_REQUEST_COUNTER.inc("write")
+        VOLUME_REQUEST_HISTOGRAM.observe("write", value=time.time() - t0)
         return Response.json({"name": req.qs("name"), "size": size,
                               "eTag": n.etag()}, status=201)
 
     def _delete_needle(self, fid: FileId, req: Request) -> Response:
+        from ..stats import VOLUME_REQUEST_COUNTER
+        denied = self._check_jwt(req, fid)
+        if denied is not None:
+            return denied
+        VOLUME_REQUEST_COUNTER.inc("delete")
         if self.store.has_volume(fid.volume_id):
             size = self.store.delete_volume_needle(fid.volume_id, fid.key,
                                                    fid.cookie)
@@ -286,9 +332,12 @@ class VolumeServer:
         locs = self._replica_locations(fid.volume_id)
         errors = []
         qs = "type=replicate"
-        for arg in ("name", "mime", "ttl"):
+        for arg in ("name", "mime", "ttl", "jwt"):
             if req.qs(arg):
                 qs += f"&{arg}={urllib.parse.quote(req.qs(arg), safe='')}"
+        auth = req.headers.get("Authorization", "")
+        if "jwt=" not in qs and auth[:7] in ("BEARER ", "Bearer "):
+            qs += f"&jwt={urllib.parse.quote(auth[7:], safe='')}"
         threads = []
 
         def send(url):

@@ -1,0 +1,78 @@
+"""CLI + benchmark tests: drive `python -m seaweedfs_tpu` commands against
+an in-process cluster (upload/download/delete/shell -c/benchmark)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.command import main
+from seaweedfs_tpu.command.benchmark import run_benchmark
+from seaweedfs_tpu.master import MasterServer
+from seaweedfs_tpu.volume_server import VolumeServer
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    master = MasterServer(seed=13)
+    master.start()
+    servers = []
+    for i in range(2):
+        d = tmp_path / f"vol{i}"
+        d.mkdir()
+        vs = VolumeServer(master.grpc_address, [str(d)], pulse_seconds=0.5,
+                          max_volume_counts=[30])
+        vs.start()
+        servers.append(vs)
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topo.data_nodes()) < 2:
+        time.sleep(0.05)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def test_upload_download_delete_cli(cluster, tmp_path, capsys):
+    master, _ = cluster
+    src = tmp_path / "in.bin"
+    src.write_bytes(os.urandom(4096))
+    assert main(["upload", "-master", master.grpc_address,
+                 str(src)]) == 0
+    fid = json.loads(capsys.readouterr().out.strip())["fid"]
+    os.chdir(tmp_path)
+    assert main(["download", "-master", master.grpc_address,
+                 "-o", "out.bin", fid]) == 0
+    assert (tmp_path / "out.bin").read_bytes() == src.read_bytes()
+    assert main(["delete", "-master", master.grpc_address, fid]) == 0
+    with pytest.raises(RuntimeError):
+        from seaweedfs_tpu import operation
+        operation.read_file(master.grpc_address, fid)
+
+
+def test_shell_oneshot_cli(cluster, capsys):
+    master, _ = cluster
+    assert main(["shell", "-master", master.grpc_address,
+                 "-c", "cluster.ps"]) == 0
+    out = capsys.readouterr().out
+    assert out.count("volume server") == 2
+
+
+def test_scaffold_and_version(capsys):
+    assert main(["scaffold", "-config", "s3"]) == 0
+    cfg = json.loads(capsys.readouterr().out)
+    assert cfg["identities"][0]["name"] == "admin"
+    assert main(["version"]) == 0
+
+
+def test_benchmark(cluster):
+    master, _ = cluster
+    results = run_benchmark(master.grpc_address, n_files=100,
+                            file_size=512, concurrency=8, quiet=True)
+    assert results["write"]["requests"] == 100
+    assert results["write"]["failed"] == 0
+    assert results["write"]["req_per_sec"] > 0
+    assert results["read"]["requests"] == 100
+    assert results["read"]["failed"] == 0
+    assert "p99_ms" in results["read"]

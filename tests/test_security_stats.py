@@ -1,0 +1,161 @@
+"""JWT write protection + prometheus metrics tests."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.master import MasterServer
+from seaweedfs_tpu.security import (Guard, JwtError, decode_jwt, gen_jwt,
+                                    verify_fid_jwt)
+from seaweedfs_tpu.stats import Registry
+from seaweedfs_tpu.util.http import http_request
+from seaweedfs_tpu.volume_server import VolumeServer
+
+KEY = "test-signing-key"
+
+
+# -- jwt unit --------------------------------------------------------------
+
+def test_jwt_roundtrip():
+    token = gen_jwt(KEY, 10, "3,01ab")
+    claims = decode_jwt(KEY, token)
+    assert claims["Fid"] == "3,01ab"
+    verify_fid_jwt(KEY, token, "3,01ab")
+    with pytest.raises(JwtError):
+        verify_fid_jwt(KEY, token, "4,ffff")
+    with pytest.raises(JwtError):
+        decode_jwt("other-key", token)
+
+
+def test_jwt_expiry():
+    token = gen_jwt(KEY, 1, "1,aa")
+    decode_jwt(KEY, token)  # valid now
+    time.sleep(1.1)
+    with pytest.raises(JwtError):
+        decode_jwt(KEY, token)  # expired
+    # expires_seconds=0 means no expiry (security/jwt.go behavior)
+    decode_jwt(KEY, gen_jwt(KEY, 0, "1,aa"))
+
+
+def test_jwt_empty_key_disabled():
+    assert gen_jwt("", 10, "x") == ""
+
+
+def test_guard_whitelist():
+    g = Guard(white_list=["10.0.0.5", "192.168.1.0/24"])
+    assert g.check_white_list("10.0.0.5")
+    assert g.check_white_list("192.168.1.77")
+    assert not g.check_white_list("10.0.0.6")
+    assert Guard().check_white_list("anything")
+
+
+# -- metrics unit ----------------------------------------------------------
+
+def test_metrics_render():
+    reg = Registry()
+    c = reg.counter("test_total", "test counter", ["op"])
+    c.inc("read")
+    c.inc("read")
+    c.inc("write")
+    h = reg.histogram("test_seconds", "latency", ["op"])
+    h.observe("read", value=0.003)
+    h.observe("read", value=0.7)
+    g = reg.gauge("test_gauge", "g")
+    g.set(value=42)
+    text = reg.render()
+    assert 'test_total{op="read"} 2.0' in text
+    assert 'test_total{op="write"} 1.0' in text
+    assert "# TYPE test_total counter" in text
+    assert "# TYPE test_seconds histogram" in text
+    assert 'test_seconds_bucket{op="read",le="0.005"} 1' in text
+    assert 'test_seconds_count{op="read"} 2' in text
+    assert "test_gauge 42" in text
+
+
+# -- secured cluster -------------------------------------------------------
+
+@pytest.fixture()
+def secured_cluster(tmp_path):
+    master = MasterServer(seed=17, jwt_signing_key=KEY)
+    master.start()
+    servers = []
+    for i in range(2):
+        d = tmp_path / f"vol{i}"
+        d.mkdir()
+        vs = VolumeServer(master.grpc_address, [str(d)], pulse_seconds=0.5,
+                          max_volume_counts=[30], jwt_signing_key=KEY)
+        vs.start()
+        servers.append(vs)
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topo.data_nodes()) < 2:
+        time.sleep(0.05)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def test_secured_write_requires_jwt(secured_cluster):
+    master, servers = secured_cluster
+    r = operation.assign(master.grpc_address)
+    assert r.auth  # master issued a token
+    # unauthenticated write rejected
+    status, _, _ = http_request(f"http://{r.url}/{r.fid}",
+                                method="POST", body=b"no token")
+    assert status == 401
+    # with the token it works
+    out = operation.upload_data(r.url, r.fid, b"signed!", jwt=r.auth)
+    assert out["size"] > 0
+    # reads are open
+    assert operation.read_file(master.grpc_address, r.fid) == b"signed!"
+    # unauthenticated delete rejected
+    status, _, _ = http_request(f"http://{r.url}/{r.fid}",
+                                method="DELETE")
+    assert status == 401
+
+
+def test_secured_replicated_write(secured_cluster):
+    master, servers = secured_cluster
+    r = operation.assign(master.grpc_address, replication="001")
+    operation.upload_data(r.url, r.fid, b"secure replica", jwt=r.auth)
+    vid = int(r.fid.split(",")[0])
+    key = int(r.fid.split(",")[1][:-8], 16)
+    holders = [vs for vs in servers
+               if vs.store.has_volume(vid)
+               and vs.store.find_volume(vid).has_needle(key)]
+    assert len(holders) == 2  # jwt was forwarded to the replica
+
+
+def test_secured_delete_via_lookup_token(secured_cluster):
+    """Deletes obtain a token from LookupVolume on the full fid."""
+    master, servers = secured_cluster
+    r = operation.assign(master.grpc_address)
+    operation.upload_data(r.url, r.fid, b"to delete", jwt=r.auth)
+    operation.delete_file(master.grpc_address, r.fid)
+    with pytest.raises(RuntimeError):
+        operation.read_file(master.grpc_address, r.fid)
+
+
+def test_guard_invalid_ip():
+    g = Guard(white_list=["192.168.1.0/24"])
+    assert not g.check_white_list("192.1685.0.1")
+    assert not g.check_white_list("not-an-ip")
+    assert not g.check_white_list("192.168.200.9")
+    assert g.check_white_list("192.168.1.200")
+
+
+def test_metrics_endpoint(secured_cluster):
+    master, servers = secured_cluster
+    fid = None
+    r = operation.assign(master.grpc_address)
+    operation.upload_data(r.url, r.fid, b"metric", jwt=r.auth)
+    operation.read_file(master.grpc_address, r.fid)
+    status, body, _ = http_request(f"http://{master.address}/metrics")
+    assert status == 200
+    text = body.decode()
+    assert "seaweedfs_master_assign_total" in text
+    status, body, _ = http_request(f"http://{servers[0].url}/metrics")
+    text = body.decode()
+    assert "seaweedfs_volume_request_total" in text
+    assert "seaweedfs_volume_server_volumes" in text
