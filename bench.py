@@ -1,20 +1,28 @@
 """Headline benchmark: sustained ec.encode throughput (GB/s of volume data
-consumed) through the fused Pallas TPU kernel, batched volumes resident in HBM.
+consumed) through the fused Pallas TPU kernel, batched volumes resident in
+HBM in the shard-major [K, V, B] layout.
 
-Reference baseline: the klauspost/reedsolomon AVX2 path the reference drives
-from weed/storage/erasure_coding/ec_encoder.go:179 sustains ~2 GB/s/core-ish
-on a modern x86 (BASELINE.md pegs the north star at >=20 GB/s == >=10x that
-single-node path, budgeted for a v5e-8; we measure per-chip).
+Reference baseline: the klauspost/reedsolomon AVX2 path the reference
+drives from weed/storage/erasure_coding/ec_encoder.go:179 sustains
+~2 GB/s/core-ish on a modern x86 (BASELINE.md pegs the north star at
+>=20 GB/s, >=10x that single-node path).
+
+Methodology (honest sustained throughput on the tunneled 'axon' chip):
+- the kernel runs as a Pallas custom call, so its full parity output is
+  always materialized (custom calls cannot be partially DCE'd);
+- per measured call, completion is confirmed by fetching an on-device
+  reduction of one parity tile (cheap: one VMEM tile, does not re-read
+  the 2+ GB parity);
+- `iters` calls are dispatched asynchronously and THEN drained, so the
+  tunnel's per-call round-trip latency pipelines away instead of being
+  charged to every iteration;
+- the dot runs on the MXU in int8 (exact for 0/1 bit-planes: partial sums
+  <= 8K <= 2040 in the int32 accumulator), 2x bf16 throughput on v5e.
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
-Timing forces device completion by folding the parity into a scalar that is
-fetched to the host (the tunneled 'axon' platform's block_until_ready does not
-actually block), so dispatch overhead is included — this is honest end-to-end
-sustained throughput, amortized over a large resident batch.
 """
 
 import argparse
-import functools
 import json
 import sys
 import time
@@ -26,48 +34,57 @@ AVX2_BASELINE_GBPS = 2.0  # klauspost single-node encode, BASELINE.md
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--quick", action="store_true", help="small shapes for smoke")
+    ap.add_argument("--quick", action="store_true",
+                    help="small shapes for smoke")
     ap.add_argument("--volumes", type=int, default=64)
     ap.add_argument("--mib-per-shard", type=int, default=8)
-    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--block-b", type=int, default=512)
     args = ap.parse_args()
 
     import jax
     import jax.numpy as jnp
 
-    from seaweedfs_tpu.ops import rs_matrix, rs_pallas, rs_jax
+    from seaweedfs_tpu.ops import rs_jax, rs_matrix, rs_pallas
 
     platform = jax.devices()[0].platform
     on_tpu = platform in ("tpu", "axon")
 
-    V = 4 if args.quick else args.volumes
+    V = 8 if args.quick else args.volumes
     B = (1 if args.quick else args.mib_per_shard) * (1 << 20)
     k, m = 10, 4
+    iters = 3 if args.quick else args.iters
 
     pm = jnp.asarray(
-        rs_pallas.to_plane_major(np.asarray(rs_matrix.parity_bit_matrix(k, m)), m, k),
-        dtype=jnp.bfloat16)
+        rs_pallas.to_plane_major(
+            np.asarray(rs_matrix.parity_bit_matrix(k, m)), m, k),
+        dtype=jnp.int8)
     sbits = jnp.asarray(rs_matrix.parity_bit_matrix(k, m))
 
-    @functools.partial(jax.jit, static_argnums=(1,))
-    def gen(key, shape):
-        return jax.random.randint(key, shape, 0, 256, dtype=jnp.uint8)
+    data = jax.jit(
+        lambda key: jax.random.randint(key, (k, V, B), 0, 256,
+                                       dtype=jnp.uint8)
+    )(jax.random.PRNGKey(0))
 
     @jax.jit
-    def enc_fold(data):
+    def enc_probe(d):
         if on_tpu:
-            p = rs_pallas.gf_matmul_bits_pallas(pm, data)
-        else:
-            p = rs_jax.gf_matmul_bits(sbits, data)
-        return jnp.sum(p.astype(jnp.int32))  # forces full materialization
+            # opaque custom call: the full parity is always materialized,
+            # so a one-tile probe suffices for completion
+            p = rs_pallas.gf_matmul_bits_pallas_sm(pm, d,
+                                                   block_b=args.block_b)
+            return p[0, :8, :128].astype(jnp.int32).sum()
+        # CPU fallback is pure XLA: a sliced probe would let the compiler
+        # DCE most of the encode — keep the full-parity reduction
+        p = rs_jax.gf_matmul_bits(sbits, jnp.moveaxis(d, 1, 0))
+        return jnp.sum(p.astype(jnp.int32))
 
-    data = gen(jax.random.PRNGKey(0), (V, k, B))
-    float(enc_fold(data))  # compile + warmup
+    float(enc_probe(data))  # compile + warmup
 
-    iters = 2 if args.quick else args.iters
     t0 = time.perf_counter()
-    for _ in range(iters):
-        float(enc_fold(data))
+    futs = [enc_probe(data) for _ in range(iters)]
+    for f in futs:
+        float(f)
     dt = (time.perf_counter() - t0) / iters
 
     gbps = V * k * B / 1e9 / dt

@@ -71,10 +71,11 @@ def cmd_filer(args) -> int:
 
 def cmd_s3(args) -> int:
     from ..s3 import IdentityAccessManagement, S3ApiServer
-    iam = IdentityAccessManagement()
     if args.config:
         with open(args.config) as fh:
             iam = IdentityAccessManagement.from_config(json.load(fh))
+    else:
+        iam = IdentityAccessManagement()
     from ..pb import ServerAddress
     filer = ServerAddress.parse(args.filer)
     s3 = S3ApiServer(filer.url, filer.grpc, host=args.ip, port=args.port,

@@ -64,8 +64,7 @@ def run_benchmark(master_grpc: str, n_files: int = 10000,
                   file_size: int = 1024, concurrency: int = 16,
                   collection: str = "", write_only: bool = False,
                   quiet: bool = False) -> dict:
-    rng = random.Random(0)
-    payload = bytes(rng.getrandbits(8) for _ in range(file_size))
+    payload = random.Random(0).randbytes(file_size)
     fids: list[str] = []
     fid_lock = threading.Lock()
     results: dict = {}

@@ -65,6 +65,8 @@ class MasterServer:
         self.garbage_threshold = garbage_threshold
         self.jwt_signing_key = jwt_signing_key
         self.jwt_expires_seconds = jwt_expires_seconds
+        from ..stats import ServerMetrics
+        self.metrics = ServerMetrics()
         self.is_leader = True
         self._rng = random.Random(seed)
         self._grow_lock = threading.Lock()
@@ -127,9 +129,8 @@ class MasterServer:
             raise RpcError(f"no writable volumes: {e}") from None
         key = self.sequencer.next_file_id(count)
         cookie = self._rng.getrandbits(32)
-        from ..stats import MASTER_ASSIGN_COUNTER
         from ..storage.types import format_needle_id_cookie
-        MASTER_ASSIGN_COUNTER.inc()
+        self.metrics.master_assign.inc()
         fid = f"{vid},{format_needle_id_cookie(key, cookie)}"
         main = nodes[0]
         out = {
@@ -320,8 +321,7 @@ class MasterServer:
         return {"vacuumed": vacuum_mod.vacuum(self.topo, threshold)}
 
     def _rpc_lookup_volume(self, req: dict) -> dict:
-        from ..stats import MASTER_LOOKUP_COUNTER
-        MASTER_LOOKUP_COUNTER.inc()
+        self.metrics.master_lookup.inc()
         out = {}
         for vid_s in req.get("volume_or_file_ids", []):
             vid = int(str(vid_s).split(",")[0])
@@ -393,8 +393,7 @@ class MasterServer:
         return Response.json({"Topology": self.topo.to_dict()})
 
     def _http_metrics(self, req: Request) -> Response:
-        from ..stats import REGISTRY
-        return Response(200, REGISTRY.render().encode(),
+        return Response(200, self.metrics.render().encode(),
                         content_type="text/plain; version=0.0.4")
 
     def _http_vol_vacuum(self, req: Request) -> Response:

@@ -16,6 +16,8 @@ _BUCKETS = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0]
 
 
 class Counter:
+    kind = "counter"
+
     def __init__(self, name: str, help_text: str):
         self.name = name
         self.help = help_text
@@ -31,7 +33,7 @@ class Counter:
 
     def render(self, label_names: list[str]) -> str:
         out = [f"# HELP {self.name} {self.help}",
-               f"# TYPE {self.name} counter"]
+               f"# TYPE {self.name} {self.kind}"]
         with self._lock:
             items = sorted(self._values.items())
         for labels, v in items:
@@ -43,12 +45,11 @@ class Counter:
 
 
 class Gauge(Counter):
+    kind = "gauge"
+
     def set(self, *labels, value: float) -> None:
         with self._lock:
             self._values[labels] = value
-
-    def render(self, label_names: list[str]) -> str:
-        return super().render(label_names).replace(" counter", " gauge", 1)
 
 
 class Histogram:
@@ -125,22 +126,33 @@ class Registry:
                              for m, names in self._metrics) + "\n"
 
 
-# the global registry + the reference's metric families (stats/metrics.go)
-REGISTRY = Registry()
+class ServerMetrics:
+    """Per-server metric families over a private Registry — each server
+    instance gets its own so co-located servers (all-in-one mode, tests)
+    never cross-report (stats/metrics.go registers per-process in the
+    reference because each Go server IS one process)."""
 
-MASTER_ASSIGN_COUNTER = REGISTRY.counter(
-    "seaweedfs_master_assign_total", "master assign requests")
-MASTER_LOOKUP_COUNTER = REGISTRY.counter(
-    "seaweedfs_master_lookup_total", "master lookup requests")
-VOLUME_REQUEST_COUNTER = REGISTRY.counter(
-    "seaweedfs_volume_request_total", "volume server requests", ["type"])
-VOLUME_REQUEST_HISTOGRAM = REGISTRY.histogram(
-    "seaweedfs_volume_request_seconds", "volume request latency", ["type"])
-FILER_REQUEST_COUNTER = REGISTRY.counter(
-    "seaweedfs_filer_request_total", "filer requests", ["type"])
-FILER_REQUEST_HISTOGRAM = REGISTRY.histogram(
-    "seaweedfs_filer_request_seconds", "filer request latency", ["type"])
-S3_REQUEST_COUNTER = REGISTRY.counter(
-    "seaweedfs_s3_request_total", "s3 requests", ["action"])
-VOLUME_COUNT_GAUGE = REGISTRY.gauge(
-    "seaweedfs_volume_server_volumes", "volumes on this server")
+    def __init__(self):
+        r = self.registry = Registry()
+        self.master_assign = r.counter(
+            "seaweedfs_master_assign_total", "master assign requests")
+        self.master_lookup = r.counter(
+            "seaweedfs_master_lookup_total", "master lookup requests")
+        self.volume_requests = r.counter(
+            "seaweedfs_volume_request_total", "volume server requests",
+            ["type"])
+        self.volume_latency = r.histogram(
+            "seaweedfs_volume_request_seconds", "volume request latency",
+            ["type"])
+        self.filer_requests = r.counter(
+            "seaweedfs_filer_request_total", "filer requests", ["type"])
+        self.filer_latency = r.histogram(
+            "seaweedfs_filer_request_seconds", "filer request latency",
+            ["type"])
+        self.s3_requests = r.counter(
+            "seaweedfs_s3_request_total", "s3 requests", ["action"])
+        self.volume_count = r.gauge(
+            "seaweedfs_volume_server_volumes", "volumes on this server")
+
+    def render(self) -> str:
+        return self.registry.render()

@@ -51,6 +51,8 @@ class VolumeServer:
         self.data_center = data_center
         self.rack = rack
         self.jwt_signing_key = jwt_signing_key
+        from ..stats import ServerMetrics
+        self.metrics = ServerMetrics()
         self.pulse_seconds = pulse_seconds
         self.store = Store(directories, max_volume_counts)
         self.http = HttpServer(host, port)
@@ -157,10 +159,9 @@ class VolumeServer:
         self.http.route("*", "/", self._http_data)
 
     def _http_metrics(self, req: Request) -> Response:
-        from ..stats import REGISTRY, VOLUME_COUNT_GAUGE
         total = sum(len(loc.volumes) for loc in self.store.locations)
-        VOLUME_COUNT_GAUGE.set(value=total)
-        return Response(200, REGISTRY.render().encode(),
+        self.metrics.volume_count.set(value=total)
+        return Response(200, self.metrics.render().encode(),
                         content_type="text/plain; version=0.0.4")
 
     def _check_jwt(self, req: Request, fid: FileId) -> "Response | None":
@@ -208,10 +209,8 @@ class VolumeServer:
         return Response.error("method not allowed", 405)
 
     def _read_needle(self, fid: FileId, req: Request) -> Response:
-        from ..stats import (VOLUME_REQUEST_COUNTER,
-                             VOLUME_REQUEST_HISTOGRAM)
         t0 = time.time()
-        VOLUME_REQUEST_COUNTER.inc("read")
+        self.metrics.volume_requests.inc("read")
         try:
             if self.store.has_volume(fid.volume_id):
                 n = self.store.read_volume_needle(fid.volume_id, fid.key,
@@ -231,7 +230,7 @@ class VolumeServer:
             headers["X-File-Name"] = n.name.decode(errors="replace")
         mime = (n.mime.decode(errors="replace")
                 if n.has_mime() else "application/octet-stream")
-        VOLUME_REQUEST_HISTOGRAM.observe("read", value=time.time() - t0)
+        self.metrics.volume_latency.observe("read", value=time.time() - t0)
         return Response(200, bytes(n.data), content_type=mime,
                         headers=headers)
 
@@ -250,8 +249,6 @@ class VolumeServer:
             "Location": f"http://{locs[0]['public_url']}/{fid}"})
 
     def _write_needle(self, fid: FileId, req: Request) -> Response:
-        from ..stats import (VOLUME_REQUEST_COUNTER,
-                             VOLUME_REQUEST_HISTOGRAM)
         t0 = time.time()
         denied = self._check_jwt(req, fid)
         if denied is not None:
@@ -272,17 +269,16 @@ class VolumeServer:
             err = self._replicate(fid, req, "POST", req.body)
             if err:
                 return Response.error(f"replication failed: {err}", 500)
-        VOLUME_REQUEST_COUNTER.inc("write")
-        VOLUME_REQUEST_HISTOGRAM.observe("write", value=time.time() - t0)
+        self.metrics.volume_requests.inc("write")
+        self.metrics.volume_latency.observe("write", value=time.time() - t0)
         return Response.json({"name": req.qs("name"), "size": size,
                               "eTag": n.etag()}, status=201)
 
     def _delete_needle(self, fid: FileId, req: Request) -> Response:
-        from ..stats import VOLUME_REQUEST_COUNTER
         denied = self._check_jwt(req, fid)
         if denied is not None:
             return denied
-        VOLUME_REQUEST_COUNTER.inc("delete")
+        self.metrics.volume_requests.inc("delete")
         if self.store.has_volume(fid.volume_id):
             size = self.store.delete_volume_needle(fid.volume_id, fid.key,
                                                    fid.cookie)

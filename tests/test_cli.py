@@ -34,14 +34,15 @@ def cluster(tmp_path):
     master.stop()
 
 
-def test_upload_download_delete_cli(cluster, tmp_path, capsys):
+def test_upload_download_delete_cli(cluster, tmp_path, capsys,
+                                    monkeypatch):
     master, _ = cluster
     src = tmp_path / "in.bin"
     src.write_bytes(os.urandom(4096))
     assert main(["upload", "-master", master.grpc_address,
                  str(src)]) == 0
     fid = json.loads(capsys.readouterr().out.strip())["fid"]
-    os.chdir(tmp_path)
+    monkeypatch.chdir(tmp_path)
     assert main(["download", "-master", master.grpc_address,
                  "-o", "out.bin", fid]) == 0
     assert (tmp_path / "out.bin").read_bytes() == src.read_bytes()

@@ -107,3 +107,24 @@ def test_wide_and_cauchy_geometries():
         oracle = RSCodec(k, m, kind=kind, backend="numpy")
         data = rng.integers(0, 256, (k, 160), dtype=np.uint8)
         assert np.array_equal(codec.encode(data), oracle.encode(data))
+
+
+def test_shard_major_kernel_interpret():
+    """The shard-major [K, V, B] kernel (the bench fast path) is bit-exact
+    for both int8 and bf16 MXU dtypes (pallas interpreter, no TPU)."""
+    import jax.numpy as jnp
+    from seaweedfs_tpu.ops import gf256, rs_pallas
+    k, m = 10, 4
+    rng = np.random.default_rng(3)
+    d = rng.integers(0, 256, (k, 8, 256), dtype=np.uint8)
+    gen = rs_matrix.generator_matrix(k, m)
+    for dtype in (jnp.int8, jnp.bfloat16):
+        pm = jnp.asarray(
+            rs_pallas.to_plane_major(
+                np.asarray(rs_matrix.parity_bit_matrix(k, m)), m, k),
+            dtype=dtype)
+        out = np.asarray(rs_pallas.gf_matmul_bits_pallas_sm(
+            pm, jnp.asarray(d), block_b=256, interpret=True))
+        for v in range(8):
+            want = gf256.matmul(gen[k:], d[:, v, :])
+            assert np.array_equal(out[:, v, :], want), (dtype, v)
