@@ -1,0 +1,300 @@
+"""Message broker — pub/sub persisted in the filer.
+
+Capability-equivalent to weed/messaging/broker/*: topics are split into
+partitions by consistent key hashing (consistent_distribution.go);
+published messages append into a per-partition in-memory log buffer that
+flushes as segment files under /topics/<ns>/<topic>/<partition>/ in the
+filer (broker_append.go appendToFile); subscribers replay persisted
+segments from their offset, then tail the live buffer
+(broker_grpc_server_subscribe.go:19-142).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+
+from ..pb.rpc import POOL, RpcError, RpcServer, from_b64, to_b64
+
+TOPICS_ROOT = "/topics"
+DEFAULT_PARTITIONS = 4
+FLUSH_INTERVAL = 2.0
+FLUSH_MAX_MESSAGES = 1000
+
+
+def partition_for_key(key: str, n_partitions: int) -> int:
+    """Stable key -> partition (the consistent hashing of
+    broker/consistent_distribution.go, simplified to a stable digest)."""
+    if not key:
+        return int(time.time() * 1000) % n_partitions
+    h = hashlib.md5(key.encode()).digest()
+    return int.from_bytes(h[:4], "big") % n_partitions
+
+
+class _Partition:
+    def __init__(self):
+        self.buffer: list[dict] = []    # live tail
+        self.flushed_count = 0          # messages already in segments
+        self.segments: list[str] = []   # filer paths, in order
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+
+
+class MessageBroker:
+    """One broker process (weed msg.broker)."""
+
+    def __init__(self, filer_grpc: str, host: str = "127.0.0.1",
+                 grpc_port: int = 0):
+        self.filer_grpc = filer_grpc
+        self.rpc = RpcServer(host, grpc_port)
+        self._topics: dict[tuple[str, str], dict] = {}  # cfg per topic
+        self._parts: dict[tuple[str, str, int], _Partition] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.rpc.add_service(
+            "SeaweedMessaging",
+            unary={
+                "ConfigureTopic": self._rpc_configure_topic,
+                "GetTopicConfiguration": self._rpc_get_topic,
+                "DeleteTopic": self._rpc_delete_topic,
+            },
+            stream={
+                "Publish": self._rpc_publish,
+                "Subscribe": self._rpc_subscribe,
+            })
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.rpc.start()
+        threading.Thread(target=self._flush_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.flush_all()
+        self.rpc.stop()
+
+    @property
+    def grpc_address(self) -> str:
+        return self.rpc.address
+
+    def _filer(self):
+        return POOL.client(self.filer_grpc, "SeaweedFiler")
+
+    # -- topic config ------------------------------------------------------
+    def _topic_cfg(self, ns: str, topic: str) -> dict:
+        with self._lock:
+            return self._topics.setdefault(
+                (ns, topic), {"partition_count": DEFAULT_PARTITIONS})
+
+    def _rpc_configure_topic(self, req: dict) -> dict:
+        ns, topic = req.get("namespace", "default"), req["topic"]
+        with self._lock:
+            self._topics[(ns, topic)] = {
+                "partition_count": int(req.get("partition_count")
+                                       or DEFAULT_PARTITIONS)}
+        return {}
+
+    def _rpc_get_topic(self, req: dict) -> dict:
+        cfg = self._topic_cfg(req.get("namespace", "default"), req["topic"])
+        return dict(cfg)
+
+    def _rpc_delete_topic(self, req: dict) -> dict:
+        ns, topic = req.get("namespace", "default"), req["topic"]
+        with self._lock:
+            self._topics.pop((ns, topic), None)
+            for key in [k for k in self._parts
+                        if k[0] == ns and k[1] == topic]:
+                del self._parts[key]
+        try:
+            self._filer().call("DeleteEntry", {
+                "directory": f"{TOPICS_ROOT}/{ns}", "name": topic,
+                "is_recursive": True, "ignore_recursive_error": True})
+        except RpcError:
+            pass
+        return {}
+
+    # -- partitions --------------------------------------------------------
+    def _partition(self, ns: str, topic: str, p: int) -> _Partition:
+        with self._lock:
+            key = (ns, topic, p)
+            if key not in self._parts:
+                part = _Partition()
+                part.segments = self._load_segments(ns, topic, p)
+                self._parts[key] = part
+            return self._parts[key]
+
+    def _seg_dir(self, ns: str, topic: str, p: int) -> str:
+        return f"{TOPICS_ROOT}/{ns}/{topic}/{p:02d}"
+
+    def _load_segments(self, ns: str, topic: str, p: int) -> list[str]:
+        try:
+            out = self._filer().stream(
+                "ListEntries",
+                iter([{"directory": self._seg_dir(ns, topic, p),
+                       "limit": 100000}]))
+            return sorted(r["entry"]["full_path"] for r in out)
+        except RpcError:
+            return []
+
+    # -- publish (broker_grpc_server_publish.go:16) ------------------------
+    def _rpc_publish(self, requests):
+        init = next(iter(requests), None)
+        if not init or "init" not in init:
+            raise RpcError("first publish message must carry init")
+        ns = init["init"].get("namespace", "default")
+        topic = init["init"]["topic"]
+        cfg = self._topic_cfg(ns, topic)
+        n = cfg["partition_count"]
+        yield {"config": {"partition_count": n}}
+        for msg in requests:
+            key = msg.get("key", "")
+            p = int(msg.get("partition", -1))
+            if p < 0:
+                p = partition_for_key(key, n)
+            part = self._partition(ns, topic, p)
+            record = {"key": key, "value": msg.get("value", ""),
+                      "ts_ns": time.time_ns(), "partition": p}
+            with part.cond:
+                part.buffer.append(record)
+                part.cond.notify_all()
+            yield {"ack_sequence": part.flushed_count + len(part.buffer)}
+
+    # -- subscribe (broker_grpc_server_subscribe.go) -----------------------
+    def _rpc_subscribe(self, requests):
+        init = next(iter(requests), None)
+        if not init or "init" not in init:
+            raise RpcError("first subscribe message must carry init")
+        ns = init["init"].get("namespace", "default")
+        topic = init["init"]["topic"]
+        p = int(init["init"].get("partition", 0))
+        offset = int(init["init"].get("start_offset", 0))
+        part = self._partition(ns, topic, p)
+        sent = 0
+        # replay persisted segments
+        for seg_path in list(part.segments):
+            records = self._read_segment(seg_path)
+            for r in records:
+                if sent >= offset:
+                    yield {"data": r}
+                sent += 1
+        # then tail the live buffer
+        while not self._stop.is_set():
+            with part.cond:
+                flushed = part.flushed_count
+                live = list(part.buffer)
+            total_before_live = flushed
+            for i, r in enumerate(live):
+                seq = total_before_live + i
+                if seq >= sent and seq >= offset:
+                    yield {"data": r}
+                    sent = seq + 1
+            with part.cond:
+                if not part.cond.wait(timeout=0.3):
+                    yield {"ping": 1}
+
+    def _read_segment(self, path: str) -> list[dict]:
+        directory, _, name = path.rpartition("/")
+        try:
+            entry = self._filer().call("LookupDirectoryEntry", {
+                "directory": directory, "name": name})["entry"]
+        except RpcError:
+            return []
+        # segment payload is stored inline in extended (small segments) —
+        # the reference appends into chunked files; inline keeps the broker
+        # independent of volume servers for tiny topics
+        raw = entry.get("extended", {}).get("segment", "")
+        if not raw:
+            return []
+        return json.loads(from_b64(raw))
+
+    # -- flush (log buffer -> filer segments, broker_append.go) ------------
+    def _flush_loop(self) -> None:
+        while not self._stop.wait(FLUSH_INTERVAL):
+            self.flush_all()
+
+    def flush_all(self) -> None:
+        with self._lock:
+            keys = list(self._parts.keys())
+        for ns, topic, p in keys:
+            self._flush_partition(ns, topic, p)
+
+    def _flush_partition(self, ns: str, topic: str, p: int) -> None:
+        part = self._partition(ns, topic, p)
+        with part.cond:
+            if not part.buffer:
+                return
+            batch = part.buffer
+            part.buffer = []
+            start = part.flushed_count
+            part.flushed_count += len(batch)
+        name = f"{start:020d}.seg"
+        path = f"{self._seg_dir(ns, topic, p)}/{name}"
+        try:
+            self._filer().call("CreateEntry", {"entry": {
+                "full_path": path,
+                "attr": {"mtime": time.time(), "crtime": time.time(),
+                         "mode": 0o660},
+                "extended": {"segment": to_b64(
+                    json.dumps(batch).encode())},
+            }})
+            with part.cond:
+                part.segments.append(path)
+        except RpcError:
+            # filer down: put the batch back at the front
+            with part.cond:
+                part.buffer = batch + part.buffer
+                part.flushed_count -= len(batch)
+
+
+# -- client helpers ---------------------------------------------------------
+
+class Publisher:
+    def __init__(self, broker_grpc: str, topic: str,
+                 namespace: str = "default"):
+        self.broker = broker_grpc
+        self.topic = topic
+        self.namespace = namespace
+        self._queue: list[dict] = []
+
+    def publish(self, messages: list[tuple[str, str]]) -> int:
+        """messages = [(key, value)]; returns acked count."""
+        client = POOL.client(self.broker, "SeaweedMessaging")
+
+        def requests():
+            yield {"init": {"namespace": self.namespace,
+                            "topic": self.topic}}
+            for key, value in messages:
+                yield {"key": key, "value": value}
+
+        acked = 0
+        for reply in client.stream("Publish", requests()):
+            if "ack_sequence" in reply:
+                acked += 1
+        return acked
+
+
+class Subscriber:
+    def __init__(self, broker_grpc: str, topic: str, partition: int = 0,
+                 namespace: str = "default", start_offset: int = 0):
+        self.broker = broker_grpc
+        self.topic = topic
+        self.partition = partition
+        self.namespace = namespace
+        self.start_offset = start_offset
+
+    def poll(self, max_messages: int = 100) -> list[dict]:
+        """Fetch up to max_messages currently available, then return."""
+        client = POOL.client(self.broker, "SeaweedMessaging")
+        out = []
+        for reply in client.stream("Subscribe", iter([{
+                "init": {"namespace": self.namespace, "topic": self.topic,
+                         "partition": self.partition,
+                         "start_offset": self.start_offset}}])):
+            if "ping" in reply:
+                break
+            out.append(reply["data"])
+            if len(out) >= max_messages:
+                break
+        return out

@@ -1,0 +1,79 @@
+"""Notification — publish filer metadata events to message queues.
+
+Capability-equivalent to weed/notification/*: a MessageQueue interface with
+pluggable backends selected by config.  Backends here: "log" (stdout/glog
+analogue), "memory" (in-process queue, the test backend and the shape the
+Kafka/SQS/PubSub adapters implement — those SDKs aren't in the image, so
+they register as unavailable rather than import-failing).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+from typing import Protocol
+
+
+class MessageQueue(Protocol):
+    def send_message(self, key: str, message: dict) -> None: ...
+
+
+class LogQueue:
+    name = "log"
+
+    def __init__(self, sink=print):
+        self._sink = sink
+
+    def send_message(self, key: str, message: dict) -> None:
+        self._sink(f"[notification] {key} "
+                   f"{json.dumps(message, default=str)[:500]}")
+
+
+class MemoryQueue:
+    """In-process queue — the test backend."""
+    name = "memory"
+
+    def __init__(self, maxsize: int = 10000):
+        self.queue: "queue.Queue[tuple[str, dict]]" = queue.Queue(maxsize)
+
+    def send_message(self, key: str, message: dict) -> None:
+        self.queue.put((key, message))
+
+    def drain(self) -> list[tuple[str, dict]]:
+        out = []
+        while not self.queue.empty():
+            out.append(self.queue.get_nowait())
+        return out
+
+
+QUEUES = {"log": LogQueue, "memory": MemoryQueue}
+UNAVAILABLE = {
+    "kafka": "kafka-python not in image",
+    "aws_sqs": "boto3 not in image",
+    "gcp_pub_sub": "google-cloud-pubsub not in image",
+    "gocdk_pub_sub": "reference-only backend",
+}
+
+
+def new_message_queue(kind: str, **kw) -> MessageQueue:
+    if kind in UNAVAILABLE:
+        raise RuntimeError(
+            f"notification backend {kind!r} unavailable: "
+            f"{UNAVAILABLE[kind]}")
+    if kind not in QUEUES:
+        raise ValueError(f"unknown notification backend {kind!r}")
+    return QUEUES[kind](**kw)
+
+
+def attach_to_filer(filer, mq: MessageQueue, path_prefix: str = "/"):
+    """Publish every metadata event (filer_notify.go notifyUpdateEvent);
+    returns the unsubscribe function."""
+    prefix = path_prefix.rstrip("/")
+
+    def on_event(ev):
+        if prefix and not (ev.directory == prefix
+                           or ev.directory.startswith(prefix + "/")):
+            return
+        mq.send_message(ev.directory, ev.to_dict())
+
+    return filer.subscribe(on_event)

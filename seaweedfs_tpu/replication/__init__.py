@@ -1,0 +1,176 @@
+"""Replication engine — metadata-event-driven sinks.
+
+Capability-equivalent to weed/replication/replicator.go + sink/*: a
+Replicator consumes filer metadata events and applies create/update/delete
+to a ReplicationSink.  Sinks: FilerSink (active-active cross-cluster,
+sink/filersink) and LocalSink (materialize into a local directory,
+sink/localsink).  Cloud sinks (S3/GCS/Azure/B2) follow the same interface —
+gated out here (no cloud SDKs in the image), the FilerSink shape is what
+they implement.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Protocol
+
+from ..filer.entry import Entry
+from ..pb.rpc import POOL, RpcError
+
+REPLICATION_SOURCE_KEY = "replication.source"  # loop-prevention signature
+
+
+class ReplicationSink(Protocol):
+    def create_entry(self, entry: Entry, signature: str) -> None: ...
+
+    def update_entry(self, old: Entry, new: Entry,
+                     signature: str) -> None: ...
+
+    def delete_entry(self, path: str, is_directory: bool) -> None: ...
+
+
+class FilerSink:
+    """Replays events into another filer over its gRPC API, stamping each
+    entry with the source signature so the target's own sync loop skips
+    events that originated here (filer_sync.go signature loop prevention)."""
+
+    def __init__(self, filer_grpc: str, path_translation: tuple[str, str]
+                 = ("/", "/"), read_chunk: "callable | None" = None,
+                 write_chunk: "callable | None" = None):
+        self.filer_grpc = filer_grpc
+        self.src_prefix, self.dst_prefix = path_translation
+        # chunk re-materialization hooks: read from source cluster, write
+        # into the target cluster (repl_util.CopyFromChunkViews)
+        self.read_chunk = read_chunk
+        self.write_chunk = write_chunk
+
+    def _client(self):
+        return POOL.client(self.filer_grpc, "SeaweedFiler")
+
+    def _translate(self, path: str) -> str:
+        if path.startswith(self.src_prefix):
+            rest = path[len(self.src_prefix):]
+            return (self.dst_prefix.rstrip("/") + "/" + rest.lstrip("/")) \
+                if rest else self.dst_prefix
+        return path
+
+    def _rewrite_chunks(self, entry: Entry) -> list[dict]:
+        """Copy chunk data into the target cluster (the sink's cluster has
+        its own volume servers; fids don't transfer)."""
+        out = []
+        for c in entry.chunks:
+            d = c.to_dict()
+            if self.read_chunk and self.write_chunk:
+                data = self.read_chunk(c.file_id)
+                d["file_id"] = self.write_chunk(data)
+            out.append(d)
+        return out
+
+    def create_entry(self, entry: Entry, signature: str) -> None:
+        e = entry.to_dict()
+        e["full_path"] = self._translate(entry.full_path)
+        e["chunks"] = self._rewrite_chunks(entry)
+        e.setdefault("extended", {})[REPLICATION_SOURCE_KEY] = signature
+        self._client().call("CreateEntry", {"entry": e})
+
+    def update_entry(self, old: Entry, new: Entry, signature: str) -> None:
+        self.create_entry(new, signature)
+
+    def delete_entry(self, path: str, is_directory: bool) -> None:
+        path = self._translate(path)
+        directory, _, name = path.rstrip("/").rpartition("/")
+        try:
+            self._client().call("DeleteEntry", {
+                "directory": directory or "/", "name": name,
+                "is_recursive": is_directory,
+                "ignore_recursive_error": True})
+        except RpcError:
+            pass  # already gone
+
+
+class LocalSink:
+    """Materialize the replicated namespace into a local directory
+    (replication/sink/localsink)."""
+
+    def __init__(self, directory: str,
+                 read_chunk: "callable | None" = None):
+        self.directory = directory
+        self.read_chunk = read_chunk
+
+    def _path(self, entry_path: str) -> str:
+        return os.path.join(self.directory, entry_path.lstrip("/"))
+
+    def create_entry(self, entry: Entry, signature: str) -> None:
+        p = self._path(entry.full_path)
+        if entry.is_directory():
+            os.makedirs(p, exist_ok=True)
+            return
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "wb") as f:
+            for c in sorted(entry.chunks, key=lambda c: c.offset):
+                if self.read_chunk:
+                    f.seek(c.offset)
+                    f.write(self.read_chunk(c.file_id))
+
+    def update_entry(self, old: Entry, new: Entry, signature: str) -> None:
+        self.create_entry(new, signature)
+
+    def delete_entry(self, path: str, is_directory: bool) -> None:
+        p = self._path(path)
+        if os.path.isdir(p):
+            import shutil
+            shutil.rmtree(p, ignore_errors=True)
+        elif os.path.exists(p):
+            os.remove(p)
+
+
+class Replicator:
+    """Applies one metadata event to a sink (replication/replicator.go
+    Replicate).
+
+    `signature` identifies THIS source cluster — the sink stamps it onto
+    replicated entries.  `skip_sources` lists signatures whose entries must
+    NOT be forwarded; for bidirectional sync each direction excludes the
+    target's signature so a peer's own data never bounces home
+    (command/filer_sync.go excludeSignatures)."""
+
+    def __init__(self, sink: ReplicationSink, signature: str,
+                 path_prefix: str = "/",
+                 skip_sources: "set[str] | None" = None):
+        self.sink = sink
+        self.signature = signature
+        self.skip_sources = skip_sources or set()
+        self.path_prefix = path_prefix.rstrip("/") or ""
+
+    def _in_scope(self, path: str) -> bool:
+        return (not self.path_prefix or path == self.path_prefix
+                or path.startswith(self.path_prefix + "/"))
+
+    def replicate(self, event: dict) -> bool:
+        """event = MetaEvent.to_dict(); returns True when applied."""
+        old, new = event.get("old_entry"), event.get("new_entry")
+        # loop prevention: never forward an entry that originated from a
+        # cluster in skip_sources (normally: the sync target itself)
+        for side in (new, old):
+            src = side and side.get("extended", {}).get(
+                REPLICATION_SOURCE_KEY)
+            if src and src in self.skip_sources:
+                return False
+        if new is not None:
+            entry = Entry.from_dict(new)
+            if not self._in_scope(entry.full_path):
+                return False
+            if old is not None:
+                self.sink.update_entry(Entry.from_dict(old), entry,
+                                       self.signature)
+            else:
+                self.sink.create_entry(entry, self.signature)
+            return True
+        if old is not None:
+            path = old["full_path"]
+            if not self._in_scope(path):
+                return False
+            self.sink.delete_entry(
+                path, bool(old.get("attr", {}).get("mode", 0) & 0o40000))
+            return True
+        return False

@@ -1,0 +1,242 @@
+"""IAM API — the AWS IAM query-protocol subset that manages S3 identities.
+
+Capability-equivalent to weed/iamapi/iamapi_server.go:49-133 +
+iamapi_management_handlers.go: a form-encoded `Action=` REST endpoint
+(CreateUser / DeleteUser / GetUser / ListUsers / CreateAccessKey /
+DeleteAccessKey / PutUserPolicy / GetUserPolicy / DeleteUserPolicy)
+mutating the same identity config the S3 gateway authenticates against,
+persisted in the filer KV (the reference stores /etc/iam/identity.json in
+the filer and the S3 server hot-reloads it via metadata subscription; here
+the S3 server shares the IdentityAccessManagement object directly and the
+KV write is the durable copy).
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+
+from ..pb.rpc import POOL, RpcError, from_b64, to_b64
+from ..util.http import HttpServer, Request, Response
+from .auth import Identity, IdentityAccessManagement
+
+IAM_CONFIG_KEY = b"/etc/iam/identity.json"
+
+
+def _resp(action: str, body_fn=None) -> bytes:
+    root = ET.Element(f"{action}Response")
+    if body_fn is not None:
+        body_fn(ET.SubElement(root, f"{action}Result"))
+    meta = ET.SubElement(root, "ResponseMetadata")
+    ET.SubElement(meta, "RequestId").text = uuid.uuid4().hex
+    return (b'<?xml version="1.0" encoding="UTF-8"?>'
+            + ET.tostring(root))
+
+
+def _error(code: str, message: str, status: int = 400) -> Response:
+    root = ET.Element("ErrorResponse")
+    err = ET.SubElement(root, "Error")
+    ET.SubElement(err, "Code").text = code
+    ET.SubElement(err, "Message").text = message
+    return Response(status,
+                    b'<?xml version="1.0"?>' + ET.tostring(root),
+                    content_type="application/xml")
+
+
+class IamApiServer:
+    def __init__(self, iam: IdentityAccessManagement,
+                 filer_grpc: str = "", host: str = "127.0.0.1",
+                 port: int = 0):
+        self.iam = iam
+        self.filer_grpc = filer_grpc
+        self.http = HttpServer(host, port)
+        self.http.route("*", "/", self._dispatch)
+        self._load()
+
+    def start(self) -> None:
+        self.http.start()
+
+    def stop(self) -> None:
+        self.http.stop()
+
+    @property
+    def address(self) -> str:
+        return self.http.address
+
+    # -- persistence (filer KV = /etc/iam/identity.json) -------------------
+    def _persist(self) -> None:
+        if not self.filer_grpc:
+            return
+        cfg = {"identities": [
+            {"name": i.name,
+             "credentials": [{"accessKey": i.access_key,
+                              "secretKey": i.secret_key}],
+             "actions": i.actions} for i in self.iam.identities]}
+        try:
+            POOL.client(self.filer_grpc, "SeaweedFiler").call(
+                "KvPut", {"key": to_b64(IAM_CONFIG_KEY),
+                          "value": to_b64(json.dumps(cfg).encode())})
+        except RpcError:
+            pass
+
+    def _load(self) -> None:
+        if not self.filer_grpc:
+            return
+        try:
+            out = POOL.client(self.filer_grpc, "SeaweedFiler").call(
+                "KvGet", {"key": to_b64(IAM_CONFIG_KEY)})
+            if out.get("value"):
+                cfg = json.loads(from_b64(out["value"]))
+                self.iam.identities = \
+                    IdentityAccessManagement.from_config(cfg).identities
+        except (RpcError, ValueError):
+            pass
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch(self, req: Request) -> Response:
+        form = urllib.parse.parse_qs(req.body.decode(errors="replace"))
+        params = {k: v[0] for k, v in form.items()}
+        for k, vs in req.query.items():
+            params.setdefault(k, vs[0])
+        action = params.get("Action", "")
+        handler = getattr(self, f"_do_{action}", None)
+        if handler is None:
+            return _error("InvalidAction", f"unknown action {action!r}")
+        return handler(params)
+
+    def _find(self, name: str) -> Identity | None:
+        for i in self.iam.identities:
+            if i.name == name:
+                return i
+        return None
+
+    # -- actions (iamapi_management_handlers.go) ---------------------------
+    def _do_CreateUser(self, p: dict) -> Response:
+        name = p.get("UserName", "")
+        if not name:
+            return _error("InvalidInput", "missing UserName")
+        if self._find(name):
+            return _error("EntityAlreadyExists", name, 409)
+        self.iam.identities.append(Identity(name=name, actions=[]))
+        self._persist()
+
+        def body(r):
+            u = ET.SubElement(r, "User")
+            ET.SubElement(u, "UserName").text = name
+            ET.SubElement(u, "UserId").text = name
+        return Response(200, _resp("CreateUser", body),
+                        content_type="application/xml")
+
+    def _do_GetUser(self, p: dict) -> Response:
+        ident = self._find(p.get("UserName", ""))
+        if ident is None:
+            return _error("NoSuchEntity", p.get("UserName", ""), 404)
+
+        def body(r):
+            u = ET.SubElement(r, "User")
+            ET.SubElement(u, "UserName").text = ident.name
+        return Response(200, _resp("GetUser", body),
+                        content_type="application/xml")
+
+    def _do_ListUsers(self, p: dict) -> Response:
+        def body(r):
+            users = ET.SubElement(r, "Users")
+            for i in self.iam.identities:
+                u = ET.SubElement(users, "member")
+                ET.SubElement(u, "UserName").text = i.name
+        return Response(200, _resp("ListUsers", body),
+                        content_type="application/xml")
+
+    def _do_DeleteUser(self, p: dict) -> Response:
+        ident = self._find(p.get("UserName", ""))
+        if ident is None:
+            return _error("NoSuchEntity", p.get("UserName", ""), 404)
+        self.iam.identities.remove(ident)
+        self._persist()
+        return Response(200, _resp("DeleteUser"),
+                        content_type="application/xml")
+
+    def _do_CreateAccessKey(self, p: dict) -> Response:
+        ident = self._find(p.get("UserName", ""))
+        if ident is None:
+            return _error("NoSuchEntity", p.get("UserName", ""), 404)
+        ident.access_key = "AKID" + secrets.token_hex(8).upper()
+        ident.secret_key = secrets.token_urlsafe(30)
+        self._persist()
+
+        def body(r):
+            k = ET.SubElement(r, "AccessKey")
+            ET.SubElement(k, "UserName").text = ident.name
+            ET.SubElement(k, "AccessKeyId").text = ident.access_key
+            ET.SubElement(k, "SecretAccessKey").text = ident.secret_key
+            ET.SubElement(k, "Status").text = "Active"
+        return Response(200, _resp("CreateAccessKey", body),
+                        content_type="application/xml")
+
+    def _do_DeleteAccessKey(self, p: dict) -> Response:
+        ident = self._find(p.get("UserName", ""))
+        if ident is None:
+            return _error("NoSuchEntity", p.get("UserName", ""), 404)
+        if p.get("AccessKeyId") in ("", ident.access_key):
+            ident.access_key = ""
+            ident.secret_key = ""
+            self._persist()
+        return Response(200, _resp("DeleteAccessKey"),
+                        content_type="application/xml")
+
+    # policies map onto the identity's action list (the reference
+    # translates IAM policy statements into its Action strings)
+    _POLICY_MAP = {
+        "s3:GetObject": "Read", "s3:ListBucket": "List",
+        "s3:PutObject": "Write", "s3:DeleteObject": "Write",
+        "s3:PutObjectTagging": "Tagging", "s3:*": "Admin",
+    }
+
+    def _do_PutUserPolicy(self, p: dict) -> Response:
+        ident = self._find(p.get("UserName", ""))
+        if ident is None:
+            return _error("NoSuchEntity", p.get("UserName", ""), 404)
+        try:
+            doc = json.loads(p.get("PolicyDocument", "{}"))
+        except ValueError:
+            return _error("MalformedPolicyDocument", "bad json")
+        actions: list[str] = []
+        for stmt in doc.get("Statement", []):
+            acts = stmt.get("Action", [])
+            if isinstance(acts, str):
+                acts = [acts]
+            for a in acts:
+                mapped = self._POLICY_MAP.get(a)
+                if mapped and mapped not in actions:
+                    actions.append(mapped)
+        ident.actions = actions
+        self._persist()
+        return Response(200, _resp("PutUserPolicy"),
+                        content_type="application/xml")
+
+    def _do_GetUserPolicy(self, p: dict) -> Response:
+        ident = self._find(p.get("UserName", ""))
+        if ident is None:
+            return _error("NoSuchEntity", p.get("UserName", ""), 404)
+
+        def body(r):
+            ET.SubElement(r, "UserName").text = ident.name
+            ET.SubElement(r, "PolicyName").text = \
+                p.get("PolicyName", "default")
+            ET.SubElement(r, "PolicyDocument").text = json.dumps(
+                {"Statement": [{"Effect": "Allow",
+                                "Action": ident.actions}]})
+        return Response(200, _resp("GetUserPolicy", body),
+                        content_type="application/xml")
+
+    def _do_DeleteUserPolicy(self, p: dict) -> Response:
+        ident = self._find(p.get("UserName", ""))
+        if ident is None:
+            return _error("NoSuchEntity", p.get("UserName", ""), 404)
+        ident.actions = []
+        self._persist()
+        return Response(200, _resp("DeleteUserPolicy"),
+                        content_type="application/xml")

@@ -122,6 +122,10 @@ class HttpServer:
                     pass
 
             do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _dispatch
+            # WebDAV verbs (webdav_server.go handles these via x/net/webdav)
+            do_OPTIONS = do_PROPFIND = do_MKCOL = _dispatch
+            do_MOVE = do_COPY = do_PROPPATCH = do_LOCK = do_UNLOCK = \
+                _dispatch
 
         self._httpd = ThreadingHTTPServer((host, port), _H)
         self._httpd.daemon_threads = True

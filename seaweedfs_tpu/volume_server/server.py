@@ -428,7 +428,74 @@ class VolumeServer:
             stream={
                 "VolumeEcShardRead": self._rpc_ec_shard_read,
                 "CopyFile": self._rpc_copy_file,
+                "Query": self._rpc_query,
             })
+
+    def _rpc_query(self, requests):
+        """SQL-ish scan over JSON/CSV needles (S3 Select analogue,
+        server/volume_grpc_query.go:12 + query/json/query_json.go).
+
+        req: {"from": {"file_ids": [...]}, "selections": [fields],
+              "where": {"field", "op" (=,!=,<,<=,>,>=,contains), "value"},
+              "input_format": "json"|"csv"}"""
+        import json as _json
+
+        OPS = {"=", "!=", "contains", "<", "<=", ">", ">="}
+
+        def matches(row: dict, where: dict) -> bool:
+            if not where:
+                return True
+            field, op, want = (where.get("field"), where.get("op", "="),
+                               where.get("value"))
+            got = row.get(field)
+            if op == "=":
+                return got == want
+            if op == "!=":
+                return got != want
+            if op == "contains":
+                return isinstance(got, str) and str(want) in got
+            try:
+                got_n, want_n = float(got), float(want)
+            except (TypeError, ValueError):
+                return False
+            return {"<": got_n < want_n, "<=": got_n <= want_n,
+                    ">": got_n > want_n, ">=": got_n >= want_n}[op]
+
+        for req in requests:
+            selections = req.get("selections") or []
+            where = req.get("where") or {}
+            if where and where.get("op", "=") not in OPS:
+                raise RpcError(
+                    f"unsupported where.op {where.get('op')!r}; "
+                    f"supported: {sorted(OPS)}")
+            fmt = req.get("input_format", "json")
+            for fid_s in req.get("from", {}).get("file_ids", []):
+                try:
+                    fid = FileId.parse(fid_s)
+                    n = self._read_needle_any(fid)
+                except Exception:
+                    continue  # malformed fid / missing needle: skip it
+                text = bytes(n.data).decode(errors="replace")
+                rows: list = []
+                if fmt == "json":
+                    for line in text.splitlines():
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rows.append(_json.loads(line))
+                        except ValueError:
+                            continue
+                else:  # csv with header row
+                    import csv as _csv
+                    import io as _io
+                    rows = list(_csv.DictReader(_io.StringIO(text)))
+                for row in rows:
+                    if not isinstance(row, dict) or not matches(row, where):
+                        continue
+                    if selections:
+                        row = {k: row.get(k) for k in selections}
+                    yield {"record": row}
 
     # volume lifecycle
     def _rpc_allocate_volume(self, req: dict) -> dict:
@@ -667,6 +734,18 @@ class VolumeServer:
                 yield {"data": to_b64(chunk)}
                 offset += len(chunk)
                 remaining -= len(chunk)
+
+    def _read_needle_any(self, fid: FileId) -> Needle:
+        """Needle from the normal volume OR its EC-encoded remnant (the
+        same fallback the HTTP read path uses)."""
+        if self.store.has_volume(fid.volume_id):
+            return self.store.read_volume_needle(fid.volume_id, fid.key,
+                                                 fid.cookie)
+        if self.store.find_ec_volume(fid.volume_id) is not None:
+            self._ensure_ec_remote_reader(fid.volume_id)
+            return self.store.read_ec_needle(fid.volume_id, fid.key,
+                                             fid.cookie)
+        raise NotFoundError(f"volume {fid.volume_id} not found")
 
     def _rpc_copy_file(self, requests):
         """Stream any volume/shard file (CopyFile volume_server.proto:60)."""

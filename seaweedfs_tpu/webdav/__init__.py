@@ -1,0 +1,197 @@
+"""WebDAV gateway over the filer.
+
+Capability-equivalent to weed/server/webdav_server.go:51-130 (which adapts
+golang.org/x/net/webdav's FileSystem to the filer): OPTIONS, PROPFIND
+(Depth 0/1), GET/HEAD, PUT, DELETE, MKCOL, MOVE, COPY — enough for
+davfs2/cadaver/Finder-style clients.  File IO proxies the filer HTTP API;
+namespace ops use the filer gRPC API.
+"""
+
+from __future__ import annotations
+
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+from ..pb.rpc import POOL, RpcError
+from ..util.http import HttpServer, Request, Response, http_request
+
+DAV_NS = "DAV:"
+
+
+def _fmt_http_date(ts: float) -> str:
+    return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(ts))
+
+
+class WebDavServer:
+    def __init__(self, filer_http: str, filer_grpc: str,
+                 host: str = "127.0.0.1", port: int = 0,
+                 root: str = "/"):
+        self.filer_http = filer_http
+        self.filer_grpc = filer_grpc
+        self.root = root.rstrip("/")
+        self.http = HttpServer(host, port)
+        self.http.route("*", "/", self._dispatch)
+
+    def start(self) -> None:
+        self.http.start()
+
+    def stop(self) -> None:
+        self.http.stop()
+
+    @property
+    def address(self) -> str:
+        return self.http.address
+
+    def _filer(self):
+        return POOL.client(self.filer_grpc, "SeaweedFiler")
+
+    def _fpath(self, dav_path: str) -> str:
+        return (self.root + "/" + dav_path.strip("/")).rstrip("/") or "/"
+
+    def _lookup(self, path: str) -> "dict | None":
+        directory, _, name = path.rstrip("/").rpartition("/")
+        if not name:
+            return {"full_path": "/", "attr": {"mode": 0o40770},
+                    "chunks": []}
+        try:
+            return self._filer().call("LookupDirectoryEntry", {
+                "directory": directory or "/", "name": name})["entry"]
+        except RpcError:
+            return None
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch(self, req: Request) -> Response:
+        path = urllib.parse.unquote(req.path)
+        method = req.method
+        if method == "OPTIONS":
+            return Response(200, b"", headers={
+                "DAV": "1,2", "MS-Author-Via": "DAV",
+                "Allow": "OPTIONS, GET, HEAD, PUT, DELETE, PROPFIND, "
+                         "MKCOL, MOVE, COPY"})
+        if method == "PROPFIND":
+            return self._propfind(path, req)
+        if method in ("GET", "HEAD"):
+            return self._get(path, req)
+        if method == "PUT":
+            return self._put(path, req)
+        if method == "DELETE":
+            return self._delete(path)
+        if method == "MKCOL":
+            return self._mkcol(path)
+        if method in ("MOVE", "COPY"):
+            return self._move_copy(path, req, copy=(method == "COPY"))
+        return Response.error("method not allowed", 405)
+
+    # -- PROPFIND ----------------------------------------------------------
+    def _prop_response(self, ms: ET.Element, href: str,
+                       entry: dict) -> None:
+        is_dir = bool(entry["attr"].get("mode", 0) & 0o40000)
+        resp = ET.SubElement(ms, f"{{{DAV_NS}}}response")
+        h = ET.SubElement(resp, f"{{{DAV_NS}}}href")
+        h.text = urllib.parse.quote(href + ("/" if is_dir
+                                            and href != "/" else ""))
+        propstat = ET.SubElement(resp, f"{{{DAV_NS}}}propstat")
+        prop = ET.SubElement(propstat, f"{{{DAV_NS}}}prop")
+        rtype = ET.SubElement(prop, f"{{{DAV_NS}}}resourcetype")
+        if is_dir:
+            ET.SubElement(rtype, f"{{{DAV_NS}}}collection")
+        else:
+            size = sum(c.get("size", 0) for c in entry.get("chunks", []))
+            ET.SubElement(prop,
+                          f"{{{DAV_NS}}}getcontentlength").text = str(size)
+        ET.SubElement(prop, f"{{{DAV_NS}}}getlastmodified").text = \
+            _fmt_http_date(entry["attr"].get("mtime", 0))
+        ET.SubElement(prop, f"{{{DAV_NS}}}displayname").text = \
+            entry["full_path"].rstrip("/").rsplit("/", 1)[-1] or "/"
+        ET.SubElement(propstat, f"{{{DAV_NS}}}status").text = \
+            "HTTP/1.1 200 OK"
+
+    def _propfind(self, path: str, req: Request) -> Response:
+        fpath = self._fpath(path)
+        entry = self._lookup(fpath)
+        if entry is None:
+            return Response(404, b"")
+        depth = req.headers.get("Depth", "1")
+        ET.register_namespace("D", DAV_NS)
+        ms = ET.Element(f"{{{DAV_NS}}}multistatus")
+        self._prop_response(ms, path.rstrip("/") or "/", entry)
+        if depth != "0" and entry["attr"].get("mode", 0) & 0o40000:
+            try:
+                for r in self._filer().stream(
+                        "ListEntries", iter([{"directory": fpath}])):
+                    child = r["entry"]
+                    name = child["full_path"].rsplit("/", 1)[-1]
+                    self._prop_response(
+                        ms, (path.rstrip("/") or "") + "/" + name, child)
+            except RpcError:
+                pass
+        body = (b'<?xml version="1.0" encoding="utf-8"?>'
+                + ET.tostring(ms))
+        return Response(207, body,
+                        content_type='application/xml; charset="utf-8"')
+
+    # -- file ops -----------------------------------------------------------
+    def _filer_url(self, fpath: str) -> str:
+        return f"http://{self.filer_http}{urllib.parse.quote(fpath)}"
+
+    def _get(self, path: str, req: Request) -> Response:
+        headers = {}
+        if req.headers.get("Range"):
+            headers["Range"] = req.headers["Range"]
+        status, body, resp_headers = http_request(
+            self._filer_url(self._fpath(path)), method=req.method,
+            headers=headers)
+        out = Response(status, body,
+                       content_type=resp_headers.get(
+                           "Content-Type", "application/octet-stream"))
+        for h in ("Content-Range", "Accept-Ranges", "Content-Length"):
+            if h in resp_headers and req.method == "HEAD":
+                out.headers[h] = resp_headers[h]
+        return out
+
+    def _put(self, path: str, req: Request) -> Response:
+        status, body, _ = http_request(self._filer_url(self._fpath(path)),
+                                       method="POST", body=req.body)
+        return Response(201 if status < 300 else status, b"")
+
+    def _delete(self, path: str) -> Response:
+        status, _, _ = http_request(
+            self._filer_url(self._fpath(path)) + "?recursive=true",
+            method="DELETE")
+        return Response(204 if status in (204, 404) else status, b"")
+
+    def _mkcol(self, path: str) -> Response:
+        fpath = self._fpath(path)
+        if self._lookup(fpath) is not None:
+            return Response(405, b"")  # already exists
+        from ..filer.entry import new_directory_entry
+        e = new_directory_entry(fpath)
+        try:
+            self._filer().call("CreateEntry", {"entry": e.to_dict()})
+        except RpcError as ex:
+            return Response.error(str(ex), 409)
+        return Response(201, b"")
+
+    def _move_copy(self, path: str, req: Request, copy: bool) -> Response:
+        dest = req.headers.get("Destination", "")
+        if not dest:
+            return Response.error("missing Destination", 400)
+        dest_path = urllib.parse.unquote(urllib.parse.urlparse(dest).path)
+        src_f = self._fpath(path)
+        dst_f = self._fpath(dest_path)
+        if copy:
+            status, body, _ = http_request(self._filer_url(src_f))
+            if status != 200:
+                return Response(404, b"")
+            http_request(self._filer_url(dst_f), method="POST", body=body)
+            return Response(201, b"")
+        sd, _, sn = src_f.rpartition("/")
+        dd, _, dn = dst_f.rpartition("/")
+        try:
+            self._filer().call("AtomicRenameEntry", {
+                "old_directory": sd or "/", "old_name": sn,
+                "new_directory": dd or "/", "new_name": dn})
+        except RpcError as ex:
+            return Response.error(str(ex), 409)
+        return Response(201, b"")

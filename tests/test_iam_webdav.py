@@ -1,0 +1,133 @@
+"""IAM API + WebDAV gateway tests."""
+
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.filer import FilerServer
+from seaweedfs_tpu.master import MasterServer
+from seaweedfs_tpu.s3 import IdentityAccessManagement
+from seaweedfs_tpu.s3.iam import IamApiServer
+from seaweedfs_tpu.util.http import http_request
+from seaweedfs_tpu.volume_server import VolumeServer
+from seaweedfs_tpu.webdav import WebDavServer
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    master = MasterServer(seed=51)
+    master.start()
+    d = tmp_path / "vol"
+    d.mkdir()
+    vs = VolumeServer(master.grpc_address, [str(d)], pulse_seconds=0.5,
+                      max_volume_counts=[30])
+    vs.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topo.data_nodes()) < 1:
+        time.sleep(0.05)
+    filer = FilerServer(master.grpc_address)
+    filer.start()
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def iam_call(addr, action, **params):
+    body = urllib.parse.urlencode({"Action": action, **params}).encode()
+    status, resp, _ = http_request(
+        f"http://{addr}/", method="POST", body=body,
+        headers={"Content-Type": "application/x-www-form-urlencoded"})
+    return status, ET.fromstring(resp)
+
+
+def test_iam_user_lifecycle(stack):
+    master, vs, filer = stack
+    iam = IdentityAccessManagement()
+    srv = IamApiServer(iam, filer.grpc_address)
+    srv.start()
+    a = srv.address
+    status, root = iam_call(a, "CreateUser", UserName="alice")
+    assert status == 200
+    assert root.find(".//UserName").text == "alice"
+    status, root = iam_call(a, "CreateUser", UserName="alice")
+    assert status == 409
+    status, root = iam_call(a, "CreateAccessKey", UserName="alice")
+    access = root.find(".//AccessKeyId").text
+    secret = root.find(".//SecretAccessKey").text
+    assert access.startswith("AKID") and secret
+    assert iam.lookup_by_access_key(access).name == "alice"
+    # policy mapping -> actions
+    policy = ('{"Statement": [{"Effect": "Allow", '
+              '"Action": ["s3:GetObject", "s3:ListBucket"]}]}')
+    status, _ = iam_call(a, "PutUserPolicy", UserName="alice",
+                         PolicyName="p", PolicyDocument=policy)
+    assert status == 200
+    assert iam.lookup_by_access_key(access).actions == ["Read", "List"]
+    status, root = iam_call(a, "ListUsers")
+    assert [u.text for u in root.iter("UserName")] == ["alice"]
+    # persisted to filer KV: a fresh server reloads it
+    srv2 = IamApiServer(IdentityAccessManagement(), filer.grpc_address)
+    assert srv2.iam.lookup_by_access_key(access).name == "alice"
+    status, _ = iam_call(a, "DeleteUser", UserName="alice")
+    assert status == 200
+    status, _ = iam_call(a, "GetUser", UserName="alice")
+    assert status == 404
+    srv.stop()
+
+
+def test_webdav_crud_propfind_move(stack):
+    master, vs, filer = stack
+    dav = WebDavServer(filer.address, filer.grpc_address)
+    dav.start()
+    a = dav.address
+    # OPTIONS advertises DAV
+    status, _, headers = http_request(f"http://{a}/", method="OPTIONS")
+    assert status == 200 and "1,2" in headers.get("DAV", "")
+    # MKCOL + PUT + GET
+    assert http_request(f"http://{a}/projects", method="MKCOL")[0] == 201
+    assert http_request(f"http://{a}/projects", method="MKCOL")[0] == 405
+    status, _, _ = http_request(f"http://{a}/projects/readme.txt",
+                                method="PUT", body=b"dav content")
+    assert status == 201
+    status, body, _ = http_request(f"http://{a}/projects/readme.txt")
+    assert status == 200 and body == b"dav content"
+    # PROPFIND depth 1 lists the collection + children
+    status, body, _ = http_request(f"http://{a}/projects",
+                                   method="PROPFIND",
+                                   headers={"Depth": "1"})
+    assert status == 207
+    root = ET.fromstring(body)
+    hrefs = [h.text for h in root.iter("{DAV:}href")]
+    assert "/projects/" in hrefs and "/projects/readme.txt" in hrefs
+    sizes = [s.text for s in root.iter("{DAV:}getcontentlength")]
+    assert "11" in sizes
+    # depth 0 only self
+    status, body, _ = http_request(f"http://{a}/projects",
+                                   method="PROPFIND",
+                                   headers={"Depth": "0"})
+    assert len(list(ET.fromstring(body).iter("{DAV:}response"))) == 1
+    # MOVE
+    status, _, _ = http_request(
+        f"http://{a}/projects/readme.txt", method="MOVE",
+        headers={"Destination": f"http://{a}/projects/renamed.txt"})
+    assert status == 201
+    assert http_request(f"http://{a}/projects/readme.txt")[0] == 404
+    assert http_request(f"http://{a}/projects/renamed.txt")[1] \
+        == b"dav content"
+    # COPY
+    status, _, _ = http_request(
+        f"http://{a}/projects/renamed.txt", method="COPY",
+        headers={"Destination": f"http://{a}/projects/copy.txt"})
+    assert status == 201
+    assert http_request(f"http://{a}/projects/copy.txt")[1] \
+        == b"dav content"
+    # DELETE collection
+    assert http_request(f"http://{a}/projects",
+                        method="DELETE")[0] == 204
+    status, _, _ = http_request(f"http://{a}/projects",
+                                method="PROPFIND")
+    assert status == 404
+    dav.stop()

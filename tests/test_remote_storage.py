@@ -1,0 +1,96 @@
+"""Remote storage (cloud drive) tests: mount, lazy cache, uncache,
+read-through, push-back sync."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer import FilerServer
+from seaweedfs_tpu.master import MasterServer
+from seaweedfs_tpu.remote_storage import (LocalDirRemoteStorage,
+                                          RemoteMount, new_remote_storage)
+from seaweedfs_tpu.util.http import http_request
+from seaweedfs_tpu.volume_server import VolumeServer
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    master = MasterServer(seed=41)
+    master.start()
+    d = tmp_path / "vol"
+    d.mkdir()
+    vs = VolumeServer(master.grpc_address, [str(d)], pulse_seconds=0.5,
+                      max_volume_counts=[30])
+    vs.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topo.data_nodes()) < 1:
+        time.sleep(0.05)
+    filer = FilerServer(master.grpc_address)
+    filer.start()
+    cloud = LocalDirRemoteStorage(str(tmp_path / "cloud"))
+    yield master, vs, filer, cloud
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_backend_registry(tmp_path):
+    s = new_remote_storage("local", root=str(tmp_path / "c"))
+    s.write_object("a/b.txt", b"cloud data")
+    assert s.read_object("a/b.txt") == b"cloud data"
+    assert s.list_objects()[0]["key"] == "a/b.txt"
+    assert s.stat_object("a/b.txt")["size"] == 10
+    s.delete_object("a/b.txt")
+    assert s.list_objects() == []
+    with pytest.raises(RuntimeError):
+        new_remote_storage("s3")
+    with pytest.raises(ValueError):
+        new_remote_storage("nope")
+
+
+def test_mount_cache_uncache_readthrough(stack):
+    master, vs, filer, cloud = stack
+    cloud.write_object("reports/q1.txt", b"quarterly numbers")
+    cloud.write_object("reports/q2.txt", b"more numbers")
+    mount = RemoteMount(filer.grpc_address, master.grpc_address, cloud,
+                        "/buckets/clouddata")
+    assert mount.mount() == 2
+    # metadata visible through the filer without any local data
+    status, body, _ = http_request(
+        f"http://{filer.address}/buckets/clouddata/reports")
+    assert status == 200
+    assert not mount.is_cached("reports/q1.txt")
+    # read-through hits the remote
+    assert mount.read("reports/q1.txt") == b"quarterly numbers"
+    # cache pulls into local chunks; reads now come from the cluster
+    mount.cache("reports/q1.txt")
+    assert mount.is_cached("reports/q1.txt")
+    cloud.write_object("reports/q1.txt", b"CHANGED REMOTELY")
+    assert mount.read("reports/q1.txt") == b"quarterly numbers"  # local
+    # uncache drops chunks, metadata stays, reads fall through again
+    mount.uncache("reports/q1.txt")
+    assert not mount.is_cached("reports/q1.txt")
+    assert mount.read("reports/q1.txt") == b"CHANGED REMOTELY"
+
+
+def test_sync_to_remote_pushes_local_writes(stack):
+    master, vs, filer, cloud = stack
+    mount = RemoteMount(filer.grpc_address, master.grpc_address, cloud,
+                        "/buckets/push")
+    mount.mount()
+    # write a new file under the mount through the filer
+    status, _, _ = http_request(
+        f"http://{filer.address}/buckets/push/new/file.bin",
+        method="POST", body=b"written locally")
+    assert status == 201
+    pushed = mount.sync_to_remote()
+    assert pushed == 1
+    assert cloud.read_object("new/file.bin") == b"written locally"
+    # second sync is a no-op (mtimes recorded)
+    assert mount.sync_to_remote() == 0
+    # modify locally -> pushed again
+    time.sleep(0.02)
+    http_request(f"http://{filer.address}/buckets/push/new/file.bin",
+                 method="POST", body=b"v2")
+    assert mount.sync_to_remote() == 1
+    assert cloud.read_object("new/file.bin") == b"v2"
