@@ -189,10 +189,16 @@ def cmd_delete(args) -> int:
 
 
 def cmd_benchmark(args) -> int:
-    from .benchmark import run_benchmark
-    run_benchmark(args.master, n_files=args.n, file_size=args.size,
-                  concurrency=args.c, collection=args.collection,
-                  write_only=args.write_only)
+    from .benchmark import run_benchmark, run_benchmark_mp
+    if args.p > 1:
+        run_benchmark_mp(args.master, n_files=args.n,
+                         file_size=args.size, processes=args.p,
+                         collection=args.collection,
+                         write_only=args.write_only)
+    else:
+        run_benchmark(args.master, n_files=args.n, file_size=args.size,
+                      concurrency=args.c, collection=args.collection,
+                      write_only=args.write_only)
     return 0
 
 
@@ -311,7 +317,10 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("-master", default="127.0.0.1:19333")
     b.add_argument("-n", type=int, default=10000)
     b.add_argument("-size", type=int, default=1024)
-    b.add_argument("-c", type=int, default=16)
+    b.add_argument("-c", type=int, default=16,
+                   help="threads (single-process mode)")
+    b.add_argument("-p", type=int, default=4,
+                   help="worker processes (1 = threaded mode)")
     b.add_argument("-collection", default="")
     b.add_argument("-writeOnly", dest="write_only", action="store_true")
     b.set_defaults(fn=cmd_benchmark)

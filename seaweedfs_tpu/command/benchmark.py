@@ -60,6 +60,105 @@ def _run_workers(n_workers: int, task) -> None:
         t.join()
 
 
+def _mp_write_worker(args) -> tuple[list[float], list[str], int]:
+    """One write worker process: batched assigns (count=N amortizes the
+    master round-trip) + uploads."""
+    master_grpc, n, file_size, collection, batch = args
+    payload = random.Random(0).randbytes(file_size)
+    lats: list[float] = []
+    fids: list[str] = []
+    failed = 0
+    remaining = n
+    while remaining > 0:
+        take = min(batch, remaining)
+        remaining -= take
+        try:
+            r = operation.assign(master_grpc, count=take,
+                                 collection=collection)
+        except Exception:
+            failed += take
+            continue
+        for fid in operation.derive_fids(r):
+            t0 = time.time()
+            try:
+                operation.upload_data(r.url, fid, payload, jwt=r.auth)
+                lats.append(time.time() - t0)
+                fids.append(fid)
+            except Exception:
+                failed += 1
+    return lats, fids, failed
+
+
+def _mp_read_worker(args) -> tuple[list[float], int, int]:
+    master_grpc, fids, seed = args
+    rng = random.Random(seed)
+    lats: list[float] = []
+    nbytes = 0
+    failed = 0
+    for _ in range(len(fids)):
+        fid = rng.choice(fids)
+        t0 = time.time()
+        try:
+            data = operation.read_file(master_grpc, fid)
+            lats.append(time.time() - t0)
+            nbytes += len(data)
+        except Exception:
+            failed += 1
+    return lats, nbytes, failed
+
+
+def run_benchmark_mp(master_grpc: str, n_files: int = 10000,
+                     file_size: int = 1024, processes: int = 4,
+                     collection: str = "", write_only: bool = False,
+                     assign_batch: int = 100, quiet: bool = False) -> dict:
+    """Multi-process load generator: Python threads share one GIL, so the
+    threaded path tops out near single-core client throughput; worker
+    PROCESSES scale until the servers saturate (the Go reference gets this
+    for free from goroutines)."""
+    import multiprocessing as mp
+    ctx = mp.get_context("spawn")  # fork + live grpc channels is unsafe
+    results: dict = {}
+    share = [n_files // processes + (1 if i < n_files % processes else 0)
+             for i in range(processes)]
+    t0 = time.time()
+    with ctx.Pool(processes) as pool:
+        outs = pool.map(_mp_write_worker,
+                        [(master_grpc, s, file_size, collection,
+                          assign_batch) for s in share])
+    wall = time.time() - t0
+    stats = _Stats()
+    fids: list[str] = []
+    for lats, worker_fids, failed in outs:
+        stats.latencies.extend(lats)
+        stats.bytes += len(lats) * file_size
+        stats.failed += failed
+        fids.extend(worker_fids)
+    results["write"] = stats.report("write", wall)
+    if not quiet:
+        _print_report(results["write"], file_size, processes)
+
+    if not write_only and fids:
+        per = max(1, len(fids) // processes)
+        chunks = [fids[i * per:(i + 1) * per]
+                  for i in range(processes)]
+        chunks = [c for c in chunks if c]
+        t0 = time.time()
+        with ctx.Pool(len(chunks)) as pool:
+            outs = pool.map(_mp_read_worker,
+                            [(master_grpc, c, i)
+                             for i, c in enumerate(chunks)])
+        wall = time.time() - t0
+        stats = _Stats()
+        for lats, nbytes, failed in outs:
+            stats.latencies.extend(lats)
+            stats.bytes += nbytes
+            stats.failed += failed
+        results["read"] = stats.report("read", wall)
+        if not quiet:
+            _print_report(results["read"], file_size, processes)
+    return results
+
+
 def run_benchmark(master_grpc: str, n_files: int = 10000,
                   file_size: int = 1024, concurrency: int = 16,
                   collection: str = "", write_only: bool = False,

@@ -352,12 +352,10 @@ class FilerServer:
         path_prefix = req.get("path_prefix", "/")
         q: "queue.Queue[dict]" = queue.Queue()
 
-        prefix = path_prefix.rstrip("/")
+        from ..util import path_matches_prefix
 
         def on_event(ev):
-            # path-boundary match: /app covers /app and /app/x, not /apple
-            if (not prefix or ev.directory == prefix
-                    or ev.directory.startswith(prefix + "/")):
+            if path_matches_prefix(ev.directory, path_prefix):
                 q.put(ev.to_dict())
 
         unsubscribe = self.filer.subscribe(on_event, since_ts_ns=since)

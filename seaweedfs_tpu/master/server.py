@@ -140,10 +140,13 @@ class MasterServer:
                          for dn in nodes[1:]],
         }
         if self.jwt_signing_key:
-            # sign the write authorization (master_server_handlers.go:146)
+            # sign the write authorization (master_server_handlers.go:146);
+            # a count>1 batch gets a volume-scoped token valid for every
+            # derived fid (verify_fid_jwt accepts vid-only claims)
             from ..security import gen_jwt
             out["auth"] = gen_jwt(self.jwt_signing_key,
-                                  self.jwt_expires_seconds, fid)
+                                  self.jwt_expires_seconds,
+                                  fid if count == 1 else str(vid))
         return out
 
     def _grow(self, option: VolumeGrowOption) -> None:

@@ -179,19 +179,36 @@ class MessageBroker:
                 if sent >= offset:
                     yield {"data": r}
                 sent += 1
-        # then tail the live buffer
+        sent = max(sent, offset)
+        # then tail: a flush may move buffered messages into a NEW segment
+        # between snapshots, so the gap [sent, flushed) must be re-read
+        # from segments before serving the live buffer
         while not self._stop.is_set():
             with part.cond:
                 flushed = part.flushed_count
                 live = list(part.buffer)
-            total_before_live = flushed
+                segs = list(part.segments)
+            if sent < flushed:
+                for seg_path in segs:
+                    start = int(seg_path.rsplit("/", 1)[-1][:-4])
+                    if start >= flushed:
+                        break
+                    records = self._read_segment(seg_path)
+                    if start + len(records) <= sent:
+                        continue
+                    for i in range(max(0, sent - start), len(records)):
+                        yield {"data": records[i]}
+                        sent = start + i + 1
+                continue  # re-snapshot: more may have flushed meanwhile
             for i, r in enumerate(live):
-                seq = total_before_live + i
-                if seq >= sent and seq >= offset:
+                seq = flushed + i
+                if seq >= sent:
                     yield {"data": r}
                     sent = seq + 1
             with part.cond:
-                if not part.cond.wait(timeout=0.3):
+                if (part.flushed_count == flushed
+                        and len(part.buffer) == len(live)
+                        and not part.cond.wait(timeout=0.3)):
                     yield {"ping": 1}
 
     def _read_segment(self, path: str) -> list[dict]:

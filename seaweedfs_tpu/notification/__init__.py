@@ -68,11 +68,10 @@ def new_message_queue(kind: str, **kw) -> MessageQueue:
 def attach_to_filer(filer, mq: MessageQueue, path_prefix: str = "/"):
     """Publish every metadata event (filer_notify.go notifyUpdateEvent);
     returns the unsubscribe function."""
-    prefix = path_prefix.rstrip("/")
+    from ..util import path_matches_prefix
 
     def on_event(ev):
-        if prefix and not (ev.directory == prefix
-                           or ev.directory.startswith(prefix + "/")):
+        if not path_matches_prefix(ev.directory, path_prefix):
             return
         mq.send_message(ev.directory, ev.to_dict())
 

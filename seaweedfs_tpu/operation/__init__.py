@@ -38,6 +38,15 @@ def assign(master_grpc: str, count: int = 1, replication: str = "",
                         auth=out.get("auth", ""))
 
 
+def derive_fids(r: AssignResult) -> list[str]:
+    """Expand a count>1 assign into its file ids: the master reserves
+    `count` consecutive keys sharing one cookie (assign_file_id.go)."""
+    vid, rest = r.fid.split(",")
+    cookie = rest[-8:]
+    key = int(rest[:-8], 16)
+    return [f"{vid},{key + i:x}{cookie}" for i in range(r.count)]
+
+
 def upload_data(url_or_server: str, fid: str, data: bytes,
                 name: str = "", mime: str = "", ttl: str = "",
                 jwt: str = "") -> dict:
@@ -62,25 +71,49 @@ def assign_and_upload(master_grpc: str, data: bytes, **kw) -> str:
     return r.fid
 
 
+# vid -> (expires, locations): the client-side vid cache every reader
+# shares (the reference's wdclient vidMap; 11s = freshest staleness tier)
+_LOOKUP_CACHE: dict = {}
+_LOOKUP_TTL = 11.0
+
+
 def lookup_volume(master_grpc: str, vid: int,
                   collection: str = "") -> list[dict]:
+    key = (master_grpc, vid, collection)
+    hit = _LOOKUP_CACHE.get(key)
+    now = time.time()
+    if hit and hit[0] > now:
+        return hit[1]
     client = POOL.client(master_grpc, "Seaweed")
     out = client.call("LookupVolume", {
         "volume_or_file_ids": [str(vid)], "collection": collection})
-    return out["volume_id_locations"][str(vid)]["locations"]
+    locs = out["volume_id_locations"][str(vid)]["locations"]
+    if locs:
+        _LOOKUP_CACHE[key] = (now + _LOOKUP_TTL, locs)
+    return locs
 
 
 def read_file(master_grpc: str, fid: str) -> bytes:
     vid = int(fid.split(",")[0])
-    locs = lookup_volume(master_grpc, vid)
-    if not locs:
-        raise RuntimeError(f"volume {vid} has no locations")
     last_err = ""
-    for loc in locs:
-        status, body, _ = http_request(f"http://{loc['url']}/{fid}")
-        if status == 200:
-            return body
-        last_err = f"{loc['url']}: HTTP {status}"
+    for fresh in (False, True):
+        if fresh:
+            # every cached location failed — the volume may have moved;
+            # evict and retry against the master's current view
+            _LOOKUP_CACHE.pop((master_grpc, vid, ""), None)
+        locs = lookup_volume(master_grpc, vid)
+        if not locs:
+            raise RuntimeError(f"volume {vid} has no locations")
+        for loc in locs:
+            try:
+                status, body, _ = http_request(
+                    f"http://{loc['url']}/{fid}")
+            except OSError as e:
+                last_err = f"{loc['url']}: {e}"
+                continue
+            if status == 200:
+                return body
+            last_err = f"{loc['url']}: HTTP {status}"
     raise RuntimeError(f"read {fid} failed: {last_err}")
 
 

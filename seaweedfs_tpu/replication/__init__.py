@@ -143,8 +143,8 @@ class Replicator:
         self.path_prefix = path_prefix.rstrip("/") or ""
 
     def _in_scope(self, path: str) -> bool:
-        return (not self.path_prefix or path == self.path_prefix
-                or path.startswith(self.path_prefix + "/"))
+        from ..util import path_matches_prefix
+        return path_matches_prefix(path, self.path_prefix)
 
     def replicate(self, event: dict) -> bool:
         """event = MetaEvent.to_dict(); returns True when applied."""

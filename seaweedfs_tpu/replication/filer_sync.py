@@ -78,6 +78,8 @@ class SyncDirection:
         since = self._load_offset()
         client = POOL.client(self.source_filer, "SeaweedFiler")
         applied = 0
+        last_ts = 0
+        unsaved = 0
         for msg in client.stream("SubscribeMetadata",
                                  iter([{"since_ns": since,
                                         "path_prefix": self.path_prefix}])):
@@ -85,9 +87,17 @@ class SyncDirection:
                 break  # caught up with the live tail
             if self.replicator.replicate(msg):
                 applied += 1
-            self._save_offset(msg["ts_ns"])
+            last_ts = msg["ts_ns"]
+            unsaved += 1
+            # persist periodically, not per event (filer_sync.go saves on
+            # a ~3s timer); a crash replays at most the unsaved window
+            if unsaved >= 100:
+                self._save_offset(last_ts)
+                unsaved = 0
             if max_events and applied >= max_events:
                 break
+        if unsaved and last_ts:
+            self._save_offset(last_ts)
         self.applied += applied
         return applied
 

@@ -1,1 +1,10 @@
 """Shared utilities (reference weed/util)."""
+
+
+def path_matches_prefix(path: str, prefix: str) -> bool:
+    """Path-boundary prefix match: '/app' covers '/app' and '/app/x' but
+    NOT '/apple'.  Empty or '/' prefix matches everything."""
+    prefix = (prefix or "").rstrip("/")
+    if not prefix:
+        return True
+    return path == prefix or path.startswith(prefix + "/")

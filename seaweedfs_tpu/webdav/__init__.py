@@ -145,14 +145,20 @@ class WebDavServer:
         out = Response(status, body,
                        content_type=resp_headers.get(
                            "Content-Type", "application/octet-stream"))
-        for h in ("Content-Range", "Accept-Ranges", "Content-Length"):
-            if h in resp_headers and req.method == "HEAD":
+        for h in ("Content-Range", "Accept-Ranges"):
+            if h in resp_headers:
                 out.headers[h] = resp_headers[h]
+        if req.method == "HEAD" and "Content-Length" in resp_headers:
+            out.headers["Content-Length"] = resp_headers["Content-Length"]
         return out
 
     def _put(self, path: str, req: Request) -> Response:
+        headers = {}
+        if req.headers.get("Content-Type"):
+            headers["Content-Type"] = req.headers["Content-Type"]
         status, body, _ = http_request(self._filer_url(self._fpath(path)),
-                                       method="POST", body=req.body)
+                                       method="POST", body=req.body,
+                                       headers=headers)
         return Response(201 if status < 300 else status, b"")
 
     def _delete(self, path: str) -> Response:

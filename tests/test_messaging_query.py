@@ -85,6 +85,41 @@ def test_segments_survive_broker_restart(stack):
     broker2.stop()
 
 
+def test_no_message_loss_across_flush_race(stack):
+    """Regression: a flush between tail snapshots moved messages out of
+    the live buffer into a NEW segment; the subscriber must re-read the
+    gap from segments — every message exactly once."""
+    import threading
+    *_, broker = stack
+    pub = Publisher(broker.grpc_address, "racy")
+    p = partition_for_key("same", 4)
+    got = []
+    done = threading.Event()
+
+    def consume():
+        from seaweedfs_tpu.pb.rpc import POOL
+        client = POOL.client(broker.grpc_address, "SeaweedMessaging")
+        for reply in client.stream("Subscribe", iter([{
+                "init": {"namespace": "default", "topic": "racy",
+                         "partition": p, "start_offset": 0}}])):
+            if "data" in reply:
+                got.append(reply["data"]["value"])
+                if len(got) >= 300:
+                    break
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    # publish with an aggressive flush after every message to maximize
+    # the buffer->segment races the tail loop must survive
+    for i in range(300):
+        pub.publish([("same", f"m{i}")])
+        broker.flush_all()
+    t.join(timeout=20)
+    assert got == [f"m{i}" for i in range(300)], (
+        len(got), [x for x in (f"m{i}" for i in range(300))
+                   if x not in got][:5])
+
+
 def test_topic_configure_and_delete(stack):
     *_, broker = stack
     c = POOL.client(broker.grpc_address, "SeaweedMessaging")
