@@ -28,10 +28,11 @@ def _wait_forever():
 
 def cmd_master(args) -> int:
     from ..master import MasterServer
+    peers = [p.strip() for p in args.peers.split(",") if p.strip()]
     m = MasterServer(host=args.ip, port=args.port, grpc_port=args.grpc_port,
                      volume_size_limit_mb=args.volume_size_limit_mb,
                      default_replication=args.default_replication,
-                     jwt_signing_key=args.jwt_key)
+                     jwt_signing_key=args.jwt_key, peers=peers)
     m.start()
     print(f"master http {m.address} grpc {m.grpc_address}")
     _wait_forever()
@@ -234,6 +235,8 @@ def build_parser() -> argparse.ArgumentParser:
                    default="000")
     m.add_argument("-jwtKey", dest="jwt_key", default="",
                    help="HS256 signing key gating volume writes")
+    m.add_argument("-peers", default="",
+                   help="comma-separated master gRPC addresses for HA")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume", help="start a volume server")
@@ -319,8 +322,9 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("-size", type=int, default=1024)
     b.add_argument("-c", type=int, default=16,
                    help="threads (single-process mode)")
-    b.add_argument("-p", type=int, default=4,
-                   help="worker processes (1 = threaded mode)")
+    b.add_argument("-p", type=int, default=1,
+                   help="worker processes (>1 switches to multiprocess "
+                        "mode and ignores -c)")
     b.add_argument("-collection", default="")
     b.add_argument("-writeOnly", dest="write_only", action="store_true")
     b.set_defaults(fn=cmd_benchmark)

@@ -1,0 +1,131 @@
+"""Master HA — leader election + state replication across master peers.
+
+Capability-equivalent to the reference's raft layer (weed/server/
+raft_server.go + chrislusf/raft): the replicated state machine there is
+just the max-volume-id counter and the sequencer (topology/
+cluster_commands.go), so a lease-based election with state piggybacking
+reproduces the behavior without a log: every master pings its peers each
+second ("Ping" RPC carrying its max-volume-id/sequencer); the leader is
+the smallest address among live peers; followers adopt the leader's
+counters and proxy Assign/Vacuum to it (proxyToLeader,
+master_server.go:180).  Volume servers learn the leader from heartbeat
+replies and re-home their stream (the reference does the same via the
+heartbeat's leader field).
+
+Trade-off vs raft: a network partition can briefly elect two leaders; the
+counters are monotonic and partition-merged with max(), so the damage is
+bounded to duplicate fid cookies (detected by cookie check) — acceptable
+for the control plane's only replicated value.  A full raft log can slot
+in behind the same is_leader/leader_address seam.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..pb.rpc import POOL, RpcError
+
+PING_INTERVAL = 1.0
+PEER_DEAD_AFTER = 3.0
+
+
+def normalize_addr(addr: str) -> str:
+    """Canonicalize host aliases so string comparison of peer addresses is
+    meaningful — 'localhost:19333' and '127.0.0.1:19333' must elect ONE
+    leader, not two."""
+    host, _, port = addr.rpartition(":")
+    if host in ("localhost", "", "0.0.0.0", "::1"):
+        host = "127.0.0.1"
+    return f"{host}:{port}"
+
+
+class HaCoordinator:
+    def __init__(self, master, peers: list[str]):
+        """peers: gRPC addresses of ALL masters including self."""
+        self.master = master
+        self.self_addr = normalize_addr(master.grpc_address)
+        self.peers = sorted({normalize_addr(p) for p in peers}
+                            | {self.self_addr})
+        self._last_seen: dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    # -- liveness ----------------------------------------------------------
+    def alive_peers(self) -> list[str]:
+        now = time.time()
+        with self._lock:
+            return sorted(
+                {self.self_addr}
+                | {p for p, ts in self._last_seen.items()
+                   if now - ts < PEER_DEAD_AFTER})
+
+    def leader_address(self) -> str:
+        return self.alive_peers()[0]
+
+    def is_leader(self) -> bool:
+        return self.leader_address() == self.self_addr
+
+    # -- ping loop ---------------------------------------------------------
+    def _ping_once(self) -> None:
+        payload = {
+            "addr": self.self_addr,
+            "max_volume_id": self.master.topo.max_volume_id,
+            "sequence": self.master.sequencer.peek(),
+        }
+
+        def ping(peer: str) -> None:
+            try:
+                out = POOL.client(peer, "Seaweed").call(
+                    "MasterPing", payload, timeout=2.0)
+                with self._lock:
+                    self._last_seen[peer] = time.time()
+                self._adopt(out)
+            except RpcError:
+                pass
+
+        # concurrent pings: serial 2s timeouts against dark peers would
+        # stretch a round past PEER_DEAD_AFTER and flap leadership
+        threads = [threading.Thread(target=ping, args=(p,), daemon=True)
+                   for p in self.peers if p != self.self_addr]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=2.5)
+        self.master.is_leader = self.is_leader()
+
+    def _adopt(self, state: dict) -> None:
+        """Merge a peer's counters (monotonic, max-merge)."""
+        with self.master.topo._lock:
+            self.master.topo.max_volume_id = max(
+                self.master.topo.max_volume_id,
+                int(state.get("max_volume_id") or 0))
+        self.master.sequencer.set_max(int(state.get("sequence") or 1) - 1)
+
+    def handle_ping(self, req: dict) -> dict:
+        with self._lock:
+            self._last_seen[normalize_addr(req["addr"])] = time.time()
+        self._adopt(req)
+        self.master.is_leader = self.is_leader()
+        return {
+            "addr": self.self_addr,
+            "max_volume_id": self.master.topo.max_volume_id,
+            "sequence": self.master.sequencer.peek(),
+            "leader": self.leader_address(),
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.self_addr = normalize_addr(self.master.grpc_address)
+        self.peers = sorted(set(self.peers) | {self.self_addr})
+        self._ping_once()
+
+        def loop():
+            while not self._stop.wait(PING_INTERVAL):
+                self._ping_once()
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

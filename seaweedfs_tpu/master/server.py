@@ -57,6 +57,7 @@ class MasterServer:
                  garbage_threshold: float = 0.3,
                  jwt_signing_key: str = "",
                  jwt_expires_seconds: int = 10,
+                 peers: list[str] | None = None,
                  seed: int | None = None):
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024, seed=seed)
@@ -68,6 +69,8 @@ class MasterServer:
         from ..stats import ServerMetrics
         self.metrics = ServerMetrics()
         self.is_leader = True
+        self.ha = None
+        self._peers = peers or []
         self._rng = random.Random(seed)
         self._grow_lock = threading.Lock()
         # admin maintenance lock (LeaseAdminToken)
@@ -89,10 +92,20 @@ class MasterServer:
     def start(self) -> None:
         self.http.start()
         self.rpc.start()
+        if self._peers:
+            from .ha import HaCoordinator
+            self.ha = HaCoordinator(self, self._peers)
+            self.ha.start()
 
     def stop(self) -> None:
+        if self.ha:
+            self.ha.stop()
         self.http.stop()
         self.rpc.stop()
+
+    @property
+    def leader_grpc(self) -> str:
+        return self.ha.leader_address() if self.ha else self.grpc_address
 
     @property
     def address(self) -> str:
@@ -116,7 +129,11 @@ class MasterServer:
 
     def assign(self, req: dict) -> dict:
         if not self.is_leader:
-            raise RpcError("not the leader")
+            # transparent follower proxy (proxyToLeader master_server.go:180)
+            leader = self.leader_grpc
+            if leader == self.grpc_address:
+                raise RpcError("no leader elected")
+            return POOL.client(leader, "Seaweed").call("Assign", req)
         count = int(req.get("count") or 1)
         option = self._grow_option(req)
         if not self.topo.has_writable_volume(option):
@@ -189,7 +206,7 @@ class MasterServer:
                 dn = self._ingest_heartbeat(hb, dn)
                 yield {
                     "volume_size_limit": self.topo.volume_size_limit,
-                    "leader": self.grpc_address,
+                    "leader": self.leader_grpc,
                 }
         finally:
             if dn is not None:
@@ -306,16 +323,22 @@ class MasterServer:
                 "GetMasterConfiguration": lambda req: {
                     "volume_size_limit_m_b":
                         self.topo.volume_size_limit // (1024 * 1024),
-                    "leader": self.grpc_address},
+                    "leader": self.leader_grpc},
                 "LeaseAdminToken": self._lease_admin_token,
                 "ReleaseAdminToken": self._release_admin_token,
                 "VolumeList": lambda req: {"topology": self.topo.to_dict()},
                 "Vacuum": self._rpc_vacuum,
+                "MasterPing": self._rpc_master_ping,
             },
             stream={
                 "SendHeartbeat": self._handle_heartbeat_stream,
                 "KeepConnected": self._handle_keep_connected,
             })
+
+    def _rpc_master_ping(self, req: dict) -> dict:
+        if self.ha is None:
+            raise RpcError("HA not configured on this master")
+        return self.ha.handle_ping(req)
 
     def _rpc_vacuum(self, req: dict) -> dict:
         from . import vacuum as vacuum_mod
@@ -324,6 +347,10 @@ class MasterServer:
         return {"vacuumed": vacuum_mod.vacuum(self.topo, threshold)}
 
     def _rpc_lookup_volume(self, req: dict) -> dict:
+        if not self.is_leader and self.leader_grpc != self.grpc_address:
+            # followers have no heartbeat-fed topology; ask the leader
+            return POOL.client(self.leader_grpc, "Seaweed").call(
+                "LookupVolume", req)
         self.metrics.master_lookup.inc()
         out = {}
         for vid_s in req.get("volume_or_file_ids", []):
@@ -341,6 +368,9 @@ class MasterServer:
         return {"volume_id_locations": out}
 
     def _rpc_lookup_ec_volume(self, req: dict) -> dict:
+        if not self.is_leader and self.leader_grpc != self.grpc_address:
+            return POOL.client(self.leader_grpc, "Seaweed").call(
+                "LookupEcVolume", req)
         vid = int(req["volume_id"])
         by_shard = self.topo.lookup_ec_shards(vid)
         if not by_shard:

@@ -104,11 +104,12 @@ def read_file(master_grpc: str, fid: str) -> bytes:
         locs = lookup_volume(master_grpc, vid)
         if not locs:
             raise RuntimeError(f"volume {vid} has no locations")
+        import http.client
         for loc in locs:
             try:
                 status, body, _ = http_request(
                     f"http://{loc['url']}/{fid}")
-            except OSError as e:
+            except (OSError, http.client.HTTPException) as e:
                 last_err = f"{loc['url']}: {e}"
                 continue
             if status == 200:

@@ -200,7 +200,8 @@ class _ConnPool:
         key = (parsed.hostname, parsed.port, timeout)
         conns = self._conns()
         path = parsed.path + (f"?{parsed.query}" if parsed.query else "")
-        for attempt in (0, 1):  # one retry on a stale kept-alive socket
+        for attempt in (0, 1):
+            reused = key in conns
             conn = conns.get(key)
             if conn is None:
                 conn = _Conn(parsed.hostname, parsed.port,
@@ -213,11 +214,18 @@ class _ConnPool:
             except (http.client.HTTPException, ConnectionError, OSError):
                 conn.close()
                 conns.pop(key, None)
-                if attempt:
+                # retry ONLY a reused keep-alive socket that may simply
+                # have gone stale; a fresh connection's failure (refused,
+                # timeout) is real — re-sending could double-apply a POST
+                if attempt or not reused:
                     raise
                 continue
             resp_headers = dict(resp.getheaders())
-            if resp.status in (301, 302, 307, 308) and follow_redirects:
+            if resp.status in (301, 302, 307, 308) and follow_redirects \
+                    and method in ("GET", "HEAD"):
+                # only safe methods auto-follow: replaying a POST body at
+                # a redirect target could turn a misrouted read into a
+                # duplicate write
                 loc = resp_headers.get("Location", "")
                 if loc:
                     if loc.startswith("/"):

@@ -47,7 +47,11 @@ class VolumeServer:
                  max_volume_counts: list[int] | None = None,
                  pulse_seconds: float = PULSE_SECONDS,
                  jwt_signing_key: str = ""):
-        self.master_grpc = master_grpc
+        # master_grpc may be a comma-separated list; heartbeats rotate
+        # through it and re-home to whatever leader the replies announce
+        self._masters = [m.strip() for m in master_grpc.split(",")
+                         if m.strip()]
+        self.master_grpc = self._masters[0]
         self.data_center = data_center
         self.rack = rack
         self.jwt_signing_key = jwt_signing_key
@@ -114,6 +118,7 @@ class VolumeServer:
         }
 
     def _heartbeat_loop(self) -> None:
+        target_idx = 0
         while not self._stop.is_set():
             try:
                 client = POOL.client(self.master_grpc, "Seaweed")
@@ -132,11 +137,35 @@ class VolumeServer:
                         self._hb_acked_gen = self._hb_inflight.pop(0)
                     if reply.get("volume_size_limit"):
                         self.volume_size_limit = reply["volume_size_limit"]
+                    leader = reply.get("leader", "")
+                    if leader and leader != self.master_grpc \
+                            and self._leader_reachable(leader):
+                        # re-home to the announced leader
+                        # (volume_grpc_client_to_master.go leader chase)
+                        self.master_grpc = leader
+                        self._hb_inflight.clear()
+                        break
                     if self._stop.is_set():
                         break
             except RpcError:
                 self._hb_inflight.clear()
+                # rotate to the next configured master
+                target_idx = (target_idx + 1) % len(self._masters)
+                self.master_grpc = self._masters[target_idx]
             self._stop.wait(1.0)
+
+    def _leader_reachable(self, leader: str) -> bool:
+        """Guard against re-home flapping: an announced leader address may
+        be an unreachable alias (e.g. the master's 127.0.0.1 view of
+        itself seen from another machine) — only switch if it answers."""
+        if leader in self._masters:
+            return True
+        try:
+            POOL.client(leader, "Seaweed").call("GetMasterConfiguration",
+                                                {}, timeout=2.0)
+            return True
+        except RpcError:
+            return False
 
     def heartbeat_now(self, timeout: float = 5.0) -> None:
         """Push a fresh snapshot through the PERSISTENT stream and wait for
