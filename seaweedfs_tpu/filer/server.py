@@ -298,7 +298,8 @@ class FilerServer:
     def _rpc_delete_entry(self, req: dict) -> dict:
         directory = req.get("directory", "/").rstrip("/") or "/"
         name = req.get("name", "")
-        path = (directory + "/" + name) if name else directory
+        path = ((directory.rstrip("/") + "/" + name) if name
+                else directory)
         try:
             self.filer.delete_entry(
                 path, recursive=req.get("is_recursive", False),

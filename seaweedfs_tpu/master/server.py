@@ -107,6 +107,12 @@ class MasterServer:
     def leader_grpc(self) -> str:
         return self.ha.leader_address() if self.ha else self.grpc_address
 
+    def _self_grpc(self) -> str:
+        """Normalized self address — leader comparisons must not treat
+        '0.0.0.0:p' vs '127.0.0.1:p' as different masters (a master
+        proxying to itself recurses)."""
+        return self.ha.self_addr if self.ha else self.grpc_address
+
     @property
     def address(self) -> str:
         return self.http.address
@@ -131,7 +137,7 @@ class MasterServer:
         if not self.is_leader:
             # transparent follower proxy (proxyToLeader master_server.go:180)
             leader = self.leader_grpc
-            if leader == self.grpc_address:
+            if leader == self._self_grpc():
                 raise RpcError("no leader elected")
             return POOL.client(leader, "Seaweed").call("Assign", req)
         count = int(req.get("count") or 1)
@@ -347,7 +353,7 @@ class MasterServer:
         return {"vacuumed": vacuum_mod.vacuum(self.topo, threshold)}
 
     def _rpc_lookup_volume(self, req: dict) -> dict:
-        if not self.is_leader and self.leader_grpc != self.grpc_address:
+        if not self.is_leader and self.leader_grpc != self._self_grpc():
             # followers have no heartbeat-fed topology; ask the leader
             return POOL.client(self.leader_grpc, "Seaweed").call(
                 "LookupVolume", req)
@@ -368,7 +374,7 @@ class MasterServer:
         return {"volume_id_locations": out}
 
     def _rpc_lookup_ec_volume(self, req: dict) -> dict:
-        if not self.is_leader and self.leader_grpc != self.grpc_address:
+        if not self.is_leader and self.leader_grpc != self._self_grpc():
             return POOL.client(self.leader_grpc, "Seaweed").call(
                 "LookupEcVolume", req)
         vid = int(req["volume_id"])

@@ -1,0 +1,9 @@
+"""FUSE mount subsystem (reference weed/mount): WeedFS operation layer,
+inode<->path map, local meta cache with subscription, page-writer upload
+pipeline.  A kernel FUSE adapter requires libfuse Python bindings (absent
+in this image); WeedFS's operations are directly callable instead."""
+
+from .meta_cache import MetaCache
+from .page_writer import PageWriter
+from .weedfs import (EEXIST, EISDIR, ENOENT, ENOTDIR, ENOTEMPTY, FuseError,
+                     InodeToPath, WeedFS)

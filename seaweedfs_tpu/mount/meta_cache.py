@@ -1,0 +1,110 @@
+"""Local metadata cache for the mount — primed lazily, kept fresh by the
+filer's metadata subscription.
+
+Capability-equivalent to weed/mount/meta_cache (leveldb-backed there;
+in-memory dict here — the mount process dies with its cache either way):
+lookups hit the cache; a background SubscribeMetadata stream applies
+create/update/delete events so other writers' changes become visible
+without re-listing (meta_cache_subscribe.go).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..pb.rpc import POOL, RpcError
+
+
+class MetaCache:
+    def __init__(self, filer_grpc: str):
+        self.filer_grpc = filer_grpc
+        self._entries: dict[str, dict] = {}
+        self._listed_dirs: set[str] = set()
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _filer(self):
+        return POOL.client(self.filer_grpc, "SeaweedFiler")
+
+    # -- reads -------------------------------------------------------------
+    def lookup(self, path: str) -> "dict | None":
+        with self._lock:
+            if path in self._entries:
+                return self._entries[path]
+        directory, _, name = path.rstrip("/").rpartition("/")
+        try:
+            entry = self._filer().call("LookupDirectoryEntry", {
+                "directory": directory or "/", "name": name})["entry"]
+        except RpcError:
+            return None
+        with self._lock:
+            self._entries[path] = entry
+        return entry
+
+    def list_dir(self, directory: str) -> list[dict]:
+        directory = directory.rstrip("/") or "/"
+        with self._lock:
+            if directory in self._listed_dirs:
+                prefix = directory if directory != "/" else ""
+                return sorted(
+                    (e for p, e in self._entries.items()
+                     if p.rpartition("/")[0] == prefix
+                     or (directory == "/" and p.rpartition("/")[0] == "")),
+                    key=lambda e: e["full_path"])
+        try:
+            entries = [r["entry"] for r in self._filer().stream(
+                "ListEntries", iter([{"directory": directory,
+                                      "limit": 100000}]))]
+        except RpcError:
+            entries = []
+        with self._lock:
+            for e in entries:
+                self._entries[e["full_path"]] = e
+            self._listed_dirs.add(directory)
+        return entries
+
+    # -- local mutation (so our own ops are visible pre-subscription) ------
+    def upsert(self, entry: dict) -> None:
+        with self._lock:
+            self._entries[entry["full_path"]] = entry
+
+    def remove(self, path: str) -> None:
+        with self._lock:
+            self._entries.pop(path, None)
+            self._listed_dirs.discard(path.rstrip("/") or "/")
+
+    # -- subscription (meta_cache_subscribe.go) ----------------------------
+    def start_subscription(self, since_ns: int = 0) -> None:
+        def loop():
+            since = since_ns
+            while not self._stop.is_set():
+                try:
+                    for msg in self._filer().stream(
+                            "SubscribeMetadata",
+                            iter([{"since_ns": since,
+                                   "path_prefix": "/"}])):
+                        if self._stop.is_set():
+                            break
+                        if "ping" in msg:
+                            continue
+                        since = max(since, msg.get("ts_ns", since))
+                        self._apply(msg)
+                except RpcError:
+                    pass
+                self._stop.wait(0.5)
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def _apply(self, msg: dict) -> None:
+        old, new = msg.get("old_entry"), msg.get("new_entry")
+        with self._lock:
+            if old and (not new
+                        or old["full_path"] != new["full_path"]):
+                self._entries.pop(old["full_path"], None)
+            if new:
+                # only cache into dirs we already track; others load lazily
+                self._entries[new["full_path"]] = new
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,90 @@
+"""Page writer + upload pipeline — the FUSE write path.
+
+Capability-equivalent to weed/mount/page_writer/* (UploadPipeline
+upload_pipeline.go:14-186): random writes land in fixed-size dirty pages;
+when a page is complete (or on flush) it SEALS and uploads on background
+workers while foreground writes continue into fresh pages; flush() drains
+the pipeline and returns the chunk list for the entry.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable
+
+# upload_fn(data, logical_offset) -> chunk dict (FileChunk.to_dict shape)
+UploadFn = Callable[[bytes, int], dict]
+
+
+class _Page:
+    def __init__(self, index: int, size: int):
+        self.index = index
+        self.buf = bytearray(size)
+        self.written: list[tuple[int, int]] = []  # [start, stop) runs
+
+    def write(self, off_in_page: int, data: bytes) -> None:
+        self.buf[off_in_page:off_in_page + len(data)] = data
+        self.written.append((off_in_page, off_in_page + len(data)))
+
+    def extent(self) -> tuple[int, int]:
+        start = min(s for s, _ in self.written)
+        stop = max(e for _, e in self.written)
+        return start, stop
+
+
+
+class PageWriter:
+    """One open file's dirty state (page_writer.go + upload_pipeline.go)."""
+
+    def __init__(self, upload_fn: UploadFn, chunk_size: int,
+                 concurrency: int = 4):
+        self.upload_fn = upload_fn
+        self.chunk_size = chunk_size
+        self._pages: dict[int, _Page] = {}
+        self._sealed: list[Future] = []
+        self._pool = ThreadPoolExecutor(max_workers=concurrency)
+        self._lock = threading.Lock()
+        self.file_size = 0
+
+    def write(self, offset: int, data: bytes) -> int:
+        with self._lock:
+            pos = 0
+            while pos < len(data):
+                abs_off = offset + pos
+                idx = abs_off // self.chunk_size
+                in_page = abs_off % self.chunk_size
+                take = min(len(data) - pos, self.chunk_size - in_page)
+                page = self._pages.get(idx)
+                if page is None:
+                    page = self._pages[idx] = _Page(idx, self.chunk_size)
+                page.write(in_page, data[pos:pos + take])
+                pos += take
+                # seal pages that are completely written start-to-end —
+                # uploads overlap subsequent writes (the pipeline)
+                start, stop = page.extent()
+                if start == 0 and stop == self.chunk_size:
+                    self._seal(idx)
+            self.file_size = max(self.file_size, offset + len(data))
+            return len(data)
+
+    def _seal(self, idx: int) -> None:
+        page = self._pages.pop(idx)
+        start, stop = page.extent()
+        payload = bytes(page.buf[start:stop])
+        logical = idx * self.chunk_size + start
+        self._sealed.append(
+            self._pool.submit(self.upload_fn, payload, logical))
+
+    def flush(self) -> list[dict]:
+        """Seal every dirty page, wait for all uploads, return chunks in
+        upload order."""
+        with self._lock:
+            for idx in sorted(self._pages):
+                self._seal(idx)
+            sealed = list(self._sealed)
+            self._sealed = []
+        return [f.result() for f in sealed]
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)

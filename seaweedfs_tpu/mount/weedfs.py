@@ -1,0 +1,262 @@
+"""WeedFS — the mount's filesystem-operation layer.
+
+Capability-equivalent to weed/mount/weedfs*.go (the go-fuse RawFileSystem
+impl): lookup/getattr/readdir/mkdir/create/open/read/write/flush/release/
+unlink/rmdir/rename, an inode<->path map (inode_to_path.go), a local meta
+cache kept fresh by metadata subscription, and the PageWriter upload
+pipeline on the write path.  A kernel adapter (fuse_adapter) can sit on
+top; every operation here is directly callable, which is how the tests
+drive it (and how an in-process POSIX-ish client can use the cluster
+without the kernel).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import operation
+from ..filer.filechunks import read_views, total_size
+from ..filer.entry import FileChunk
+from ..pb.rpc import POOL, RpcError
+from .meta_cache import MetaCache
+from .page_writer import PageWriter
+
+CHUNK_SIZE = 8 * 1024 * 1024
+ROOT_INODE = 1
+
+
+class FuseError(Exception):
+    def __init__(self, errno_: int, msg: str = ""):
+        super().__init__(msg or f"errno {errno_}")
+        self.errno = errno_
+
+
+ENOENT, EEXIST, ENOTDIR, EISDIR, ENOTEMPTY = 2, 17, 20, 21, 39
+
+
+class InodeToPath:
+    """Bidirectional inode<->path map (mount/inode_to_path.go)."""
+
+    def __init__(self):
+        self._path_to_inode = {"/": ROOT_INODE}
+        self._inode_to_path = {ROOT_INODE: "/"}
+        self._next = ROOT_INODE + 1
+        self._lock = threading.Lock()
+
+    def lookup(self, path: str) -> int:
+        with self._lock:
+            ino = self._path_to_inode.get(path)
+            if ino is None:
+                ino = self._next
+                self._next += 1
+                self._path_to_inode[path] = ino
+                self._inode_to_path[ino] = path
+            return ino
+
+    def path_of(self, inode: int) -> "str | None":
+        return self._inode_to_path.get(inode)
+
+    def move(self, old: str, new: str) -> None:
+        with self._lock:
+            ino = self._path_to_inode.pop(old, None)
+            if ino is not None:
+                self._path_to_inode[new] = ino
+                self._inode_to_path[ino] = new
+
+    def forget(self, path: str) -> None:
+        with self._lock:
+            ino = self._path_to_inode.pop(path, None)
+            if ino is not None:
+                self._inode_to_path.pop(ino, None)
+
+
+class WeedFS:
+    def __init__(self, filer_grpc: str, master_grpc: str,
+                 chunk_size: int = CHUNK_SIZE,
+                 replication: str = "", collection: str = ""):
+        self.filer_grpc = filer_grpc
+        self.master_grpc = master_grpc
+        self.chunk_size = chunk_size
+        self.replication = replication
+        self.collection = collection
+        self.meta = MetaCache(filer_grpc)
+        self.inodes = InodeToPath()
+        self._open_writers: dict[str, PageWriter] = {}
+        self._chunk_cache: dict[str, bytes] = {}  # tiny read cache
+        self._lock = threading.RLock()
+
+    def start(self) -> None:
+        self.meta.start_subscription(since_ns=time.time_ns())
+
+    def stop(self) -> None:
+        # flush, not drop: close(2)-on-unmount must persist dirty pages
+        for path in list(self._open_writers):
+            self.flush(path)
+        self.meta.stop()
+
+    def _filer(self):
+        return POOL.client(self.filer_grpc, "SeaweedFiler")
+
+    # -- namespace ops ------------------------------------------------------
+    def lookup(self, path: str) -> dict:
+        entry = self.meta.lookup(path)
+        if entry is None:
+            raise FuseError(ENOENT, path)
+        self.inodes.lookup(path)
+        return entry
+
+    def getattr(self, path: str) -> dict:
+        entry = self.lookup(path)
+        chunks = [FileChunk.from_dict(c)
+                  for c in entry.get("chunks", [])]
+        size = total_size(chunks)
+        pw = self._open_writers.get(path)
+        if pw is not None:
+            size = max(size, pw.file_size)
+        return {
+            "inode": self.inodes.lookup(path),
+            "mode": entry["attr"].get("mode", 0o660),
+            "size": size,
+            "mtime": entry["attr"].get("mtime", 0),
+            "is_dir": bool(entry["attr"].get("mode", 0) & 0o40000),
+        }
+
+    def readdir(self, path: str) -> list[str]:
+        entry = self.lookup(path)
+        if not entry["attr"].get("mode", 0) & 0o40000:
+            raise FuseError(ENOTDIR, path)
+        return [e["full_path"].rsplit("/", 1)[-1]
+                for e in self.meta.list_dir(path)]
+
+    def mkdir(self, path: str, mode: int = 0o770) -> None:
+        if self.meta.lookup(path) is not None:
+            raise FuseError(EEXIST, path)
+        now = time.time()
+        entry = {"full_path": path.rstrip("/"),
+                 "attr": {"mtime": now, "crtime": now,
+                          "mode": 0o40000 | mode}}
+        self._filer().call("CreateEntry", {"entry": entry})
+        self.meta.upsert(entry)
+
+    def unlink(self, path: str) -> None:
+        entry = self.lookup(path)
+        if entry["attr"].get("mode", 0) & 0o40000:
+            raise FuseError(EISDIR, path)
+        self._delete(path, recursive=False)
+
+    def rmdir(self, path: str) -> None:
+        entry = self.lookup(path)
+        if not entry["attr"].get("mode", 0) & 0o40000:
+            raise FuseError(ENOTDIR, path)
+        if self.meta.list_dir(path):
+            raise FuseError(ENOTEMPTY, path)
+        self._delete(path, recursive=True)
+
+    def _delete(self, path: str, recursive: bool) -> None:
+        directory, _, name = path.rstrip("/").rpartition("/")
+        try:
+            self._filer().call("DeleteEntry", {
+                "directory": directory or "/", "name": name,
+                "is_recursive": recursive,
+                "ignore_recursive_error": False})
+        except RpcError as e:
+            raise FuseError(ENOENT, str(e)) from None
+        self.meta.remove(path)
+        self.inodes.forget(path)
+
+    def rename(self, old: str, new: str) -> None:
+        od, _, on = old.rstrip("/").rpartition("/")
+        nd, _, nn = new.rstrip("/").rpartition("/")
+        try:
+            self._filer().call("AtomicRenameEntry", {
+                "old_directory": od or "/", "old_name": on,
+                "new_directory": nd or "/", "new_name": nn})
+        except RpcError as e:
+            raise FuseError(ENOENT, str(e)) from None
+        self.meta.remove(old)
+        self.meta.remove(new)
+        self.inodes.move(old, new)
+
+    # -- file IO ------------------------------------------------------------
+    def create(self, path: str, mode: int = 0o660) -> None:
+        now = time.time()
+        entry = {"full_path": path,
+                 "attr": {"mtime": now, "crtime": now, "mode": mode},
+                 "chunks": []}
+        self._filer().call("CreateEntry", {"entry": entry})
+        self.meta.upsert(entry)
+        self.inodes.lookup(path)
+
+    def _upload_chunk(self, data: bytes, logical_offset: int) -> dict:
+        r = operation.assign(self.master_grpc,
+                             replication=self.replication,
+                             collection=self.collection)
+        operation.upload_data(r.url, r.fid, data, jwt=r.auth)
+        return {"file_id": r.fid, "offset": logical_offset,
+                "size": len(data), "modified_ts_ns": time.time_ns()}
+
+    def write(self, path: str, offset: int, data: bytes) -> int:
+        with self._lock:
+            pw = self._open_writers.get(path)
+            if pw is None:
+                pw = PageWriter(self._upload_chunk, self.chunk_size)
+                self._open_writers[path] = pw
+        return pw.write(offset, data)
+
+    def flush(self, path: str) -> None:
+        """Seal + upload dirty pages, then merge chunks into the entry
+        (weedfs_file_sync.go doFlush)."""
+        with self._lock:
+            pw = self._open_writers.pop(path, None)
+        if pw is None:
+            return
+        new_chunks = pw.flush()
+        pw.close()
+        if not new_chunks:
+            return
+        entry = self.meta.lookup(path)
+        if entry is None:
+            now = time.time()
+            entry = {"full_path": path,
+                     "attr": {"mtime": now, "crtime": now, "mode": 0o660},
+                     "chunks": []}
+        entry = dict(entry)
+        entry["chunks"] = list(entry.get("chunks", [])) + new_chunks
+        entry["attr"] = dict(entry["attr"], mtime=time.time())
+        self._filer().call("CreateEntry", {"entry": entry})
+        self.meta.upsert(entry)
+
+    release = flush  # close(2) semantics
+
+    def read(self, path: str, offset: int, n: int) -> bytes:
+        # read-after-write consistency: dirty AND sealed-in-flight pages
+        # both become entry chunks on flush, so flush before reading
+        # (simpler than the reference's page-cache overlay and always
+        # correct; the cost is losing write pipelining across a read)
+        if path in self._open_writers:
+            self.flush(path)
+        entry = self.lookup(path)
+        chunks = [FileChunk.from_dict(c)
+                  for c in entry.get("chunks", [])]
+        size = total_size(chunks)
+        if offset >= size:
+            return b""
+        n = min(n, size - offset)
+        out = bytearray(n)
+        for view in read_views(chunks, offset, n):
+            blob = self._chunk_blob(view.file_id)
+            piece = blob[view.offset_in_chunk:
+                         view.offset_in_chunk + view.size]
+            at = view.logic_offset - offset
+            out[at:at + len(piece)] = piece
+        return bytes(out)
+
+    def _chunk_blob(self, fid: str) -> bytes:
+        blob = self._chunk_cache.get(fid)
+        if blob is None:
+            blob = operation.read_file(self.master_grpc, fid)
+            if len(self._chunk_cache) > 64:  # tiny LRU-ish cap
+                self._chunk_cache.pop(next(iter(self._chunk_cache)))
+            self._chunk_cache[fid] = blob
+        return blob

@@ -72,11 +72,23 @@ class LocalDirRemoteStorage:
         with open(self._p(key), "rb") as f:
             return f.read()
 
+    def read_object_range(self, key: str, offset: int, size: int) -> bytes:
+        with open(self._p(key), "rb") as f:
+            f.seek(offset)
+            return f.read(size)
+
     def write_object(self, key: str, data: bytes) -> None:
         p = self._p(key)
         os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
         with open(p, "wb") as f:
             f.write(data)
+
+    def write_object_stream(self, key: str, fileobj) -> None:
+        import shutil
+        p = self._p(key)
+        os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+        with open(p, "wb") as f:
+            shutil.copyfileobj(fileobj, f, 8 << 20)
 
     def delete_object(self, key: str) -> None:
         if os.path.exists(self._p(key)):

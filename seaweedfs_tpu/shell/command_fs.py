@@ -1,0 +1,192 @@
+"""Filer (fs.*) and S3-bucket shell commands — capability-equivalent to
+weed/shell/command_fs_*.go and command_s3_bucket_*.go.
+
+CommandEnv learns the filer address from `fs.configure -filer <grpc>` (the
+reference embeds it in the current-directory path state)."""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+
+from ..pb.rpc import POOL, RpcError
+from .commands import CommandEnv, ShellError, command, parse_flags
+
+BUCKETS_PATH = "/buckets"
+
+
+def _filer(env: CommandEnv):
+    addr = getattr(env, "filer_grpc", "")
+    if not addr:
+        raise ShellError("no filer configured: run "
+                         "`fs.configure -filer host:grpcPort` first")
+    return POOL.client(addr, "SeaweedFiler")
+
+
+@command("fs.configure", "point the shell at a filer: -filer host:grpcPort")
+def cmd_fs_configure(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    env.filer_grpc = flags.get("filer", "")
+    return f"filer = {env.filer_grpc}"
+
+
+@command("fs.ls", "list a filer directory: fs.ls /path")
+def cmd_fs_ls(env: CommandEnv, args: list[str]) -> str:
+    path = next((a for a in args if not a.startswith("-")), "/")
+    out = []
+    for r in _filer(env).stream("ListEntries",
+                                iter([{"directory": path}])):
+        e = r["entry"]
+        is_dir = bool(e["attr"].get("mode", 0) & 0o40000)
+        size = sum(c.get("size", 0) for c in e.get("chunks", []))
+        name = e["full_path"].rsplit("/", 1)[-1]
+        out.append(f"{'d' if is_dir else '-'} {size:>10} {name}")
+    return "\n".join(out)
+
+
+@command("fs.du", "disk usage of a filer tree: fs.du /path")
+def cmd_fs_du(env: CommandEnv, args: list[str]) -> str:
+    path = next((a for a in args if not a.startswith("-")), "/")
+
+    def walk(directory: str) -> tuple[int, int]:
+        files, size = 0, 0
+        try:
+            for r in _filer(env).stream("ListEntries",
+                                        iter([{"directory": directory}])):
+                e = r["entry"]
+                if e["attr"].get("mode", 0) & 0o40000:
+                    f2, s2 = walk(e["full_path"])
+                    files += f2
+                    size += s2
+                else:
+                    files += 1
+                    size += sum(c.get("size", 0)
+                                for c in e.get("chunks", []))
+        except RpcError:
+            pass
+        return files, size
+
+    files, size = walk(path)
+    return json.dumps({"path": path, "files": files, "bytes": size})
+
+
+@command("fs.cat", "print a file's content: fs.cat /path")
+def cmd_fs_cat(env: CommandEnv, args: list[str]) -> str:
+    path = next((a for a in args if not a.startswith("-")), "")
+    directory, _, name = path.rstrip("/").rpartition("/")
+    try:
+        entry = _filer(env).call("LookupDirectoryEntry", {
+            "directory": directory or "/", "name": name})["entry"]
+    except RpcError:
+        raise ShellError(f"{path} not found") from None
+    from .. import operation
+    out = bytearray()
+    for c in sorted(entry.get("chunks", []), key=lambda c: c["offset"]):
+        out += operation.read_file(env.master_grpc, c["file_id"])
+    return out.decode(errors="replace")
+
+
+@command("fs.rm", "delete a filer entry: fs.rm [-r] /path")
+def cmd_fs_rm(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    path = next((a for a in args if not a.startswith("-")), "")
+    directory, _, name = path.rstrip("/").rpartition("/")
+    _filer(env).call("DeleteEntry", {
+        "directory": directory or "/", "name": name,
+        "is_recursive": "r" in flags, "ignore_recursive_error": True})
+    return f"removed {path}"
+
+
+@command("fs.meta.save", "dump filer metadata to a local file: -o out.json [/path]")
+def cmd_fs_meta_save(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    root = next((a for a in args if not a.startswith("-")
+                 and a != flags.get("o")), "/")
+    entries: list[dict] = []
+
+    def walk(directory: str):
+        try:
+            for r in _filer(env).stream("ListEntries",
+                                        iter([{"directory": directory}])):
+                e = r["entry"]
+                entries.append(e)
+                if e["attr"].get("mode", 0) & 0o40000:
+                    walk(e["full_path"])
+        except RpcError:
+            pass
+
+    walk(root)
+    out_path = flags.get("o", "filer_meta.json")
+    with open(out_path, "w") as f:
+        json.dump({"root": root, "entries": entries}, f)
+    return json.dumps({"saved": len(entries), "to": out_path})
+
+
+@command("fs.meta.load", "restore filer metadata from a dump: -i in.json")
+def cmd_fs_meta_load(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    with open(flags.get("i", "filer_meta.json")) as f:
+        dump = json.load(f)
+    n = 0
+    for e in dump["entries"]:
+        _filer(env).call("CreateEntry", {"entry": e})
+        n += 1
+    return json.dumps({"loaded": n})
+
+
+# -- s3 bucket commands (command_s3_bucket_*.go) ----------------------------
+
+@command("s3.bucket.list", "list buckets")
+def cmd_bucket_list(env: CommandEnv, args: list[str]) -> str:
+    out = []
+    try:
+        for r in _filer(env).stream("ListEntries",
+                                    iter([{"directory": BUCKETS_PATH}])):
+            e = r["entry"]
+            if e["attr"].get("mode", 0) & 0o40000:
+                out.append(e["full_path"].rsplit("/", 1)[-1])
+    except RpcError:
+        pass
+    return "\n".join(out)
+
+
+@command("s3.bucket.create", "create a bucket: -name b")
+def cmd_bucket_create(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    name = flags.get("name") or next(
+        (a for a in args if not a.startswith("-")), "")
+    if not name:
+        raise ShellError("need -name")
+    _filer(env).call("CreateEntry", {"entry": {
+        "full_path": f"{BUCKETS_PATH}/{name}",
+        "attr": {"mtime": time.time(), "crtime": time.time(),
+                 "mode": 0o40000 | 0o770}}})
+    return f"created bucket {name}"
+
+
+@command("s3.bucket.delete", "delete a bucket: -name b")
+def cmd_bucket_delete(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    name = flags.get("name", "")
+    _filer(env).call("DeleteEntry", {
+        "directory": BUCKETS_PATH, "name": name,
+        "is_recursive": True, "ignore_recursive_error": True})
+    return f"deleted bucket {name}"
+
+
+@command("s3.bucket.quota", "set bucket quota: -name b -sizeMB n (0 clears)")
+def cmd_bucket_quota(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    name = flags.get("name", "")
+    mb = int(flags.get("sizeMB", "0"))
+    entry = _filer(env).call("LookupDirectoryEntry", {
+        "directory": BUCKETS_PATH, "name": name})["entry"]
+    ext = entry.get("extended", {})
+    if mb > 0:
+        ext["quota.bytes"] = str(mb * 1024 * 1024)
+    else:
+        ext.pop("quota.bytes", None)
+    entry["extended"] = ext
+    _filer(env).call("UpdateEntry", {"entry": entry})
+    return json.dumps({"bucket": name, "quota_mb": mb})

@@ -1,0 +1,157 @@
+"""Maintenance commands: volume.tier.move/download, volume.check.disk,
+volume.server.evacuate — weed/shell/command_volume_tier_move.go,
+command_volume_check_disk.go, command_volume_server_evacuate.go."""
+
+from __future__ import annotations
+
+import json
+
+from ..pb.rpc import RpcError
+from ..storage.ec.shard_bits import ShardBits
+from ..storage.ec.layout import TOTAL_SHARDS_COUNT
+from .commands import (CommandEnv, ShellError, command, iter_data_nodes,
+                       node_grpc, parse_flags)
+from .command_volume import _move_volume
+
+
+@command("volume.tier.move",
+         "move a sealed volume's .dat to remote storage: -volumeId N "
+         "-dest local -destDir /path [-keepLocalDatFile]")
+def cmd_tier_move(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    env.confirm_is_locked()
+    vid = int(flags["volumeId"])
+    topo = env.topology()
+    holders = [dn for _, _, dn in iter_data_nodes(topo)
+               if any(v["id"] == vid for v in dn["volumes"])]
+    if not holders:
+        raise ShellError(f"volume {vid} not found")
+    cfg = {}
+    if flags.get("destDir"):
+        cfg["root"] = flags["destDir"]
+    # freeze EVERY replica first, then tier each one — they share the same
+    # remote key (identical sealed content), so storage is paid once
+    for dn in holders:
+        env.volume_server(node_grpc(dn)).call(
+            "VolumeMarkReadonly", {"volume_id": vid})
+    for dn in holders:
+        env.volume_server(node_grpc(dn)).call(
+            "VolumeTierMoveDatToRemote", {
+                "volume_id": vid,
+                "destination_backend": flags.get("dest", "local"),
+                "backend_config": cfg,
+                "keep_local_dat_file":
+                    flags.get("keepLocalDatFile") == "true"},
+            timeout=3600)
+    return json.dumps({"volume_id": vid, "replicas_tiered": len(holders),
+                       "tiered_to": flags.get("dest", "local")})
+
+
+@command("volume.tier.download",
+         "pull a tiered volume's .dat back local: -volumeId N")
+def cmd_tier_download(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    env.confirm_is_locked()
+    vid = int(flags["volumeId"])
+    topo = env.topology()
+    holders = [dn for _, _, dn in iter_data_nodes(topo)
+               if any(v["id"] == vid for v in dn["volumes"])]
+    if not holders:
+        raise ShellError(f"volume {vid} not found")
+    for dn in holders:
+        env.volume_server(node_grpc(dn)).call(
+            "VolumeTierMoveDatFromRemote", {"volume_id": vid},
+            timeout=3600)
+    return json.dumps({"volume_id": vid, "replicas": len(holders),
+                       "downloaded": True})
+
+
+@command("volume.check.disk",
+         "verify replicas of each volume hold the same needles")
+def cmd_check_disk(env: CommandEnv, args: list[str]) -> str:
+    """The reference syncs differing replicas (command_volume_check_disk.go);
+    here: report volumes whose replicas disagree on file counts."""
+    topo = env.topology()
+    by_vid: dict[int, list[dict]] = {}
+    for _, _, dn in iter_data_nodes(topo):
+        for v in dn["volumes"]:
+            by_vid.setdefault(v["id"], []).append(
+                {"node": dn["id"],
+                 "file_count": v.get("file_count", 0),
+                 "size": v.get("size", 0)})
+    mismatches = {vid: reps for vid, reps in by_vid.items()
+                  if len(reps) > 1 and len(
+                      {r["file_count"] for r in reps}) > 1}
+    return json.dumps({"volumes_checked": len(by_vid),
+                       "mismatched": mismatches})
+
+
+@command("volume.server.evacuate",
+         "move everything off a server: -node ip:port [-force]")
+def cmd_evacuate(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    node_id = flags.get("node", "")
+    topo = env.topology()
+    src = None
+    others = []
+    for _, _, dn in iter_data_nodes(topo):
+        if dn["id"] == node_id:
+            src = dn
+        else:
+            others.append(dn)
+    if src is None:
+        raise ShellError(f"node {node_id} not in topology")
+    if not others:
+        raise ShellError("no other servers to evacuate to")
+    plan = []
+    # volumes round-robin to the emptiest other servers
+    others.sort(key=lambda d: len(d["volumes"]))
+    held_elsewhere = {v["id"]: {d["id"] for d in others
+                                for v2 in d["volumes"]
+                                if v2["id"] == v["id"]}
+                      for v in src["volumes"]}
+    i = 0
+    for v in src["volumes"]:
+        for _ in range(len(others)):
+            dst = others[i % len(others)]
+            i += 1
+            if dst["id"] not in held_elsewhere.get(v["id"], set()):
+                plan.append({"volume_id": v["id"],
+                             "collection": v.get("collection", ""),
+                             "from_grpc": node_grpc(src),
+                             "to": dst["id"],
+                             "to_grpc": node_grpc(dst)})
+                break
+    # ec shards round-robin too
+    ec_moves = []
+    for vid_s, bits in src.get("ec_shards", {}).items():
+        for shard in ShardBits(int(bits)).shard_ids():
+            dst = others[i % len(others)]
+            i += 1
+            ec_moves.append({"volume_id": int(vid_s), "shard_id": shard,
+                             "from_grpc": node_grpc(src),
+                             "to_grpc": node_grpc(dst)})
+    if flags.get("force") != "true":
+        return json.dumps({"planned_volumes": plan,
+                           "planned_ec_shards": ec_moves})
+    env.confirm_is_locked()
+    for mv in plan:
+        _move_volume(env, mv)
+    for mv in ec_moves:
+        dst = env.volume_server(mv["to_grpc"])
+        dst.call("VolumeEcShardsCopy", {
+            "volume_id": mv["volume_id"], "shard_ids": [mv["shard_id"]],
+            "copy_ecx_files": True,
+            "source_data_node": mv["from_grpc"]}, timeout=3600)
+        dst.call("VolumeEcShardsMount",
+                 {"volume_id": mv["volume_id"], "collection": "",
+                  "shard_ids": [mv["shard_id"]]})
+        srcc = env.volume_server(mv["from_grpc"])
+        srcc.call("VolumeEcShardsUnmount",
+                  {"volume_id": mv["volume_id"],
+                   "shard_ids": [mv["shard_id"]]})
+        srcc.call("VolumeEcShardsDelete",
+                  {"volume_id": mv["volume_id"], "collection": "",
+                   "shard_ids": [mv["shard_id"]]})
+    return json.dumps({"evacuated_volumes": len(plan),
+                       "evacuated_shards": len(ec_moves)})

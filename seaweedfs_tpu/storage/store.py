@@ -42,9 +42,13 @@ class DiskLocation:
         (disk_location.go loadExistingVolumes); serial here — map replay is
         already vectorized."""
         for fname in sorted(os.listdir(self.directory)):
-            if not fname.endswith(".dat"):
+            # .tier = sealed .dat living on remote storage (storage/tier.py)
+            if fname.endswith(".dat"):
+                base = fname[:-4]
+            elif fname.endswith(".tier"):
+                base = fname[:-5]
+            else:
                 continue
-            base = fname[:-4]
             try:
                 collection, vid = parse_volume_base_name(base)
             except ValueError:
@@ -182,6 +186,13 @@ class Store:
         for loc in self.locations:
             if vid in loc.volumes:
                 loc.delete_volume(vid)
+                return
+
+    def unload_volume(self, vid: int) -> None:
+        """Close without deleting files (tier moves, unmount)."""
+        for loc in self.locations:
+            if vid in loc.volumes:
+                loc.unload_volume(vid)
                 return
 
     # -- needle ops (store.go:341,365) ------------------------------------

@@ -1,0 +1,103 @@
+"""Volume tiering — sealed .dat files living on remote storage.
+
+Capability-equivalent to weed/storage/backend/s3_backend +
+shell/command_volume_tier_move/upload: a read-only volume's .dat uploads
+to a remote store; the local .dat is replaced by a small .tier descriptor;
+reads go through RemoteBackendFile (range reads against the remote); the
+.idx (40 bytes/needle) stays local so lookups remain O(1).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..remote_storage import RemoteStorageClient, new_remote_storage
+from .backend import BackendStorageFile
+
+
+class RemoteBackendFile(BackendStorageFile):
+    """Read-only BackendStorageFile over a remote object
+    (backend/s3_backend/s3_sessions.go readAt-over-S3)."""
+
+    def __init__(self, remote: RemoteStorageClient, key: str):
+        self.remote = remote
+        self.key = key
+        st = remote.stat_object(key)
+        self._size = st["size"]
+        self._mtime = st["mtime"]
+
+    def read_at(self, size: int, offset: int) -> bytes:
+        if hasattr(self.remote, "read_object_range"):
+            return self.remote.read_object_range(self.key, offset, size)
+        return self.remote.read_object(self.key)[offset:offset + size]
+
+    def write_at(self, data: bytes, offset: int) -> int:
+        raise OSError("tiered volume is read-only")
+
+    def truncate(self, size: int) -> None:
+        raise OSError("tiered volume is read-only")
+
+    def get_stat(self) -> tuple[int, float]:
+        return self._size, self._mtime
+
+    def sync(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    @property
+    def name(self) -> str:
+        return f"remote://{self.key}"
+
+
+def tier_descriptor_path(base_path: str) -> str:
+    return base_path + ".tier"
+
+
+def upload_volume_dat(base_path: str, remote: RemoteStorageClient,
+                      remote_kind: str, remote_cfg: dict,
+                      key_prefix: str = "volumes",
+                      keep_local: bool = False) -> dict:
+    """Push <base>.dat to the remote; write the .tier descriptor; drop the
+    local .dat unless keep_local (volume.tier.move semantics)."""
+    vid_base = os.path.basename(base_path)
+    key = f"{key_prefix}/{vid_base}.dat"
+    with open(base_path + ".dat", "rb") as f:
+        # stream — a sealed .dat can be 30 GB; never buffer it whole
+        if hasattr(remote, "write_object_stream"):
+            remote.write_object_stream(key, f)
+        else:
+            remote.write_object(key, f.read())
+    desc = {"kind": remote_kind, "config": remote_cfg, "key": key}
+    with open(tier_descriptor_path(base_path), "w") as f:
+        json.dump(desc, f)
+    if not keep_local:
+        os.remove(base_path + ".dat")
+    return desc
+
+
+def open_tiered_backend(base_path: str) -> "RemoteBackendFile | None":
+    """When <base>.tier exists, open the remote .dat (volume load hook)."""
+    p = tier_descriptor_path(base_path)
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        desc = json.load(f)
+    remote = new_remote_storage(desc["kind"], **desc.get("config", {}))
+    return RemoteBackendFile(remote, desc["key"])
+
+
+def untier_volume_dat(base_path: str) -> None:
+    """Pull the .dat back local and drop the descriptor
+    (volume.tier.download)."""
+    backend = open_tiered_backend(base_path)
+    if backend is None:
+        return
+    size, _ = backend.get_stat()
+    chunk = 8 << 20
+    with open(base_path + ".dat", "wb") as f:
+        for off in range(0, size, chunk):
+            f.write(backend.read_at(min(chunk, size - off), off))
+    os.remove(tier_descriptor_path(base_path))

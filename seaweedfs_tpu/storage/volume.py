@@ -97,9 +97,17 @@ class Volume:
 
         base = volume_file_name(directory, collection, vid)
         self.base_path = base
-        dat_exists = os.path.exists(base + ".dat")
-        self.data_backend: BackendStorageFile = open_backend(
-            backend_kind, base + ".dat")
+        # a .tier descriptor means the sealed .dat lives on remote storage
+        # (storage/tier.py; the reference's s3_backend VolumeInfo files)
+        from .tier import open_tiered_backend
+        tiered = open_tiered_backend(base)
+        if tiered is not None:
+            self.data_backend: BackendStorageFile = tiered
+            self.read_only = True
+            dat_exists = True
+        else:
+            dat_exists = os.path.exists(base + ".dat")
+            self.data_backend = open_backend(backend_kind, base + ".dat")
         if dat_exists and self.data_backend.get_stat()[0] >= 8:
             header = self.data_backend.read_at(512, 0)
             self.super_block = SuperBlock.from_bytes(header)

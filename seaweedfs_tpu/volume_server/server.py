@@ -40,6 +40,30 @@ PULSE_SECONDS = 5
 EC_LOCATION_STALENESS = 11.0  # the freshest staleness tier (store_ec.go:227)
 
 
+def _maybe_resize_image(data: bytes, mime: str, width: str, height: str,
+                        mode: str) -> tuple[bytes, str]:
+    """On-the-fly image resize on GET ?width=&height=[&mode=fit|fill]
+    (weed/images/resizing.go, volume_server_handlers_read.go:267-292).
+    Non-images or decode failures pass through untouched."""
+    try:
+        import io
+
+        from PIL import Image
+        img = Image.open(io.BytesIO(data))
+        fmt = img.format or "PNG"
+        w = int(width) if width else img.width
+        h = int(height) if height else img.height
+        if mode == "fill":
+            img = img.resize((w, h))
+        else:  # fit: preserve aspect ratio within the box
+            img.thumbnail((w, h))
+        out = io.BytesIO()
+        img.save(out, format=fmt)
+        return out.getvalue(), f"image/{fmt.lower()}"
+    except Exception:
+        return data, mime
+
+
 class VolumeServer:
     def __init__(self, master_grpc: str, directories: list[str],
                  host: str = "127.0.0.1", port: int = 0, grpc_port: int = 0,
@@ -259,9 +283,13 @@ class VolumeServer:
             headers["X-File-Name"] = n.name.decode(errors="replace")
         mime = (n.mime.decode(errors="replace")
                 if n.has_mime() else "application/octet-stream")
+        data = bytes(n.data)
+        if req.qs("width") or req.qs("height"):
+            data, mime = _maybe_resize_image(
+                data, mime, req.qs("width"), req.qs("height"),
+                req.qs("mode"))
         self.metrics.volume_latency.observe("read", value=time.time() - t0)
-        return Response(200, bytes(n.data), content_type=mime,
-                        headers=headers)
+        return Response(200, data, content_type=mime, headers=headers)
 
     def _redirect_or_404(self, fid: FileId) -> Response:
         try:
@@ -445,6 +473,8 @@ class VolumeServer:
                 "VolumeServerStatus": self._rpc_server_status,
                 "Ping": lambda req: {"ok": True},
                 "VolumeCopy": self._rpc_volume_copy,
+                "VolumeTierMoveDatToRemote": self._rpc_tier_move_to,
+                "VolumeTierMoveDatFromRemote": self._rpc_tier_move_from,
                 "VolumeEcShardsGenerate": self._rpc_ec_generate,
                 "VolumeEcShardsRebuild": self._rpc_ec_rebuild,
                 "VolumeEcShardsCopy": self._rpc_ec_copy,
@@ -458,7 +488,33 @@ class VolumeServer:
                 "VolumeEcShardRead": self._rpc_ec_shard_read,
                 "CopyFile": self._rpc_copy_file,
                 "Query": self._rpc_query,
+                "VolumeTailSender": self._rpc_volume_tail,
             })
+
+    def _rpc_volume_tail(self, requests):
+        """Stream needles appended after since_ns — the incremental
+        backup/replica-catchup feed (volume_grpc_tail.go VolumeTailSender,
+        operation/tail_volume.go)."""
+        for req in requests:
+            vid = int(req["volume_id"])
+            since_ns = int(req.get("since_ns", 0))
+            v = self.store.find_volume(vid)
+            if v is None:
+                raise RpcError(f"volume {vid} not found")
+            for offset, n, body_len in v.scan_needles():
+                try:
+                    full = Needle.read_from(
+                        v.data_backend, offset, n.size, v.version)
+                except Exception:
+                    continue
+                # append_at_ns lives in the record TRAILER (v3), so the
+                # filter runs after the full read, not on the header scan
+                if full.append_at_ns and full.append_at_ns <= since_ns:
+                    continue
+                yield {"needle_id": full.id, "cookie": full.cookie,
+                       "append_at_ns": full.append_at_ns,
+                       "is_delete": full.size == 0 and not full.data,
+                       "needle_blob": to_b64(bytes(full.data))}
 
     def _rpc_query(self, requests):
         """SQL-ish scan over JSON/CSV needles (S3 Select analogue,
@@ -640,6 +696,48 @@ class VolumeServer:
                 "ec_shards": [{"id": e["id"],
                                "ec_index_bits": int(e["ec_index_bits"])}
                               for e in hb.ec_shards]}
+
+    # -- tiering (volume_grpc_tier.go) -------------------------------------
+    def _rpc_tier_move_to(self, req: dict) -> dict:
+        """Push a sealed volume's .dat to remote storage and reopen it
+        through the remote backend (VolumeTierMoveDatToRemote)."""
+        from ..remote_storage import new_remote_storage
+        from ..storage.tier import upload_volume_dat
+        vid = int(req["volume_id"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise RpcError(f"volume {vid} not found")
+        if not v.read_only:
+            raise RpcError(f"volume {vid} must be readonly before tiering")
+        kind = req.get("destination_backend", "local")
+        cfg = req.get("backend_config") or {}
+        remote = new_remote_storage(kind, **cfg)
+        v.sync()
+        base = v.base_path
+        collection = v.collection
+        self.store.unload_volume(vid)
+        upload_volume_dat(base, remote, kind, cfg,
+                          keep_local=bool(req.get("keep_local_dat_file")))
+        for loc in self.store.locations:
+            loc.load_existing_volumes()
+        if not self.store.has_volume(vid):
+            raise RpcError(f"volume {vid} failed to reopen tiered")
+        return {}
+
+    def _rpc_tier_move_from(self, req: dict) -> dict:
+        """Pull a tiered .dat back to local disk
+        (VolumeTierMoveDatFromRemote)."""
+        from ..storage.tier import untier_volume_dat
+        vid = int(req["volume_id"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise RpcError(f"volume {vid} not found")
+        base = v.base_path
+        self.store.unload_volume(vid)
+        untier_volume_dat(base)
+        for loc in self.store.locations:
+            loc.load_existing_volumes()
+        return {}
 
     # -- EC RPCs (volume_grpc_erasure_coding.go) ---------------------------
     def _base_path(self, vid: int, collection: str) -> str:

@@ -1,0 +1,181 @@
+"""Tiering, volume tail, image resize, and new shell command tests."""
+
+import io
+import json
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation, shell
+from seaweedfs_tpu.filer import FilerServer
+from seaweedfs_tpu.master import MasterServer
+from seaweedfs_tpu.pb.rpc import POOL, from_b64
+from seaweedfs_tpu.util.http import http_request
+from seaweedfs_tpu.volume_server import VolumeServer
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    master = MasterServer(seed=101)
+    master.start()
+    servers = []
+    for i in range(2):
+        d = tmp_path / f"vol{i}"
+        d.mkdir()
+        vs = VolumeServer(master.grpc_address, [str(d)], pulse_seconds=0.5,
+                          max_volume_counts=[30])
+        vs.start()
+        servers.append(vs)
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topo.data_nodes()) < 2:
+        time.sleep(0.05)
+    filer = FilerServer(master.grpc_address)
+    filer.start()
+    env = shell.CommandEnv(master.grpc_address)
+    yield master, servers, filer, env, tmp_path
+    filer.stop()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def test_volume_tier_move_and_download(stack):
+    master, servers, filer, env, tmp_path = stack
+    blobs = {operation.assign_and_upload(master.grpc_address,
+                                         os.urandom(2000 + i)): i
+             for i in range(5)}
+    fid0 = next(iter(blobs))
+    vid = int(fid0.split(",")[0])
+    in_vol = [f for f in blobs if int(f.split(",")[0]) == vid]
+    datas = {f: operation.read_file(master.grpc_address, f)
+             for f in in_vol}
+    for vs in servers:
+        vs.heartbeat_now()
+    cloud = tmp_path / "tier-cloud"
+    shell.run_command(env, "lock")
+    out = json.loads(shell.run_command(
+        env, f"volume.tier.move -volumeId {vid} -dest local "
+             f"-destDir {cloud}"))
+    assert out["volume_id"] == vid
+    # the .dat now lives in the remote dir; local .dat gone
+    holder = next(vs for vs in servers if vs.store.has_volume(vid))
+    v = holder.store.find_volume(vid)
+    assert v.data_backend.name.startswith("remote://")
+    assert not os.path.exists(v.base_path + ".dat")
+    assert os.path.exists(v.base_path + ".tier")
+    # reads still work through the remote backend
+    for f, want in datas.items():
+        assert operation.read_file(master.grpc_address, f) == want
+    # writes rejected (tiered volumes are sealed)
+    assert v.read_only
+    # download back
+    json.loads(shell.run_command(
+        env, f"volume.tier.download -volumeId {vid}"))
+    v = holder.store.find_volume(vid)
+    assert os.path.exists(v.base_path + ".dat")
+    assert not os.path.exists(v.base_path + ".tier")
+    for f, want in datas.items():
+        assert operation.read_file(master.grpc_address, f) == want
+    shell.run_command(env, "unlock")
+
+
+def test_volume_tail_incremental(stack):
+    master, servers, filer, env, _ = stack
+    fid1 = operation.assign_and_upload(master.grpc_address, b"first")
+    t_mid = time.time_ns()
+    vid = int(fid1.split(",")[0])
+    # force the second write into the same volume
+    r = operation.assign(master.grpc_address)
+    tries = 0
+    while int(r.fid.split(",")[0]) != vid and tries < 60:
+        r = operation.assign(master.grpc_address)
+        tries += 1
+    if int(r.fid.split(",")[0]) != vid:
+        pytest.skip("could not co-locate second write")
+    operation.upload_data(r.url, r.fid, b"second", jwt=r.auth)
+    holder = next(vs for vs in servers if vs.store.has_volume(vid))
+    c = POOL.client(holder.grpc_address, "VolumeServer")
+    # full tail sees both; since t_mid sees only the second
+    all_rows = list(c.stream("VolumeTailSender",
+                             iter([{"volume_id": vid}])))
+    assert {from_b64(r["needle_blob"]) for r in all_rows} >= \
+        {b"first", b"second"}
+    newer = list(c.stream("VolumeTailSender",
+                          iter([{"volume_id": vid,
+                                 "since_ns": t_mid}])))
+    assert {from_b64(r["needle_blob"]) for r in newer} == {b"second"}
+
+
+def test_image_resize_on_get(stack):
+    from PIL import Image
+    master, servers, *_ = stack
+    buf = io.BytesIO()
+    Image.new("RGB", (100, 80), (200, 10, 10)).save(buf, format="PNG")
+    r = operation.assign(master.grpc_address)
+    operation.upload_data(r.url, r.fid, buf.getvalue(), mime="image/png")
+    status, body, headers = http_request(
+        f"http://{r.url}/{r.fid}?width=50")
+    assert status == 200
+    img = Image.open(io.BytesIO(body))
+    assert img.size == (50, 40)  # aspect preserved (fit mode)
+    status, body, _ = http_request(
+        f"http://{r.url}/{r.fid}?width=30&height=30&mode=fill")
+    assert Image.open(io.BytesIO(body)).size == (30, 30)
+    # non-image data passes through untouched
+    r2 = operation.assign(master.grpc_address)
+    operation.upload_data(r2.url, r2.fid, b"not an image")
+    status, body, _ = http_request(f"http://{r2.url}/{r2.fid}?width=10")
+    assert body == b"not an image"
+
+
+def test_fs_and_bucket_shell_commands(stack, tmp_path):
+    master, servers, filer, env, _ = stack
+    http_request(f"http://{filer.address}/dir/a.txt", method="POST",
+                 body=b"shell sees me")
+    shell.run_command(env, f"fs.configure -filer {filer.grpc_address}")
+    ls = shell.run_command(env, "fs.ls /dir")
+    assert "a.txt" in ls
+    assert shell.run_command(env, "fs.cat /dir/a.txt") == "shell sees me"
+    du = json.loads(shell.run_command(env, "fs.du /dir"))
+    assert du["files"] == 1 and du["bytes"] == 13
+    # meta save/load round trip
+    dump = tmp_path / "meta.json"
+    out = json.loads(shell.run_command(env, f"fs.meta.save -o {dump} /dir"))
+    assert out["saved"] == 1
+    shell.run_command(env, "fs.rm /dir/a.txt")
+    assert "a.txt" not in shell.run_command(env, "fs.ls /dir")
+    json.loads(shell.run_command(env, f"fs.meta.load -i {dump}"))
+    assert "a.txt" in shell.run_command(env, "fs.ls /dir")
+    # buckets
+    shell.run_command(env, "s3.bucket.create -name projects")
+    assert "projects" in shell.run_command(env, "s3.bucket.list")
+    q = json.loads(shell.run_command(
+        env, "s3.bucket.quota -name projects -sizeMB 10"))
+    assert q["quota_mb"] == 10
+    shell.run_command(env, "s3.bucket.delete -name projects")
+    assert "projects" not in shell.run_command(env, "s3.bucket.list")
+
+
+def test_volume_check_disk_and_evacuate(stack):
+    master, servers, filer, env, _ = stack
+    for i in range(4):
+        operation.assign_and_upload(master.grpc_address, os.urandom(500))
+    for vs in servers:
+        vs.heartbeat_now()
+    out = json.loads(shell.run_command(env, "volume.check.disk"))
+    assert out["volumes_checked"] >= 1
+    assert out["mismatched"] == {}
+    # evacuate server 0 onto server 1
+    victim = servers[0]
+    held = set(victim.store.locations[0].volumes.keys())
+    if not held:
+        pytest.skip("server 0 holds no volumes")
+    shell.run_command(env, "lock")
+    out = json.loads(shell.run_command(
+        env, f"volume.server.evacuate -node {victim.url} -force"))
+    assert out["evacuated_volumes"] == len(held)
+    for vs in servers:
+        vs.heartbeat_now()
+    assert not victim.store.locations[0].volumes
+    shell.run_command(env, "unlock")
