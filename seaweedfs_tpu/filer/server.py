@@ -82,9 +82,18 @@ class FilerServer:
         self.http.start()
         self.rpc.start()
         threading.Thread(target=self._deletion_loop, daemon=True).start()
+        # announce to the master's cluster registry (filer leader election
+        # happens there: first registrant leads, cluster/cluster.go)
+        from ..wdclient import MasterClient
+        self._master_client = MasterClient(
+            self.master_grpc, client_name=self.grpc_address,
+            client_type="filer")
+        self._master_client.start()
 
     def stop(self) -> None:
         self._stop.set()
+        if getattr(self, "_master_client", None):
+            self._master_client.stop()
         self.http.stop()
         self.rpc.stop()
         self.filer.store.close()

@@ -80,6 +80,11 @@ class MasterServer:
         self._admin_ts: float = 0.0
         # KeepConnected subscribers: name -> queue of location deltas
         self._subscribers: dict[int, queue.Queue] = {}
+        # non-volume cluster nodes by type (cluster/cluster.go): an
+        # insertion-ordered name -> refcount map; first live name is the
+        # type's leader.  Refcounted because a reconnecting node's NEW
+        # stream can register before the old stream's cleanup runs.
+        self.cluster_nodes: dict[str, dict[str, int]] = {}
         self._sub_seq = 0
         self._sub_lock = threading.Lock()
 
@@ -251,6 +256,16 @@ class MasterServer:
     def _handle_keep_connected(self, requests):
         first = next(iter(requests), None)  # client announces itself
         q: queue.Queue = queue.Queue()
+        # cluster registry: track non-volume nodes (filers, brokers) by
+        # type while their stream lives (cluster/cluster.go); the first
+        # registrant of a type is that type's leader (filer leader election)
+        node_type = (first or {}).get("client_type", "client")
+        node_name = (first or {}).get("client_name", "")
+        registered = node_type in ("filer", "broker") and node_name
+        if registered:
+            with self._sub_lock:
+                counts = self.cluster_nodes.setdefault(node_type, {})
+                counts[node_name] = counts.get(node_name, 0) + 1
         with self._sub_lock:
             self._sub_seq += 1
             sid = self._sub_seq
@@ -268,6 +283,12 @@ class MasterServer:
         finally:
             with self._sub_lock:
                 self._subscribers.pop(sid, None)
+                if registered:
+                    counts = self.cluster_nodes.get(node_type, {})
+                    if counts.get(node_name, 0) <= 1:
+                        counts.pop(node_name, None)
+                    else:
+                        counts[node_name] -= 1
 
     def _publish(self, msg: dict) -> None:
         with self._sub_lock:
@@ -333,6 +354,7 @@ class MasterServer:
                 "LeaseAdminToken": self._lease_admin_token,
                 "ReleaseAdminToken": self._release_admin_token,
                 "VolumeList": lambda req: {"topology": self.topo.to_dict()},
+                "ListClusterNodes": self._rpc_list_cluster_nodes,
                 "Vacuum": self._rpc_vacuum,
                 "MasterPing": self._rpc_master_ping,
             },
@@ -340,6 +362,15 @@ class MasterServer:
                 "SendHeartbeat": self._handle_heartbeat_stream,
                 "KeepConnected": self._handle_keep_connected,
             })
+
+    def _rpc_list_cluster_nodes(self, req: dict) -> dict:
+        with self._sub_lock:
+            return {
+                "nodes": {t: list(counts)
+                          for t, counts in self.cluster_nodes.items()},
+                "leaders": {t: next(iter(counts))
+                            for t, counts in self.cluster_nodes.items()
+                            if counts}}
 
     def _rpc_master_ping(self, req: dict) -> dict:
         if self.ha is None:

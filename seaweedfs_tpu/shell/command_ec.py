@@ -42,8 +42,9 @@ def collect_volume_ids_for_ec_encode(topo: dict, volume_size_limit: int,
     return sorted(vids)
 
 
-def plan_shard_distribution(topo: dict, vid: int,
-                            source_id: str) -> dict[str, list[int]]:
+def plan_shard_distribution(topo: dict, vid: int, source_id: str,
+                            n_total: int = TOTAL_SHARDS_COUNT
+                            ) -> dict[str, list[int]]:
     """node_id -> shard ids, most-free-slots first, round-robin
     (balancedEcDistribution command_ec_encode.go:249)."""
     nodes = []
@@ -58,7 +59,7 @@ def plan_shard_distribution(topo: dict, vid: int,
     nodes.sort(reverse=True)
     out: dict[str, list[int]] = {nid: [] for _, nid in nodes}
     order = [nid for _, nid in nodes]
-    for shard in range(TOTAL_SHARDS_COUNT):
+    for shard in range(n_total):
         out[order[shard % len(order)]].append(shard)
     return {nid: shards for nid, shards in out.items() if shards}
 
@@ -115,7 +116,8 @@ def _grpc_of_location(topo: dict, url: str) -> str:
     raise ShellError(f"no grpc address for {url}")
 
 
-def do_ec_encode(env: CommandEnv, vid: int, collection: str = "") -> dict:
+def do_ec_encode(env: CommandEnv, vid: int, collection: str = "",
+                 data_shards: int = 0, parity_shards: int = 0) -> dict:
     """Full doEcEncode flow (command_ec_encode.go:95-188)."""
     topo = env.topology()
     locations = _volume_locations(env, vid)
@@ -127,11 +129,17 @@ def do_ec_encode(env: CommandEnv, vid: int, collection: str = "") -> dict:
         env.volume_server(_grpc_of_location(topo, loc["url"])).call(
             "VolumeMarkReadonly", {"volume_id": vid})
     # generate shards on one replica (the TPU hot loop)
-    env.volume_server(src_grpc).call(
-        "VolumeEcShardsGenerate",
-        {"volume_id": vid, "collection": collection}, timeout=3600)
+    gen_req = {"volume_id": vid, "collection": collection}
+    n_total = TOTAL_SHARDS_COUNT
+    if data_shards or parity_shards:
+        gen_req["data_shards"] = data_shards or 10
+        gen_req["parity_shards"] = parity_shards or 4
+        n_total = gen_req["data_shards"] + gen_req["parity_shards"]
+    env.volume_server(src_grpc).call("VolumeEcShardsGenerate", gen_req,
+                                     timeout=3600)
     # spread + mount
-    plan = plan_shard_distribution(topo, vid, locations[0]["url"])
+    plan = plan_shard_distribution(topo, vid, locations[0]["url"],
+                                   n_total=n_total)
     grpc_by_id = {dn["id"]: node_grpc(dn)
                   for _, _, dn in iter_data_nodes(topo)}
     src_id = None
@@ -152,7 +160,7 @@ def do_ec_encode(env: CommandEnv, vid: int, collection: str = "") -> dict:
     # drop non-local shard files from the source, delete original volume
     src = env.volume_server(src_grpc)
     keep = set(plan.get(src_id, []))
-    drop = [s for s in range(TOTAL_SHARDS_COUNT) if s not in keep]
+    drop = [s for s in range(n_total) if s not in keep]
     if drop:
         src.call("VolumeEcShardsUnmount", {"volume_id": vid,
                                            "shard_ids": drop})
@@ -171,7 +179,21 @@ def do_ec_rebuild(env: CommandEnv, vid: int, collection: str = "") -> dict:
     topo = env.topology()
     shard_map = collect_ec_shard_map(topo).get(vid, {})
     present = {s for ids in shard_map.values() for s in ids}
-    missing = [s for s in range(TOTAL_SHARDS_COUNT) if s not in present]
+    grpc_by_id0 = {dn["id"]: node_grpc(dn)
+                   for _, _, dn in iter_data_nodes(topo)}
+    # wide stripes: the true total comes from a holder's .vif, not the
+    # fixed 10+4 default
+    n_total = TOTAL_SHARDS_COUNT
+    for nid in shard_map:
+        try:
+            n_total = env.volume_server(grpc_by_id0[nid]).call(
+                "VolumeEcGeometry",
+                {"volume_id": vid, "collection": collection}
+            )["total_shards"]
+            break
+        except RpcError:
+            continue
+    missing = [s for s in range(n_total) if s not in present]
     if not missing:
         return {"volume_id": vid, "rebuilt": []}
     grpc_by_id = {dn["id"]: node_grpc(dn)
@@ -225,7 +247,10 @@ def cmd_ec_encode(env: CommandEnv, args: list[str]) -> str:
             full_percent=float(flags.get("fullPercent", 95)),
             quiet_seconds=float(flags.get("quietFor", 3600)),
             collection=flags.get("collection", ""))
-    results = [do_ec_encode(env, vid, flags.get("collection", ""))
+    results = [do_ec_encode(env, vid, flags.get("collection", ""),
+                            data_shards=int(flags.get("dataShards", 0)),
+                            parity_shards=int(flags.get("parityShards",
+                                                        0)))
                for vid in vids]
     return json.dumps({"encoded": results})
 

@@ -42,6 +42,23 @@ def load_volume_info(base_path: str) -> dict:
         return json.load(f)
 
 
+def geometry_from_vif(base_path: str,
+                      default: EcGeometry = DEFAULT_GEOMETRY) -> EcGeometry:
+    """The stripe geometry is part of the volume's identity — wide stripes
+    RS(28,4)/RS(16,8) coexist with RS(10,4) volumes, so every consumer
+    (mount, rebuild, decode, reads) loads (k, m) from the .vif sidecar."""
+    info = load_volume_info(base_path)
+    if "data_shards" in info:
+        return EcGeometry(
+            data_shards=info["data_shards"],
+            parity_shards=info["parity_shards"],
+            large_block_size=info.get("large_block_size",
+                                      default.large_block_size),
+            small_block_size=info.get("small_block_size",
+                                      default.small_block_size))
+    return default
+
+
 def encode_volume_to_ec(base_path: str, version: int,
                         geo: EcGeometry = DEFAULT_GEOMETRY, codec=None
                         ) -> None:
@@ -49,18 +66,24 @@ def encode_volume_to_ec(base_path: str, version: int,
     (weed/server/volume_grpc_erasure_coding.go:38-80): shards + .ecx + .vif.
 
     The exact .dat size goes into .vif: shard size alone cannot recover the
-    large/small row split at row boundaries (layout.n_large_block_rows)."""
+    large/small row split at row boundaries (layout.n_large_block_rows).
+    The geometry goes there too (wide-stripe volumes are self-describing)."""
     write_sorted_file_from_idx(base_path)
     write_ec_files(base_path, geo, codec)
     save_volume_info(base_path, version,
-                     dat_size=os.path.getsize(base_path + ".dat"))
+                     dat_size=os.path.getsize(base_path + ".dat"),
+                     data_shards=geo.data_shards,
+                     parity_shards=geo.parity_shards,
+                     large_block_size=geo.large_block_size,
+                     small_block_size=geo.small_block_size)
 
 
 def decode_ec_to_volume(base_path: str,
-                        geo: EcGeometry = DEFAULT_GEOMETRY) -> None:
+                        geo: "EcGeometry | None" = None) -> None:
     """The VolumeEcShardsToVolume flow
     (volume_grpc_erasure_coding.go VolumeEcShardsToVolume): rebuild missing
     data shards if needed, then stitch .dat and .idx back."""
+    geo = geo or geometry_from_vif(base_path)
     missing_data = [s for s in range(geo.data_shards)
                     if not os.path.exists(base_path + to_ext(s))]
     if missing_data:

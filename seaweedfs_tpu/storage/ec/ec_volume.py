@@ -79,13 +79,17 @@ class EcVolume:
     """All local shards of one EC volume + its .ecx/.ecj index files."""
 
     def __init__(self, directory: str, collection: str, vid: int,
-                 geo: EcGeometry = DEFAULT_GEOMETRY,
+                 geo: "EcGeometry | None" = None,
                  codec: RSCodec | None = None,
                  remote_reader: RemoteShardReader | None = None,
                  version: int = t.CURRENT_VERSION):
         self.directory = directory
         self.collection = collection
         self.volume_id = vid
+        if geo is None:
+            # wide-stripe volumes are self-describing via .vif
+            from . import geometry_from_vif
+            geo = geometry_from_vif(self._base())
         self.geo = geo
         self.codec = codec or RSCodec(geo.data_shards, geo.parity_shards)
         self.remote_reader = remote_reader

@@ -116,11 +116,14 @@ def write_ec_files(base_path: str, geo: EcGeometry = DEFAULT_GEOMETRY,
             f.close()
 
 
-def rebuild_ec_files(base_path: str, geo: EcGeometry = DEFAULT_GEOMETRY,
+def rebuild_ec_files(base_path: str, geo: "EcGeometry | None" = None,
                      codec: RSCodec | None = None,
                      batch_bytes: int = DEFAULT_BATCH_BYTES) -> list[int]:
     """Regenerate every missing .ecNN from the surviving ones
     (RebuildEcFiles ec_encoder.go:61/233).  Returns rebuilt shard ids."""
+    if geo is None:
+        from . import geometry_from_vif
+        geo = geometry_from_vif(base_path)
     codec = _codec_for(geo, codec)
     n = geo.total_shards
     have = [os.path.exists(base_path + to_ext(i)) for i in range(n)]

@@ -483,6 +483,7 @@ class VolumeServer:
                 "VolumeEcShardsUnmount": self._rpc_ec_unmount,
                 "VolumeEcBlobDelete": self._rpc_ec_blob_delete,
                 "VolumeEcShardsToVolume": self._rpc_ec_to_volume,
+                "VolumeEcGeometry": self._rpc_ec_geometry,
             },
             stream={
                 "VolumeEcShardRead": self._rpc_ec_shard_read,
@@ -741,12 +742,14 @@ class VolumeServer:
 
     # -- EC RPCs (volume_grpc_erasure_coding.go) ---------------------------
     def _base_path(self, vid: int, collection: str) -> str:
+        import glob as _glob
         for loc in self.store.locations:
             base = volume_file_name(loc.directory, collection, vid)
+            # geometry-independent probe: any shard file counts (wide
+            # stripes reach .ec23 and beyond)
             if (os.path.exists(base + ".dat")
                     or os.path.exists(base + ".ecx")
-                    or any(os.path.exists(base + to_ext(s))
-                           for s in range(DEFAULT_GEOMETRY.total_shards))):
+                    or _glob.glob(base + ".ec[0-9][0-9]")):
                 return base
         # fall back to the first location (for incoming copies)
         return volume_file_name(self.store.locations[0].directory,
@@ -760,7 +763,14 @@ class VolumeServer:
         if v is None:
             raise RpcError(f"volume {vid} not found")
         v.sync()
-        ec_pkg.encode_volume_to_ec(v.base_path, version=v.version)
+        geo = DEFAULT_GEOMETRY
+        if req.get("data_shards"):
+            # wide stripes: RS(28,4) / RS(16,8) etc (BASELINE targets)
+            from ..storage.ec.layout import EcGeometry
+            geo = EcGeometry(
+                data_shards=int(req["data_shards"]),
+                parity_shards=int(req.get("parity_shards", 4)))
+        ec_pkg.encode_volume_to_ec(v.base_path, version=v.version, geo=geo)
         return {}
 
     def _rpc_ec_rebuild(self, req: dict) -> dict:
@@ -806,8 +816,9 @@ class VolumeServer:
             if os.path.exists(p):
                 os.remove(p)
         # drop index files when no shards remain (volume_grpc_erasure_coding.go:205)
+        total = ec_pkg.geometry_from_vif(base).total_shards
         if not any(os.path.exists(base + to_ext(s))
-                   for s in range(DEFAULT_GEOMETRY.total_shards)):
+                   for s in range(total)):
             for ext in (".ecx", ".ecj", ".vif"):
                 if os.path.exists(base + ext):
                     os.remove(base + ext)
@@ -837,12 +848,22 @@ class VolumeServer:
         vid = int(req["volume_id"])
         collection = req.get("collection", "")
         base = self._base_path(vid, collection)
+        total = ec_pkg.geometry_from_vif(base).total_shards
         ec_pkg.decode_ec_to_volume(base)
-        self.store.unmount_ec_shards(vid,
-                                     list(range(DEFAULT_GEOMETRY.total_shards)))
+        self.store.unmount_ec_shards(vid, list(range(total)))
         for loc in self.store.locations:
             loc.load_existing_volumes()
         return {}
+
+    def _rpc_ec_geometry(self, req: dict) -> dict:
+        """The stripe geometry recorded in .vif (wide-stripe support —
+        maintenance tools must not assume 10+4)."""
+        base = self._base_path(int(req["volume_id"]),
+                               req.get("collection", ""))
+        geo = ec_pkg.geometry_from_vif(base)
+        return {"data_shards": geo.data_shards,
+                "parity_shards": geo.parity_shards,
+                "total_shards": geo.total_shards}
 
     def _rpc_ec_shard_read(self, requests):
         """Stream shard bytes (VolumeEcShardRead volume_server.proto:82)."""

@@ -15,9 +15,11 @@ from ..pb.rpc import POOL, RpcError
 
 
 class MasterClient:
-    def __init__(self, master_grpc: str, client_name: str = "client"):
+    def __init__(self, master_grpc: str, client_name: str = "client",
+                 client_type: str = "client"):
         self.master_grpc = master_grpc
         self.client_name = client_name
+        self.client_type = client_type
         self._vid_map: dict[int, list[dict]] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -54,7 +56,7 @@ class MasterClient:
                 client = POOL.client(self.master_grpc, "Seaweed")
                 for msg in client.stream(
                         "KeepConnected",
-                        iter([{"client_type": "client",
+                        iter([{"client_type": self.client_type,
                                "client_name": self.client_name}])):
                     self._apply(msg)
                     if self._stop.is_set():

@@ -237,3 +237,36 @@ def test_ec_encode_spread_degraded_read(cluster):
     f0 = next(iter(payloads))
     status, body, _ = http_request(f"http://{src.url}/{fids[f0]}")
     assert status == 200 and body == payloads[f0]
+
+
+def test_cluster_registry_tracks_filers(cluster):
+    """Filers announce via KeepConnected; the registry elects the first
+    as filer leader and drops them when the stream dies
+    (cluster/cluster.go)."""
+    import time as _time
+    from seaweedfs_tpu.filer import FilerServer
+    master, servers = cluster
+    f1 = FilerServer(master.grpc_address)
+    f1.start()
+    f2 = FilerServer(master.grpc_address)
+    f2.start()
+    c = POOL.client(master.grpc_address, "Seaweed")
+    deadline = _time.time() + 5
+    nodes = {}
+    while _time.time() < deadline:
+        nodes = c.call("ListClusterNodes")
+        if len(nodes.get("nodes", {}).get("filer", [])) == 2:
+            break
+        _time.sleep(0.05)
+    assert sorted(nodes["nodes"]["filer"]) == sorted(
+        [f1.grpc_address, f2.grpc_address])
+    assert nodes["leaders"]["filer"] == f1.grpc_address  # first = leader
+    f1.stop()
+    deadline = _time.time() + 5
+    while _time.time() < deadline:
+        nodes = c.call("ListClusterNodes")
+        if nodes["nodes"].get("filer") == [f2.grpc_address]:
+            break
+        _time.sleep(0.05)
+    assert nodes["leaders"]["filer"] == f2.grpc_address  # leader moved
+    f2.stop()

@@ -196,6 +196,41 @@ def test_shell_ec_encode_rebuild_balance(cluster):
     shell.run_command(env, "unlock")
 
 
+def test_shell_ec_encode_wide_stripe(cluster):
+    """RS(16,8) wide stripe (a BASELINE target beyond the reference's
+    fixed 10+4): encode, degraded read with 8 shards lost."""
+    master, servers, env = cluster
+    fids = write_blobs(master, 8)
+    vid = int(next(iter(fids)).split(",")[0])
+    in_vol = {f: d for f, d in fids.items()
+              if int(f.split(",")[0]) == vid}
+    for vs in servers:
+        vs.heartbeat_now()
+    shell.run_command(env, "lock")
+    out = json.loads(shell.run_command(
+        env, f"ec.encode -volumeId {vid} -dataShards 16 -parityShards 8"))
+    dist = out["encoded"][0]["distribution"]
+    assert sorted(s for ids in dist.values() for s in ids) == list(range(24))
+    for vs in servers:
+        vs.heartbeat_now()
+    # all needles readable through the wide stripe
+    for f, data in in_vol.items():
+        assert operation.read_file(master.grpc_address, f) == data
+    # drop one whole holder (up to 6 shards with 4 nodes) -> still fine
+    holder = next(vs for vs in servers if vs.store.find_ec_volume(vid))
+    lost = list(holder.store.find_ec_volume(vid).shards.keys())
+    assert len(lost) <= 8
+    holder.store.unmount_ec_shards(vid, lost)
+    c = env.volume_server(holder.grpc_address)
+    c.call("VolumeEcShardsDelete", {"volume_id": vid, "shard_ids": lost})
+    holder.heartbeat_now()
+    for vs in servers:
+        vs._ec_locations.clear()
+    for f, data in in_vol.items():
+        assert operation.read_file(master.grpc_address, f) == data
+    shell.run_command(env, "unlock")
+
+
 def test_shell_ec_decode(cluster):
     master, servers, env = cluster
     fids = write_blobs(master, 6)
