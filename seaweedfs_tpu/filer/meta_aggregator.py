@@ -1,0 +1,94 @@
+"""Meta aggregator — filer HA without a shared store.
+
+Capability-equivalent to weed/filer/meta_aggregator.go:37-246: each filer
+discovers its peers from the master's cluster registry (ClusterNodeUpdate
+over KeepConnected in the reference; polled from ListClusterNodes here),
+subscribes to every peer's LOCAL metadata stream, and re-publishes those
+events into its own aggregate feed.  Subscribers of ANY filer therefore
+see the whole cluster's mutations — S3 credential hot-reload, filer.sync,
+and mounts keep working when their filer dies and they reconnect to
+another.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from ..pb.rpc import POOL, RpcError
+
+
+class MetaAggregator:
+    def __init__(self, master_grpc: str, self_filer_grpc: str,
+                 publish: Callable[[dict], None]):
+        """publish(event_dict) re-emits a peer's event into the local
+        aggregate feed."""
+        self.master_grpc = master_grpc
+        self.self_filer = self_filer_grpc
+        self.publish = publish
+        self._stop = threading.Event()
+        self._peer_threads: dict[str, threading.Thread] = {}
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        threading.Thread(target=self._discovery_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- peer discovery (MetaAggregator.OnPeerUpdate) ----------------------
+    def _discovery_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                out = POOL.client(self.master_grpc, "Seaweed").call(
+                    "ListClusterNodes")
+                peers = [p for p in out.get("nodes", {}).get("filer", [])
+                         if p != self.self_filer]
+                with self._lock:
+                    for peer in peers:
+                        if peer not in self._peer_threads or \
+                                not self._peer_threads[peer].is_alive():
+                            t = threading.Thread(
+                                target=self._follow_peer, args=(peer,),
+                                daemon=True)
+                            self._peer_threads[peer] = t
+                            t.start()
+            except RpcError:
+                pass
+            self._stop.wait(1.0)
+
+    # -- per-peer subscription loop (loopSubscribeToOneFiler) --------------
+    def _follow_peer(self, peer: str) -> None:
+        # since=0: replay the peer's full (capped) history so a freshly
+        # started filer converges its store, and so no events are lost to
+        # clock skew between machines (the peer's own ts_ns is the cursor)
+        since = 0
+        while not self._stop.is_set():
+            try:
+                # LOCAL stream only — following the peer's aggregate would
+                # echo our own re-published events back and forth
+                for msg in POOL.client(peer, "SeaweedFiler").stream(
+                        "SubscribeLocalMetadata",
+                        iter([{"since_ns": since, "path_prefix": "/"}])):
+                    if self._stop.is_set():
+                        return
+                    if "ping" in msg:
+                        continue
+                    since = max(since, msg.get("ts_ns", since))
+                    msg = dict(msg)
+                    msg["source_filer"] = peer
+                    self.publish(msg)
+            except RpcError:
+                pass
+            if self._stop.wait(1.0):
+                return
+            # peer may be gone for good: stop following once the registry
+            # drops it
+            try:
+                out = POOL.client(self.master_grpc, "Seaweed").call(
+                    "ListClusterNodes")
+                if peer not in out.get("nodes", {}).get("filer", []):
+                    return
+            except RpcError:
+                pass

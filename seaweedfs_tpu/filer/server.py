@@ -34,6 +34,42 @@ from .filer import Filer
 from .filerstore import NotFound, new_filer_store
 
 CHUNK_SIZE = 8 * 1024 * 1024  # autochunk size (filer_server.go option)
+FILER_CONF_PATH = "/etc/seaweedfs/filer.conf"
+FILER_CONF_TTL = 5.0  # hot-reload window
+
+
+class FilerConf:
+    """Per-path-prefix placement rules (weed/filer/filer_conf.go): the
+    longest matching location_prefix decides collection/replication/ttl
+    for writes under it.  Stored as a namespace ENTRY at
+    /etc/seaweedfs/filer.conf (conf JSON in its extended attrs) — entries
+    replicate across filers via the meta aggregator, so one fs.configure
+    reaches every filer; reloaded on a short TTL."""
+
+    def __init__(self, store):
+        self.store = store
+        self._rules: list[dict] = []
+        self._loaded = 0.0
+
+    def _maybe_reload(self) -> None:
+        if time.time() - self._loaded < FILER_CONF_TTL:
+            return
+        self._loaded = time.time()
+        try:
+            entry = self.store.find_entry(FILER_CONF_PATH)
+            cfg = json.loads(entry.extended.get("conf", "{}"))
+            self._rules = sorted(cfg.get("locations", []),
+                                 key=lambda r: -len(
+                                     r.get("location_prefix", "")))
+        except Exception:
+            self._rules = []
+
+    def match(self, path: str) -> dict:
+        self._maybe_reload()
+        for rule in self._rules:  # longest prefix first
+            if path.startswith(rule.get("location_prefix", "")):
+                return rule
+        return {}
 
 
 def _parse_range(spec: str, size: int) -> "tuple[int, int] | None":
@@ -74,6 +110,14 @@ class FilerServer:
         self.rpc = RpcServer(host, grpc_port)
         self._del_queue: "queue.Queue[str]" = queue.Queue()
         self._stop = threading.Event()
+        # aggregate feed = local events + peer filers' events
+        # (meta_aggregator.go); peers follow our LOCAL stream only, so
+        # re-published peer events can never loop back
+        self._agg_subs: "dict[int, queue.Queue]" = {}
+        self._agg_seq = 0
+        self._agg_lock = threading.Lock()
+        self._aggregator = None
+        self.conf = FilerConf(self.filer.store)
         self._register_http()
         self._register_rpc()
 
@@ -89,9 +133,19 @@ class FilerServer:
             self.master_grpc, client_name=self.grpc_address,
             client_type="filer")
         self._master_client.start()
+        # peer events: applied to the local store (namespace convergence
+        # across filers with separate stores) and fanned to aggregate
+        # subscribers.  Local events reach subscribers via Filer.subscribe
+        # inside each aggregate stream.
+        from .meta_aggregator import MetaAggregator
+        self._aggregator = MetaAggregator(
+            self.master_grpc, self.grpc_address, self._on_peer_event)
+        self._aggregator.start()
 
     def stop(self) -> None:
         self._stop.set()
+        if self._aggregator is not None:
+            self._aggregator.stop()
         if getattr(self, "_master_client", None):
             self._master_client.stop()
         self.http.stop()
@@ -140,11 +194,14 @@ class FilerServer:
             time.sleep(0.02)
 
     # -- chunk IO ----------------------------------------------------------
-    def _save_chunk(self, data: bytes, ts_ns: int,
-                    offset: int) -> FileChunk:
-        r = operation.assign(self.master_grpc,
-                             replication=self.replication,
-                             collection=self.collection)
+    def _save_chunk(self, data: bytes, ts_ns: int, offset: int,
+                    path: str = "") -> FileChunk:
+        rule = self.conf.match(path) if path else {}
+        r = operation.assign(
+            self.master_grpc,
+            replication=rule.get("replication") or self.replication,
+            collection=rule.get("collection") or self.collection,
+            ttl=rule.get("ttl", ""))
         out = operation.upload_data(r.url, r.fid, data, jwt=r.auth)
         return FileChunk(file_id=r.fid, offset=offset, size=len(data),
                          modified_ts_ns=ts_ns, etag=out.get("eTag", ""))
@@ -186,14 +243,23 @@ class FilerServer:
         for off in range(0, len(body), self.chunk_size) or [0]:
             piece = body[off:off + self.chunk_size]
             if piece or off == 0:
-                chunks.append(self._save_chunk(piece, ts_ns, off))
+                chunks.append(self._save_chunk(piece, ts_ns, off,
+                                               path=path))
         chunks = maybe_manifestize(self._save_manifest_blob, chunks)
         now = time.time()
         import hashlib
+        from ..storage.ttl import TTL
+        rule = self.conf.match(path)
+        ttl_sec = 0
+        if rule.get("ttl"):
+            # the entry must expire with its TTL-volume chunks, or it
+            # dangles after the master reclaims the volume
+            ttl_sec = TTL.parse(rule["ttl"]).minutes() * 60
         entry = Entry(
             full_path=path.rstrip("/"),
             attr=Attr(mtime=now, crtime=now, mode=0o660,
-                      mime=req.headers.get("Content-Type", "")),
+                      mime=req.headers.get("Content-Type", ""),
+                      ttl_sec=ttl_sec),
             chunks=chunks,
             extended={"etag": hashlib.md5(body).hexdigest()})
         self.filer.create_entry(entry)
@@ -284,8 +350,57 @@ class FilerServer:
             },
             stream={
                 "ListEntries": self._rpc_list_entries,
-                "SubscribeMetadata": self._rpc_subscribe_metadata,
+                "SubscribeLocalMetadata": self._rpc_subscribe_metadata,
+                "SubscribeMetadata": self._rpc_subscribe_aggregate,
             })
+
+    def _on_peer_event(self, event: dict) -> None:
+        """A peer filer's mutation: converge the local store (the
+        reference's MetaAggregator applies events when stores aren't
+        shared) and fan to aggregate subscribers.  Store writes bypass
+        Filer.create_entry so no LOCAL event is emitted — peers follow
+        only local streams, so nothing loops."""
+        old, new = event.get("old_entry"), event.get("new_entry")
+        try:
+            if new is not None:
+                self.filer.store.insert_entry(Entry.from_dict(new))
+            elif old is not None:
+                self.filer.store.delete_entry(old["full_path"])
+        except Exception:
+            pass
+        with self._agg_lock:
+            for q in self._agg_subs.values():
+                q.put(event)
+
+    def _rpc_subscribe_aggregate(self, requests):
+        """Aggregate stream: the local backlog+live feed (via
+        Filer.subscribe, which guarantees backlog-before-live with no
+        gap/duplication) merged with peer events (SubscribeMetadata in the
+        reference; peer history replays through the aggregator)."""
+        req = next(iter(requests), {}) or {}
+        since = req.get("since_ns", 0)
+        prefix = (req.get("path_prefix", "/") or "/").rstrip("/")
+        from ..util import path_matches_prefix
+        q: "queue.Queue[dict]" = queue.Queue()
+        with self._agg_lock:
+            self._agg_seq += 1
+            sid = self._agg_seq
+            self._agg_subs[sid] = q
+        unsubscribe = self.filer.subscribe(
+            lambda ev: q.put(ev.to_dict()), since_ts_ns=since)
+        try:
+            while True:
+                try:
+                    ev = q.get(timeout=0.5)
+                except queue.Empty:
+                    yield {"ping": 1}
+                    continue
+                if path_matches_prefix(ev.get("directory", "/"), prefix):
+                    yield ev
+        finally:
+            unsubscribe()
+            with self._agg_lock:
+                self._agg_subs.pop(sid, None)
 
     def _rpc_lookup(self, req: dict) -> dict:
         directory = req.get("directory", "/").rstrip("/") or "/"

@@ -1,0 +1,24 @@
+"""FTP gateway — skeleton, matching the reference's own state.
+
+The reference ships only an unimplemented driver stub
+(weed/ftpd/ftp_server.go:13-20, 81 lines: ftpserverlib wiring with every
+driver method returning 'not implemented').  The same honest skeleton
+here: the server shape exists so a driver can land, and start() explains
+what's missing instead of pretending.
+"""
+
+from __future__ import annotations
+
+
+class FtpServer:
+    def __init__(self, filer_grpc: str, host: str = "127.0.0.1",
+                 port: int = 8021):
+        self.filer_grpc = filer_grpc
+        self.host = host
+        self.port = port
+
+    def start(self) -> None:
+        raise NotImplementedError(
+            "FTP driver is a skeleton in the reference too "
+            "(weed/ftpd/ftp_server.go); use the WebDAV or S3 gateway, or "
+            "implement the driver against seaweedfs_tpu.filer's gRPC API")

@@ -179,14 +179,14 @@ def do_ec_rebuild(env: CommandEnv, vid: int, collection: str = "") -> dict:
     topo = env.topology()
     shard_map = collect_ec_shard_map(topo).get(vid, {})
     present = {s for ids in shard_map.values() for s in ids}
-    grpc_by_id0 = {dn["id"]: node_grpc(dn)
-                   for _, _, dn in iter_data_nodes(topo)}
+    grpc_by_id = {dn["id"]: node_grpc(dn)
+                  for _, _, dn in iter_data_nodes(topo)}
     # wide stripes: the true total comes from a holder's .vif, not the
     # fixed 10+4 default
     n_total = TOTAL_SHARDS_COUNT
     for nid in shard_map:
         try:
-            n_total = env.volume_server(grpc_by_id0[nid]).call(
+            n_total = env.volume_server(grpc_by_id[nid]).call(
                 "VolumeEcGeometry",
                 {"volume_id": vid, "collection": collection}
             )["total_shards"]
@@ -196,8 +196,6 @@ def do_ec_rebuild(env: CommandEnv, vid: int, collection: str = "") -> dict:
     missing = [s for s in range(n_total) if s not in present]
     if not missing:
         return {"volume_id": vid, "rebuilt": []}
-    grpc_by_id = {dn["id"]: node_grpc(dn)
-                  for _, _, dn in iter_data_nodes(topo)}
     # rebuilder: most local shards already
     rebuilder_id = max(shard_map, key=lambda nid: len(shard_map[nid]))
     rebuilder = env.volume_server(grpc_by_id[rebuilder_id])

@@ -24,11 +24,47 @@ def _filer(env: CommandEnv):
     return POOL.client(addr, "SeaweedFiler")
 
 
-@command("fs.configure", "point the shell at a filer: -filer host:grpcPort")
+FILER_CONF_PATH = "/etc/seaweedfs/filer.conf"
+
+
+@command("fs.configure",
+         "point the shell at a filer (-filer host:grpcPort) and/or set "
+         "path rules: -locationPrefix /p -collection c -replication r "
+         "-ttl t [-delete]")
 def cmd_fs_configure(env: CommandEnv, args: list[str]) -> str:
     flags = parse_flags(args)
-    env.filer_grpc = flags.get("filer", "")
-    return f"filer = {env.filer_grpc}"
+    if flags.get("filer"):
+        env.filer_grpc = flags["filer"]
+    if "locationPrefix" not in flags:
+        return f"filer = {getattr(env, 'filer_grpc', '')}"
+    # per-path rules live as a namespace ENTRY at /etc/seaweedfs/filer.conf
+    # (filer/filer_conf.go) so they replicate to every filer via the meta
+    # aggregator
+    client = _filer(env)
+    directory, _, name = FILER_CONF_PATH.rpartition("/")
+    try:
+        entry = client.call("LookupDirectoryEntry", {
+            "directory": directory, "name": name})["entry"]
+        cfg = json.loads(entry.get("extended", {}).get("conf", "{}"))
+    except (RpcError, ValueError):
+        entry = None
+        cfg = {}
+    cfg.setdefault("locations", [])
+    prefix = flags["locationPrefix"]
+    cfg["locations"] = [r for r in cfg["locations"]
+                        if r.get("location_prefix") != prefix]
+    if flags.get("delete") != "true":
+        rule = {"location_prefix": prefix}
+        for key in ("collection", "replication", "ttl"):
+            if flags.get(key):
+                rule[key] = flags[key]
+        cfg["locations"].append(rule)
+    client.call("CreateEntry", {"entry": {
+        "full_path": FILER_CONF_PATH,
+        "attr": {"mtime": time.time(), "crtime": time.time(),
+                 "mode": 0o660},
+        "extended": {"conf": json.dumps(cfg)}}})
+    return json.dumps(cfg)
 
 
 @command("fs.ls", "list a filer directory: fs.ls /path")

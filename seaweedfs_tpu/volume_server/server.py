@@ -857,9 +857,15 @@ class VolumeServer:
 
     def _rpc_ec_geometry(self, req: dict) -> dict:
         """The stripe geometry recorded in .vif (wide-stripe support —
-        maintenance tools must not assume 10+4)."""
+        maintenance tools must not assume 10+4).  Fails rather than guess
+        when the .vif is absent/incomplete so callers probe another
+        holder instead of shrinking a wide stripe to 14."""
         base = self._base_path(int(req["volume_id"]),
                                req.get("collection", ""))
+        info = ec_pkg.load_volume_info(base)
+        if "data_shards" not in info:
+            raise RpcError(f"no geometry in .vif for volume "
+                           f"{req['volume_id']} at {base}")
         geo = ec_pkg.geometry_from_vif(base)
         return {"data_shards": geo.data_shards,
                 "parity_shards": geo.parity_shards,

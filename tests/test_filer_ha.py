@@ -1,0 +1,158 @@
+"""Filer HA (meta aggregator) + filer.conf path rules tests."""
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation, shell
+from seaweedfs_tpu.filer import FilerServer
+from seaweedfs_tpu.master import MasterServer
+from seaweedfs_tpu.pb.rpc import POOL
+from seaweedfs_tpu.util.http import http_request
+from seaweedfs_tpu.volume_server import VolumeServer
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    master = MasterServer(seed=141)
+    master.start()
+    d = tmp_path / "vol"
+    d.mkdir()
+    vs = VolumeServer(master.grpc_address, [str(d)], pulse_seconds=0.5,
+                      max_volume_counts=[30])
+    vs.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topo.data_nodes()) < 1:
+        time.sleep(0.05)
+    f1 = FilerServer(master.grpc_address)
+    f1.start()
+    f2 = FilerServer(master.grpc_address)
+    f2.start()
+    # wait until both filers appear in the registry (aggregator input)
+    c = POOL.client(master.grpc_address, "Seaweed")
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        nodes = c.call("ListClusterNodes")
+        if len(nodes.get("nodes", {}).get("filer", [])) == 2:
+            break
+        time.sleep(0.05)
+    yield master, vs, f1, f2
+    f2.stop()
+    f1.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_aggregate_stream_carries_peer_events(stack):
+    """A subscriber on filer 2's AGGREGATE stream sees a mutation made on
+    filer 1 (meta_aggregator.go) — stores are separate; only events flow."""
+    master, vs, f1, f2 = stack
+    time.sleep(1.5)  # let f2's aggregator connect to f1
+    got = []
+    import threading
+
+    def subscribe():
+        c = POOL.client(f2.grpc_address, "SeaweedFiler")
+        pings = 0
+        for msg in c.stream("SubscribeMetadata",
+                            iter([{"since_ns": time.time_ns(),
+                                   "path_prefix": "/"}])):
+            if "ping" in msg:
+                pings += 1
+                if pings > 20 or got:
+                    break
+                continue
+            got.append(msg)
+            break
+
+    t = threading.Thread(target=subscribe, daemon=True)
+    t.start()
+    time.sleep(0.5)
+    http_request(f"http://{f1.address}/from-f1.txt", method="POST",
+                 body=b"made on filer 1")
+    t.join(timeout=15)
+    assert got, "no peer event arrived on filer 2's aggregate stream"
+    ev = got[0]
+    assert ev["new_entry"]["full_path"] == "/from-f1.txt"
+    assert ev.get("source_filer") == f1.grpc_address
+
+
+def test_local_stream_excludes_peer_events(stack):
+    master, vs, f1, f2 = stack
+    time.sleep(1.5)
+    since = time.time_ns()
+    http_request(f"http://{f1.address}/only-local.txt", method="POST",
+                 body=b"x")
+    time.sleep(1.0)  # aggregator propagation window
+    c = POOL.client(f2.grpc_address, "SeaweedFiler")
+    local = []
+    for msg in c.stream("SubscribeLocalMetadata",
+                        iter([{"since_ns": since, "path_prefix": "/"}])):
+        if "ping" in msg:
+            break
+        local.append(msg)
+    paths = [m["new_entry"]["full_path"] for m in local
+             if m.get("new_entry")]
+    assert "/only-local.txt" not in paths  # peer event; not local to f2
+
+
+def test_namespace_converges_across_filers(stack):
+    """Peer events APPLY to the local store (separate stores, one
+    namespace — the aggregator's store-sync role)."""
+    master, vs, f1, f2 = stack
+    time.sleep(1.5)  # aggregator connects
+    http_request(f"http://{f1.address}/conv/x.txt", method="POST",
+                 body=b"converged")
+    deadline = time.time() + 8
+    body = b""
+    while time.time() < deadline:
+        status, body, _ = http_request(f"http://{f2.address}/conv/x.txt")
+        if status == 200:
+            break
+        time.sleep(0.1)
+    assert body == b"converged"  # f2 serves it from its OWN store + events
+
+
+def test_filer_conf_path_rules(stack):
+    """fs.configure path rules route writes under a prefix into their own
+    collection (filer_conf.go); the rule entry replicates to every filer."""
+    master, vs, f1, f2 = stack
+    time.sleep(1.5)
+    env = shell.CommandEnv(master.grpc_address)
+    shell.run_command(env, f"fs.configure -filer {f1.grpc_address}")
+    out = json.loads(shell.run_command(
+        env, "fs.configure -locationPrefix /hot/ -collection fastdata"))
+    assert out["locations"][0]["collection"] == "fastdata"
+    # conf cache TTL is 5s; force a fresh read
+    f1.conf._loaded = 0.0
+    status, _, _ = http_request(f"http://{f1.address}/hot/a.bin",
+                                method="POST", body=b"hot data")
+    assert status == 201
+    # the rule written via f1 reaches f2 through the aggregator
+    deadline = time.time() + 8
+    while time.time() < deadline:
+        f2.conf._loaded = 0.0
+        if f2.conf.match("/hot/z").get("collection") == "fastdata":
+            break
+        time.sleep(0.1)
+    assert f2.conf.match("/hot/z").get("collection") == "fastdata"
+    status, _, _ = http_request(f"http://{f1.address}/cold/b.bin",
+                                method="POST", body=b"cold data")
+    assert status == 201
+    vs.heartbeat_now()
+    # the /hot chunk landed in a 'fastdata'-collection volume
+    colls = {v.collection for v in
+             vs.store.locations[0].volumes.values()}
+    assert "fastdata" in colls
+    hot_vols = [vid for vid, v in vs.store.locations[0].volumes.items()
+                if v.collection == "fastdata"]
+    entry = POOL.client(f1.grpc_address, "SeaweedFiler").call(
+        "LookupDirectoryEntry", {"directory": "/hot", "name": "a.bin"}
+    )["entry"]
+    chunk_vid = int(entry["chunks"][0]["file_id"].split(",")[0])
+    assert chunk_vid in hot_vols
+    # rule deletion
+    out = json.loads(shell.run_command(
+        env, "fs.configure -locationPrefix /hot/ -delete"))
+    assert out["locations"] == []
