@@ -29,6 +29,10 @@ class MetaAggregator:
         self.publish = publish
         self._stop = threading.Event()
         self._peer_threads: dict[str, threading.Thread] = {}
+        # per-peer consumed-ts cursor; survives follower-thread restarts
+        # so a peer that drops out of the registry and rejoins does not
+        # replay its whole history to live subscribers
+        self._cursors: dict[str, int] = {}
         self._lock = threading.Lock()
 
     def start(self) -> None:
@@ -60,10 +64,11 @@ class MetaAggregator:
 
     # -- per-peer subscription loop (loopSubscribeToOneFiler) --------------
     def _follow_peer(self, peer: str) -> None:
-        # since=0: replay the peer's full (capped) history so a freshly
-        # started filer converges its store, and so no events are lost to
-        # clock skew between machines (the peer's own ts_ns is the cursor)
-        since = 0
+        # first contact starts at 0: replay the peer's full (capped)
+        # history so a freshly started filer converges its store, and so
+        # no events are lost to clock skew (the PEER's ts_ns is the
+        # cursor); reconnects resume from the persisted cursor
+        since = self._cursors.get(peer, 0)
         while not self._stop.is_set():
             try:
                 # LOCAL stream only — following the peer's aggregate would
@@ -76,6 +81,7 @@ class MetaAggregator:
                     if "ping" in msg:
                         continue
                     since = max(since, msg.get("ts_ns", since))
+                    self._cursors[peer] = since
                     msg = dict(msg)
                     msg["source_filer"] = peer
                     self.publish(msg)

@@ -197,12 +197,16 @@ class FilerServer:
     def _save_chunk(self, data: bytes, ts_ns: int, offset: int,
                     path: str = "") -> FileChunk:
         rule = self.conf.match(path) if path else {}
+        ttl = rule.get("ttl", "")
         r = operation.assign(
             self.master_grpc,
             replication=rule.get("replication") or self.replication,
             collection=rule.get("collection") or self.collection,
-            ttl=rule.get("ttl", ""))
-        out = operation.upload_data(r.url, r.fid, data, jwt=r.auth)
+            ttl=ttl)
+        # the needle must carry the ttl too — needle expiry on read
+        # (storage/volume.py) is what actually retires the data
+        out = operation.upload_data(r.url, r.fid, data, jwt=r.auth,
+                                    ttl=ttl)
         return FileChunk(file_id=r.fid, offset=offset, size=len(data),
                          modified_ts_ns=ts_ns, etag=out.get("eTag", ""))
 

@@ -58,6 +58,7 @@ class MasterServer:
                  jwt_signing_key: str = "",
                  jwt_expires_seconds: int = 10,
                  peers: list[str] | None = None,
+                 auto_vacuum_interval: float = 0.0,
                  seed: int | None = None):
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024, seed=seed)
@@ -71,6 +72,8 @@ class MasterServer:
         self.is_leader = True
         self.ha = None
         self._peers = peers or []
+        self.auto_vacuum_interval = auto_vacuum_interval
+        self._stop_vacuum = threading.Event()
         self._rng = random.Random(seed)
         self._grow_lock = threading.Lock()
         # admin maintenance lock (LeaseAdminToken)
@@ -101,8 +104,23 @@ class MasterServer:
             from .ha import HaCoordinator
             self.ha = HaCoordinator(self, self._peers)
             self.ha.start()
+        if self.auto_vacuum_interval > 0:
+            # the embedded maintenance cron (startAdminScripts,
+            # master_server.go:212 / master.maintenance scaffold)
+            def vacuum_loop():
+                from . import vacuum as vacuum_mod
+                while not self._stop_vacuum.wait(
+                        self.auto_vacuum_interval):
+                    if self.is_leader:
+                        try:
+                            vacuum_mod.vacuum(self.topo,
+                                              self.garbage_threshold)
+                        except Exception:
+                            pass
+            threading.Thread(target=vacuum_loop, daemon=True).start()
 
     def stop(self) -> None:
+        self._stop_vacuum.set()
         if self.ha:
             self.ha.stop()
         self.http.stop()

@@ -1,6 +1,7 @@
 """Interactive maintenance shell (reference weed/shell): commands register
 into the COMMANDS map; CommandEnv holds the master connection + admin lock."""
 
-from . import (command_ec, command_fs,  # noqa: F401
-               command_maintenance, command_volume)
+from . import (command_collection, command_ec,  # noqa: F401
+               command_fs, command_maintenance,
+               command_volume)
 from .commands import COMMANDS, CommandEnv, ShellError, run_command

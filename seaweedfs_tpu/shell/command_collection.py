@@ -1,0 +1,180 @@
+"""Collection + remaining volume commands — weed/shell/command_collection_*.go,
+command_volume_configure_replication.go, command_volume_fsck.go,
+command_volume_mount.go."""
+
+from __future__ import annotations
+
+import json
+
+from ..pb.rpc import RpcError
+from ..storage.super_block import ReplicaPlacement
+from .commands import (CommandEnv, ShellError, command, iter_data_nodes,
+                       node_grpc, parse_flags)
+
+
+@command("collection.list", "list collections")
+def cmd_collection_list(env: CommandEnv, args: list[str]) -> str:
+    topo = env.topology()
+    colls: dict[str, int] = {}
+    for _, _, dn in iter_data_nodes(topo):
+        for v in dn["volumes"]:
+            colls[v.get("collection", "")] = \
+                colls.get(v.get("collection", ""), 0) + 1
+    ec_colls: dict[str, set] = {}
+    for vid_s, coll in topo.get("ec_collections", {}).items():
+        ec_colls.setdefault(coll, set()).add(vid_s)
+    names = sorted(set(colls) | set(ec_colls))
+    return json.dumps([{"name": c or "(default)",
+                        "volumes": colls.get(c, 0),
+                        "ec_volumes": len(ec_colls.get(c, ()))}
+                       for c in names])
+
+
+@command("collection.delete", "delete every volume of a collection: -collection c -force")
+def cmd_collection_delete(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    name = flags.get("collection", "")
+    if not name:
+        raise ShellError("need -collection")
+    if flags.get("force") != "true":
+        raise ShellError("add -force to really delete a whole collection")
+    env.confirm_is_locked()
+    topo = env.topology()
+    ec_vids = {int(vid_s) for vid_s, coll
+               in topo.get("ec_collections", {}).items() if coll == name}
+    deleted = ec_deleted = 0
+    from ..storage.ec.shard_bits import ShardBits
+    for _, _, dn in iter_data_nodes(topo):
+        c = env.volume_server(node_grpc(dn))
+        for v in dn["volumes"]:
+            if v.get("collection", "") == name:
+                c.call("VolumeDelete", {"volume_id": v["id"]})
+                deleted += 1
+        # this collection's EC shards go too (the reference's
+        # collection.delete removes both forms)
+        for vid_s, bits in dn.get("ec_shards", {}).items():
+            vid = int(vid_s)
+            if vid not in ec_vids:
+                continue
+            ids = ShardBits(int(bits)).shard_ids()
+            c.call("VolumeEcShardsUnmount",
+                   {"volume_id": vid, "shard_ids": ids})
+            c.call("VolumeEcShardsDelete",
+                   {"volume_id": vid, "collection": name,
+                    "shard_ids": ids})
+            ec_deleted += len(ids)
+    return json.dumps({"collection": name, "volumes_deleted": deleted,
+                       "ec_shards_deleted": ec_deleted})
+
+
+@command("volume.configure.replication",
+         "change a volume's replication: -volumeId N -replication xyz")
+def cmd_configure_replication(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    env.confirm_is_locked()
+    vid = int(flags["volumeId"])
+    rp = ReplicaPlacement.parse(flags["replication"])  # validates
+    topo = env.topology()
+    changed = 0
+    for _, _, dn in iter_data_nodes(topo):
+        if any(v["id"] == vid for v in dn["volumes"]):
+            env.volume_server(node_grpc(dn)).call(
+                "VolumeConfigureReplication",
+                {"volume_id": vid, "replication": str(rp)})
+            changed += 1
+    if not changed:
+        raise ShellError(f"volume {vid} not found")
+    return json.dumps({"volume_id": vid, "replication": str(rp),
+                       "replicas_updated": changed})
+
+
+@command("volume.fsck",
+         "find filer chunks referencing missing volumes/needles and "
+         "orphaned volume data (-filer required for chunk scan)")
+def cmd_volume_fsck(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    topo = env.topology()
+    known_vids = {v["id"] for _, _, dn in iter_data_nodes(topo)
+                  for v in dn["volumes"]}
+    for _, _, dn in iter_data_nodes(topo):
+        for vid_s in dn.get("ec_shards", {}):
+            known_vids.add(int(vid_s))
+    dangling: list[dict] = []
+    referenced_vids: set[int] = set()
+    filer_addr = flags.get("filer") or getattr(env, "filer_grpc", "")
+    if filer_addr:
+        import json as _json
+
+        from .. import operation
+        from ..pb.rpc import POOL
+
+        def expand(chunks: list[dict]) -> list[dict]:
+            """Resolve manifest chunks so manifest-internal data chunks
+            count as referenced (filechunk_manifest.go)."""
+            out = []
+            for c in chunks:
+                out.append(c)
+                if c.get("is_chunk_manifest"):
+                    try:
+                        payload = _json.loads(operation.read_file(
+                            env.master_grpc, c["file_id"]))
+                        out.extend(expand(payload.get("chunks", [])))
+                    except Exception:
+                        dangling.append({"file_id": c["file_id"],
+                                         "error": "unreadable manifest"})
+            return out
+
+        def walk(directory: str):
+            try:
+                for r in POOL.client(filer_addr, "SeaweedFiler").stream(
+                        "ListEntries", iter([{"directory": directory}])):
+                    e = r["entry"]
+                    if e["attr"].get("mode", 0) & 0o40000:
+                        walk(e["full_path"])
+                        continue
+                    for c in expand(e.get("chunks", [])):
+                        vid = int(c["file_id"].split(",")[0])
+                        referenced_vids.add(vid)
+                        if vid not in known_vids:
+                            dangling.append(
+                                {"path": e["full_path"],
+                                 "file_id": c["file_id"]})
+            except RpcError:
+                pass
+
+        walk("/")
+    orphan_vids = sorted(known_vids - referenced_vids) if filer_addr \
+        else []
+    return json.dumps({"volumes_in_topology": len(known_vids),
+                       "dangling_chunks": dangling,
+                       "volumes_with_no_filer_references": orphan_vids})
+
+
+@command("volume.unmount", "unload a volume: -volumeId N -node grpc")
+def cmd_volume_unmount(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    env.volume_server(flags["node"]).call(
+        "VolumeUnmount", {"volume_id": int(flags["volumeId"])})
+    return "unmounted"
+
+
+@command("volume.mount", "load a volume from disk: -volumeId N -node grpc")
+def cmd_volume_mount(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    env.volume_server(flags["node"]).call(
+        "VolumeMount", {"volume_id": int(flags["volumeId"])})
+    return "mounted"
+
+
+@command("fs.mkdir", "create a directory: fs.mkdir /path")
+def cmd_fs_mkdir(env: CommandEnv, args: list[str]) -> str:
+    from .command_fs import _filer
+    import time as _time
+    path = next((a for a in args if not a.startswith("-")), "")
+    if not path:
+        raise ShellError("need a path")
+    _filer(env).call("CreateEntry", {"entry": {
+        "full_path": path.rstrip("/"),
+        "attr": {"mtime": _time.time(), "crtime": _time.time(),
+                 "mode": 0o40000 | 0o770}}})
+    return f"created {path}"

@@ -181,6 +181,8 @@ class Topology:
     # -- serialization (the `volume.list` shape, shell tests' input) -------
     def to_dict(self) -> dict:
         out: dict = {"max_volume_id": self.max_volume_id,
+                     "ec_collections": {str(vid): coll for vid, coll
+                                        in self.ec_collections.items()},
                      "data_centers": []}
         for dc in self.root.children.values():
             dcd = {"id": dc.id, "racks": []}

@@ -460,6 +460,8 @@ class VolumeServer:
             unary={
                 "AllocateVolume": self._rpc_allocate_volume,
                 "VolumeDelete": self._rpc_volume_delete,
+                "VolumeConfigureReplication":
+                    self._rpc_configure_replication,
                 "VolumeMarkReadonly": self._rpc_mark_readonly,
                 "VolumeMarkWritable": self._rpc_mark_writable,
                 "VolumeMount": self._rpc_volume_mount,
@@ -600,6 +602,21 @@ class VolumeServer:
         if v is None:
             raise RpcError(f"volume {req['volume_id']} not found")
         return v
+
+    def _rpc_configure_replication(self, req: dict) -> dict:
+        """Rewrite the superblock's replica-placement byte
+        (volume_grpc_admin.go VolumeConfigureReplication)."""
+        import dataclasses
+
+        from ..storage.super_block import ReplicaPlacement
+        v = self._find_volume(req)
+        rp = ReplicaPlacement.parse(req["replication"])
+        # replace() keeps every other superblock field (notably `extra`,
+        # whose length the needle offsets depend on)
+        v.super_block = dataclasses.replace(v.super_block,
+                                            replica_placement=rp)
+        v.data_backend.write_at(v.super_block.to_bytes(), 0)
+        return {}
 
     def _rpc_mark_readonly(self, req: dict) -> dict:
         self._find_volume(req).read_only = True
@@ -866,10 +883,10 @@ class VolumeServer:
         if "data_shards" not in info:
             raise RpcError(f"no geometry in .vif for volume "
                            f"{req['volume_id']} at {base}")
-        geo = ec_pkg.geometry_from_vif(base)
-        return {"data_shards": geo.data_shards,
-                "parity_shards": geo.parity_shards,
-                "total_shards": geo.total_shards}
+        return {"data_shards": info["data_shards"],
+                "parity_shards": info["parity_shards"],
+                "total_shards": info["data_shards"]
+                + info["parity_shards"]}
 
     def _rpc_ec_shard_read(self, requests):
         """Stream shard bytes (VolumeEcShardRead volume_server.proto:82)."""

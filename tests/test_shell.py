@@ -288,3 +288,40 @@ def test_shell_vacuum(cluster):
     for f, data in fids.items():
         if f not in list(fids)[:5]:
             assert operation.read_file(master.grpc_address, f) == data
+
+
+def test_shell_collection_and_fsck_commands(cluster):
+    master, servers, env = cluster
+    fids = {}
+    for i in range(3):
+        fid = operation.assign_and_upload(master.grpc_address,
+                                          b"c" + bytes([i]),
+                                          collection="photos")
+        fids[fid] = None
+    for vs in servers:
+        vs.heartbeat_now()
+    out = json.loads(shell.run_command(env, "collection.list"))
+    names = {c["name"] for c in out}
+    assert "photos" in names
+    # fsck with no filer: reports topology volumes, no chunk scan
+    out = json.loads(shell.run_command(env, "volume.fsck"))
+    assert out["volumes_in_topology"] >= 1
+    # configure replication on one volume (locked operation)
+    shell.run_command(env, "lock")
+    vid = int(next(iter(fids)).split(",")[0])
+    out = json.loads(shell.run_command(
+        env, f"volume.configure.replication -volumeId {vid} "
+             f"-replication 001"))
+    assert out["replication"] == "001"
+    holder = next(vs for vs in servers if vs.store.has_volume(vid))
+    assert str(holder.store.find_volume(vid)
+               .super_block.replica_placement) == "001"
+    # delete the whole collection
+    out = json.loads(shell.run_command(
+        env, "collection.delete -collection photos -force"))
+    assert out["volumes_deleted"] >= 1
+    shell.run_command(env, "unlock")
+    for vs in servers:
+        vs.heartbeat_now()
+    out = json.loads(shell.run_command(env, "collection.list"))
+    assert "photos" not in {c["name"] for c in out}
