@@ -99,7 +99,10 @@ class FilerServer:
                  store_kind: str = "memory", store_path: str = ":memory:",
                  collection: str = "", replication: str = "",
                  chunk_size: int = CHUNK_SIZE):
-        self.master_grpc = master_grpc
+        # may be a comma-separated HA master list; resolved to the leader
+        # at start (and re-resolved when calls start failing)
+        self._master_spec = master_grpc
+        self.master_grpc = master_grpc.split(",")[0].strip()
         self.collection = collection
         self.replication = replication
         self.chunk_size = chunk_size
@@ -123,6 +126,9 @@ class FilerServer:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
+        if "," in self._master_spec:
+            from ..wdclient import resolve_leader
+            self.master_grpc = resolve_leader(self._master_spec)
         self.http.start()
         self.rpc.start()
         threading.Thread(target=self._deletion_loop, daemon=True).start()
@@ -193,16 +199,30 @@ class FilerServer:
         while not self._del_queue.empty() and time.time() < deadline:
             time.sleep(0.02)
 
+    def _refresh_master(self) -> None:
+        if "," in self._master_spec:
+            from ..wdclient import resolve_leader
+            self.master_grpc = resolve_leader(self._master_spec)
+
     # -- chunk IO ----------------------------------------------------------
     def _save_chunk(self, data: bytes, ts_ns: int, offset: int,
                     path: str = "") -> FileChunk:
         rule = self.conf.match(path) if path else {}
         ttl = rule.get("ttl", "")
-        r = operation.assign(
-            self.master_grpc,
-            replication=rule.get("replication") or self.replication,
-            collection=rule.get("collection") or self.collection,
-            ttl=ttl)
+        try:
+            r = operation.assign(
+                self.master_grpc,
+                replication=rule.get("replication") or self.replication,
+                collection=rule.get("collection") or self.collection,
+                ttl=ttl)
+        except RpcError:
+            # master may have failed over; chase the new leader once
+            self._refresh_master()
+            r = operation.assign(
+                self.master_grpc,
+                replication=rule.get("replication") or self.replication,
+                collection=rule.get("collection") or self.collection,
+                ttl=ttl)
         # the needle must carry the ttl too — needle expiry on read
         # (storage/volume.py) is what actually retires the data
         out = operation.upload_data(r.url, r.fid, data, jwt=r.auth,

@@ -99,13 +99,89 @@ class IdentityAccessManagement:
             return anon
         raise S3AuthError("AccessDenied", "no credentials provided")
 
+    def decode_streaming_body(self, headers: dict, body: bytes,
+                              ident: Identity) -> bytes:
+        """Decode an aws-chunked body (STREAMING-AWS4-HMAC-SHA256-PAYLOAD,
+        the aws-cli default for uploads), verifying the per-chunk
+        signature chain when the request was header-signed
+        (auth_signature_v4.go newChunkedReader).
+
+        Format per chunk: <hex size>;chunk-signature=<sig>\r\n<data>\r\n,
+        terminated by a 0-size chunk.  Each chunk signature covers the
+        previous one, seeded by the Authorization header's signature.
+        Requests authenticated another way (presigned, anonymous, IAM
+        disabled) still get the framing unwrapped — storing the raw
+        framing would corrupt the object — just without chain checks."""
+        auth = headers.get("Authorization", "")
+        verify = auth.startswith("AWS4-HMAC-SHA256") \
+            and bool(ident.secret_key)
+        k = b""
+        scope = ""
+        prev_sig = ""
+        amz_date = headers.get("X-Amz-Date") or headers.get("Date", "")
+        if verify:
+            try:
+                parts = _parse_auth_header(auth)
+                prev_sig = parts["Signature"]
+                _, date, region, service, _ = \
+                    parts["Credential"].split("/")
+            except (ValueError, KeyError):
+                raise S3AuthError("AuthorizationHeaderMalformed",
+                                  "malformed Authorization "
+                                  "header") from None
+            k = _signing_key(ident.secret_key, date, region, service)
+            scope = f"{date}/{region}/{service}/aws4_request"
+        out = bytearray()
+        pos = 0
+        while True:
+            nl = body.find(b"\r\n", pos)
+            if nl < 0:
+                raise S3AuthError("IncompleteBody",
+                                  "truncated chunked body", 400)
+            header = body[pos:nl].decode(errors="replace")
+            size_hex, _, ext = header.partition(";")
+            try:
+                size = int(size_hex, 16)
+            except ValueError:
+                raise S3AuthError("IncompleteBody",
+                                  f"bad chunk size {size_hex!r}",
+                                  400) from None
+            chunk_sig = ""
+            if ext.startswith("chunk-signature="):
+                chunk_sig = ext[len("chunk-signature="):]
+            data = body[nl + 2:nl + 2 + size]
+            if len(data) != size:
+                raise S3AuthError("IncompleteBody", "short chunk", 400)
+            if verify:
+                string_to_sign = "\n".join([
+                    "AWS4-HMAC-SHA256-PAYLOAD", amz_date, scope,
+                    prev_sig, hashlib.sha256(b"").hexdigest(),
+                    hashlib.sha256(data).hexdigest()])
+                want = hmac.new(k, string_to_sign.encode(),
+                                hashlib.sha256).hexdigest()
+                if not hmac.compare_digest(want.encode(),
+                                           chunk_sig.encode(
+                                               errors="replace")):
+                    raise S3AuthError("SignatureDoesNotMatch",
+                                      f"chunk signature mismatch at "
+                                      f"{pos}")
+                prev_sig = chunk_sig
+            out += data
+            pos = nl + 2 + size + 2  # skip trailing \r\n
+            if size == 0:
+                break
+        declared = headers.get("X-Amz-Decoded-Content-Length", "")
+        if declared and declared.isdigit() and int(declared) != len(out):
+            raise S3AuthError(
+                "IncompleteBody",
+                f"decoded {len(out)} bytes, declared {declared}", 400)
+        return bytes(out)
+
     def _verify_sigv4(self, method: str, path: str, query: dict,
                       headers: dict, body: bytes) -> Identity:
         auth = headers["Authorization"]
         try:
-            parts = dict(
-                kv.strip().split("=", 1)
-                for kv in auth[len("AWS4-HMAC-SHA256"):].strip().split(","))
+            parts = _parse_auth_header(auth)
             credential = parts["Credential"]
             signed_headers = parts["SignedHeaders"].split(";")
             signature = parts["Signature"]
@@ -173,6 +249,23 @@ class IdentityAccessManagement:
         return ident
 
 
+def _parse_auth_header(auth: str) -> dict:
+    """'AWS4-HMAC-SHA256 Credential=..., SignedHeaders=..., Signature=...'
+    -> dict of its key=value parts."""
+    return dict(kv.strip().split("=", 1) for kv in
+                auth[len("AWS4-HMAC-SHA256"):].strip().split(","))
+
+
+def _signing_key(secret_key: str, date: str, region: str,
+                 service: str) -> bytes:
+    """The SigV4 derived signing key (shared by request signing,
+    verification, and the chunk chain)."""
+    k = f"AWS4{secret_key}".encode()
+    for part in (date, region, service, "aws4_request"):
+        k = hmac.new(k, part.encode(), hashlib.sha256).digest()
+    return k
+
+
 def _flat(query: dict) -> dict:
     return {k: (v[0] if isinstance(v, list) else v)
             for k, v in query.items()}
@@ -207,9 +300,7 @@ def sign_v4(method: str, path: str, query: dict, headers: dict,
     string_to_sign = "\n".join([
         "AWS4-HMAC-SHA256", amz_date, scope,
         hashlib.sha256(canonical_request.encode()).hexdigest()])
-    k = f"AWS4{secret_key}".encode()
-    for part in (date, region, service, "aws4_request"):
-        k = hmac.new(k, part.encode(), hashlib.sha256).digest()
+    k = _signing_key(secret_key, date, region, service)
     return hmac.new(k, string_to_sign.encode(), hashlib.sha256).hexdigest()
 
 

@@ -87,6 +87,12 @@ class S3ApiServer:
         try:
             ident = self.iam.authenticate(req.method, req.path, req.query,
                                           req.headers, req.body)
+            if req.headers.get("X-Amz-Content-Sha256") \
+                    == "STREAMING-AWS4-HMAC-SHA256-PAYLOAD":
+                # aws-chunked upload: verify the chunk signature chain and
+                # unwrap the framing before the object handlers see it
+                req.body = self.iam.decode_streaming_body(
+                    req.headers, req.body, ident)
         except S3AuthError as e:
             return Response(e.status, _error_xml(e.code, str(e), path),
                             content_type="application/xml")

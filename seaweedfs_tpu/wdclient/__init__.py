@@ -14,6 +14,20 @@ import threading
 from ..pb.rpc import POOL, RpcError
 
 
+def resolve_leader(masters: str, timeout: float = 2.0) -> str:
+    """Resolve a comma-separated master list to the current leader's gRPC
+    address (clients hold ONE address; the list is for discovery)."""
+    candidates = [m.strip() for m in masters.split(",") if m.strip()]
+    for m in candidates:
+        try:
+            out = POOL.client(m, "Seaweed").call(
+                "GetMasterConfiguration", {}, timeout=timeout)
+            return out.get("leader") or m
+        except RpcError:
+            continue
+    return candidates[0]
+
+
 class MasterClient:
     def __init__(self, master_grpc: str, client_name: str = "client",
                  client_type: str = "client"):

@@ -4,6 +4,7 @@ request-level like s3api handler tests)."""
 
 import hashlib
 import json
+import os
 import time
 import urllib.parse
 import urllib.request
@@ -281,6 +282,71 @@ def test_auth_enforcement(s3stack):
     assert xml_root(body).find("Code").text == "AccessDenied"
     # reader cannot create buckets (Admin only)
     status, _, _ = reader.request("PUT", "/newbucket")
+    assert status == 403
+
+
+def test_streaming_chunked_upload(s3stack):
+    """STREAMING-AWS4-HMAC-SHA256-PAYLOAD (the aws-cli upload default):
+    the body is chunk-framed with a per-chunk signature chain."""
+    import hmac as _hmac
+    *_, s3, client = s3stack[-3], s3stack[-2], s3stack[-1]
+    client.request("PUT", "/stream")
+    payload = os.urandom(70000)
+    chunk_size = 32768
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    date = amz_date[:8]
+    region, service = "us-east-1", "s3"
+    path = "/stream/chunked.bin"
+    headers = {
+        "Host": s3.address,
+        "X-Amz-Date": amz_date,
+        "X-Amz-Content-Sha256": "STREAMING-AWS4-HMAC-SHA256-PAYLOAD",
+        "Content-Encoding": "aws-chunked",
+        "X-Amz-Decoded-Content-Length": str(len(payload)),
+    }
+    signed = sorted(h.lower() for h in headers)
+    seed_sig = sign_v4("PUT", path, {}, headers, signed,
+                       "STREAMING-AWS4-HMAC-SHA256-PAYLOAD", amz_date,
+                       date, region, service, SECRET)
+    scope = f"{ACCESS}/{date}/{region}/s3/aws4_request"
+    headers["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={seed_sig}")
+    # chunk signing key
+    k = f"AWS4{SECRET}".encode()
+    for part in (date, region, service, "aws4_request"):
+        k = _hmac.new(k, part.encode(), hashlib.sha256).digest()
+    sig_scope = f"{date}/{region}/{service}/aws4_request"
+
+    def chunk_frame(data, prev):
+        sts = "\n".join([
+            "AWS4-HMAC-SHA256-PAYLOAD", amz_date, sig_scope, prev,
+            hashlib.sha256(b"").hexdigest(),
+            hashlib.sha256(data).hexdigest()])
+        sig = _hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+        return (f"{len(data):x};chunk-signature={sig}\r\n".encode()
+                + data + b"\r\n", sig)
+
+    body = bytearray()
+    prev = seed_sig
+    for off in range(0, len(payload), chunk_size):
+        frame, prev = chunk_frame(payload[off:off + chunk_size], prev)
+        body += frame
+    final, prev = chunk_frame(b"", prev)
+    body += final
+    status, resp, _ = http_request(
+        f"http://{s3.address}{path}", method="PUT", body=bytes(body),
+        headers=headers)
+    assert status == 200, resp
+    # the stored object is the UNWRAPPED payload
+    status, got, _ = client.request("GET", path)
+    assert status == 200 and got == payload
+    # a tampered chunk signature is rejected
+    bad = bytes(body).replace(b"chunk-signature=", b"chunk-signature=0",
+                              1)
+    status, resp, _ = http_request(
+        f"http://{s3.address}{path}", method="PUT", body=bad,
+        headers=headers)
     assert status == 403
 
 
