@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import signal
 import sys
 import time
@@ -105,9 +106,15 @@ def cmd_server(args) -> int:
                                          args.max.split(",")],
                       jwt_signing_key=args.jwt_key)
     vs.start()
+    store_path = args.filer_store_path
+    if store_path == "./filer.db":
+        # default the metadata DB into the data dir so two all-in-one
+        # servers in one cwd don't silently share a store
+        store_path = os.path.join(args.dir.split(",")[0], "filer.db")
     f = FilerServer(m.grpc_address, host=args.ip, port=args.filer_port,
+                    grpc_port=args.filer_port + 10000,
                     store_kind=args.filer_store,
-                    store_path=args.filer_store_path)
+                    store_path=store_path)
     f.start()
     parts = [f"master {m.address} (grpc {m.grpc_address})",
              f"volume {vs.url}", f"filer {f.address}"]
@@ -200,6 +207,59 @@ def cmd_benchmark(args) -> int:
         run_benchmark(args.master, n_files=args.n, file_size=args.size,
                       concurrency=args.c, collection=args.collection,
                       write_only=args.write_only)
+    return 0
+
+
+def cmd_webdav(args) -> int:
+    from ..pb import ServerAddress
+    from ..webdav import WebDavServer
+    filer = ServerAddress.parse(args.filer)
+    dav = WebDavServer(filer.url, filer.grpc, host=args.ip,
+                       port=args.port, root=args.root)
+    dav.start()
+    print(f"webdav {dav.address} -> filer {filer.url}")
+    _wait_forever()
+    dav.stop()
+    return 0
+
+
+def cmd_iam(args) -> int:
+    from ..pb import ServerAddress
+    from ..s3 import IdentityAccessManagement
+    from ..s3.iam import IamApiServer
+    filer = ServerAddress.parse(args.filer)
+    srv = IamApiServer(IdentityAccessManagement(), filer.grpc,
+                       host=args.ip, port=args.port)
+    srv.start()
+    print(f"iam api {srv.address}")
+    _wait_forever()
+    srv.stop()
+    return 0
+
+
+def cmd_msg_broker(args) -> int:
+    from ..messaging import MessageBroker
+    from ..pb import ServerAddress
+    filer = ServerAddress.parse(args.filer)
+    broker = MessageBroker(filer.grpc, host=args.ip, grpc_port=args.port)
+    broker.start()
+    print(f"message broker grpc {broker.grpc_address}")
+    _wait_forever()
+    broker.stop()
+    return 0
+
+
+def cmd_filer_sync(args) -> int:
+    from ..pb import ServerAddress
+    from ..replication.filer_sync import FilerSync
+    a = ServerAddress.parse(args.a)
+    b = ServerAddress.parse(args.b)
+    sync = FilerSync(a.grpc, args.a_master, b.grpc, args.b_master,
+                     path_prefix=args.path)
+    sync.start()
+    print(f"filer.sync {a.url} <-> {b.url} (prefix {args.path})")
+    _wait_forever()
+    sync.stop()
     return 0
 
 
@@ -329,6 +389,37 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("-writeOnly", dest="write_only", action="store_true")
     b.set_defaults(fn=cmd_benchmark)
 
+    dav = sub.add_parser("webdav", help="start a WebDAV gateway")
+    dav.add_argument("-ip", default="127.0.0.1")
+    dav.add_argument("-port", type=int, default=7333)
+    dav.add_argument("-filer", default="127.0.0.1:8888.18888")
+    dav.add_argument("-root", default="/")
+    dav.set_defaults(fn=cmd_webdav)
+
+    iam = sub.add_parser("iam", help="start the IAM API")
+    iam.add_argument("-ip", default="127.0.0.1")
+    iam.add_argument("-port", type=int, default=8111)
+    iam.add_argument("-filer", default="127.0.0.1:8888.18888")
+    iam.set_defaults(fn=cmd_iam)
+
+    br = sub.add_parser("msg.broker", help="start a message broker")
+    br.add_argument("-ip", default="127.0.0.1")
+    br.add_argument("-port", type=int, default=17777)
+    br.add_argument("-filer", default="127.0.0.1:8888.18888")
+    br.set_defaults(fn=cmd_msg_broker)
+
+    fsync = sub.add_parser("filer.sync",
+                           help="bidirectional sync between two filers")
+    fsync.add_argument("-a", required=True,
+                       help="filer A host:port[.grpcPort]")
+    fsync.add_argument("-b", required=True)
+    fsync.add_argument("-a.master", dest="a_master",
+                       default="127.0.0.1:19333")
+    fsync.add_argument("-b.master", dest="b_master",
+                       default="127.0.0.1:19333")
+    fsync.add_argument("-path", default="/")
+    fsync.set_defaults(fn=cmd_filer_sync)
+
     sc = sub.add_parser("scaffold", help="print sample configs")
     sc.add_argument("-config", default="")
     sc.set_defaults(fn=cmd_scaffold)
@@ -341,5 +432,19 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    import sys as _sys
+    argv = list(_sys.argv[1:] if argv is None else argv)
+    # global -v N (glog-style verbosity), accepted anywhere
+    verbosity = 0
+    if "-v" in argv:
+        i = argv.index("-v")
+        if i + 1 < len(argv) and argv[i + 1].isdigit():
+            verbosity = int(argv[i + 1])
+            del argv[i:i + 2]
+        else:
+            verbosity = 1
+            del argv[i]
+    from ..util import weedlog
+    weedlog.setup(verbosity)
     args = build_parser().parse_args(argv)
     return args.fn(args) or 0

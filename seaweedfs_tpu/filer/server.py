@@ -189,7 +189,8 @@ class FilerServer:
             except queue.Empty:
                 continue
             try:
-                operation.delete_file(self.master_grpc, fid)
+                self._with_master(
+                    lambda m: operation.delete_file(m, fid))
             except Exception:
                 pass
 
@@ -204,25 +205,26 @@ class FilerServer:
             from ..wdclient import resolve_leader
             self.master_grpc = resolve_leader(self._master_spec)
 
+    def _with_master(self, fn):
+        """Run fn(master_grpc); on failure, chase a failed-over leader
+        once and retry.  EVERY master-facing path goes through this — a
+        filer half-working after failover (writes ok, reads dead) is
+        worse than an outage."""
+        try:
+            return fn(self.master_grpc)
+        except (RpcError, RuntimeError):
+            self._refresh_master()
+            return fn(self.master_grpc)
+
     # -- chunk IO ----------------------------------------------------------
     def _save_chunk(self, data: bytes, ts_ns: int, offset: int,
                     path: str = "") -> FileChunk:
         rule = self.conf.match(path) if path else {}
         ttl = rule.get("ttl", "")
-        try:
-            r = operation.assign(
-                self.master_grpc,
-                replication=rule.get("replication") or self.replication,
-                collection=rule.get("collection") or self.collection,
-                ttl=ttl)
-        except RpcError:
-            # master may have failed over; chase the new leader once
-            self._refresh_master()
-            r = operation.assign(
-                self.master_grpc,
-                replication=rule.get("replication") or self.replication,
-                collection=rule.get("collection") or self.collection,
-                ttl=ttl)
+        r = self._with_master(lambda m: operation.assign(
+            m, replication=rule.get("replication") or self.replication,
+            collection=rule.get("collection") or self.collection,
+            ttl=ttl))
         # the needle must carry the ttl too — needle expiry on read
         # (storage/volume.py) is what actually retires the data
         out = operation.upload_data(r.url, r.fid, data, jwt=r.auth,
@@ -231,14 +233,14 @@ class FilerServer:
                          modified_ts_ns=ts_ns, etag=out.get("eTag", ""))
 
     def _save_manifest_blob(self, data: bytes) -> tuple[str, str]:
-        r = operation.assign(self.master_grpc,
-                             replication=self.replication,
-                             collection=self.collection)
+        r = self._with_master(lambda m: operation.assign(
+            m, replication=self.replication, collection=self.collection))
         out = operation.upload_data(r.url, r.fid, data, jwt=r.auth)
         return r.fid, out.get("eTag", "")
 
     def _read_chunk_blob(self, fid: str) -> bytes:
-        return operation.read_file(self.master_grpc, fid)
+        return self._with_master(
+            lambda m: operation.read_file(m, fid))
 
     # -- HTTP --------------------------------------------------------------
     def _register_http(self) -> None:
@@ -465,20 +467,20 @@ class FilerServer:
         return {}
 
     def _rpc_assign_volume(self, req: dict) -> dict:
-        r = operation.assign(
-            self.master_grpc, count=req.get("count", 1),
+        r = self._with_master(lambda m: operation.assign(
+            m, count=req.get("count", 1),
             replication=req.get("replication") or self.replication,
             collection=req.get("collection") or self.collection,
             ttl=req.get("ttl_sec") and str(req["ttl_sec"]) + "s" or "",
-            data_center=req.get("data_center", ""))
+            data_center=req.get("data_center", "")))
         return {"file_id": r.fid, "url": r.url,
                 "public_url": r.public_url, "count": r.count}
 
     def _rpc_lookup_volume(self, req: dict) -> dict:
         out = {}
         for vid_s in req.get("volume_ids", []):
-            locs = operation.lookup_volume(self.master_grpc,
-                                           int(str(vid_s).split(",")[0]))
+            locs = self._with_master(lambda m: operation.lookup_volume(
+                m, int(str(vid_s).split(",")[0])))
             out[str(vid_s)] = {"locations": locs}
         return {"locations_map": out}
 

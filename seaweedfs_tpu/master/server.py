@@ -32,7 +32,10 @@ from ..topology import (Topology, VolumeGrowOption, grow_volumes,
 from ..topology.node import DataNode
 from ..topology.volume_growth import NoFreeSlotError
 from ..util.http import HttpServer, Request, Response
+from ..util.weedlog import logger
 from .sequencer import MemorySequencer
+
+LOG = logger(__name__)
 
 
 def _volume_info_from_dict(d: dict) -> VolumeInfo:
@@ -209,6 +212,8 @@ class MasterServer:
                 "ttl": opt.ttl_str})
 
         grown = grow_volumes(self.topo, option, count, allocate, self._rng)
+        LOG.info("grew %d volumes %s (collection=%r rp=%s)", len(grown),
+                 grown, option.collection, option.replica_placement)
         for vid in grown:
             self._publish_volume_location(vid, option.collection)
 
@@ -239,6 +244,8 @@ class MasterServer:
                 }
         finally:
             if dn is not None:
+                LOG.info("volume server %s disconnected; unregistering",
+                         dn.id)
                 self.topo.unregister_data_node(dn)
                 self._publish_node_change(dn, is_add=False)
 
@@ -251,6 +258,8 @@ class MasterServer:
                 grpc_port=hb.get("grpc_port", 0),
                 public_url=hb.get("public_url", ""),
                 max_volumes=hb.get("max_volume_count", 7))
+            LOG.info("volume server %s registered (dc=%s rack=%s)",
+                     dn.id, hb.get("data_center", ""), hb.get("rack", ""))
             self._publish_node_change(dn, is_add=True)
         dn.last_seen = time.time()
         dn.max_volumes = hb.get("max_volume_count", dn.max_volumes)

@@ -113,8 +113,12 @@ class IdentityAccessManagement:
         disabled) still get the framing unwrapped — storing the raw
         framing would corrupt the object — just without chain checks."""
         auth = headers.get("Authorization", "")
+        # only the signed-chunk sentinels carry a verifiable chain;
+        # STREAMING-UNSIGNED-PAYLOAD-TRAILER frames without signatures
+        sha = headers.get("X-Amz-Content-Sha256", "")
+        signed_chunks = sha.startswith("STREAMING-AWS4-HMAC-SHA256")
         verify = auth.startswith("AWS4-HMAC-SHA256") \
-            and bool(ident.secret_key)
+            and bool(ident.secret_key) and signed_chunks
         k = b""
         scope = ""
         prev_sig = ""
@@ -196,8 +200,10 @@ class IdentityAccessManagement:
         amz_date = headers.get("X-Amz-Date") or headers.get("Date", "")
         payload_hash = headers.get("X-Amz-Content-Sha256",
                                    "UNSIGNED-PAYLOAD")
-        if payload_hash not in ("UNSIGNED-PAYLOAD",
-                                "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"):
+        # streaming sentinels (incl. the -TRAILER variants aws-cli v2
+        # sends with flexible checksums) defer hashing to the chunk chain
+        if payload_hash != "UNSIGNED-PAYLOAD" \
+                and not payload_hash.startswith("STREAMING-"):
             actual = hashlib.sha256(body).hexdigest()
             if actual != payload_hash:
                 raise S3AuthError("XAmzContentSHA256Mismatch",

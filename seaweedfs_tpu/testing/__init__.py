@@ -107,7 +107,10 @@ class SimCluster:
 
     def stop(self) -> None:
         if self.s3_server:
-            self.s3_server.stop()
+            try:
+                self.s3_server.stop()
+            except Exception:
+                pass
         for f in self.filers:
             try:
                 f.stop()

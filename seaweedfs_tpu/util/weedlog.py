@@ -1,0 +1,62 @@
+"""Leveled logging — the glog analogue (reference weed/glog).
+
+Google-style -v levels over Python's logging: `V(2).info(...)` emits only
+when the configured verbosity is >= 2; `setup(-v)` wires a
+glog-look-alike line format (L MMDD hh:mm:ss.uuu logger] msg).  Servers
+log through `logger(__name__)`.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_VERBOSITY = 0
+_CONFIGURED = False
+
+
+class _GlogFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        level = record.levelname[0]
+        ts = self.formatTime(record, "%m%d %H:%M:%S")
+        return (f"{level}{ts}.{int(record.msecs):03d} "
+                f"{record.name}] {record.getMessage()}")
+
+
+def setup(verbosity: int = 0, stream=None) -> None:
+    """Install the glog-style handler on the package root logger."""
+    global _VERBOSITY, _CONFIGURED
+    _VERBOSITY = verbosity
+    root = logging.getLogger("seaweedfs_tpu")
+    if not _CONFIGURED:
+        h = logging.StreamHandler(stream or sys.stderr)
+        h.setFormatter(_GlogFormatter())
+        root.addHandler(h)
+        root.propagate = False
+        _CONFIGURED = True
+    root.setLevel(logging.DEBUG if verbosity > 0 else logging.INFO)
+
+
+def logger(name: str) -> logging.Logger:
+    return logging.getLogger(
+        name if name.startswith("seaweedfs_tpu") else
+        f"seaweedfs_tpu.{name}")
+
+
+class _Gate:
+    """glog's V(n): a logger that only emits when verbosity >= n."""
+
+    def __init__(self, n: int, name: str):
+        self._enabled = _VERBOSITY >= n
+        self._log = logger(name)
+
+    def __bool__(self) -> bool:
+        return self._enabled
+
+    def info(self, msg: str, *args) -> None:
+        if self._enabled:
+            self._log.info(msg, *args)
+
+
+def V(n: int, name: str = "v") -> _Gate:
+    return _Gate(n, name)

@@ -36,6 +36,10 @@ from ..storage.types import FileId
 from ..storage.volume import NotFoundError, volume_file_name
 from ..util.http import HttpServer, Request, Response, http_request
 
+from ..util.weedlog import logger
+
+LOG = logger(__name__)
+
 PULSE_SECONDS = 5
 EC_LOCATION_STALENESS = 11.0  # the freshest staleness tier (store_ec.go:227)
 
@@ -780,6 +784,8 @@ class VolumeServer:
         if v is None:
             raise RpcError(f"volume {vid} not found")
         v.sync()
+        LOG.info("ec encode volume %d (%d bytes) starting", vid,
+                 v.content_size())
         geo = DEFAULT_GEOMETRY
         if req.get("data_shards"):
             # wide stripes: RS(28,4) / RS(16,8) etc (BASELINE targets)

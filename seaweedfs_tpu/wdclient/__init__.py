@@ -22,9 +22,19 @@ def resolve_leader(masters: str, timeout: float = 2.0) -> str:
         try:
             out = POOL.client(m, "Seaweed").call(
                 "GetMasterConfiguration", {}, timeout=timeout)
-            return out.get("leader") or m
         except RpcError:
             continue
+        leader = out.get("leader") or m
+        if leader == m:
+            return m
+        # a follower can briefly report a DEAD leader during an election;
+        # trust the claim only if the claimed leader answers
+        try:
+            POOL.client(leader, "Seaweed").call(
+                "GetMasterConfiguration", {}, timeout=timeout)
+            return leader
+        except RpcError:
+            return m  # the responder itself is reachable — use it
     return candidates[0]
 
 

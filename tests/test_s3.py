@@ -39,10 +39,11 @@ class S3Client:
         date = amz_date[:8]
         payload_hash = hashlib.sha256(body).hexdigest()
         headers = dict(headers or {})
+        headers.setdefault("X-Amz-Content-Sha256", payload_hash)
+        payload_hash = headers["X-Amz-Content-Sha256"]
         headers.update({
             "Host": self.endpoint,
-            "X-Amz-Date": amz_date,
-            "X-Amz-Content-Sha256": payload_hash})
+            "X-Amz-Date": amz_date})
         signed = sorted(h.lower() for h in headers)
         # sign the on-the-wire (percent-encoded) path, like real SDKs
         epath = urllib.parse.quote(path, safe="/-_.~")
@@ -363,3 +364,23 @@ def test_presigned_url(s3stack):
     bad = url.replace("X-Amz-Signature=", "X-Amz-Signature=0")
     status, _, _ = http_request(bad)
     assert status == 403
+
+
+def test_streaming_unsigned_trailer_upload(s3stack):
+    """STREAMING-UNSIGNED-PAYLOAD-TRAILER (aws-cli v2 flexible-checksum
+    default): framing unwraps, trailers after the 0-chunk are ignored."""
+    *_, s3, client = s3stack[-3], s3stack[-2], s3stack[-1]
+    client.request("PUT", "/ut")
+    payload = os.urandom(9000)
+    frame = (f"{len(payload):x}\r\n".encode() + payload + b"\r\n"
+             + b"0\r\n"
+             + b"x-amz-checksum-crc32:AAAAAA==\r\n\r\n")
+    status, resp, _ = client.request(
+        "PUT", "/ut/trailer.bin", bytes(frame),
+        headers={"X-Amz-Content-Sha256":
+                 "STREAMING-UNSIGNED-PAYLOAD-TRAILER",
+                 "Content-Encoding": "aws-chunked",
+                 "X-Amz-Decoded-Content-Length": str(len(payload))})
+    assert status == 200, resp
+    status, got, _ = client.request("GET", "/ut/trailer.bin")
+    assert got == payload

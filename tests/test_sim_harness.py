@@ -3,9 +3,6 @@ injection that SURVEY §4 calls for (the reference has no equivalent)."""
 
 import time
 
-import pytest
-
-from seaweedfs_tpu import operation
 from seaweedfs_tpu.testing import SimCluster
 
 
