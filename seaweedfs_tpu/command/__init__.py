@@ -107,7 +107,7 @@ def cmd_server(args) -> int:
                       jwt_signing_key=args.jwt_key)
     vs.start()
     store_path = args.filer_store_path
-    if store_path == "./filer.db":
+    if store_path is None:
         # default the metadata DB into the data dir so two all-in-one
         # servers in one cwd don't silently share a store
         store_path = os.path.join(args.dir.split(",")[0], "filer.db")
@@ -207,6 +207,58 @@ def cmd_benchmark(args) -> int:
         run_benchmark(args.master, n_files=args.n, file_size=args.size,
                       concurrency=args.c, collection=args.collection,
                       write_only=args.write_only)
+    return 0
+
+
+def cmd_backup(args) -> int:
+    """Incremental volume backup (command/backup.go): pull needles
+    appended since the last run via VolumeTailSender into a local copy."""
+    from .. import operation
+    from ..pb.rpc import POOL, from_b64
+    from ..shell.commands import iter_data_nodes, node_grpc
+    from ..storage.needle import Needle
+    from ..storage.volume import Volume
+    vid = args.volumeId
+    locs = operation.lookup_volume(args.master, vid)
+    if not locs:
+        print(f"volume {vid} not found", file=sys.stderr)
+        return 1
+    # find the holder's gRPC address from the master topology
+    topo = POOL.client(args.master, "Seaweed").call("VolumeList")["topology"]
+    holder_grpc = None
+    for _, _, dn in iter_data_nodes(topo):
+        if any(v["id"] == vid for v in dn["volumes"]) \
+                and dn["id"] == locs[0]["url"]:
+            holder_grpc = node_grpc(dn)
+    if holder_grpc is None:
+        print(f"no gRPC address for volume {vid} holder", file=sys.stderr)
+        return 1
+    os.makedirs(args.dir, exist_ok=True)
+    ts_path = os.path.join(args.dir, f"{vid}.last_ts")
+    since = 0
+    if os.path.exists(ts_path):
+        with open(ts_path) as fh:
+            since = int(fh.read().strip() or 0)
+    v = Volume(args.dir, args.collection, vid)
+    client = POOL.client(holder_grpc, "VolumeServer")
+    pulled = 0
+    last_ts = since
+    for r in client.stream("VolumeTailSender",
+                           iter([{"volume_id": vid,
+                                  "since_ns": since}])):
+        n = Needle(id=int(r["needle_id"]), cookie=int(r["cookie"]),
+                   data=from_b64(r["needle_blob"]))
+        if r.get("is_delete"):
+            v.delete_needle(n.id)
+        else:
+            v.write_needle(n)
+        pulled += 1
+        last_ts = max(last_ts, int(r.get("append_at_ns", 0)))
+    v.close()
+    with open(ts_path, "w") as fh:
+        fh.write(str(last_ts))
+    print(json.dumps({"volume_id": vid, "needles_pulled": pulled,
+                      "backup_dir": args.dir}))
     return 0
 
 
@@ -345,7 +397,8 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("-max", default="7")
     srv.add_argument("-filer.store", dest="filer_store", default="sqlite")
     srv.add_argument("-filer.store_path", dest="filer_store_path",
-                     default="./filer.db")
+                     default=None,
+                     help="default: <dir>/filer.db")
     srv.add_argument("-jwtKey", dest="jwt_key", default="")
     srv.set_defaults(fn=cmd_server)
 
@@ -388,6 +441,14 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("-collection", default="")
     b.add_argument("-writeOnly", dest="write_only", action="store_true")
     b.set_defaults(fn=cmd_benchmark)
+
+    bk = sub.add_parser("backup",
+                        help="incremental local backup of one volume")
+    bk.add_argument("-master", default="127.0.0.1:19333")
+    bk.add_argument("-volumeId", type=int, required=True)
+    bk.add_argument("-collection", default="")
+    bk.add_argument("-dir", default="./backup")
+    bk.set_defaults(fn=cmd_backup)
 
     dav = sub.add_parser("webdav", help="start a WebDAV gateway")
     dav.add_argument("-ip", default="127.0.0.1")
@@ -434,16 +495,19 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     import sys as _sys
     argv = list(_sys.argv[1:] if argv is None else argv)
-    # global -v N (glog-style verbosity), accepted anywhere
+    # global verbosity: bare -v or glog-style -v=N; a following token is
+    # NEVER consumed (so `master -v 100` can't silently swallow an
+    # argument meant for the subcommand)
     verbosity = 0
-    if "-v" in argv:
-        i = argv.index("-v")
-        if i + 1 < len(argv) and argv[i + 1].isdigit():
-            verbosity = int(argv[i + 1])
-            del argv[i:i + 2]
-        else:
+    for i, a in enumerate(list(argv)):
+        if a == "-v":
             verbosity = 1
-            del argv[i]
+            argv.pop(i)
+            break
+        if a.startswith("-v=") and a[3:].isdigit():
+            verbosity = int(a[3:])
+            argv.pop(i)
+            break
     from ..util import weedlog
     weedlog.setup(verbosity)
     args = build_parser().parse_args(argv)

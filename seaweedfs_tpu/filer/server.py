@@ -212,7 +212,10 @@ class FilerServer:
         worse than an outage."""
         try:
             return fn(self.master_grpc)
-        except (RpcError, RuntimeError):
+        except RpcError:
+            # RpcError = master unreachable/rejecting; RuntimeError (404s,
+            # no-locations) must NOT trigger re-resolution — retrying a
+            # not-found doubles latency on a common path
             self._refresh_master()
             return fn(self.master_grpc)
 

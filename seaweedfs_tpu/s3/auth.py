@@ -22,6 +22,13 @@ ACTION_LIST = "List"
 ACTION_TAGGING = "Tagging"
 
 
+STREAMING_SENTINELS = (
+    "STREAMING-AWS4-HMAC-SHA256-PAYLOAD",
+    "STREAMING-AWS4-HMAC-SHA256-PAYLOAD-TRAILER",
+    "STREAMING-UNSIGNED-PAYLOAD-TRAILER",
+)
+
+
 class S3AuthError(Exception):
     def __init__(self, code: str, message: str, status: int = 403):
         super().__init__(message)
@@ -173,6 +180,9 @@ class IdentityAccessManagement:
             out += data
             pos = nl + 2 + size + 2  # skip trailing \r\n
             if size == 0:
+                # trailer section: header lines after the final chunk
+                # (x-amz-checksum-*, x-amz-trailer-signature)
+                _check_trailers(body[nl + 2:], bytes(out))
                 break
         declared = headers.get("X-Amz-Decoded-Content-Length", "")
         if declared and declared.isdigit() and int(declared) != len(out):
@@ -201,9 +211,10 @@ class IdentityAccessManagement:
         payload_hash = headers.get("X-Amz-Content-Sha256",
                                    "UNSIGNED-PAYLOAD")
         # streaming sentinels (incl. the -TRAILER variants aws-cli v2
-        # sends with flexible checksums) defer hashing to the chunk chain
-        if payload_hash != "UNSIGNED-PAYLOAD" \
-                and not payload_hash.startswith("STREAMING-"):
+        # sends with flexible checksums) defer hashing to the chunk
+        # chain/trailer; anything else claiming STREAMING- is NOT given a
+        # hash-check bypass
+        if payload_hash not in ("UNSIGNED-PAYLOAD", *STREAMING_SENTINELS):
             actual = hashlib.sha256(body).hexdigest()
             if actual != payload_hash:
                 raise S3AuthError("XAmzContentSHA256Mismatch",
@@ -253,6 +264,24 @@ class IdentityAccessManagement:
             raise S3AuthError("SignatureDoesNotMatch",
                               "signature does not match")
         return ident
+
+
+def _check_trailers(raw: bytes, payload: bytes) -> None:
+    """Validate any declared trailer checksum over the decoded payload
+    (AWS rejects on mismatch; storing corrupt data with a 200 is worse
+    than no checksum at all)."""
+    import base64
+    import zlib
+    for line in raw.split(b"\r\n"):
+        name, _, value = line.partition(b":")
+        if name.strip().lower() == b"x-amz-checksum-crc32":
+            want = base64.b64encode(
+                zlib.crc32(payload).to_bytes(4, "big"))
+            if value.strip() != want:
+                raise S3AuthError(
+                    "BadDigest",
+                    "x-amz-checksum-crc32 does not match the decoded "
+                    "payload", 400)
 
 
 def _parse_auth_header(auth: str) -> dict:

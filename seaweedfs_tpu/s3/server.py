@@ -87,8 +87,9 @@ class S3ApiServer:
         try:
             ident = self.iam.authenticate(req.method, req.path, req.query,
                                           req.headers, req.body)
-            if str(req.headers.get("X-Amz-Content-Sha256",
-                                    "")).startswith("STREAMING-"):
+            from .auth import STREAMING_SENTINELS
+            if req.headers.get("X-Amz-Content-Sha256") \
+                    in STREAMING_SENTINELS:
                 # aws-chunked upload: verify the chunk signature chain and
                 # unwrap the framing before the object handlers see it
                 req.body = self.iam.decode_streaming_body(

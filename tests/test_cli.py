@@ -77,3 +77,28 @@ def test_benchmark(cluster):
     assert results["read"]["requests"] == 100
     assert results["read"]["failed"] == 0
     assert "p99_ms" in results["read"]
+
+
+def test_backup_cli(cluster, tmp_path, capsys, monkeypatch):
+    """weed backup analogue: incremental needle pull into a local volume."""
+    master, servers = cluster
+    from seaweedfs_tpu import operation
+    from seaweedfs_tpu.storage.volume import Volume
+    fid = operation.assign_and_upload(master.grpc_address, b"backup me")
+    vid = int(fid.split(",")[0])
+    key = int(fid.split(",")[1][:-8], 16)
+    for vs in servers:
+        vs.heartbeat_now()
+    bdir = tmp_path / "bk"
+    assert main(["backup", "-master", master.grpc_address,
+                 "-volumeId", str(vid), "-dir", str(bdir)]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["needles_pulled"] >= 1
+    v = Volume(str(bdir), "", vid)
+    assert v.read_needle(key).data == b"backup me"
+    v.close()
+    # incremental: second run pulls nothing new
+    assert main(["backup", "-master", master.grpc_address,
+                 "-volumeId", str(vid), "-dir", str(bdir)]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["needles_pulled"] == 0

@@ -370,17 +370,24 @@ def test_streaming_unsigned_trailer_upload(s3stack):
     """STREAMING-UNSIGNED-PAYLOAD-TRAILER (aws-cli v2 flexible-checksum
     default): framing unwraps, trailers after the 0-chunk are ignored."""
     *_, s3, client = s3stack[-3], s3stack[-2], s3stack[-1]
+    import base64
+    import zlib
     client.request("PUT", "/ut")
     payload = os.urandom(9000)
+    crc = base64.b64encode(zlib.crc32(payload).to_bytes(4, "big"))
     frame = (f"{len(payload):x}\r\n".encode() + payload + b"\r\n"
              + b"0\r\n"
-             + b"x-amz-checksum-crc32:AAAAAA==\r\n\r\n")
-    status, resp, _ = client.request(
-        "PUT", "/ut/trailer.bin", bytes(frame),
-        headers={"X-Amz-Content-Sha256":
-                 "STREAMING-UNSIGNED-PAYLOAD-TRAILER",
-                 "Content-Encoding": "aws-chunked",
-                 "X-Amz-Decoded-Content-Length": str(len(payload))})
+             + b"x-amz-checksum-crc32:" + crc + b"\r\n\r\n")
+    hdrs = {"X-Amz-Content-Sha256": "STREAMING-UNSIGNED-PAYLOAD-TRAILER",
+            "Content-Encoding": "aws-chunked",
+            "X-Amz-Decoded-Content-Length": str(len(payload))}
+    status, resp, _ = client.request("PUT", "/ut/trailer.bin",
+                                     bytes(frame), headers=hdrs)
     assert status == 200, resp
     status, got, _ = client.request("GET", "/ut/trailer.bin")
     assert got == payload
+    # a corrupted trailer checksum is rejected (BadDigest), not stored
+    bad = bytes(frame).replace(crc, b"AAAAAAA=")
+    status, resp, _ = client.request("PUT", "/ut/bad.bin", bad,
+                                     headers=hdrs)
+    assert status == 400 and b"BadDigest" in resp, (status, resp)
