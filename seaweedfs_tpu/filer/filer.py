@@ -56,6 +56,13 @@ class Filer:
 
     # -- meta event log ----------------------------------------------------
     def _notify(self, old: Entry | None, new: Entry | None) -> None:
+        # events always carry the RESOLVED view of hardlinked entries:
+        # subscribers (mount meta caches, peer filers without our KV)
+        # must be able to serve reads from the event alone
+        if old is not None and old.hard_link_id:
+            old = self._resolve_hardlink(old)
+        if new is not None and new.hard_link_id:
+            new = self._resolve_hardlink(new)
         directory = (new or old).parent_dir if (new or old) else "/"
         with self._log_lock:
             ts = max(time.time_ns(), self._last_ts + 1)
@@ -119,6 +126,30 @@ class Filer:
             # a file may not bury a directory's children (filer.go:175)
             raise ValueError(
                 f"{entry.full_path} is a directory; delete it first")
+        if old is not None and old.hard_link_id \
+                and not entry.is_directory():
+            # overwriting a hardlinked path writes THROUGH the link
+            # (whether the caller sends a plain entry or echoes back the
+            # resolved one, as the mount's flush does):
+            # every sibling path must see the new content, and the
+            # pointer must survive or the shared record leaks
+            resolved_old = self._resolve_hardlink(old)
+            new_fids = {c.file_id for c in entry.chunks}
+            dead = [c for c in resolved_old.chunks
+                    if c.file_id not in new_fids]
+            try:
+                counter = self._load_hardlink(
+                    old.hard_link_id).get("counter", 1)
+            except Exception:
+                counter = 1
+            self._save_hardlink(old.hard_link_id, {
+                "attr": vars(entry.attr).copy(),
+                "chunks": [c.to_dict() for c in entry.chunks],
+                "extended": entry.extended, "counter": counter})
+            if dead:
+                self.delete_chunks_fn(dead)
+            self._notify(old, old)  # resolved view of the new content
+            return
         if old is not None and not old.is_directory() \
                 and not entry.is_directory():
             # overwrite: chunks unique to the old version are garbage
@@ -150,20 +181,38 @@ class Filer:
             old = self.store.find_entry(entry.full_path)
         except NotFound:
             pass
+        if old is not None and old.hard_link_id:
+            # writes through any link update the SHARED content; tolerate
+            # a missing KV record (counter resets to 1) the same way the
+            # read/unlink paths do
+            try:
+                counter = self._load_hardlink(
+                    old.hard_link_id).get("counter", 1)
+            except Exception:
+                counter = 1
+            self._save_hardlink(old.hard_link_id, {
+                "attr": vars(entry.attr).copy(),
+                "chunks": [c.to_dict() for c in entry.chunks],
+                "extended": entry.extended,
+                "counter": counter})
+            self._notify(old, old)  # resolved view post-write
+            return
         self.store.update_entry(entry)
         self._notify(old, entry)
 
     def find_entry(self, full_path: str) -> Entry:
         if full_path in ("", "/"):
             return new_directory_entry("/")
-        return self.store.find_entry(full_path.rstrip("/") or "/")
+        entry = self.store.find_entry(full_path.rstrip("/") or "/")
+        return self._resolve_hardlink(entry)
 
     def list_entries(self, dir_path: str, start_name: str = "",
                      include_start: bool = False, limit: int = 1024,
                      prefix: str = "") -> list[Entry]:
-        return self.store.list_directory_entries(
-            dir_path.rstrip("/") or "/", start_name, include_start, limit,
-            prefix)
+        return [self._resolve_hardlink(e) if e.hard_link_id else e
+                for e in self.store.list_directory_entries(
+                    dir_path.rstrip("/") or "/", start_name,
+                    include_start, limit, prefix)]
 
     def delete_entry(self, full_path: str, recursive: bool = False,
                      ignore_recursive_error: bool = False) -> None:
@@ -182,6 +231,9 @@ class Filer:
                 except Exception:
                     if not ignore_recursive_error:
                         raise
+        elif entry.hard_link_id:
+            # only the LAST link frees the shared chunks
+            dead = self._unlink_hardlink(entry)
         else:
             dead = list(entry.chunks)
         self.store.delete_entry(full_path)
@@ -210,6 +262,86 @@ class Filer:
         self.create_entry(moved)
         self.store.delete_entry(old_path)
         self._notify(entry, None)
+
+    # -- hardlinks (filerstore_hardlink.go) --------------------------------
+    # shared content (attr + chunks + counter) lives in the store KV under
+    # hardlink:<id>; linked entries are pointers carrying hard_link_id.
+    def _hardlink_key(self, link_id: str) -> bytes:
+        return f"hardlink:{link_id}".encode()
+
+    def _load_hardlink(self, link_id: str) -> dict:
+        import json as _json
+        return _json.loads(self.store.kv_get(self._hardlink_key(link_id)))
+
+    def _save_hardlink(self, link_id: str, content: dict) -> None:
+        import json as _json
+        self.store.kv_put(self._hardlink_key(link_id),
+                          _json.dumps(content).encode())
+
+    def _resolve_hardlink(self, entry: Entry) -> Entry:
+        """Pointer entry -> full entry with the shared chunks/attr."""
+        if not entry.hard_link_id:
+            return entry
+        try:
+            content = self._load_hardlink(entry.hard_link_id)
+        except Exception:
+            return entry
+        return Entry(full_path=entry.full_path,
+                     attr=Attr(**content["attr"]),
+                     chunks=[FileChunk.from_dict(c)
+                             for c in content["chunks"]],
+                     extended=content.get("extended", {}),
+                     hard_link_id=entry.hard_link_id,
+                     hard_link_counter=content.get("counter", 1))
+
+    def link(self, src_path: str, dst_path: str) -> None:
+        """Hard-link dst to src's content (weedfs_link.go Link): both
+        paths share one chunk list; deletes only free the blobs when the
+        last link goes."""
+        dst_path = dst_path.rstrip("/")
+        try:
+            self.store.find_entry(dst_path)
+            raise ValueError(f"{dst_path} already exists")  # EEXIST
+        except NotFound:
+            pass
+        src = self.store.find_entry(src_path)
+        if src.is_directory():
+            raise ValueError(f"cannot hard-link directory {src_path}")
+        if not src.hard_link_id:
+            # first link: move the content into the shared KV record
+            import secrets
+            link_id = secrets.token_hex(8)
+            self._save_hardlink(link_id, {
+                "attr": vars(src.attr).copy(),
+                "chunks": [c.to_dict() for c in src.chunks],
+                "extended": src.extended, "counter": 1})
+            pointer = Entry(full_path=src.full_path, attr=src.attr,
+                            chunks=[], hard_link_id=link_id)
+            self.store.update_entry(pointer)
+            src = pointer
+        content = self._load_hardlink(src.hard_link_id)
+        content["counter"] = content.get("counter", 1) + 1
+        self._save_hardlink(src.hard_link_id, content)
+        dst = Entry(full_path=dst_path, attr=src.attr,
+                    chunks=[], hard_link_id=src.hard_link_id)
+        self._ensure_parents(dst.parent_dir)
+        self.store.insert_entry(dst)
+        self._notify(None, dst)  # _notify resolves the pointer
+
+    def _unlink_hardlink(self, entry: Entry) -> list[FileChunk]:
+        """Decrement; returns the chunks to free when the LAST link
+        dies, else []."""
+        try:
+            content = self._load_hardlink(entry.hard_link_id)
+        except Exception:
+            return []
+        counter = content.get("counter", 1) - 1
+        if counter <= 0:
+            self.store.kv_delete(self._hardlink_key(entry.hard_link_id))
+            return [FileChunk.from_dict(c) for c in content["chunks"]]
+        content["counter"] = counter
+        self._save_hardlink(entry.hard_link_id, content)
+        return []
 
     # -- helpers -----------------------------------------------------------
     def resolve_chunks(self, entry: Entry,

@@ -371,6 +371,7 @@ class FilerServer:
                 "UpdateEntry": self._rpc_update_entry,
                 "DeleteEntry": self._rpc_delete_entry,
                 "AtomicRenameEntry": self._rpc_rename,
+                "CreateHardLink": self._rpc_link,
                 "AssignVolume": self._rpc_assign_volume,
                 "LookupVolume": self._rpc_lookup_volume,
                 "KvGet": self._rpc_kv_get,
@@ -461,6 +462,11 @@ class FilerServer:
         except NotFound:
             if not req.get("ignore_recursive_error"):
                 raise RpcError(f"{path} not found") from None
+        return {}
+
+    def _rpc_link(self, req: dict) -> dict:
+        """Hard-link (mount Link op; filerstore_hardlink.go)."""
+        self.filer.link(req["src"], req["dst"])
         return {}
 
     def _rpc_rename(self, req: dict) -> dict:

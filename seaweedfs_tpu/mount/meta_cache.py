@@ -30,8 +30,14 @@ class MetaCache:
     # -- reads -------------------------------------------------------------
     def lookup(self, path: str) -> "dict | None":
         with self._lock:
-            if path in self._entries:
-                return self._entries[path]
+            cached = self._entries.get(path)
+            # hardlinked entries are never served from cache: sibling
+            # paths share one content record, and a write through one
+            # path emits no event for the others (the kernel-FUSE
+            # equivalent invalidates by shared inode, which a path-keyed
+            # cache cannot express)
+            if cached is not None and not cached.get("hard_link_id"):
+                return cached
         directory, _, name = path.rstrip("/").rpartition("/")
         try:
             entry = self._filer().call("LookupDirectoryEntry", {

@@ -165,6 +165,15 @@ class WeedFS:
         self.meta.remove(path)
         self.inodes.forget(path)
 
+    def link(self, src: str, dst: str) -> None:
+        """Hard link (weedfs_link.go)."""
+        try:
+            self._filer().call("CreateHardLink", {"src": src, "dst": dst})
+        except RpcError as e:
+            raise FuseError(ENOENT, str(e)) from None
+        self.meta.remove(src)  # src became a pointer entry
+        self.inodes.lookup(dst)
+
     def rename(self, old: str, new: str) -> None:
         od, _, on = old.rstrip("/").rpartition("/")
         nd, _, nn = new.rstrip("/").rpartition("/")

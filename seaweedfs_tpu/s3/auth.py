@@ -22,6 +22,14 @@ ACTION_LIST = "List"
 ACTION_TAGGING = "Tagging"
 
 
+# SigV2 CanonicalizedResource sub-resources (AWS V2 signing spec)
+V2_SUBRESOURCES = frozenset({
+    "acl", "delete", "lifecycle", "location", "logging", "notification",
+    "partNumber", "policy", "requestPayment", "tagging", "torrent",
+    "uploadId", "uploads", "versionId", "versioning", "versions",
+    "website",
+})
+
 STREAMING_SENTINELS = (
     "STREAMING-AWS4-HMAC-SHA256-PAYLOAD",
     "STREAMING-AWS4-HMAC-SHA256-PAYLOAD-TRAILER",
@@ -99,12 +107,61 @@ class IdentityAccessManagement:
         auth = headers.get("Authorization", "")
         if auth.startswith("AWS4-HMAC-SHA256"):
             return self._verify_sigv4(method, path, query, headers, body)
+        if auth.startswith("AWS ") and ":" in auth:
+            return self._verify_sigv2(method, path, query, headers)
         if "X-Amz-Signature" in _flat(query):
             return self._verify_presigned(method, path, query, headers)
         anon = self.lookup_anonymous()
         if anon is not None:
             return anon
         raise S3AuthError("AccessDenied", "no credentials provided")
+
+    def _verify_sigv2(self, method: str, path: str, query: dict,
+                      headers: dict) -> Identity:
+        """Legacy Signature V2 (auth_signature_v2.go): HMAC-SHA1 over
+        method, content-md5, content-type, date, canonicalized amz
+        headers + resource."""
+        import base64
+        auth = headers.get("Authorization", "")
+        try:
+            access_key, sent_sig = auth[4:].split(":", 1)
+        except ValueError:
+            raise S3AuthError("AuthorizationHeaderMalformed",
+                              "malformed V2 Authorization") from None
+        ident = self.lookup_by_access_key(access_key)
+        if ident is None:
+            raise S3AuthError("InvalidAccessKeyId",
+                              "access key does not exist")
+        amz_headers = sorted(
+            (k.lower(), str(v).strip()) for k, v in headers.items()
+            if k.lower().startswith("x-amz-"))
+        # Date element is EMPTY when x-amz-date is supplied (V2 spec)
+        date_elem = "" if any(k == "x-amz-date"
+                              for k, _ in amz_headers) \
+            else headers.get("Date", "")
+        # CanonicalizedResource includes the spec's sub-resource list,
+        # sorted, with values (auth_signature_v2.go)
+        sub = sorted(
+            (k, vs[0] if isinstance(vs, list) else vs)
+            for k, vs in query.items() if k in V2_SUBRESOURCES)
+        resource = path
+        if sub:
+            resource += "?" + "&".join(
+                f"{k}={v}" if v else k for k, v in sub)
+        canonical = "\n".join([
+            method,
+            headers.get("Content-Md5", ""),
+            headers.get("Content-Type", ""),
+            date_elem,
+        ] + [f"{k}:{v}" for k, v in amz_headers] + [resource])
+        want = base64.b64encode(hmac.new(
+            ident.secret_key.encode(), canonical.encode(),
+            hashlib.sha1).digest()).decode()
+        if not hmac.compare_digest(want.encode(),
+                                   sent_sig.encode(errors="replace")):
+            raise S3AuthError("SignatureDoesNotMatch",
+                              "V2 signature does not match")
+        return ident
 
     def decode_streaming_body(self, headers: dict, body: bytes,
                               ident: Identity) -> bytes:

@@ -283,3 +283,95 @@ def test_filer_metadata_subscription(stack):
     paths = [m["new_entry"]["full_path"] for m in got
              if m.get("new_entry")]
     assert "/watched/a.txt" in paths
+
+
+def test_hardlinks():
+    """filerstore_hardlink semantics: shared content, write-through any
+    link, chunks freed only when the LAST link dies."""
+    dead = []
+    f = Filer(MemoryStore(), delete_chunks_fn=lambda cs: dead.extend(cs))
+    f.create_entry(Entry(full_path="/a", attr=Attr(),
+                         chunks=[chunk("c1", 0, 10, 1)]))
+    f.link("/a", "/b")
+    f.link("/a", "/c")
+    for p in ("/a", "/b", "/c"):
+        e = f.find_entry(p)
+        assert [c.file_id for c in e.chunks] == ["c1"], p
+        assert e.hard_link_counter == 3
+    # write through one link -> visible through the others
+    e = f.find_entry("/b")
+    f.update_entry(Entry(full_path="/b", attr=e.attr,
+                         chunks=[chunk("c2", 0, 20, 2)]))
+    assert [c.file_id for c in f.find_entry("/a").chunks] == ["c2"]
+    # deleting two links frees nothing
+    f.delete_entry("/a")
+    f.delete_entry("/c")
+    assert dead == []
+    assert [c.file_id for c in f.find_entry("/b").chunks] == ["c2"]
+    # last link frees the shared chunks
+    f.delete_entry("/b")
+    assert [c.file_id for c in dead] == ["c2"]
+
+
+def test_hardlink_via_mount_and_grpc(tmp_path):
+    import time as _time
+
+    from seaweedfs_tpu.master import MasterServer
+    from seaweedfs_tpu.mount import WeedFS
+    from seaweedfs_tpu.volume_server import VolumeServer
+    master = MasterServer(seed=201)
+    master.start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer(master.grpc_address, [str(d)], pulse_seconds=0.4,
+                      max_volume_counts=[30])
+    vs.start()
+    deadline = _time.time() + 10
+    while _time.time() < deadline and len(master.topo.data_nodes()) < 1:
+        _time.sleep(0.05)
+    from seaweedfs_tpu.filer import FilerServer
+    filer = FilerServer(master.grpc_address)
+    filer.start()
+    w = WeedFS(filer.grpc_address, master.grpc_address, chunk_size=4096)
+    w.start()
+    try:
+        w.create("/orig.bin")
+        w.write("/orig.bin", 0, b"linked content")
+        w.flush("/orig.bin")
+        w.link("/orig.bin", "/alias.bin")
+        assert w.read("/alias.bin", 0, 100) == b"linked content"
+        w.unlink("/orig.bin")
+        assert w.read("/alias.bin", 0, 100) == b"linked content"
+    finally:
+        w.stop()
+        filer.stop()
+        vs.stop()
+        master.stop()
+
+
+def test_hardlink_overwrite_writes_through():
+    """Regression: CreateEntry on a hardlinked path must update the
+    SHARED content (visible via every link), never sever the link."""
+    dead = []
+    f = Filer(MemoryStore(), delete_chunks_fn=lambda cs: dead.extend(cs))
+    f.create_entry(Entry(full_path="/a", attr=Attr(),
+                         chunks=[chunk("c1", 0, 10, 1)]))
+    f.link("/a", "/b")
+    # overwrite /a via the create path (what mount flush / HTTP POST do)
+    f.create_entry(Entry(full_path="/a", attr=Attr(),
+                         chunks=[chunk("c2", 0, 20, 2)]))
+    assert [c.file_id for c in dead] == ["c1"]  # old shared chunk freed
+    assert [c.file_id for c in f.find_entry("/b").chunks] == ["c2"]
+    assert [c.file_id for c in f.find_entry("/a").chunks] == ["c2"]
+    # listing resolves pointers
+    listed = {e.name: [c.file_id for c in e.chunks]
+              for e in f.list_entries("/")}
+    assert listed["a"] == ["c2"] and listed["b"] == ["c2"]
+    # link to existing destination -> EEXIST, nothing leaked
+    with pytest.raises(ValueError):
+        f.link("/a", "/b")
+    assert f.find_entry("/a").hard_link_counter == 2
+    # full cleanup still frees exactly once
+    f.delete_entry("/a")
+    f.delete_entry("/b")
+    assert [c.file_id for c in dead] == ["c1", "c2"]
