@@ -51,6 +51,9 @@ class Filer:
         self.delete_chunks_fn = delete_chunks_fn or (lambda chunks: None)
         self._log: list[MetaEvent] = []
         self._log_lock = threading.Lock()
+        # serializes hardlink KV read-modify-write (counters must not
+        # lose increments/decrements across RPC threads)
+        self._hardlink_lock = threading.Lock()
         self._last_ts = 0
         self._subscribers: list[Callable[[MetaEvent], None]] = []
 
@@ -137,15 +140,16 @@ class Filer:
             new_fids = {c.file_id for c in entry.chunks}
             dead = [c for c in resolved_old.chunks
                     if c.file_id not in new_fids]
-            try:
-                counter = self._load_hardlink(
-                    old.hard_link_id).get("counter", 1)
-            except Exception:
-                counter = 1
-            self._save_hardlink(old.hard_link_id, {
-                "attr": vars(entry.attr).copy(),
-                "chunks": [c.to_dict() for c in entry.chunks],
-                "extended": entry.extended, "counter": counter})
+            with self._hardlink_lock:
+                try:
+                    counter = self._load_hardlink(
+                        old.hard_link_id).get("counter", 1)
+                except Exception:
+                    counter = 1
+                self._save_hardlink(old.hard_link_id, {
+                    "attr": vars(entry.attr).copy(),
+                    "chunks": [c.to_dict() for c in entry.chunks],
+                    "extended": entry.extended, "counter": counter})
             if dead:
                 self.delete_chunks_fn(dead)
             self._notify(old, old)  # resolved view of the new content
@@ -185,16 +189,17 @@ class Filer:
             # writes through any link update the SHARED content; tolerate
             # a missing KV record (counter resets to 1) the same way the
             # read/unlink paths do
-            try:
-                counter = self._load_hardlink(
-                    old.hard_link_id).get("counter", 1)
-            except Exception:
-                counter = 1
-            self._save_hardlink(old.hard_link_id, {
-                "attr": vars(entry.attr).copy(),
-                "chunks": [c.to_dict() for c in entry.chunks],
-                "extended": entry.extended,
-                "counter": counter})
+            with self._hardlink_lock:
+                try:
+                    counter = self._load_hardlink(
+                        old.hard_link_id).get("counter", 1)
+                except Exception:
+                    counter = 1
+                self._save_hardlink(old.hard_link_id, {
+                    "attr": vars(entry.attr).copy(),
+                    "chunks": [c.to_dict() for c in entry.chunks],
+                    "extended": entry.extended,
+                    "counter": counter})
             self._notify(old, old)  # resolved view post-write
             return
         self.store.update_entry(entry)
@@ -252,14 +257,23 @@ class Filer:
                 self.rename_entry(
                     child.full_path,
                     new_path.rstrip("/") + "/" + child.name)
+        # an overwritten destination is DELETED first (rename(2)
+        # semantics): its chunks/link counters release through the normal
+        # delete path — routing through create_entry would WRITE-THROUGH
+        # a hardlinked destination and clobber its siblings
+        try:
+            self.store.find_entry(new_path.rstrip("/") or "/")
+            self.delete_entry(new_path.rstrip("/") or "/",
+                              recursive=True)
+        except NotFound:
+            pass
         moved = Entry(full_path=new_path, attr=entry.attr,
                       chunks=entry.chunks, extended=entry.extended,
                       hard_link_id=entry.hard_link_id,
                       hard_link_counter=entry.hard_link_counter)
         self._ensure_parents(moved.parent_dir)
-        # an overwritten destination's chunks are garbage — go through
-        # create_entry so they reach the deletion pipeline
-        self.create_entry(moved)
+        self.store.insert_entry(moved)
+        self._notify(None, moved)
         self.store.delete_entry(old_path)
         self._notify(entry, None)
 
@@ -318,10 +332,14 @@ class Filer:
             pointer = Entry(full_path=src.full_path, attr=src.attr,
                             chunks=[], hard_link_id=link_id)
             self.store.update_entry(pointer)
+            # announce the conversion: subscribers must learn the path is
+            # now hardlinked (their caches switch to bypass mode)
+            self._notify(src, pointer)
             src = pointer
-        content = self._load_hardlink(src.hard_link_id)
-        content["counter"] = content.get("counter", 1) + 1
-        self._save_hardlink(src.hard_link_id, content)
+        with self._hardlink_lock:
+            content = self._load_hardlink(src.hard_link_id)
+            content["counter"] = content.get("counter", 1) + 1
+            self._save_hardlink(src.hard_link_id, content)
         dst = Entry(full_path=dst_path, attr=src.attr,
                     chunks=[], hard_link_id=src.hard_link_id)
         self._ensure_parents(dst.parent_dir)
@@ -331,17 +349,20 @@ class Filer:
     def _unlink_hardlink(self, entry: Entry) -> list[FileChunk]:
         """Decrement; returns the chunks to free when the LAST link
         dies, else []."""
-        try:
-            content = self._load_hardlink(entry.hard_link_id)
-        except Exception:
+        with self._hardlink_lock:
+            try:
+                content = self._load_hardlink(entry.hard_link_id)
+            except Exception:
+                return []
+            counter = content.get("counter", 1) - 1
+            if counter <= 0:
+                self.store.kv_delete(
+                    self._hardlink_key(entry.hard_link_id))
+                return [FileChunk.from_dict(c)
+                        for c in content["chunks"]]
+            content["counter"] = counter
+            self._save_hardlink(entry.hard_link_id, content)
             return []
-        counter = content.get("counter", 1) - 1
-        if counter <= 0:
-            self.store.kv_delete(self._hardlink_key(entry.hard_link_id))
-            return [FileChunk.from_dict(c) for c in content["chunks"]]
-        content["counter"] = counter
-        self._save_hardlink(entry.hard_link_id, content)
-        return []
 
     # -- helpers -----------------------------------------------------------
     def resolve_chunks(self, entry: Entry,

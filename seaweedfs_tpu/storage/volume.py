@@ -182,6 +182,65 @@ class Volume:
             self.last_modified = int(time.time())
             return size
 
+    # -- group-commit write path (volume_write.go:233-306) ----------------
+    def _ensure_write_worker(self) -> None:
+        with self._lock:
+            if getattr(self, "_gc_queue", None) is not None:
+                return
+            import queue as _queue
+            from concurrent.futures import Future
+            self._gc_queue = _queue.Queue()
+            self._gc_future_cls = Future
+
+            def worker():
+                while True:
+                    item = self._gc_queue.get()
+                    if item is None:
+                        return
+                    batch = [item]
+                    # coalesce everything already queued (asyncWrite batching)
+                    while True:
+                        try:
+                            nxt = self._gc_queue.get_nowait()
+                        except _queue.Empty:
+                            break
+                        if nxt is None:
+                            self._gc_queue.put(None)
+                            break
+                        batch.append(nxt)
+                    for n, fut in batch:
+                        try:
+                            size = self.write_needle(n, fsync=False)
+                        except Exception as e:
+                            fut.set_exception(e)
+                            batch = [b for b in batch if b[1] is not fut]
+                    # ONE fsync covers the whole batch
+                    try:
+                        self.data_backend.sync()
+                        self._gc_sync_count = getattr(
+                            self, "_gc_sync_count", 0) + 1
+                    except Exception as e:
+                        for _, fut in batch:
+                            if not fut.done():
+                                fut.set_exception(e)
+                        continue
+                    for (n, fut) in batch:
+                        if not fut.done():
+                            fut.set_result(len(n.data))
+
+            t = threading.Thread(target=worker, daemon=True)
+            t.start()
+            self._gc_thread = t
+
+    def write_needle_durable(self, n: Needle):
+        """Queue a durable (fsynced) write; returns a Future.  Concurrent
+        callers share one fsync per drained batch — the reference's
+        volume_write.go:233 asyncWrite worker."""
+        self._ensure_write_worker()
+        fut = self._gc_future_cls()
+        self._gc_queue.put((n, fut))
+        return fut
+
     # -- read path (volume_read.go:16-80) ---------------------------------
     def read_needle(self, n_id: int, cookie: int | None = None) -> Needle:
         with self._lock:
@@ -298,6 +357,9 @@ class Volume:
 
     def close(self) -> None:
         with self._lock:
+            if getattr(self, "_gc_queue", None) is not None:
+                self._gc_queue.put(None)  # stop the group-commit worker
+                self._gc_queue = None
             self.nm.close()
             self.data_backend.close()
 

@@ -130,8 +130,13 @@ class HttpServer:
             do_MOVE = do_COPY = do_PROPPATCH = do_LOCK = do_UNLOCK = \
                 _dispatch
 
-        self._httpd = ThreadingHTTPServer((host, port), _H)
-        self._httpd.daemon_threads = True
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            # the BaseServer default backlog of 5 resets connections under
+            # modest burst concurrency (40 parallel uploads)
+            request_queue_size = 128
+
+        self._httpd = _Server((host, port), _H)
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None

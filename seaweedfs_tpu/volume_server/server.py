@@ -324,8 +324,12 @@ class VolumeServer:
             n.set_mime(req.qs("mime").encode())
         if req.qs("ttl"):
             n.set_ttl(TTL.parse(req.qs("ttl")))
-        size = self.store.write_volume_needle(fid.volume_id, n,
-                                              fsync=bool(req.qs("fsync")))
+        if req.qs("fsync"):
+            # durable writes ride the group-commit worker: N concurrent
+            # fsync writers share one fsync per batch (volume_write.go:233)
+            size = v.write_needle_durable(n).result(timeout=30)
+        else:
+            size = self.store.write_volume_needle(fid.volume_id, n)
         if req.qs("type") != "replicate":
             err = self._replicate(fid, req, "POST", req.body)
             if err:
