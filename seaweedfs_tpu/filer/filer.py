@@ -250,23 +250,38 @@ class Filer:
     def rename_entry(self, old_path: str, new_path: str) -> None:
         if old_path.rstrip("/") == new_path.rstrip("/"):
             return  # no-op move; deleting old_path would destroy the entry
+        new_path = new_path.rstrip("/") or "/"
         entry = self.store.find_entry(old_path)
+        # rename(2) destination semantics — checked BEFORE moving any
+        # children (the child loop itself creates the destination dir, so
+        # a later check would wipe the just-moved children):
+        #   dst dir  + src file -> EISDIR
+        #   dst dir  + src dir  -> only an EMPTY dst may be replaced
+        #   dst file + src dir  -> ENOTDIR
+        #   dst file + src file -> dst deleted (chunks/links released)
+        try:
+            dst = self.store.find_entry(new_path)
+        except NotFound:
+            dst = None
+        if dst is not None:
+            if dst.is_directory():
+                if not entry.is_directory():
+                    raise ValueError(
+                        f"{new_path} is a directory")  # EISDIR
+                if self.store.list_directory_entries(new_path, limit=1):
+                    raise ValueError(
+                        f"{new_path}: directory not empty")  # ENOTEMPTY
+                self.delete_entry(new_path)
+            else:
+                if entry.is_directory():
+                    raise ValueError(
+                        f"{new_path} is not a directory")  # ENOTDIR
+                self.delete_entry(new_path)
         if entry.is_directory():
             for child in self.store.list_directory_entries(old_path,
                                                            limit=1 << 30):
-                self.rename_entry(
-                    child.full_path,
-                    new_path.rstrip("/") + "/" + child.name)
-        # an overwritten destination is DELETED first (rename(2)
-        # semantics): its chunks/link counters release through the normal
-        # delete path — routing through create_entry would WRITE-THROUGH
-        # a hardlinked destination and clobber its siblings
-        try:
-            self.store.find_entry(new_path.rstrip("/") or "/")
-            self.delete_entry(new_path.rstrip("/") or "/",
-                              recursive=True)
-        except NotFound:
-            pass
+                self.rename_entry(child.full_path,
+                                  new_path + "/" + child.name)
         moved = Entry(full_path=new_path, attr=entry.attr,
                       chunks=entry.chunks, extended=entry.extended,
                       hard_link_id=entry.hard_link_id,

@@ -453,6 +453,7 @@ class MasterServer:
         self.http.route("GET", "/vol/status", self._http_vol_status)
         self.http.route("*", "/vol/vacuum", self._http_vol_vacuum)
         self.http.route("GET", "/metrics", self._http_metrics)
+        self.http.route("GET", "/ui", self._http_ui)
 
     def _http_assign(self, req: Request) -> Response:
         try:
@@ -492,6 +493,45 @@ class MasterServer:
     def _http_metrics(self, req: Request) -> Response:
         return Response(200, self.metrics.render().encode(),
                         content_type="text/plain; version=0.0.4")
+
+    def _http_ui(self, req: Request) -> Response:
+        """Minimal HTML status page (the reference ships master_ui/)."""
+        import html as _html
+
+        esc = _html.escape  # heartbeat-supplied names could carry HTML
+        topo = self.topo.to_dict()  # lock-protected snapshot
+        rows = []
+        for dc in topo["data_centers"]:
+            for rack in dc["racks"]:
+                for dn in rack["data_nodes"]:
+                    shard_count = sum(
+                        bin(int(b)).count("1")
+                        for b in dn.get("ec_shards", {}).values())
+                    rows.append(
+                        f"<tr><td>{esc(dc['id'])}</td>"
+                        f"<td>{esc(rack['id'])}</td>"
+                        f"<td>{esc(dn['id'])}</td>"
+                        f"<td>{len(dn['volumes'])}/"
+                        f"{dn['max_volumes']}</td>"
+                        f"<td>{shard_count}</td></tr>")
+        with self._sub_lock:
+            cluster = {t: list(c) for t, c in self.cluster_nodes.items()}
+        html = (
+            "<!doctype html><title>seaweedfs-tpu master</title>"
+            "<style>body{font-family:monospace;margin:2em}"
+            "table{border-collapse:collapse}td,th{border:1px solid #999;"
+            "padding:4px 8px}</style>"
+            f"<h1>master {self.address}</h1>"
+            f"<p>leader: {esc(self.leader_grpc)} | max volume id: "
+            f"{self.topo.max_volume_id} | cluster nodes: "
+            f"{esc(str(cluster))}</p>"
+            "<table><tr><th>DC</th><th>Rack</th><th>Volume Server</th>"
+            "<th>Volumes</th><th>EC shards</th></tr>"
+            + "".join(rows) + "</table>"
+            '<p><a href="/cluster/status">cluster/status</a> | '
+            '<a href="/metrics">metrics</a> | '
+            '<a href="/dir/assign">dir/assign</a></p>')
+        return Response(200, html.encode(), content_type="text/html")
 
     def _http_vol_vacuum(self, req: Request) -> Response:
         """Trigger a cluster vacuum sweep (master_server_handlers_admin.go

@@ -189,23 +189,23 @@ class Volume:
                 return
             import queue as _queue
             from concurrent.futures import Future
-            self._gc_queue = _queue.Queue()
+            q = self._gc_queue = _queue.Queue()
             self._gc_future_cls = Future
 
             def worker():
                 while True:
-                    item = self._gc_queue.get()
+                    item = q.get()
                     if item is None:
                         return
                     batch = [item]
                     # coalesce everything already queued (asyncWrite batching)
                     while True:
                         try:
-                            nxt = self._gc_queue.get_nowait()
+                            nxt = q.get_nowait()
                         except _queue.Empty:
                             break
                         if nxt is None:
-                            self._gc_queue.put(None)
+                            q.put(None)
                             break
                         batch.append(nxt)
                     for n, fut in batch:
@@ -317,6 +317,8 @@ class Volume:
         """Compact + commit in one step (no concurrent-write diff tracking —
         callers freeze writes first, like the master's vacuum orchestration).
         Returns bytes reclaimed."""
+        # the group-commit worker fsyncs the backend we are about to swap
+        self._stop_write_worker()
         with self._lock:
             before = self.content_size()
             base = self.base_path
@@ -355,11 +357,23 @@ class Volume:
         self.data_backend.sync()
         self.nm.sync()
 
+    def _stop_write_worker(self) -> None:
+        """Drain + stop the group-commit worker (must run OUTSIDE _lock:
+        the worker's write_needle takes _lock, so joining under it
+        deadlocks)."""
+        q = getattr(self, "_gc_queue", None)
+        t = getattr(self, "_gc_thread", None)
+        if q is None:
+            return
+        q.put(None)
+        if t is not None:
+            t.join(timeout=10)
+        self._gc_queue = None
+        self._gc_thread = None
+
     def close(self) -> None:
+        self._stop_write_worker()
         with self._lock:
-            if getattr(self, "_gc_queue", None) is not None:
-                self._gc_queue.put(None)  # stop the group-commit worker
-                self._gc_queue = None
             self.nm.close()
             self.data_backend.close()
 

@@ -375,3 +375,39 @@ def test_hardlink_overwrite_writes_through():
     f.delete_entry("/a")
     f.delete_entry("/b")
     assert [c.file_id for c in dead] == ["c1", "c2"]
+
+
+def test_rename_posix_semantics():
+    """Regression: directory rename must MOVE children (never wipe them),
+    and destination conflicts follow rename(2)."""
+    dead = []
+    f = Filer(MemoryStore(), delete_chunks_fn=lambda cs: dead.extend(cs))
+    f.create_entry(Entry(full_path="/a/f1", attr=Attr(),
+                         chunks=[chunk("c1", 0, 10, 1)]))
+    f.create_entry(Entry(full_path="/a/sub/f2", attr=Attr(),
+                         chunks=[chunk("c2", 0, 10, 1)]))
+    f.rename_entry("/a", "/b")
+    assert [c.file_id for c in f.find_entry("/b/f1").chunks] == ["c1"]
+    assert [c.file_id for c in f.find_entry("/b/sub/f2").chunks] == ["c2"]
+    assert dead == []  # nothing freed by a pure move
+    with pytest.raises(NotFound):
+        f.find_entry("/a/f1")
+    # file onto existing dir -> EISDIR-style error, dir untouched
+    f.create_entry(Entry(full_path="/plain", attr=Attr(),
+                         chunks=[chunk("c3", 0, 10, 1)]))
+    with pytest.raises(ValueError):
+        f.rename_entry("/plain", "/b")
+    assert f.find_entry("/b/f1")  # still there
+    # dir onto existing file -> ENOTDIR-style error
+    with pytest.raises(ValueError):
+        f.rename_entry("/b", "/plain")
+    # dir onto NON-EMPTY dir -> ENOTEMPTY
+    f.create_entry(Entry(full_path="/c/x", attr=Attr()))
+    with pytest.raises(ValueError):
+        f.rename_entry("/b", "/c")
+    # file onto file: destination's chunks released
+    f.create_entry(Entry(full_path="/old", attr=Attr(),
+                         chunks=[chunk("c4", 0, 10, 1)]))
+    f.rename_entry("/plain", "/old")
+    assert [c.file_id for c in dead] == ["c4"]
+    assert [c.file_id for c in f.find_entry("/old").chunks] == ["c3"]
