@@ -248,9 +248,14 @@ class Filer:
 
     # -- rename (filer_rename.go; emitted as delete+create) ---------------
     def rename_entry(self, old_path: str, new_path: str) -> None:
-        if old_path.rstrip("/") == new_path.rstrip("/"):
-            return  # no-op move; deleting old_path would destroy the entry
+        old_path = old_path.rstrip("/") or "/"
         new_path = new_path.rstrip("/") or "/"
+        if old_path == new_path:
+            return  # no-op move; deleting old_path would destroy the entry
+        if new_path.startswith(old_path + "/"):
+            # moving a directory into its own subtree recurses forever
+            raise ValueError(
+                f"cannot move {old_path} into itself")  # EINVAL
         entry = self.store.find_entry(old_path)
         # rename(2) destination semantics — checked BEFORE moving any
         # children (the child loop itself creates the destination dir, so

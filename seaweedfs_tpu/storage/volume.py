@@ -235,11 +235,18 @@ class Volume:
     def write_needle_durable(self, n: Needle):
         """Queue a durable (fsynced) write; returns a Future.  Concurrent
         callers share one fsync per drained batch — the reference's
-        volume_write.go:233 asyncWrite worker."""
-        self._ensure_write_worker()
-        fut = self._gc_future_cls()
-        self._gc_queue.put((n, fut))
-        return fut
+        volume_write.go:233 asyncWrite worker.  Enqueue happens under
+        _lock so a concurrent _stop_write_worker (vacuum/close) can never
+        strand the item behind the stop sentinel."""
+        while True:
+            self._ensure_write_worker()
+            with self._lock:
+                q = getattr(self, "_gc_queue", None)
+                if q is not None:
+                    fut = self._gc_future_cls()
+                    q.put((n, fut))
+                    return fut
+            # worker was stopped between ensure and put; recreate + retry
 
     # -- read path (volume_read.go:16-80) ---------------------------------
     def read_needle(self, n_id: int, cookie: int | None = None) -> Needle:
@@ -358,18 +365,21 @@ class Volume:
         self.nm.sync()
 
     def _stop_write_worker(self) -> None:
-        """Drain + stop the group-commit worker (must run OUTSIDE _lock:
-        the worker's write_needle takes _lock, so joining under it
-        deadlocks)."""
-        q = getattr(self, "_gc_queue", None)
-        t = getattr(self, "_gc_thread", None)
+        """Drain + stop the group-commit worker.  The refs swap out under
+        _lock (so enqueuers race-free retry against a fresh worker), then
+        the join runs OUTSIDE _lock (the worker's write_needle takes
+        _lock) and UNBOUNDED: proceeding to swap/close the backend under
+        a live worker corrupts acknowledged durable writes."""
+        with self._lock:
+            q = getattr(self, "_gc_queue", None)
+            t = getattr(self, "_gc_thread", None)
+            self._gc_queue = None
+            self._gc_thread = None
         if q is None:
             return
         q.put(None)
         if t is not None:
-            t.join(timeout=10)
-        self._gc_queue = None
-        self._gc_thread = None
+            t.join()
 
     def close(self) -> None:
         self._stop_write_worker()

@@ -411,3 +411,14 @@ def test_rename_posix_semantics():
     f.rename_entry("/plain", "/old")
     assert [c.file_id for c in dead] == ["c4"]
     assert [c.file_id for c in f.find_entry("/old").chunks] == ["c3"]
+
+
+def test_rename_into_own_subtree_rejected():
+    f = Filer(MemoryStore())
+    f.create_entry(Entry(full_path="/a/f1", attr=Attr()))
+    with pytest.raises(ValueError):
+        f.rename_entry("/a", "/a/sub/new")  # EINVAL, not recursion
+    assert f.find_entry("/a/f1")  # tree untouched
+    # trailing slashes normalized on both sides
+    f.rename_entry("/a/", "/b/")
+    assert f.find_entry("/b/f1")
