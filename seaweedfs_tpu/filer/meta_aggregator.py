@@ -13,7 +13,6 @@ another.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Callable
 
 from ..pb.rpc import POOL, RpcError

@@ -25,7 +25,7 @@ import time
 import urllib.parse
 
 from .. import operation
-from ..pb.rpc import POOL, RpcError, RpcServer
+from ..pb.rpc import RpcError, RpcServer
 from ..util.http import HttpServer, Request, Response
 from .entry import Attr, Entry, FileChunk
 from .filechunk_manifest import MANIFEST_BATCH, maybe_manifestize

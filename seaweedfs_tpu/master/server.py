@@ -30,7 +30,6 @@ from ..storage.ec.shard_bits import ShardBits
 from ..topology import (Topology, VolumeGrowOption, grow_volumes,
                         targets_for_replication)
 from ..topology.node import DataNode
-from ..topology.volume_growth import NoFreeSlotError
 from ..util.http import HttpServer, Request, Response
 from ..util.weedlog import logger
 from .sequencer import MemorySequencer

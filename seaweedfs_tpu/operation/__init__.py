@@ -11,7 +11,7 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 
-from ..pb.rpc import POOL, RpcError
+from ..pb.rpc import POOL
 from ..util.http import http_request
 
 

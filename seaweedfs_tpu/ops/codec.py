@@ -19,8 +19,6 @@ stripped on return.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np

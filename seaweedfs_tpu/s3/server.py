@@ -16,15 +16,14 @@ copied, just re-offset (filer_multipart.go:87-160).
 from __future__ import annotations
 
 import hashlib
-import json
 import time
 import urllib.parse
 import uuid
 import xml.etree.ElementTree as ET
 
-from ..filer.entry import Attr, Entry, FileChunk
+from ..filer.entry import Entry, FileChunk
 from ..filer.filechunks import total_size
-from ..pb.rpc import POOL, RpcError, RpcServer
+from ..pb.rpc import POOL, RpcError
 from ..util.http import HttpServer, Request, Response, http_request
 from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_TAGGING,
                    ACTION_WRITE, Identity, IdentityAccessManagement,

@@ -6,7 +6,6 @@ reference embeds it in the current-directory path state)."""
 
 from __future__ import annotations
 
-import base64
 import json
 import time
 

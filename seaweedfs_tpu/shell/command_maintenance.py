@@ -6,9 +6,7 @@ from __future__ import annotations
 
 import json
 
-from ..pb.rpc import RpcError
 from ..storage.ec.shard_bits import ShardBits
-from ..storage.ec.layout import TOTAL_SHARDS_COUNT
 from .commands import (CommandEnv, ShellError, command, iter_data_nodes,
                        node_grpc, parse_flags)
 from .command_volume import _move_volume

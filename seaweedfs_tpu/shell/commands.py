@@ -12,7 +12,6 @@ from __future__ import annotations
 import io
 import shlex
 import threading
-import time
 from typing import Callable
 
 from ..pb.rpc import POOL, RpcError

@@ -24,10 +24,10 @@ import numpy as np
 
 from ...ops.codec import RSCodec
 from .. import types as t
-from ..idx import idx_entry_bytes, parse_index_bytes
+from ..idx import parse_index_bytes
 from ..needle import Needle
 from .decoder import iterate_ecj_keys
-from .layout import DEFAULT_GEOMETRY, EcGeometry, Interval, locate_data, to_ext
+from .layout import EcGeometry, Interval, locate_data, to_ext
 from .shard_bits import ShardBits
 
 

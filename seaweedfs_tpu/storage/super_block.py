@@ -11,7 +11,7 @@ Replica placement "xyz" = DiffDataCenter/DiffRack/SameRack extra-copy counts
 from __future__ import annotations
 
 import struct
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 
 from . import types as t
 from .ttl import TTL, EMPTY_TTL

@@ -25,11 +25,10 @@ import time
 from dataclasses import dataclass
 
 from . import types as t
-from .backend import BackendStorageFile, DiskFile, open_backend
+from .backend import BackendStorageFile, open_backend
 from .idx import idx_entry_bytes, parse_index_bytes
 from .needle import Needle, read_needle_header
-from .needle_map import (KIND_MEMORY, MemoryNeedleMap, NeedleMapper,
-                         new_needle_map)
+from .needle_map import KIND_MEMORY, NeedleMapper, new_needle_map
 from .super_block import ReplicaPlacement, SuperBlock
 from .ttl import TTL, EMPTY_TTL
 

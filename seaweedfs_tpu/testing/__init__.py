@@ -25,7 +25,7 @@ import time
 from .. import operation
 from ..filer import FilerServer
 from ..master import MasterServer
-from ..s3 import IdentityAccessManagement, S3ApiServer
+from ..s3 import S3ApiServer
 from ..volume_server import VolumeServer
 
 
