@@ -628,10 +628,15 @@ class VolumeServer:
 
     def _rpc_mark_readonly(self, req: dict) -> dict:
         self._find_volume(req).read_only = True
+        # nudge an immediate heartbeat so the master stops routing writes
+        # here NOW, not a pulse later (the reference's delta channels give
+        # the same promptness) — ec.encode freezes volumes via this RPC
+        self._hb_wake.set()
         return {}
 
     def _rpc_mark_writable(self, req: dict) -> dict:
         self._find_volume(req).read_only = False
+        self._hb_wake.set()
         return {}
 
     def _rpc_volume_mount(self, req: dict) -> dict:

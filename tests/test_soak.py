@@ -27,12 +27,19 @@ def test_mixed_workload_soak(tmp_path, seconds):
             rng = random.Random(wid)
             while not stop.is_set():
                 data = rng.randbytes(rng.randint(100, 5000))
-                try:
-                    fid = c.upload(data)
-                    with lock:
-                        live[fid] = data
-                except Exception as e:
-                    errors.append(f"write: {e}")
+                # one retry: an assign can transiently race the EC freeze
+                # of its chosen volume (real clients retry the same way)
+                for attempt in (0, 1):
+                    try:
+                        fid = c.upload(data)
+                        with lock:
+                            live[fid] = data
+                        break
+                    except Exception as e:
+                        if attempt:
+                            errors.append(f"write: {e}")
+                        else:
+                            time.sleep(0.6)  # > heartbeat pulse
 
         def reader(rid):
             rng = random.Random(100 + rid)
@@ -42,10 +49,20 @@ def test_mixed_workload_soak(tmp_path, seconds):
                         time.sleep(0.01)
                         continue
                     fid, want = rng.choice(list(live.items()))
-                try:
-                    got = c.read(fid)
-                except Exception:
-                    # may have raced a concurrent delete; re-check
+                got = None
+                # retry window covers delete races AND the heartbeat gap
+                # while a volume converts to EC shards
+                deadline = time.time() + 3.0
+                while time.time() < deadline:
+                    try:
+                        got = c.read(fid)
+                        break
+                    except Exception:
+                        with lock:
+                            if fid not in live:
+                                break  # concurrently deleted: fine
+                        time.sleep(0.1)
+                if got is None:
                     with lock:
                         if fid in live:
                             errors.append(f"read lost {fid}")
@@ -69,18 +86,47 @@ def test_mixed_workload_soak(tmp_path, seconds):
                 except Exception:
                     pass
 
+        ec_converted: list[int] = []
+
         def maintenance():
+            from seaweedfs_tpu import shell
+            env = shell.CommandEnv(c.master_grpc)
+            rng = random.Random(4242)
+            rounds = 0
             while not stop.is_set():
                 time.sleep(1.0)
-                # vacuum sweep through the leader
+                rounds += 1
+                # vacuum sweep through the leader (timeout stays BELOW the
+                # join timeout so the final sweep is truly quiescent)
                 try:
-                    # vacuum timeout stays BELOW the join timeout so the
-                    # final byte-exact sweep is truly quiescent
                     POOL.client(c.master_grpc, "Seaweed").call(
                         "Vacuum", {"garbage_threshold": 0.4},
                         timeout=20)
                 except Exception:
                     pass
+                # every other round: EC-encode one live volume while the
+                # readers are hammering it — the north-star flow under load
+                if rounds % 2 or stop.is_set():
+                    continue
+                with lock:
+                    vids = {int(f.split(",")[0]) for f in live}
+                vids -= set(ec_converted)
+                if not vids:
+                    continue
+                vid = rng.choice(sorted(vids))
+                try:
+                    c.sync_heartbeats()
+                    shell.run_command(env, "lock")
+                    shell.run_command(env, f"ec.encode -volumeId {vid}")
+                    ec_converted.append(vid)
+                except Exception:
+                    pass  # racing writers can keep the volume busy
+                finally:
+                    try:
+                        shell.run_command(env, "unlock")
+                    except Exception:
+                        pass
+                c.sync_heartbeats()
 
         threads = ([threading.Thread(target=writer, args=(i,))
                     for i in range(3)]
