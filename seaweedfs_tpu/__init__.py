@@ -1,0 +1,17 @@
+"""seaweedfs-tpu — a TPU-native distributed object store / file system
+with the capabilities of SeaweedFS.
+
+Entry points:
+- CLI: `python -m seaweedfs_tpu <command>` (see command/)
+- Servers: master.MasterServer, volume_server.VolumeServer,
+  filer.FilerServer, s3.S3ApiServer, webdav.WebDavServer,
+  messaging.MessageBroker
+- Client ops: operation.assign / upload_data / read_file / delete_file
+- TPU codec: ops.codec.RSCodec (pallas/jax/numpy backends), ops.lrc
+- Testing: testing.SimCluster (in-process multi-node harness)
+
+See README.md for the architecture and COVERAGE.md for the
+reference-inventory map.
+"""
+
+__version__ = "0.1.0"
