@@ -1,0 +1,87 @@
+"""Independent re-derivation of klauspost/reedsolomon's buildMatrix.
+
+Pure Python ints, carry-less multiply reduced by 0x11D, brute-force inverse.
+No numpy, no imports from the repo. This is the Backblaze JavaReedSolomon
+construction: vandermonde(total, data) -> invert top kxk -> multiply.
+galExp(0, 0) == 1 per klauspost galois.go.
+"""
+
+POLY = 0x11D
+
+def gmul(a, b):
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        b >>= 1
+        a <<= 1
+        if a & 0x100:
+            a ^= POLY
+    return r
+
+def gpow(a, n):
+    r = 1
+    for _ in range(n):
+        r = gmul(r, a)
+    return r
+
+def ginv(a):
+    assert a != 0
+    for x in range(1, 256):
+        if gmul(a, x) == 1:
+            return x
+    raise AssertionError
+
+def mat_mul(A, B):
+    n, k, c = len(A), len(B), len(B[0])
+    out = [[0]*c for _ in range(n)]
+    for i in range(n):
+        for j in range(c):
+            acc = 0
+            for t in range(k):
+                acc ^= gmul(A[i][t], B[t][j])
+            out[i][j] = acc
+    return out
+
+def mat_inv(A):
+    n = len(A)
+    aug = [row[:] + [1 if i == j else 0 for j in range(n)] for i, row in enumerate(A)]
+    for col in range(n):
+        piv = next(r for r in range(col, n) if aug[r][col] != 0)
+        aug[col], aug[piv] = aug[piv], aug[col]
+        iv = ginv(aug[col][col])
+        aug[col] = [gmul(x, iv) for x in aug[col]]
+        for r in range(n):
+            if r != col and aug[r][col] != 0:
+                f = aug[r][col]
+                aug[r] = [x ^ gmul(f, y) for x, y in zip(aug[r], aug[col])]
+    return [row[n:] for row in aug]
+
+def build_matrix(k, total):
+    # vandermonde[r][c] = galExp(r, c); galExp(0,0)=1, galExp(0,c>0)=0
+    vm = [[gpow(r, c) for c in range(k)] for r in range(total)]
+    top_inv = mat_inv([row[:] for row in vm[:k]])
+    return mat_mul(vm, top_inv)
+
+def main():
+    for (k, m) in [(10, 4), (28, 4), (16, 8)]:
+        g = build_matrix(k, k + m)
+        # check systematic
+        for i in range(k):
+            assert g[i] == [1 if j == i else 0 for j in range(k)], (k, m, i)
+        print(f"RS({k},{m}) parity rows:")
+        for row in g[k:]:
+            print("  [" + ", ".join(f"0x{v:02x}" for v in row) + "],")
+    # golden fixture: deterministic stripe, shard_size=64
+    k, m, S = 10, 4, 64
+    data = [[(31 * s + 7 * i + (i * i * s) % 251) % 256 for i in range(S)] for s in range(k)]
+    g = build_matrix(k, k + m)
+    parity = mat_mul(g[k:], data)
+    print("golden data rows (hex):")
+    for row in data:
+        print("  " + bytes(row).hex())
+    print("golden parity rows (hex):")
+    for row in parity:
+        print("  " + bytes(row).hex())
+
+main()
