@@ -64,17 +64,24 @@ def encode_volumes(mesh: Mesh, parity_bits: jax.Array, data: jax.Array) -> jax.A
     return jax.lax.with_sharding_constraint(out, shard)
 
 
-def make_shard_parallel_matmul(mesh: Mesh, axis: str, k: int, m: int):
+def make_shard_parallel_matmul(mesh: Mesh, axis: str, k: int, m: int,
+                               byte_axis: str | None = None):
     """Mode 3 core: jitted fn(bits[8m, 8*k_pad], shards[k_pad, B]) -> [m, B]
     with the shard axis sharded over `axis` (k padded to a multiple of the
     axis size with zero shards — zeros contribute nothing to the XOR).  Each
     chip multiplies its bit-matrix column block against its local shards
     (via rs_jax.gf_matmul_bits, the single source of exactness), then the
     packed partials are XOR-all-reduced over the ring.  The bit-matrix is a
-    runtime input, so one executable serves encode and every loss mask."""
+    runtime input, so one executable serves encode and every loss mask.
+
+    `byte_axis` additionally shards the stripe-column (byte) axis — mode 2+3
+    combined, the layout a wide-stripe degraded read uses: B must then be a
+    multiple of 128 * mesh.shape[byte_axis].  The ring xor_psum runs per
+    byte-column block; no cross-column communication is ever needed."""
     n_dev = mesh.shape[axis]
     k_pad = -(-k // n_dev) * n_dev
     k_loc = k_pad // n_dev
+    b_spec = byte_axis  # None -> replicated columns
 
     def _local(bits_full, local_shards):
         idx = jax.lax.axis_index(axis)
@@ -85,8 +92,8 @@ def make_shard_parallel_matmul(mesh: Mesh, axis: str, k: int, m: int):
 
     mapped = shard_map(
         _local, mesh=mesh,
-        in_specs=(P(None, None), P(axis, None)),
-        out_specs=P(None, None),
+        in_specs=(P(None, None), P(axis, b_spec)),
+        out_specs=P(None, b_spec),
         check_vma=False)
 
     return jax.jit(mapped), k_pad

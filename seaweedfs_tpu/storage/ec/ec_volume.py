@@ -91,6 +91,10 @@ class EcVolume:
             from . import geometry_from_vif
             geo = geometry_from_vif(self._base())
         self.geo = geo
+        # degraded reads reconstruct small intervals and are latency-bound:
+        # the single-chip codec (pallas on TPU) is the right engine here.
+        # Batched throughput work — encode/rebuild — routes through
+        # parallel.mesh_codec via storage/ec/encoder.py:_codec_for instead.
         self.codec = codec or RSCodec(geo.data_shards, geo.parity_shards)
         self.remote_reader = remote_reader
         self.version = version

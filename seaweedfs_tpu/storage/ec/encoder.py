@@ -37,12 +37,17 @@ from .layout import DEFAULT_GEOMETRY, EcGeometry, to_ext
 DEFAULT_BATCH_BYTES = 8 * 1024 * 1024
 
 
-def _codec_for(geo: EcGeometry, codec: RSCodec | None) -> RSCodec:
+def _codec_for(geo: EcGeometry, codec: RSCodec | None):
     if codec is not None:
         if (codec.k, codec.m) != (geo.data_shards, geo.parity_shards):
             raise ValueError("codec geometry does not match EC geometry")
         return codec
-    return RSCodec(geo.data_shards, geo.parity_shards)
+    # production picker: the multi-chip MeshCodec whenever this process has
+    # a device mesh (so ec.encode/ec.rebuild verbs and the
+    # VolumeEcShardsGenerate/Rebuild RPCs ride it), single-chip RSCodec
+    # otherwise — same math, byte-identical shards either way.
+    from ...parallel.mesh_codec import codec_for_devices
+    return codec_for_devices(geo.data_shards, geo.parity_shards)
 
 
 def _encode_rows(dat: np.memmap, start: int, block: int, n_rows: int,

@@ -1,0 +1,139 @@
+"""MeshCodec — the multi-chip production codec — on the 8-device CPU mesh.
+
+Covers VERDICT r1 items: the mesh codec wired into the serving paths
+(write_ec_files/rebuild_ec_files pick it automatically on a multi-device
+host) and the byte-axis-sharded reconstruct layout (mode 2+3) that a
+wide-stripe degraded read uses.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from seaweedfs_tpu.ops import gf256, rs_matrix
+from seaweedfs_tpu.ops.codec import RSCodec
+from seaweedfs_tpu.parallel.mesh_codec import (MeshCodec, codec_for_devices,
+                                               default_ec_mesh)
+
+rng = np.random.default_rng(7)
+
+
+def test_default_mesh_uses_both_axes():
+    mesh = default_ec_mesh()
+    assert mesh.shape["s"] * mesh.shape["b"] == len(jax.devices())
+    if len(jax.devices()) >= 4:
+        assert mesh.shape["b"] > 1, "byte axis must be exercised"
+
+
+def test_production_picker_selects_mesh_codec():
+    codec = codec_for_devices(10, 4)
+    assert isinstance(codec, MeshCodec)
+
+
+@pytest.mark.parametrize("k,m", [(10, 4), (16, 8)])
+def test_mesh_encode_matches_oracle(k, m):
+    B = 1111  # deliberately unaligned
+    data = rng.integers(0, 256, (k, B), dtype=np.uint8)
+    codec = MeshCodec(k, m)
+    parity = codec.encode(data)
+    gen = rs_matrix.generator_matrix(k, m)
+    assert np.array_equal(parity, gf256.matmul(gen[k:], data))
+
+
+def test_mesh_encode_batched_volumes():
+    k, m, V, B = 10, 4, 3, 515
+    data = rng.integers(0, 256, (V, k, B), dtype=np.uint8)
+    parity = MeshCodec(k, m).encode(data)
+    assert parity.shape == (V, m, B)
+    single = RSCodec(k, m, backend="numpy")
+    for v in range(V):
+        assert np.array_equal(parity[v], single.encode(data[v]))
+
+
+@pytest.mark.parametrize("lost", [[0], [1, 12], [0, 4, 9, 13]])
+def test_mesh_reconstruct_matches_oracle(lost):
+    k, m, B = 10, 4, 777
+    data = rng.integers(0, 256, (k, B), dtype=np.uint8)
+    gen = rs_matrix.generator_matrix(k, m)
+    shards = gf256.matmul(gen, data)
+    holes = [None if i in lost else shards[i] for i in range(k + m)]
+    filled = MeshCodec(k, m).reconstruct(holes)
+    for i in range(k + m):
+        assert np.array_equal(filled[i], shards[i])
+
+
+def test_mesh_reconstruct_data_only_and_verify():
+    k, m, B = 10, 4, 300
+    data = rng.integers(0, 256, (k, B), dtype=np.uint8)
+    gen = rs_matrix.generator_matrix(k, m)
+    shards = gf256.matmul(gen, data)
+    codec = MeshCodec(k, m)
+    holes = [None if i in (2, 11) else shards[i] for i in range(k + m)]
+    filled = codec.reconstruct(holes, data_only=True)
+    assert np.array_equal(filled[2], shards[2])
+    assert filled[11] is None  # parity not rebuilt in data_only mode
+    assert codec.verify(list(shards))
+    bad = list(shards)
+    bad[k] = bad[k] ^ np.uint8(1)
+    assert not codec.verify(bad)
+
+
+def test_mesh_reconstruct_too_few_raises():
+    k, m, B = 10, 4, 128
+    shards = [np.zeros(B, np.uint8)] * 9 + [None] * 5
+    with pytest.raises(ValueError):
+        MeshCodec(k, m).reconstruct(shards)
+
+
+def test_ec_files_route_through_mesh_codec(tmp_path, monkeypatch):
+    """write_ec_files/rebuild_ec_files must pick MeshCodec on this
+    multi-device host, and the shard files must be byte-identical to the
+    single-chip path's."""
+    from seaweedfs_tpu.storage.ec import encoder as enc_mod
+    from seaweedfs_tpu.storage.ec.layout import EcGeometry, to_ext
+
+    geo = EcGeometry(data_shards=10, parity_shards=4,
+                     large_block_size=2048, small_block_size=256)
+    base = str(tmp_path / "77")
+    payload = rng.integers(0, 256, geo.large_row_size() + 3000,
+                           dtype=np.uint8).tobytes()
+    with open(base + ".dat", "wb") as f:
+        f.write(payload)
+
+    picked = []
+    orig = enc_mod._codec_for
+
+    def spy(geo_, codec_):
+        c = orig(geo_, codec_)
+        picked.append(type(c).__name__)
+        return c
+
+    monkeypatch.setattr(enc_mod, "_codec_for", spy)
+    enc_mod.write_ec_files(base, geo)
+    assert picked == ["MeshCodec"]
+
+    golden = {}
+    for i in range(geo.total_shards):
+        with open(base + to_ext(i), "rb") as f:
+            golden[i] = f.read()
+    # single-chip oracle produces identical bytes
+    base2 = str(tmp_path / "78")
+    with open(base2 + ".dat", "wb") as f:
+        f.write(payload)
+    enc_mod.write_ec_files(base2, geo, codec=RSCodec(10, 4, backend="jax"))
+    for i in range(geo.total_shards):
+        with open(base2 + to_ext(i), "rb") as f:
+            assert f.read() == golden[i], f"shard {i} differs from single-chip"
+
+    # lose 3 shards, rebuild through the mesh path
+    for s in (0, 5, 12):
+        os.remove(base + to_ext(s))
+    rebuilt = enc_mod.rebuild_ec_files(base, geo)
+    assert sorted(rebuilt) == [0, 5, 12]
+    assert picked[-1] == "MeshCodec"
+    for i in range(geo.total_shards):
+        with open(base + to_ext(i), "rb") as f:
+            assert f.read() == golden[i], f"rebuilt shard {i} corrupt"
