@@ -1,33 +1,38 @@
-"""Master HA — leader election + state replication across master peers.
+"""Master HA — raft-replicated control state behind the is_leader /
+leader_address seam.
 
-Capability-equivalent to the reference's raft layer (weed/server/
-raft_server.go + chrislusf/raft): the replicated state machine there is
-just the max-volume-id counter and the sequencer (topology/
-cluster_commands.go), so a lease-based election with state piggybacking
-reproduces the behavior without a log: every master pings its peers each
-second ("Ping" RPC carrying its max-volume-id/sequencer); the leader is
-the smallest address among live peers; followers adopt the leader's
-counters and proxy Assign/Vacuum to it (proxyToLeader,
-master_server.go:180).  Volume servers learn the leader from heartbeat
-replies and re-home their stream (the reference does the same via the
-heartbeat's leader field).
+Round 1 used a lease election with an admitted split-brain window; this is
+the promised replacement (raft.py): a real replicated log whose state
+machine carries exactly what the reference replicates (weed/server/
+raft_server.go + topology/cluster_commands.go): the max-volume-id counter
+and the file-id sequencer.
 
-Trade-off vs raft: a network partition can briefly elect two leaders; the
-counters are monotonic and partition-merged with max(), so the damage is
-bounded to duplicate fid cookies (detected by cookie check) — acceptable
-for the control plane's only replicated value.  A full raft log can slot
-in behind the same is_leader/leader_address seam.
+Two commands, both using a floor so application is deterministic on every
+replica even though each master also max-merges vids from volume-server
+heartbeats:
+
+- {"t": "vid", "n": N, "floor": F} — reserve N new volume ids above
+  max(replicated max_vid, F); returns the first.  Volume growth routes
+  through this (the reference's MaxVolumeIdCommand raised per new vid).
+- {"t": "seq", "n": N, "floor": F} — reserve a block of N file ids above
+  max(replicated next_sequence, F); returns the block start.
+
+File-id assignment cannot afford a quorum round-trip per assign, so
+RaftSequencer serves ids from a locally held block and replicates only
+block reservations (one commit per SEQ_BLOCK ids).  A deposed or
+partitioned leader keeps only its own already-committed block — ids stay
+globally unique with zero coordination on the hot path, and the raft
+leader lease (raft.py _check_lease) stops a minority leader from serving
+within ~2 election timeouts.
 """
 
 from __future__ import annotations
 
 import threading
-import time
 
-from ..pb.rpc import POOL, RpcError
+from .raft import RaftNode, NotLeaderError  # noqa: F401 (re-export)
 
-PING_INTERVAL = 1.0
-PEER_DEAD_AFTER = 3.0
+SEQ_BLOCK = 4096
 
 
 def normalize_addr(addr: str) -> str:
@@ -41,91 +46,128 @@ def normalize_addr(addr: str) -> str:
 
 
 class HaCoordinator:
-    def __init__(self, master, peers: list[str]):
-        """peers: gRPC addresses of ALL masters including self."""
+    """Owns the RaftNode + replicated state machine for one master."""
+
+    def __init__(self, master, peers: list[str],
+                 raft_dir: str | None = None,
+                 election_timeout: float = 0.4,
+                 seed: int | None = None):
         self.master = master
         self.self_addr = normalize_addr(master.grpc_address)
         self.peers = sorted({normalize_addr(p) for p in peers}
                             | {self.self_addr})
-        self._last_seen: dict[str, float] = {}
-        self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
-        self._lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self.max_vid = 0
+        self.next_sequence = 1
+        self.raft = RaftNode(
+            self.self_addr, self.peers,
+            apply_fn=self._apply,
+            snapshot_fn=self._snapshot,
+            restore_fn=self._restore,
+            on_role_change=self._on_role_change,
+            election_timeout=election_timeout,
+            state_dir=raft_dir, seed=seed)
 
-    # -- liveness ----------------------------------------------------------
-    def alive_peers(self) -> list[str]:
-        now = time.time()
-        with self._lock:
-            return sorted(
-                {self.self_addr}
-                | {p for p, ts in self._last_seen.items()
-                   if now - ts < PEER_DEAD_AFTER})
+    # -- state machine ------------------------------------------------------
+    def _apply(self, cmd: dict):
+        kind = cmd.get("t")
+        if kind == "vid":
+            with self._state_lock:
+                base = max(self.max_vid, int(cmd.get("floor", 0)))
+                first = base + 1
+                self.max_vid = base + int(cmd.get("n", 1))
+            topo = self.master.topo
+            with topo._lock:
+                topo.max_volume_id = max(topo.max_volume_id, self.max_vid)
+            return first
+        if kind == "seq":
+            with self._state_lock:
+                base = max(self.next_sequence, int(cmd.get("floor", 0)))
+                self.next_sequence = base + int(cmd.get("n", 1))
+            return base
+        raise ValueError(f"unknown raft command {kind!r}")
 
+    def _snapshot(self) -> dict:
+        with self._state_lock:
+            return {"max_vid": self.max_vid,
+                    "next_sequence": self.next_sequence}
+
+    def _restore(self, state: dict) -> None:
+        with self._state_lock:
+            self.max_vid = max(self.max_vid, state.get("max_vid", 0))
+            self.next_sequence = max(self.next_sequence,
+                                     state.get("next_sequence", 1))
+        topo = self.master.topo
+        with topo._lock:
+            topo.max_volume_id = max(topo.max_volume_id, self.max_vid)
+
+    def _on_role_change(self, is_leader: bool) -> None:
+        self.master.is_leader = is_leader
+
+    # -- replicated allocators ---------------------------------------------
+    def reserve_vid(self) -> int:
+        """Allocate one globally unique volume id through the log.  The
+        floor folds in heartbeat-discovered vids (pre-existing volumes on
+        freshly joined servers)."""
+        return self.raft.propose(
+            {"t": "vid", "n": 1, "floor": self.master.topo.max_volume_id})
+
+    def reserve_seq(self, n: int, floor: int) -> int:
+        return self.raft.propose({"t": "seq", "n": n, "floor": floor})
+
+    # -- seam used by MasterServer -----------------------------------------
     def leader_address(self) -> str:
-        return self.alive_peers()[0]
+        # self as fallback preserves the "no leader elected" error path
+        return self.raft.leader_id or self.self_addr
 
     def is_leader(self) -> bool:
-        return self.leader_address() == self.self_addr
+        return self.raft.role == "leader"
 
-    # -- ping loop ---------------------------------------------------------
-    def _ping_once(self) -> None:
-        payload = {
-            "addr": self.self_addr,
-            "max_volume_id": self.master.topo.max_volume_id,
-            "sequence": self.master.sequencer.peek(),
-        }
-
-        def ping(peer: str) -> None:
-            try:
-                out = POOL.client(peer, "Seaweed").call(
-                    "MasterPing", payload, timeout=2.0)
-                with self._lock:
-                    self._last_seen[peer] = time.time()
-                self._adopt(out)
-            except RpcError:
-                pass
-
-        # concurrent pings: serial 2s timeouts against dark peers would
-        # stretch a round past PEER_DEAD_AFTER and flap leadership
-        threads = [threading.Thread(target=ping, args=(p,), daemon=True)
-                   for p in self.peers if p != self.self_addr]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=2.5)
-        self.master.is_leader = self.is_leader()
-
-    def _adopt(self, state: dict) -> None:
-        """Merge a peer's counters (monotonic, max-merge)."""
-        with self.master.topo._lock:
-            self.master.topo.max_volume_id = max(
-                self.master.topo.max_volume_id,
-                int(state.get("max_volume_id") or 0))
-        self.master.sequencer.set_max(int(state.get("sequence") or 1) - 1)
-
-    def handle_ping(self, req: dict) -> dict:
-        with self._lock:
-            self._last_seen[normalize_addr(req["addr"])] = time.time()
-        self._adopt(req)
-        self.master.is_leader = self.is_leader()
-        return {
-            "addr": self.self_addr,
-            "max_volume_id": self.master.topo.max_volume_id,
-            "sequence": self.master.sequencer.peek(),
-            "leader": self.leader_address(),
-        }
-
-    # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
-        self.self_addr = normalize_addr(self.master.grpc_address)
-        self.peers = sorted(set(self.peers) | {self.self_addr})
-        self._ping_once()
-
-        def loop():
-            while not self._stop.wait(PING_INTERVAL):
-                self._ping_once()
-        self._thread = threading.Thread(target=loop, daemon=True)
-        self._thread.start()
+        self.raft.start()
 
     def stop(self) -> None:
-        self._stop.set()
+        self.raft.stop()
+
+    def set_partitioned(self, flag: bool) -> None:
+        self.raft.set_partitioned(flag)
+
+
+class RaftSequencer:
+    """Sequencer facade serving file ids from raft-reserved blocks.
+
+    Same interface as MemorySequencer (next_file_id/set_max/peek); only
+    block reservations hit the log.  set_max folds in max file keys seen
+    in volume-server heartbeats — the reservation floor guarantees the
+    next block clears them."""
+
+    def __init__(self, coordinator: HaCoordinator):
+        self._coord = coordinator
+        self._lock = threading.Lock()
+        self._next = 1
+        self._limit = 1      # empty block: first alloc reserves
+
+    def next_file_id(self, count: int = 1) -> int:
+        with self._lock:
+            if self._next + count > self._limit:
+                need = max(SEQ_BLOCK, count)
+                start = self._coord.reserve_seq(need, floor=self._next)
+                self._next, self._limit = start, start + need
+            first = self._next
+            self._next += count
+            return first
+
+    def set_max(self, seen: int) -> None:
+        with self._lock:
+            if seen >= self._next:
+                self._next = seen + 1
+
+    def peek(self) -> int:
+        # sequential (never nested) acquisition: peek must not hold
+        # _state_lock while waiting on _lock or it could deadlock against
+        # an in-flight reservation's apply
+        with self._coord._state_lock:
+            replicated = self._coord.next_sequence
+        with self._lock:
+            local = self._next
+        return max(local, replicated)

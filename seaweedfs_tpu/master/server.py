@@ -10,9 +10,10 @@ Capability-equivalent to weed/server/master_server.go + master_grpc_server*.go:
 - HTTP: /dir/assign, /dir/lookup, /cluster/status, /vol/grow
   (master_server_handlers.go).
 
-Single-master here; the raft seam is the `is_leader` flag + max-volume-id
-counter in Topology (the reference's whole replicated state machine is just
-that counter + sequencer, topology/cluster_commands.go).
+Multi-master HA runs a real raft log (master/raft.py + master/ha.py): the
+replicated state machine carries the max-volume-id counter and the file-id
+sequencer — exactly the reference's (topology/cluster_commands.go +
+raft_server.go:45-62 snapshot).  Single-master mode skips raft entirely.
 """
 
 from __future__ import annotations
@@ -61,6 +62,8 @@ class MasterServer:
                  jwt_expires_seconds: int = 10,
                  peers: list[str] | None = None,
                  auto_vacuum_interval: float = 0.0,
+                 raft_dir: str | None = None,
+                 election_timeout: float = 0.4,
                  seed: int | None = None):
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024, seed=seed)
@@ -71,11 +74,15 @@ class MasterServer:
         self.jwt_expires_seconds = jwt_expires_seconds
         from ..stats import ServerMetrics
         self.metrics = ServerMetrics()
-        self.is_leader = True
+        self.is_leader = not peers   # multi-master: raft elects
         self.ha = None
         self._peers = peers or []
+        self._raft_dir = raft_dir
+        self._election_timeout = election_timeout
+        self._partitioned = False
         self.auto_vacuum_interval = auto_vacuum_interval
         self._stop_vacuum = threading.Event()
+        self._seed = seed
         self._rng = random.Random(seed)
         self._grow_lock = threading.Lock()
         # admin maintenance lock (LeaseAdminToken)
@@ -103,8 +110,13 @@ class MasterServer:
         self.http.start()
         self.rpc.start()
         if self._peers:
-            from .ha import HaCoordinator
-            self.ha = HaCoordinator(self, self._peers)
+            from .ha import HaCoordinator, RaftSequencer
+            self.ha = HaCoordinator(
+                self, self._peers, raft_dir=self._raft_dir,
+                election_timeout=self._election_timeout,
+                seed=self._seed)
+            self.sequencer = RaftSequencer(self.ha)
+            self.topo.vid_allocator = self.ha.reserve_vid
             self.ha.start()
         if self.auto_vacuum_interval > 0:
             # the embedded maintenance cron (startAdminScripts,
@@ -131,6 +143,19 @@ class MasterServer:
     @property
     def leader_grpc(self) -> str:
         return self.ha.leader_address() if self.ha else self.grpc_address
+
+    # -- fault injection (SimCluster partition_master) ----------------------
+    def set_partitioned(self, flag: bool) -> None:
+        """Simulate a full network partition: raft RPCs cut both ways and
+        client-facing surfaces refuse, so heartbeat streams break and
+        volume servers re-home to the majority side."""
+        self._partitioned = flag
+        if self.ha:
+            self.ha.set_partitioned(flag)
+
+    def _check_partition(self) -> None:
+        if self._partitioned:
+            raise RpcError("master partitioned (fault injection)")
 
     def _self_grpc(self) -> str:
         """Normalized self address — leader comparisons must not treat
@@ -159,12 +184,25 @@ class MasterServer:
             preferred_data_node=req.get("data_node", ""))
 
     def assign(self, req: dict) -> dict:
+        self._check_partition()
         if not self.is_leader:
             # transparent follower proxy (proxyToLeader master_server.go:180)
             leader = self.leader_grpc
             if leader == self._self_grpc():
                 raise RpcError("no leader elected")
             return POOL.client(leader, "Seaweed").call("Assign", req)
+        try:
+            return self._assign_as_leader(req)
+        except RpcError:
+            # deposed mid-assign: if a new leader is already known, hand
+            # the request over once instead of failing the client
+            leader = self.leader_grpc
+            if not self.is_leader and leader != self._self_grpc() \
+                    and not self._partitioned:
+                return POOL.client(leader, "Seaweed").call("Assign", req)
+            raise
+
+    def _assign_as_leader(self, req: dict) -> dict:
         count = int(req.get("count") or 1)
         option = self._grow_option(req)
         if not self.topo.has_writable_volume(option):
@@ -236,6 +274,7 @@ class MasterServer:
         dn: DataNode | None = None
         try:
             for hb in requests:
+                self._check_partition()
                 dn = self._ingest_heartbeat(hb, dn)
                 yield {
                     "volume_size_limit": self.topo.volume_size_limit,
@@ -280,6 +319,7 @@ class MasterServer:
 
     # -- KeepConnected pub-sub (master_grpc_server.go:185-252) --------------
     def _handle_keep_connected(self, requests):
+        self._check_partition()
         first = next(iter(requests), None)  # client announces itself
         q: queue.Queue = queue.Queue()
         # cluster registry: track non-volume nodes (filers, brokers) by
@@ -382,12 +422,27 @@ class MasterServer:
                 "VolumeList": lambda req: {"topology": self.topo.to_dict()},
                 "ListClusterNodes": self._rpc_list_cluster_nodes,
                 "Vacuum": self._rpc_vacuum,
-                "MasterPing": self._rpc_master_ping,
             },
             stream={
                 "SendHeartbeat": self._handle_heartbeat_stream,
                 "KeepConnected": self._handle_keep_connected,
             })
+        # raft transport: lazy delegation — the HaCoordinator (and its
+        # RaftNode) is constructed in start() once the gRPC port is known
+        self.rpc.add_service(
+            "Raft",
+            unary={
+                "RequestVote": self._raft_rpc("handle_request_vote"),
+                "AppendEntries": self._raft_rpc("handle_append_entries"),
+                "InstallSnapshot": self._raft_rpc("handle_install_snapshot"),
+            })
+
+    def _raft_rpc(self, method: str):
+        def handler(req: dict) -> dict:
+            if self.ha is None:
+                raise RpcError("raft not configured on this master")
+            return getattr(self.ha.raft, method)(req)
+        return handler
 
     def _rpc_list_cluster_nodes(self, req: dict) -> dict:
         with self._sub_lock:
@@ -398,11 +453,6 @@ class MasterServer:
                             for t, counts in self.cluster_nodes.items()
                             if counts}}
 
-    def _rpc_master_ping(self, req: dict) -> dict:
-        if self.ha is None:
-            raise RpcError("HA not configured on this master")
-        return self.ha.handle_ping(req)
-
     def _rpc_vacuum(self, req: dict) -> dict:
         from . import vacuum as vacuum_mod
         threshold = float(req.get("garbage_threshold")
@@ -410,6 +460,7 @@ class MasterServer:
         return {"vacuumed": vacuum_mod.vacuum(self.topo, threshold)}
 
     def _rpc_lookup_volume(self, req: dict) -> dict:
+        self._check_partition()
         if not self.is_leader and self.leader_grpc != self._self_grpc():
             # followers have no heartbeat-fed topology; ask the leader
             return POOL.client(self.leader_grpc, "Seaweed").call(

@@ -9,7 +9,7 @@ freeze/advance heartbeats.  Every integration test in tests/ runs on this
 (most via local fixtures that predate the harness; new tests should use
 SimCluster directly).
 
-    with SimCluster(masters=2, volume_servers=3, filers=1) as c:
+    with SimCluster(masters=3, volume_servers=3, filers=1) as c:
         fid = c.upload(b"hello")
         c.kill_master(c.leader_index())   # failover
         assert c.read(fid) == b"hello"
@@ -52,11 +52,10 @@ class SimCluster:
         master_ports = [free_port() for _ in range(masters)]
         self.peers = [f"127.0.0.1:{p}" for p in master_ports] \
             if masters > 1 else []
+        self._master_ports = master_ports
         self.masters: list[MasterServer | None] = []
         for i, port in enumerate(master_ports):
-            self.masters.append(MasterServer(
-                grpc_port=port, peers=self.peers, jwt_signing_key=jwt_key,
-                seed=seed + i))
+            self.masters.append(self._make_master(i, port))
         # volume servers/filers/s3 are built in start(): a single master
         # on an ephemeral gRPC port only knows its address after starting
         self._n_volume_servers = volume_servers
@@ -70,6 +69,13 @@ class SimCluster:
             self._vs_dirs.append(d)
         self.filers: list[FilerServer] = []
         self.s3_server: "S3ApiServer | None" = None
+
+    def _make_master(self, i: int, port: int) -> MasterServer:
+        raft_dir = os.path.join(self.base_dir, f"raft{i}") \
+            if self.peers else None
+        return MasterServer(
+            grpc_port=port, peers=self.peers, jwt_signing_key=self.jwt_key,
+            raft_dir=raft_dir, election_timeout=0.3, seed=self._seed + i)
 
     def _make_vs(self, i: int) -> VolumeServer:
         return VolumeServer(
@@ -182,6 +188,40 @@ class SimCluster:
         if m is not None:
             m.stop()
             self.masters[i] = None
+
+    def restart_master(self, i: int) -> MasterServer:
+        """Re-launch on the same port with the same raft state dir — the
+        node rejoins with its persisted term/vote/log intact."""
+        assert self.masters[i] is None, "kill it first"
+        m = self._make_master(i, self._master_ports[i])
+        m.start()
+        self.masters[i] = m
+        return m
+
+    def partition_master(self, i: int) -> None:
+        """Full network partition of master i: raft RPCs cut both ways,
+        heartbeat/assign/lookup surfaces refuse — the majority side elects
+        a fresh leader and volume servers re-home to it, while the
+        minority side steps down and cannot acknowledge assigns."""
+        m = self.masters[i]
+        if m is not None:
+            m.set_partitioned(True)
+
+    def heal_master(self, i: int) -> None:
+        m = self.masters[i]
+        if m is not None:
+            m.set_partitioned(False)
+
+    def wait_for_leader(self, timeout: float = 10.0,
+                        exclude: int = -1) -> int:
+        """Block until some non-excluded master claims raft leadership."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for i, m in enumerate(self.masters):
+                if i != exclude and m is not None and m.is_leader:
+                    return i
+            time.sleep(0.05)
+        raise TimeoutError("no leader elected")
 
     def kill_volume_server(self, i: int) -> None:
         """Hard-stop; its volumes become unavailable until restart."""

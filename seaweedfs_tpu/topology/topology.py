@@ -38,6 +38,9 @@ class Topology:
         self.ec_shard_map: dict[int, dict[int, list[DataNode]]] = {}
         self.ec_collections: dict[int, str] = {}
         self.max_volume_id = 0
+        # multi-master: HA swaps in a raft-replicated allocator (ha.py
+        # reserve_vid — the reference's MaxVolumeIdCommand)
+        self.vid_allocator = None
         self._lock = threading.RLock()
         self._rng = random.Random(seed)
 
@@ -160,7 +163,12 @@ class Topology:
     # -- id assignment -----------------------------------------------------
     def next_volume_id(self) -> int:
         """The raft-replicated MaxVolumeIdCommand counter
-        (topology/cluster_commands.go)."""
+        (topology/cluster_commands.go).  The allocator is called OUTSIDE
+        the topology lock — it may block on a raft quorum round-trip whose
+        apply path itself takes this lock."""
+        alloc = self.vid_allocator
+        if alloc is not None:
+            return alloc()
         with self._lock:
             self.max_volume_id += 1
             return self.max_volume_id

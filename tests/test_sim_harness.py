@@ -40,7 +40,7 @@ def test_volume_server_crash_and_restart(tmp_path):
 
 
 def test_master_failover_with_harness(tmp_path):
-    with SimCluster(masters=2, volume_servers=2,
+    with SimCluster(masters=3, volume_servers=2,
                     base_dir=str(tmp_path)) as c:
         fid = c.upload(b"pre-failover")
         leader = c.leader_index()
