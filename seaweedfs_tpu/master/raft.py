@@ -98,6 +98,11 @@ class RaftNode:
         self.log: list[dict] = []
         self.snap_index = 0
         self.snap_term = 0
+        # state dict frozen AT compaction time — InstallSnapshot must ship
+        # this, not a live snapshot_fn() read, or the receiver re-applies
+        # entries (snap_index, last_applied] on top of state that already
+        # includes them
+        self._snap_state: dict = {}
         self.commit_index = 0
         self.last_applied = 0
         self.role = FOLLOWER
@@ -106,13 +111,14 @@ class RaftNode:
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
         self._last_ack: dict[str, float] = {}
-        self._inflight: set[str] = set()
         self._futures: dict[int, _Future] = {}
         self._partitioned = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # wakes the long-lived per-peer replicator loops (no per-heartbeat
+        # thread spawning)
+        self._cond = threading.Condition()
         self._election_deadline = 0.0
-        self._last_broadcast = 0.0
         if state_dir:
             os.makedirs(state_dir, exist_ok=True)
             self._load_state()
@@ -182,6 +188,7 @@ class RaftNode:
                 snap = json.load(f)
             self.snap_index = snap["snap_index"]
             self.snap_term = snap["snap_term"]
+            self._snap_state = snap["state"]
             self.restore_fn(snap["state"])
             self.commit_index = self.last_applied = self.snap_index
         log_p = os.path.join(self.state_dir, "log.jsonl")
@@ -200,9 +207,16 @@ class RaftNode:
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"raft-{self.self_addr}")
         self._thread.start()
+        for p in self.peers:
+            if p != self.self_addr:
+                threading.Thread(target=self._peer_loop, args=(p,),
+                                 daemon=True,
+                                 name=f"raft-repl-{p}").start()
 
     def stop(self) -> None:
         self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
         self._fail_pending(RpcError("raft node stopped"))
 
     def set_partitioned(self, flag: bool) -> None:
@@ -224,9 +238,6 @@ class RaftNode:
                     self._election_deadline = self._rand_deadline()
                     continue
                 if self.role == LEADER:
-                    if now - self._last_broadcast >= self.hb_interval:
-                        self._last_broadcast = now
-                        self._broadcast()
                     self._check_lease(now)
                     behind = self.last_applied < self.commit_index
                 elif now >= self._election_deadline:
@@ -322,7 +333,6 @@ class RaftNode:
         self._last_ack = {p: now for p in self.peers}
         # no-op commits prior-term entries promptly (§5.4.2 / §8)
         self._append_local({"t": "noop"})
-        self._last_broadcast = now
         self._broadcast()
         if self.on_role_change:
             self.on_role_change(True)
@@ -339,69 +349,81 @@ class RaftNode:
         return index
 
     def _broadcast(self) -> None:
-        for p in self.peers:
-            if p != self.self_addr and p not in self._inflight:
-                self._inflight.add(p)
-                threading.Thread(target=self._replicate_to, daemon=True,
-                                 args=(p, self.term)).start()
+        """Wake every replicator loop for an immediate AppendEntries."""
+        with self._cond:
+            self._cond.notify_all()
+
+    def _peer_loop(self, peer: str) -> None:
+        """One long-lived replication loop per peer: heartbeat every
+        hb_interval, sooner when _broadcast signals new entries."""
+        while not self._stop.is_set():
+            with self._cond:
+                self._cond.wait(self.hb_interval)
+            if self._stop.is_set():
+                return
+            with self._lock:
+                if self.role != LEADER or self._partitioned:
+                    continue
+                term = self.term
+            try:
+                self._replicate_to(peer, term)
+            except Exception:       # never kill the loop
+                pass
 
     def _replicate_to(self, peer: str, term: int) -> None:
+        with self._lock:
+            if self.role != LEADER or self.term != term:
+                return
+            ni = self._next_index.get(peer, self.last_index + 1)
+            snap_req = None
+            if ni <= self.snap_index:
+                # build under the lock, send outside it — a 2s RPC
+                # holding _lock would stall heartbeats to healthy
+                # followers and flap leadership
+                snap_req = {"term": term, "leader": self.self_addr,
+                            "snap_index": self.snap_index,
+                            "snap_term": self.snap_term,
+                            "state": self._snap_state}
+        if snap_req is not None:
+            self._send_snapshot(peer, term, snap_req)
+            return
+        with self._lock:
+            if self.role != LEADER or self.term != term:
+                return
+            ni = self._next_index.get(peer, self.last_index + 1)
+            if ni <= self.snap_index:
+                return      # compacted again meanwhile; next round
+            prev = ni - 1
+            entries = [self._entry(i)
+                       for i in range(ni, self.last_index + 1)]
+            req = {"term": term, "leader": self.self_addr,
+                   "prev_index": prev, "prev_term": self._term_at(prev),
+                   "entries": entries, "commit": self.commit_index}
         try:
-            with self._lock:
-                if self.role != LEADER or self.term != term:
-                    return
-                ni = self._next_index.get(peer, self.last_index + 1)
-                snap_req = None
-                if ni <= self.snap_index:
-                    # build under the lock, send outside it — a 2s RPC
-                    # holding _lock would stall heartbeats to healthy
-                    # followers and flap leadership
-                    snap_req = {"term": term, "leader": self.self_addr,
-                                "snap_index": self.snap_index,
-                                "snap_term": self.snap_term,
-                                "state": self.snapshot_fn()}
-            if snap_req is not None:
-                self._send_snapshot(peer, term, snap_req)
+            out = self._call(peer, "AppendEntries", req,
+                             timeout=self.election_timeout)
+        except RpcError:
+            return
+        apply_now = False
+        with self._lock:
+            if out.get("term", 0) > self.term:
+                self._become_follower(out["term"])
                 return
-            with self._lock:
-                if self.role != LEADER or self.term != term:
-                    return
-                ni = self._next_index.get(peer, self.last_index + 1)
-                if ni <= self.snap_index:
-                    return      # compacted again meanwhile; next round
-                prev = ni - 1
-                entries = [self._entry(i)
-                           for i in range(ni, self.last_index + 1)]
-                req = {"term": term, "leader": self.self_addr,
-                       "prev_index": prev, "prev_term": self._term_at(prev),
-                       "entries": entries, "commit": self.commit_index}
-            try:
-                out = self._call(peer, "AppendEntries", req,
-                                 timeout=self.election_timeout)
-            except RpcError:
+            if self.role != LEADER or self.term != term:
                 return
-            apply_now = False
-            with self._lock:
-                if out.get("term", 0) > self.term:
-                    self._become_follower(out["term"])
-                    return
-                if self.role != LEADER or self.term != term:
-                    return
-                self._last_ack[peer] = time.monotonic()
-                if out.get("ok"):
-                    match = prev + len(entries)
-                    if match > self._match_index.get(peer, 0):
-                        self._match_index[peer] = match
-                    self._next_index[peer] = match + 1
-                    apply_now = self._advance_commit()
-                else:
-                    # follower hints its last index to jump back quickly
-                    self._next_index[peer] = max(
-                        1, min(ni - 1, out.get("last", ni - 1) + 1))
-            if apply_now:
-                self._apply_committed()
-        finally:
-            self._inflight.discard(peer)
+            self._last_ack[peer] = time.monotonic()
+            if out.get("ok"):
+                match = prev + len(entries)
+                if match > self._match_index.get(peer, 0):
+                    self._match_index[peer] = match
+                self._next_index[peer] = match + 1
+                apply_now = self._advance_commit()
+            else:
+                # follower hints its last index to jump back quickly
+                self._next_index[peer] = max(
+                    1, min(ni - 1, out.get("last", ni - 1) + 1))
+        if apply_now:
+            self._apply_committed()
 
     def _send_snapshot(self, peer: str, term: int, req: dict) -> None:
         """Called with _lock NOT held (req was built under it)."""
@@ -458,6 +480,7 @@ class RaftNode:
             self.snap_term = self._term_at(new_snap)
             self.log = [e for e in self.log if e["i"] > new_snap]
             self.snap_index = new_snap
+            self._snap_state = state
             # snapshot BEFORE log: a crash between the writes must leave a
             # snap covering everything the truncated log no longer holds
             # (_load_state drops log entries <= snap_index, so the reverse
@@ -479,8 +502,7 @@ class RaftNode:
             index = self.last_index + 1
             self._futures[index] = fut
             self._append_local(cmd)
-            self._last_broadcast = time.monotonic()
-            self._broadcast()
+        self._broadcast()
         if self.quorum == 1:
             self._apply_committed()
         if not fut.wait(timeout):
@@ -579,6 +601,7 @@ class RaftNode:
                 self.restore_fn(req["state"])
                 self.snap_index = req["snap_index"]
                 self.snap_term = req["snap_term"]
+                self._snap_state = req["state"]
                 self.log = [e for e in self.log
                             if e["i"] > self.snap_index]
                 self.commit_index = max(self.commit_index, self.snap_index)

@@ -5,9 +5,11 @@ Capability-equivalent to weed/remote_storage/* + command/filer_remote_sync*
 + shell/command_remote_*.go:
 - RemoteStorageClient interface (remote_storage.go): list/read/write/
   delete/stat on a remote location.
-- LocalDirRemoteStorage: a directory standing in for a cloud bucket — the
-  registered backend in this image (S3/GCS/Azure/HDFS SDKs absent; they
-  implement the same five methods).
+- LocalDirRemoteStorage: a directory standing in for a cloud bucket.
+- S3RemoteStorage: any S3 endpoint via plain SigV4 HTTP (s3/client.py) —
+  including the repo's own S3 gateway, making the cloud tier fully
+  self-hosted.  (GCS/Azure/HDFS SDKs absent from the image; they
+  implement the same five methods.)
 - RemoteMount: attaches a remote location under a filer path; `mount`
   materializes remote metadata as filer entries whose `remote` extended
   attrs carry (remote_mtime, remote_size, synced) — the RemoteEntry pb.
@@ -99,8 +101,63 @@ class LocalDirRemoteStorage:
         return {"key": key, "size": st.st_size, "mtime": st.st_mtime}
 
 
-STORAGE_TYPES = {"local": LocalDirRemoteStorage}
-UNAVAILABLE = {"s3": "boto3 not in image", "gcs": "gcs SDK not in image",
+class S3RemoteStorage:
+    """Any S3 endpoint as the 'cloud' — speaks plain SigV4 HTTP via
+    s3/client.py, no SDK.  Pointing it at the repo's own S3 gateway gives
+    a fully self-hosted cloud tier (reference s3_backend/s3_backend.go +
+    remote_storage/s3 need the AWS SDK for the same capability)."""
+    name = "s3"
+
+    def __init__(self, endpoint: str, bucket: str, access_key: str = "",
+                 secret_key: str = "", prefix: str = "",
+                 region: str = "us-east-1", create_bucket: bool = True):
+        from ..s3.client import S3Client
+        self.client = S3Client(endpoint, access_key, secret_key,
+                               region=region)
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        if create_bucket:
+            self.client.create_bucket(bucket)
+
+    def _k(self, key: str) -> str:
+        key = key.lstrip("/")
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def _unk(self, key: str) -> str:
+        if self.prefix and key.startswith(self.prefix + "/"):
+            return key[len(self.prefix) + 1:]
+        return key
+
+    def list_objects(self, prefix: str = "") -> list[dict]:
+        out = self.client.list_objects(self.bucket,
+                                       self._k(prefix.lstrip("/")))
+        for o in out:
+            o["key"] = self._unk(o["key"])
+        return out
+
+    def read_object(self, key: str) -> bytes:
+        return self.client.get_object(self.bucket, self._k(key))
+
+    def read_object_range(self, key: str, offset: int, size: int) -> bytes:
+        return self.client.get_object_range(self.bucket, self._k(key),
+                                            offset, size)
+
+    def write_object(self, key: str, data: bytes) -> None:
+        self.client.put_object(self.bucket, self._k(key), data)
+
+    def write_object_stream(self, key: str, fileobj) -> None:
+        self.client.put_object_stream(self.bucket, self._k(key), fileobj)
+
+    def delete_object(self, key: str) -> None:
+        self.client.delete_object(self.bucket, self._k(key))
+
+    def stat_object(self, key: str) -> dict:
+        st = self.client.head_object(self.bucket, self._k(key))
+        return {"key": key, "size": st["size"], "mtime": st["mtime"]}
+
+
+STORAGE_TYPES = {"local": LocalDirRemoteStorage, "s3": S3RemoteStorage}
+UNAVAILABLE = {"gcs": "gcs SDK not in image",
                "azure": "azure SDK not in image",
                "hdfs": "hdfs client not in image"}
 

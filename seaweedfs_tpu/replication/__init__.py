@@ -3,10 +3,11 @@
 Capability-equivalent to weed/replication/replicator.go + sink/*: a
 Replicator consumes filer metadata events and applies create/update/delete
 to a ReplicationSink.  Sinks: FilerSink (active-active cross-cluster,
-sink/filersink) and LocalSink (materialize into a local directory,
-sink/localsink).  Cloud sinks (S3/GCS/Azure/B2) follow the same interface —
-gated out here (no cloud SDKs in the image), the FilerSink shape is what
-they implement.
+sink/filersink), LocalSink (materialize into a local directory,
+sink/localsink) and S3Sink (objects into any S3 endpoint via plain SigV4
+HTTP — matching sink/s3sink/s3_sink.go without the AWS SDK; pointing it
+at another cluster's S3 gateway replicates cluster→cloud self-hosted).
+GCS/Azure/B2 sinks follow the same interface (SDKs absent from image).
 """
 
 from __future__ import annotations
@@ -122,6 +123,53 @@ class LocalSink:
             shutil.rmtree(p, ignore_errors=True)
         elif os.path.exists(p):
             os.remove(p)
+
+
+class S3Sink:
+    """Replicate the namespace as objects into an S3 bucket
+    (replication/sink/s3sink/s3_sink.go): entry path -> object key,
+    chunk bytes stitched in offset order; directories are implicit."""
+
+    def __init__(self, endpoint: str, bucket: str, access_key: str = "",
+                 secret_key: str = "", prefix: str = "",
+                 read_chunk: "callable" = None):
+        if read_chunk is None:
+            # without a chunk reader every replicated file would land as
+            # an empty object — refuse early
+            raise ValueError("S3Sink requires read_chunk")
+        from ..s3.client import S3Client
+        self.client = S3Client(endpoint, access_key, secret_key)
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self.read_chunk = read_chunk
+        self.client.create_bucket(bucket)
+
+    def _key(self, path: str) -> str:
+        key = path.lstrip("/")
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def create_entry(self, entry: Entry, signature: str) -> None:
+        if entry.is_directory():
+            return              # S3 has no directories
+        data = bytearray()
+        for c in sorted(entry.chunks, key=lambda c: c.offset):
+            chunk = self.read_chunk(c.file_id)
+            if len(data) < c.offset:      # sparse hole → zero fill
+                data.extend(b"\0" * (c.offset - len(data)))
+            data[c.offset:c.offset + len(chunk)] = chunk
+        self.client.put_object(self.bucket,
+                               self._key(entry.full_path), bytes(data))
+
+    def update_entry(self, old: Entry, new: Entry, signature: str) -> None:
+        self.create_entry(new, signature)
+
+    def delete_entry(self, path: str, is_directory: bool) -> None:
+        if is_directory:
+            for obj in self.client.list_objects(
+                    self.bucket, self._key(path) + "/"):
+                self.client.delete_object(self.bucket, obj["key"])
+        else:
+            self.client.delete_object(self.bucket, self._key(path))
 
 
 class Replicator:

@@ -1,0 +1,215 @@
+"""Minimal SigV4 S3 client — plain HTTP, no SDK.
+
+The self-hosted-cloud building block: the tier backend
+(storage/backend/s3_backend/s3_backend.go), the replication S3 sink
+(replication/sink/s3sink/s3_sink.go) and the remote-storage "s3" kind all
+speak this client at any S3 endpoint — most usefully the repo's OWN S3
+gateway, so cloud flows are exercised end-to-end with zero external
+dependencies (the reference needs the AWS SDK + a real bucket for the
+same paths).
+
+Signing reuses the same sign_v4 routine the server verifies with
+(s3/auth.py) — but through the public request surface, so a signature
+bug on either side fails the round-trip test rather than cancelling out.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+from ..util.http import http_request
+from .auth import sign_v4
+
+
+class S3ClientError(Exception):
+    def __init__(self, status: int, body: bytes):
+        super().__init__(f"S3 request failed: HTTP {status} "
+                         f"{body[:200]!r}")
+        self.status = status
+        self.body = body
+
+
+def _strip_ns(tag: str) -> str:
+    return tag.rsplit("}", 1)[-1]
+
+
+class S3Client:
+    def __init__(self, endpoint: str, access_key: str = "",
+                 secret_key: str = "", region: str = "us-east-1",
+                 timeout: float = 3600.0):
+        if not endpoint.startswith("http"):
+            endpoint = "http://" + endpoint
+        self.endpoint = endpoint.rstrip("/")
+        self.host = self.endpoint.split("://", 1)[-1]
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.timeout = timeout
+
+    # -- signing ------------------------------------------------------------
+    def _signed_headers(self, method: str, path: str, query: dict,
+                        body: bytes) -> dict:
+        payload_hash = hashlib.sha256(body).hexdigest()
+        headers = {
+            "Host": self.host,
+            "x-amz-content-sha256": payload_hash,
+            "x-amz-date": time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()),
+        }
+        if not self.access_key:
+            return headers      # anonymous (auth-disabled gateway)
+        amz_date = headers["x-amz-date"]
+        date = amz_date[:8]
+        signed = ["host", "x-amz-content-sha256", "x-amz-date"]
+        sig = sign_v4(method, path, query, headers, signed, payload_hash,
+                      amz_date, date, self.region, "s3", self.secret_key)
+        scope = f"{date}/{self.region}/s3/aws4_request"
+        headers["Authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={';'.join(sorted(signed))}, Signature={sig}")
+        return headers
+
+    def _request(self, method: str, path: str,
+                 query: dict | None = None, body: bytes = b"",
+                 extra_headers: dict | None = None,
+                 ok: tuple = (200, 204)) -> tuple[int, bytes, dict]:
+        query = query or {}
+        epath = urllib.parse.quote(path, safe="/-_.~")
+        headers = self._signed_headers(method, epath, query, body)
+        if extra_headers:
+            # unsigned extras (Range etc.) ride outside the signature,
+            # mirroring how real SDKs keep Range out of SignedHeaders
+            headers.update(extra_headers)
+        url = f"{self.endpoint}{epath}"
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        status, rbody, rheaders = http_request(
+            url, method=method, body=body or None, headers=headers,
+            timeout=self.timeout)
+        if status not in ok:
+            raise S3ClientError(status, rbody)
+        return status, rbody, rheaders
+
+    # -- buckets ------------------------------------------------------------
+    def create_bucket(self, bucket: str) -> None:
+        self._request("PUT", f"/{bucket}", ok=(200, 204, 409))
+
+    def delete_bucket(self, bucket: str) -> None:
+        self._request("DELETE", f"/{bucket}", ok=(200, 204, 404))
+
+    # -- objects ------------------------------------------------------------
+    def put_object(self, bucket: str, key: str, data: bytes) -> None:
+        self._request("PUT", f"/{bucket}/{key}", body=data)
+
+    def put_object_stream(self, bucket: str, key: str, fileobj,
+                          chunk: int = 64 << 20) -> None:
+        """Multipart upload — a sealed 30GB .dat must never be buffered
+        whole; peak memory is one `chunk`."""
+        first = fileobj.read(chunk)
+        more = fileobj.read(1)
+        if not more:            # small object: plain PUT
+            self.put_object(bucket, key, first)
+            return
+        _, body, _ = self._request("POST", f"/{bucket}/{key}",
+                                   query={"uploads": ""})
+        upload_id = ""
+        for el in ET.fromstring(body).iter():
+            if _strip_ns(el.tag) == "UploadId":
+                upload_id = el.text or ""
+        parts: list[tuple[int, str]] = []
+        num = 0
+        pending = first + more
+        while pending:
+            num += 1
+            _, _, headers = self._request(
+                "PUT", f"/{bucket}/{key}",
+                query={"partNumber": str(num), "uploadId": upload_id},
+                body=pending)
+            lower = {k.lower(): v for k, v in headers.items()}
+            parts.append((num, lower.get("etag", "").strip('"')))
+            pending = fileobj.read(chunk)
+        complete = ET.Element("CompleteMultipartUpload")
+        for n, etag in parts:
+            p = ET.SubElement(complete, "Part")
+            ET.SubElement(p, "PartNumber").text = str(n)
+            ET.SubElement(p, "ETag").text = etag
+        self._request("POST", f"/{bucket}/{key}",
+                      query={"uploadId": upload_id},
+                      body=ET.tostring(complete))
+
+    def get_object(self, bucket: str, key: str) -> bytes:
+        _, body, _ = self._request("GET", f"/{bucket}/{key}")
+        return body
+
+    def get_object_range(self, bucket: str, key: str, offset: int,
+                         size: int) -> bytes:
+        _, body, _ = self._request(
+            "GET", f"/{bucket}/{key}",
+            extra_headers={"Range": f"bytes={offset}-{offset + size - 1}"},
+            ok=(200, 206))
+        return body
+
+    def head_object(self, bucket: str, key: str) -> dict:
+        _, _, headers = self._request("HEAD", f"/{bucket}/{key}")
+        lower = {k.lower(): v for k, v in headers.items()}
+        return {"size": int(lower.get("content-length", 0)),
+                "etag": lower.get("etag", "").strip('"'),
+                "mtime": _parse_http_date(lower.get("last-modified", ""))}
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        self._request("DELETE", f"/{bucket}/{key}", ok=(200, 204, 404))
+
+    def list_objects(self, bucket: str, prefix: str = "") -> list[dict]:
+        """Paginated ListObjectsV2 → [{key, size, mtime}]."""
+        out: list[dict] = []
+        token = ""
+        while True:
+            query = {"list-type": "2", "prefix": prefix,
+                     "max-keys": "1000"}
+            if token:
+                query["continuation-token"] = token
+            _, body, _ = self._request("GET", f"/{bucket}", query=query)
+            root = ET.fromstring(body)
+            truncated = False
+            token = ""
+            for el in root:
+                tag = _strip_ns(el.tag)
+                if tag == "Contents":
+                    kv = {_strip_ns(c.tag): (c.text or "") for c in el}
+                    out.append({
+                        "key": kv.get("Key", ""),
+                        "size": int(kv.get("Size") or 0),
+                        "mtime": _parse_iso_date(
+                            kv.get("LastModified", ""))})
+                elif tag == "IsTruncated":
+                    truncated = (el.text or "") == "true"
+                elif tag == "NextContinuationToken":
+                    token = el.text or ""
+            if not truncated or not token:
+                return out
+
+
+def _parse_http_date(s: str) -> float:
+    if not s:
+        return 0.0
+    try:
+        import calendar
+        # the header is GMT — timegm, not mktime (which would skew by the
+        # host's UTC offset and break remote-sync mtime comparisons)
+        return calendar.timegm(
+            time.strptime(s, "%a, %d %b %Y %H:%M:%S %Z"))
+    except ValueError:
+        return 0.0
+
+
+def _parse_iso_date(s: str) -> float:
+    if not s:
+        return 0.0
+    try:
+        import calendar
+        return calendar.timegm(
+            time.strptime(s[:19], "%Y-%m-%dT%H:%M:%S"))
+    except ValueError:
+        return 0.0

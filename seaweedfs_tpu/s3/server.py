@@ -253,9 +253,13 @@ class S3ApiServer:
         out = Response(status, body,
                        content_type=resp_headers.get(
                            "Content-Type", "application/octet-stream"))
-        for h in ("Content-Range", "Accept-Ranges"):
+        for h in ("Content-Range", "Accept-Ranges", "ETag",
+                  "Last-Modified"):
             if h in resp_headers:
                 out.headers[h] = resp_headers[h]
+        if req.method == "HEAD" and "Content-Length" in resp_headers:
+            # a HEAD body is empty; advertise the object's real size
+            out.headers["Content-Length"] = resp_headers["Content-Length"]
         return out
 
     def _delete_object(self, bucket: str, key: str) -> Response:

@@ -12,9 +12,29 @@ from .commands import (CommandEnv, ShellError, command, iter_data_nodes,
 from .command_volume import _move_volume
 
 
+def _tier_backend_config(flags: dict) -> dict:
+    """Build the remote-storage config from shell flags: -destDir for the
+    local kind; -s3Endpoint/-s3Bucket/-s3AccessKey/-s3SecretKey/-s3Prefix
+    for the s3 kind (any endpoint, incl. this cluster's own gateway)."""
+    cfg = {}
+    if flags.get("destDir"):
+        cfg["root"] = flags["destDir"]
+    if flags.get("s3Endpoint"):
+        cfg["endpoint"] = flags["s3Endpoint"]
+        cfg["bucket"] = flags.get("s3Bucket", "volume-tier")
+        if flags.get("s3AccessKey"):
+            cfg["access_key"] = flags["s3AccessKey"]
+            cfg["secret_key"] = flags.get("s3SecretKey", "")
+        if flags.get("s3Prefix"):
+            cfg["prefix"] = flags["s3Prefix"]
+    return cfg
+
+
 @command("volume.tier.move",
          "move a sealed volume's .dat to remote storage: -volumeId N "
-         "-dest local -destDir /path [-keepLocalDatFile]")
+         "-dest local|s3 -destDir /path | -s3Endpoint host:port "
+         "-s3Bucket b [-s3AccessKey .. -s3SecretKey ..] "
+         "[-keepLocalDatFile]")
 def cmd_tier_move(env: CommandEnv, args: list[str]) -> str:
     flags = parse_flags(args)
     env.confirm_is_locked()
@@ -24,9 +44,7 @@ def cmd_tier_move(env: CommandEnv, args: list[str]) -> str:
                if any(v["id"] == vid for v in dn["volumes"])]
     if not holders:
         raise ShellError(f"volume {vid} not found")
-    cfg = {}
-    if flags.get("destDir"):
-        cfg["root"] = flags["destDir"]
+    cfg = _tier_backend_config(flags)
     # freeze EVERY replica first, then tier each one — they share the same
     # remote key (identical sealed content), so storage is paid once
     for dn in holders:

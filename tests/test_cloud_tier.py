@@ -1,0 +1,155 @@
+"""Self-hosted cloud tier: the repo's own S3 gateway as the cloud.
+
+Covers VERDICT round-1 item 4: an S3 tier backend
+(storage/backend/s3_backend/s3_backend.go) and an S3 replication sink
+(replication/sink/s3sink) speaking plain SigV4 HTTP — exercised against
+a SimCluster S3 endpoint, no SDK, no external service."""
+
+import io
+import json
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation, shell
+from seaweedfs_tpu.filer.entry import Entry
+from seaweedfs_tpu.replication import Replicator, S3Sink
+from seaweedfs_tpu.s3.client import S3Client, S3ClientError
+from seaweedfs_tpu.testing import SimCluster
+
+
+@pytest.fixture()
+def s3_cluster(tmp_path):
+    with SimCluster(volume_servers=2, filers=1, s3=True,
+                    base_dir=str(tmp_path)) as c:
+        yield c
+
+
+def test_s3_client_roundtrip(s3_cluster):
+    c = s3_cluster
+    cl = S3Client(c.s3_server.address)
+    cl.create_bucket("t")
+    cl.put_object("t", "a/b.txt", b"hello world")
+    assert cl.get_object("t", "a/b.txt") == b"hello world"
+    assert cl.get_object_range("t", "a/b.txt", 6, 5) == b"world"
+    st = cl.head_object("t", "a/b.txt")
+    assert st["size"] == 11
+    listing = cl.list_objects("t", "a/")
+    assert [o["key"] for o in listing] == ["a/b.txt"]
+    assert listing[0]["size"] == 11
+    # multipart streaming path: force tiny parts
+    blob = os.urandom(10_000)
+    cl.put_object_stream("t", "big.bin", io.BytesIO(blob), chunk=3000)
+    assert cl.get_object("t", "big.bin") == blob
+    cl.delete_object("t", "a/b.txt")
+    with pytest.raises(S3ClientError):
+        cl.get_object("t", "a/b.txt")
+
+
+def test_volume_tier_move_to_own_s3(s3_cluster):
+    """volume.tier.move -dest s3 pointed at the cluster's OWN S3 gateway:
+    the sealed .dat becomes an object, reads ride ranged GETs, download
+    brings it home."""
+    c = s3_cluster
+    blobs = {operation.assign_and_upload(c.master_grpc,
+                                         os.urandom(2000 + i)): i
+             for i in range(5)}
+    fid0 = next(iter(blobs))
+    vid = int(fid0.split(",")[0])
+    in_vol = [f for f in blobs if int(f.split(",")[0]) == vid]
+    datas = {f: operation.read_file(c.master_grpc, f) for f in in_vol}
+    c.sync_heartbeats()
+    env = shell.CommandEnv(c.master_grpc)
+    shell.run_command(env, "lock")
+    out = json.loads(shell.run_command(
+        env, f"volume.tier.move -volumeId {vid} -dest s3 "
+             f"-s3Endpoint {c.s3_server.address} -s3Bucket vol-tier"))
+    assert out["tiered_to"] == "s3"
+    holder = next(vs for vs in c.volume_servers
+                  if vs.store.has_volume(vid))
+    v = holder.store.find_volume(vid)
+    assert v.data_backend.name.startswith("remote://")
+    assert not os.path.exists(v.base_path + ".dat")
+    # the object really lives in the gateway's bucket
+    cl = S3Client(c.s3_server.address)
+    keys = [o["key"] for o in cl.list_objects("vol-tier")]
+    assert any(k.endswith(f"{vid}.dat") for k in keys), keys
+    # reads hit the tiered volume through ranged GETs on the gateway
+    for f, want in datas.items():
+        assert operation.read_file(c.master_grpc, f) == want
+    # pull it back local
+    json.loads(shell.run_command(
+        env, f"volume.tier.download -volumeId {vid}"))
+    v = holder.store.find_volume(vid)
+    assert os.path.exists(v.base_path + ".dat")
+    for f, want in datas.items():
+        assert operation.read_file(c.master_grpc, f) == want
+    shell.run_command(env, "unlock")
+
+
+def test_replication_to_s3_sink(tmp_path):
+    """Filer metadata events from cluster A replicated into cluster B's
+    S3 gateway — the reference's s3sink flow, self-hosted."""
+    with SimCluster(volume_servers=1, filers=1,
+                    base_dir=str(tmp_path / "a")) as a, \
+         SimCluster(volume_servers=1, filers=1, s3=True,
+                    base_dir=str(tmp_path / "b")) as b:
+        sink = S3Sink(b.s3_server.address, "backup",
+                      read_chunk=lambda fid: operation.read_file(
+                          a.master_grpc, fid))
+        repl = Replicator(sink, signature="cluster-a")
+        # subscribe A's filer events straight into the replicator (the
+        # continuous-replication wiring, replication/replicator.go)
+        from seaweedfs_tpu.util.http import http_request
+        fa = a.filers[0]
+        unsub = fa.filer.subscribe(lambda ev: repl.replicate(ev.to_dict()))
+        for name, data in [("x.txt", b"xx"), ("sub/y.txt", b"yyy" * 100)]:
+            status, body, _ = http_request(
+                f"http://{fa.address}/docs/{name}", method="POST",
+                body=data)
+            assert status == 201, body
+        cl = S3Client(b.s3_server.address)
+        assert cl.get_object("backup", "docs/x.txt") == b"xx"
+        assert cl.get_object("backup", "docs/sub/y.txt") == b"yyy" * 100
+        # deletes propagate too
+        status, _, _ = http_request(
+            f"http://{fa.address}/docs/x.txt", method="DELETE")
+        assert status in (200, 204)
+        with pytest.raises(S3ClientError):
+            cl.get_object("backup", "docs/x.txt")
+        unsub()
+
+
+def test_s3_sink_entry_shapes():
+    """S3Sink path→key mapping + directory delete fan-out (unit-level,
+    no cluster: the sink only needs the client wire surface)."""
+    calls = []
+
+    class FakeClient:
+        def create_bucket(self, b):
+            calls.append(("create_bucket", b))
+
+        def put_object(self, b, k, d):
+            calls.append(("put", b, k, d))
+
+        def delete_object(self, b, k):
+            calls.append(("del", b, k))
+
+        def list_objects(self, b, prefix=""):
+            return [{"key": prefix + "one"}, {"key": prefix + "two"}]
+
+    sink = S3Sink.__new__(S3Sink)
+    sink.client = FakeClient()
+    sink.bucket = "bk"
+    sink.prefix = "pre"
+    sink.read_chunk = lambda fid: b"DATA"
+    e = Entry.from_dict({
+        "full_path": "/docs/f.bin",
+        "attr": {"mode": 0o644, "mtime": 1.0, "crtime": 1.0},
+        "chunks": [{"file_id": "3,abc", "offset": 0, "size": 4}]})
+    sink.create_entry(e, "sig")
+    assert ("put", "bk", "pre/docs/f.bin", b"DATA") in calls
+    sink.delete_entry("/docs", True)
+    assert ("del", "bk", "pre/docs/one") in calls
+    assert ("del", "bk", "pre/docs/two") in calls

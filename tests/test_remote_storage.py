@@ -42,8 +42,12 @@ def test_backend_registry(tmp_path):
     assert s.stat_object("a/b.txt")["size"] == 10
     s.delete_object("a/b.txt")
     assert s.list_objects() == []
+    # s3 is a REGISTERED kind now (self-hosted via s3/client.py); only the
+    # SDK-gated clouds stay unavailable
+    with pytest.raises(TypeError):
+        new_remote_storage("s3")     # missing endpoint/bucket config
     with pytest.raises(RuntimeError):
-        new_remote_storage("s3")
+        new_remote_storage("gcs")
     with pytest.raises(ValueError):
         new_remote_storage("nope")
 
