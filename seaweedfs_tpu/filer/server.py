@@ -98,7 +98,10 @@ class FilerServer:
                  port: int = 0, grpc_port: int = 0,
                  store_kind: str = "memory", store_path: str = ":memory:",
                  collection: str = "", replication: str = "",
-                 chunk_size: int = CHUNK_SIZE):
+                 chunk_size: int = CHUNK_SIZE,
+                 chunk_cache_mem_mb: int = 64,
+                 chunk_cache_dir: "str | None" = None,
+                 chunk_cache_disk_mb: int = 1024):
         # may be a comma-separated HA master list; resolved to the leader
         # at start (and re-resolved when calls start failing)
         self._master_spec = master_grpc
@@ -109,6 +112,15 @@ class FilerServer:
         store = (new_filer_store(store_kind, store_path)
                  if store_kind == "sqlite" else new_filer_store(store_kind))
         self.filer = Filer(store, delete_chunks_fn=self._enqueue_deletion)
+        # read-path chunk cache tiers (util/chunk_cache + reader_at.go);
+        # fids are immutable so entries only ever age out by capacity
+        from ..util.chunk_cache import TieredChunkCache
+        self.chunk_cache = TieredChunkCache(
+            mem_limit_bytes=chunk_cache_mem_mb << 20,
+            mem_item_limit=max(chunk_size, 8 << 20),
+            cache_dir=chunk_cache_dir,
+            disk_limit_bytes=chunk_cache_disk_mb << 20) \
+            if chunk_cache_mem_mb > 0 or chunk_cache_dir else None
         self.http = HttpServer(host, port)
         self.rpc = RpcServer(host, grpc_port)
         self._del_queue: "queue.Queue[str]" = queue.Queue()
@@ -242,8 +254,15 @@ class FilerServer:
         return r.fid, out.get("eTag", "")
 
     def _read_chunk_blob(self, fid: str) -> bytes:
-        return self._with_master(
+        if self.chunk_cache is not None:
+            blob = self.chunk_cache.get(fid)
+            if blob is not None:
+                return blob
+        blob = self._with_master(
             lambda m: operation.read_file(m, fid))
+        if self.chunk_cache is not None:
+            self.chunk_cache.put(fid, blob)
+        return blob
 
     # -- HTTP --------------------------------------------------------------
     def _register_http(self) -> None:

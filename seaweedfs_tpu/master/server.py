@@ -227,12 +227,17 @@ class MasterServer:
         }
         if self.jwt_signing_key:
             # sign the write authorization (master_server_handlers.go:146);
-            # a count>1 batch gets a volume-scoped token valid for every
-            # derived fid (verify_fid_jwt accepts vid-only claims)
+            # a count>1 batch gets a token scoped to the assigned
+            # needle-key RANGE — not the whole volume, so it cannot
+            # write or delete other users' needles in the same vid
             from ..security import gen_jwt
-            out["auth"] = gen_jwt(self.jwt_signing_key,
-                                  self.jwt_expires_seconds,
-                                  fid if count == 1 else str(vid))
+            if count == 1:
+                out["auth"] = gen_jwt(self.jwt_signing_key,
+                                      self.jwt_expires_seconds, fid)
+            else:
+                out["auth"] = gen_jwt(self.jwt_signing_key,
+                                      self.jwt_expires_seconds, str(vid),
+                                      key_base=key, key_count=count)
         return out
 
     def _grow(self, option: VolumeGrowOption) -> None:

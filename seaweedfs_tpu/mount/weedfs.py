@@ -74,7 +74,9 @@ class InodeToPath:
 class WeedFS:
     def __init__(self, filer_grpc: str, master_grpc: str,
                  chunk_size: int = CHUNK_SIZE,
-                 replication: str = "", collection: str = ""):
+                 replication: str = "", collection: str = "",
+                 cache_mem_mb: int = 32,
+                 cache_dir: "str | None" = None):
         self.filer_grpc = filer_grpc
         self.master_grpc = master_grpc
         self.chunk_size = chunk_size
@@ -83,7 +85,13 @@ class WeedFS:
         self.meta = MetaCache(filer_grpc)
         self.inodes = InodeToPath()
         self._open_writers: dict[str, PageWriter] = {}
-        self._chunk_cache: dict[str, bytes] = {}  # tiny read cache
+        # tiered read cache (mount chunk_cache tiers, weed/mount read
+        # path); mem-only by default, disk tier when cache_dir given
+        from ..util.chunk_cache import TieredChunkCache
+        self._chunk_cache = TieredChunkCache(
+            mem_limit_bytes=cache_mem_mb << 20,
+            mem_item_limit=max(chunk_size, 8 << 20),
+            cache_dir=cache_dir)
         self._lock = threading.RLock()
 
     def start(self) -> None:
@@ -265,7 +273,5 @@ class WeedFS:
         blob = self._chunk_cache.get(fid)
         if blob is None:
             blob = operation.read_file(self.master_grpc, fid)
-            if len(self._chunk_cache) > 64:  # tiny LRU-ish cap
-                self._chunk_cache.pop(next(iter(self._chunk_cache)))
-            self._chunk_cache[fid] = blob
+            self._chunk_cache.put(fid, blob)
         return blob

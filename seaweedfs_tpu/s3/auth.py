@@ -239,7 +239,11 @@ class IdentityAccessManagement:
             if size == 0:
                 # trailer section: header lines after the final chunk
                 # (x-amz-checksum-*, x-amz-trailer-signature)
-                _check_trailers(body[nl + 2:], bytes(out))
+                _check_trailers(
+                    body[nl + 2:], bytes(out),
+                    verify_ctx=(k, scope, amz_date, prev_sig)
+                    if verify else None,
+                    require_sig=sha.endswith("-TRAILER"))
                 break
         declared = headers.get("X-Amz-Decoded-Content-Length", "")
         if declared and declared.isdigit() and int(declared) != len(out):
@@ -323,22 +327,76 @@ class IdentityAccessManagement:
         return ident
 
 
-def _check_trailers(raw: bytes, payload: bytes) -> None:
-    """Validate any declared trailer checksum over the decoded payload
-    (AWS rejects on mismatch; storing corrupt data with a 200 is worse
-    than no checksum at all)."""
+def _check_trailers(raw: bytes, payload: bytes,
+                    verify_ctx: "tuple | None" = None,
+                    require_sig: bool = False) -> None:
+    """Validate EVERY declared trailer checksum over the decoded payload
+    (crc32/crc32c/sha1/sha256; an unsupported declared algorithm is a 400,
+    never a silent accept) and, for signed-trailer uploads, verify
+    x-amz-trailer-signature against the chunk-signature chain.
+    verify_ctx = (signing_key, scope, amz_date, prev_chunk_sig);
+    require_sig (the ...-PAYLOAD-TRAILER sentinel) makes a MISSING
+    trailer signature an error — stripping the trailer block must not
+    silently drop the client's integrity check."""
     import base64
     import zlib
+
+    def want_crc32c(data: bytes) -> bytes:
+        from ..storage.crc import crc32c
+        return base64.b64encode(crc32c(data).to_bytes(4, "big"))
+
+    checks = {
+        b"x-amz-checksum-crc32": lambda d: base64.b64encode(
+            zlib.crc32(d).to_bytes(4, "big")),
+        b"x-amz-checksum-crc32c": want_crc32c,
+        b"x-amz-checksum-sha1": lambda d: base64.b64encode(
+            hashlib.sha1(d).digest()),
+        b"x-amz-checksum-sha256": lambda d: base64.b64encode(
+            hashlib.sha256(d).digest()),
+    }
+    trailer_headers: list[tuple[bytes, bytes]] = []
+    trailer_sig = b""
     for line in raw.split(b"\r\n"):
+        if not line.strip():
+            continue
         name, _, value = line.partition(b":")
-        if name.strip().lower() == b"x-amz-checksum-crc32":
-            want = base64.b64encode(
-                zlib.crc32(payload).to_bytes(4, "big"))
-            if value.strip() != want:
+        name = name.strip().lower()
+        value = value.strip()
+        if name == b"x-amz-trailer-signature":
+            trailer_sig = value
+            continue
+        trailer_headers.append((name, value))
+        if name.startswith(b"x-amz-checksum-"):
+            fn = checks.get(name)
+            if fn is None:
+                raise S3AuthError(
+                    "InvalidRequest",
+                    f"unsupported trailer checksum "
+                    f"{name.decode(errors='replace')}", 400)
+            if value != fn(payload):
                 raise S3AuthError(
                     "BadDigest",
-                    "x-amz-checksum-crc32 does not match the decoded "
+                    f"{name.decode()} does not match the decoded "
                     "payload", 400)
+    if require_sig and verify_ctx is not None and not trailer_sig:
+        raise S3AuthError(
+            "SignatureDoesNotMatch",
+            "signed-trailer upload is missing x-amz-trailer-signature")
+    if trailer_sig and verify_ctx is not None:
+        # STREAMING-AWS4-HMAC-SHA256-PAYLOAD-TRAILER: the trailer block
+        # is signed against the last chunk signature (AWS SigV4 trailing
+        # headers: hash over "name:value\n" lines)
+        k, scope, amz_date, prev_sig = verify_ctx
+        block = b"".join(n + b":" + v + b"\n"
+                         for n, v in trailer_headers)
+        string_to_sign = "\n".join([
+            "AWS4-HMAC-SHA256-TRAILER", amz_date, scope, prev_sig,
+            hashlib.sha256(block).hexdigest()])
+        want = hmac.new(k, string_to_sign.encode(),
+                        hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want.encode(), trailer_sig):
+            raise S3AuthError("SignatureDoesNotMatch",
+                              "trailer signature mismatch")
 
 
 def _parse_auth_header(auth: str) -> dict:

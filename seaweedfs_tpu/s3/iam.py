@@ -5,10 +5,11 @@ iamapi_management_handlers.go: a form-encoded `Action=` REST endpoint
 (CreateUser / DeleteUser / GetUser / ListUsers / CreateAccessKey /
 DeleteAccessKey / PutUserPolicy / GetUserPolicy / DeleteUserPolicy)
 mutating the same identity config the S3 gateway authenticates against,
-persisted in the filer KV (the reference stores /etc/iam/identity.json in
-the filer and the S3 server hot-reloads it via metadata subscription; here
-the S3 server shares the IdentityAccessManagement object directly and the
-KV write is the durable copy).
+persisted in the filer KV AND as the filer entry /etc/iam/identity.json
+whose extended attrs carry the config — so every S3 gateway subscribed to
+the filer metadata stream hot-reloads identities without restart, exactly
+the reference's flow (s3api/auth_credentials_subscribe.go watching
+/etc/iam/identity.json).
 """
 
 from __future__ import annotations
@@ -24,6 +25,8 @@ from ..util.http import HttpServer, Request, Response
 from .auth import Identity, IdentityAccessManagement
 
 IAM_CONFIG_KEY = b"/etc/iam/identity.json"
+IAM_CONFIG_PATH = "/etc/iam/identity.json"
+IAM_CONFIG_ATTR = "iam.config"   # extended attr carrying the json config
 
 
 def _resp(action: str, body_fn=None) -> bytes:
@@ -75,10 +78,20 @@ class IamApiServer:
              "credentials": [{"accessKey": i.access_key,
                               "secretKey": i.secret_key}],
              "actions": i.actions} for i in self.iam.identities]}
+        payload = json.dumps(cfg)
         try:
-            POOL.client(self.filer_grpc, "SeaweedFiler").call(
-                "KvPut", {"key": to_b64(IAM_CONFIG_KEY),
-                          "value": to_b64(json.dumps(cfg).encode())})
+            client = POOL.client(self.filer_grpc, "SeaweedFiler")
+            client.call("KvPut", {"key": to_b64(IAM_CONFIG_KEY),
+                                  "value": to_b64(payload.encode())})
+            # ALSO write the config as a filer entry: its metadata event
+            # is what running S3 gateways subscribe to for hot-reload
+            import time as _time
+            now = _time.time()
+            client.call("CreateEntry", {"entry": {
+                "full_path": IAM_CONFIG_PATH,
+                "attr": {"mtime": now, "crtime": now, "mode": 0o600},
+                "chunks": [],
+                "extended": {IAM_CONFIG_ATTR: payload}}})
         except RpcError:
             pass
 

@@ -16,6 +16,8 @@ copied, just re-offset (filer_multipart.go:87-160).
 from __future__ import annotations
 
 import hashlib
+import json
+import threading
 import time
 import urllib.parse
 import uuid
@@ -63,11 +65,50 @@ class S3ApiServer:
         self.iam = iam or IdentityAccessManagement()
         self.http = HttpServer(host, port)
         self.http.route("*", "/", self._dispatch)
+        self._iam_stop = threading.Event()
 
     def start(self) -> None:
         self.http.start()
+        if self.filer_grpc:
+            threading.Thread(target=self._watch_iam_config, daemon=True,
+                             name="s3-iam-reload").start()
+
+    def _watch_iam_config(self) -> None:
+        """Hot-reload identities when /etc/iam/identity.json changes —
+        the reference's auth_credentials_subscribe.go flow: any IAM server
+        (even on another host) rotates credentials and every running S3
+        gateway picks them up from the filer metadata stream."""
+        from ..pb.rpc import POOL, RpcError
+        from .iam import IAM_CONFIG_ATTR, IAM_CONFIG_PATH
+        while not self._iam_stop.is_set():
+            try:
+                stream = POOL.client(self.filer_grpc, "SeaweedFiler") \
+                    .stream("SubscribeMetadata",
+                            iter([{"since_ns": 0,
+                                   "path_prefix": "/etc/iam"}]))
+                for msg in stream:
+                    if self._iam_stop.is_set():
+                        return
+                    new = msg.get("new_entry")
+                    if not new or new.get("full_path") != IAM_CONFIG_PATH:
+                        continue
+                    payload = new.get("extended", {}).get(IAM_CONFIG_ATTR)
+                    if not payload:
+                        continue
+                    try:
+                        cfg = json.loads(payload)
+                        self.iam.identities = IdentityAccessManagement \
+                            .from_config(cfg).identities
+                    except Exception:
+                        # one malformed payload must not kill the
+                        # subscription — later rotations still apply
+                        continue
+            except Exception:   # stream broke — reconnect, never die
+                if self._iam_stop.wait(0.5):
+                    return
 
     def stop(self) -> None:
+        self._iam_stop.set()
         self.http.stop()
 
     @property

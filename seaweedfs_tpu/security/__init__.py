@@ -27,13 +27,21 @@ def _unb64url(s: str) -> bytes:
     return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
 
 
-def gen_jwt(signing_key: str, expires_seconds: int, fid: str) -> str:
-    """GenJwt (security/jwt.go:34-50); empty key -> no token."""
+def gen_jwt(signing_key: str, expires_seconds: int, fid: str,
+            key_base: int = 0, key_count: int = 0) -> str:
+    """GenJwt (security/jwt.go:34-50); empty key -> no token.
+
+    key_base/key_count scope a batch-assign token to its needle-key range
+    (tighter than the reference's vid-wide batch tokens): Fid carries the
+    vid and the claims pin [key_base, key_base+key_count)."""
     if not signing_key:
         return ""
     header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"},
                                 separators=(",", ":")).encode())
     claims = {"Fid": fid}
+    if key_count > 0:
+        claims["KeyBase"] = key_base
+        claims["KeyCount"] = key_count
     if expires_seconds > 0:
         claims["exp"] = int(time.time()) + expires_seconds
     payload = _b64url(json.dumps(claims, separators=(",", ":")).encode())
@@ -66,12 +74,29 @@ def decode_jwt(signing_key: str, token: str) -> dict:
 
 
 def verify_fid_jwt(signing_key: str, token: str, fid: str) -> None:
-    """The volume-server write gate: token must be valid AND scoped to this
-    fid (or a whole-volume token, vid only)."""
+    """The volume-server write gate: token must be valid AND scoped to
+    this fid — exact match, or a vid token whose KeyBase/KeyCount claims
+    (batch assigns) cover the fid's needle key.  A bare vid token with no
+    key range is accepted for backward compatibility (the reference's
+    vid-wide tokens)."""
     claims = decode_jwt(signing_key, token)
     claimed = claims.get("Fid", "")
-    if claimed and claimed != fid and claimed != fid.split(",")[0]:
+    if not claimed or claimed == fid:
+        return
+    if claimed != fid.split(",")[0]:
         raise JwtError(f"token is for {claimed}, not {fid}")
+    count = int(claims.get("KeyCount") or 0)
+    if count > 0:
+        from ..storage.types import parse_needle_id_cookie
+        try:
+            key, _ = parse_needle_id_cookie(fid.split(",", 1)[1])
+        except Exception:
+            raise JwtError(f"unparseable fid {fid}") from None
+        base = int(claims.get("KeyBase") or 0)
+        if not base <= key < base + count:
+            raise JwtError(
+                f"token covers keys [{base}, {base + count}), "
+                f"not {key}")
 
 
 @dataclass

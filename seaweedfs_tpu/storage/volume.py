@@ -207,9 +207,11 @@ class Volume:
                             q.put(None)
                             break
                         batch.append(nxt)
+                    sizes: dict[int, int] = {}
                     for n, fut in batch:
                         try:
-                            size = self.write_needle(n, fsync=False)
+                            sizes[id(fut)] = self.write_needle(
+                                n, fsync=False)
                         except Exception as e:
                             fut.set_exception(e)
                             batch = [b for b in batch if b[1] is not fut]
@@ -225,7 +227,9 @@ class Volume:
                         continue
                     for (n, fut) in batch:
                         if not fut.done():
-                            fut.set_result(len(n.data))
+                            # report the same stored size write_needle
+                            # returns on the non-fsync path
+                            fut.set_result(sizes[id(fut)])
 
             t = threading.Thread(target=worker, daemon=True)
             t.start()

@@ -1,0 +1,184 @@
+"""Tiered chunk cache — memory LRU + disk tier, keyed by fid.
+
+Capability-equivalent to weed/util/chunk_cache/ (chunk_cache.go: memory
+cache + on-disk leveldb-backed tiers) as used by the filer read path
+(filer/reader_at.go) and the FUSE mount.  Chunks are immutable once
+written under a fid (the cookie changes on any rewrite), so entries never
+need invalidation — only capacity eviction.
+
+Differences from the reference, deliberate:
+- the disk tier is plain content files under a cache dir (no leveldb in
+  the image); an in-memory LRU index tracks access order and total bytes,
+  rebuilt by scanning the dir on startup — crash-safe because entries are
+  whole files written atomically via rename.
+- one size-classed policy instead of three leveldb tiers: chunks up to
+  mem_item_limit live in RAM; everything up to disk_item_limit also goes
+  to disk, so hot small chunks are RAM-fast while an 8MB autochunk still
+  avoids a volume-server round-trip.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+
+
+class MemChunkCache:
+    """Byte-bounded LRU of fid -> chunk bytes."""
+
+    def __init__(self, limit_bytes: int = 64 << 20,
+                 item_limit: int = 2 << 20):
+        self.limit = limit_bytes
+        self.item_limit = item_limit
+        self._data: OrderedDict[str, bytes] = OrderedDict()
+        self._size = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, fid: str) -> bytes | None:
+        with self._lock:
+            blob = self._data.get(fid)
+            if blob is None:
+                self.misses += 1
+                return None
+            self._data.move_to_end(fid)
+            self.hits += 1
+            return blob
+
+    def put(self, fid: str, data: bytes) -> None:
+        if len(data) > self.item_limit:
+            return
+        with self._lock:
+            old = self._data.pop(fid, None)
+            if old is not None:
+                self._size -= len(old)
+            self._data[fid] = data
+            self._size += len(data)
+            while self._size > self.limit and self._data:
+                _, evicted = self._data.popitem(last=False)
+                self._size -= len(evicted)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+            self._size = 0
+
+
+class DiskChunkCache:
+    """Byte-bounded LRU of fid -> file under cache_dir.
+
+    Files are written to a temp name then renamed, so a reader never sees
+    a torn entry; the LRU index is rebuilt from the dir on startup."""
+
+    def __init__(self, cache_dir: str, limit_bytes: int = 1 << 30,
+                 item_limit: int = 64 << 20):
+        self.dir = cache_dir
+        self.limit = limit_bytes
+        self.item_limit = item_limit
+        self._lock = threading.Lock()
+        self._index: OrderedDict[str, int] = OrderedDict()  # name -> size
+        self._size = 0
+        os.makedirs(cache_dir, exist_ok=True)
+        for name in sorted(os.listdir(cache_dir)):
+            if name.endswith(".tmp"):
+                os.remove(os.path.join(cache_dir, name))
+                continue
+            sz = os.path.getsize(os.path.join(cache_dir, name))
+            self._index[name] = sz
+            self._size += sz
+
+    @staticmethod
+    def _name(fid: str) -> str:
+        # fids contain ','; hash for a safe flat filename
+        return hashlib.sha1(fid.encode()).hexdigest()
+
+    def get(self, fid: str) -> bytes | None:
+        name = self._name(fid)
+        with self._lock:
+            if name not in self._index:
+                return None
+            self._index.move_to_end(name)
+        try:
+            with open(os.path.join(self.dir, name), "rb") as f:
+                return f.read()
+        except OSError:
+            with self._lock:
+                self._size -= self._index.pop(name, 0)
+            return None
+
+    def put(self, fid: str, data: bytes) -> None:
+        if len(data) > self.item_limit:
+            return
+        name = self._name(fid)
+        path = os.path.join(self.dir, name)
+        # unique tmp per write: concurrent puts of the same hot fid must
+        # not truncate each other's inode mid-write (torn reads)
+        import tempfile
+        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        with self._lock:
+            self._size -= self._index.pop(name, 0)
+            self._index[name] = len(data)
+            self._size += len(data)
+            while self._size > self.limit and self._index:
+                victim, sz = self._index.popitem(last=False)
+                self._size -= sz
+                try:
+                    os.remove(os.path.join(self.dir, victim))
+                except OSError:
+                    pass
+
+
+class TieredChunkCache:
+    """Mem tier in front of an optional disk tier (chunk_cache.go
+    onDiskCacheSizeLimit layering)."""
+
+    def __init__(self, mem_limit_bytes: int = 64 << 20,
+                 mem_item_limit: int = 8 << 20,
+                 cache_dir: str | None = None,
+                 disk_limit_bytes: int = 1 << 30,
+                 disk_item_limit: int = 64 << 20):
+        # mem_item_limit defaults to the filer autochunk size so a
+        # full-size chunk is cacheable without a disk tier
+        self.mem = MemChunkCache(mem_limit_bytes, mem_item_limit)
+        self.disk = DiskChunkCache(cache_dir, disk_limit_bytes,
+                                   disk_item_limit) if cache_dir else None
+
+    def get(self, fid: str) -> bytes | None:
+        blob = self.mem.get(fid)
+        if blob is not None:
+            return blob
+        if self.disk is not None:
+            blob = self.disk.get(fid)
+            if blob is not None:
+                self.mem.put(fid, blob)    # promote
+            return blob
+        return None
+
+    def put(self, fid: str, data: bytes) -> None:
+        """Best-effort: a cache write failure (ENOSPC on the cache dir)
+        must never fail the read that fetched the blob."""
+        self.mem.put(fid, data)
+        if self.disk is not None:
+            try:
+                self.disk.put(fid, data)
+            except OSError:
+                pass
+
+    @property
+    def stats(self) -> dict:
+        return {"mem_hits": self.mem.hits, "mem_misses": self.mem.misses,
+                "mem_bytes": self.mem._size,
+                "disk_bytes": self.disk._size if self.disk else 0}

@@ -54,6 +54,10 @@ def _maybe_resize_image(data: bytes, mime: str, width: str, height: str,
 
         from PIL import Image
         img = Image.open(io.BytesIO(data))
+        # decompression-bomb guard: a tiny stored blob can declare a huge
+        # pixel canvas; decoding it would exhaust server memory on GET
+        if img.width * img.height > 64_000_000:
+            return data, mime
         fmt = img.format or "PNG"
         w = int(width) if width else img.width
         h = int(height) if height else img.height

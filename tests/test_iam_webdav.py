@@ -131,3 +131,57 @@ def test_webdav_crud_propfind_move(stack):
                                 method="PROPFIND")
     assert status == 404
     dav.stop()
+
+
+def test_s3_identity_hot_reload(stack):
+    """VERDICT round-1 item 10 (reference
+    s3api/auth_credentials_subscribe.go): an S3 gateway that does NOT
+    share the IAM server's identity object picks up credential changes
+    live through the filer metadata subscription."""
+    from seaweedfs_tpu.s3 import S3ApiServer
+    from seaweedfs_tpu.s3.client import S3Client, S3ClientError
+    master, vs, filer = stack
+    # gateway with its OWN IdentityAccessManagement (no shared object)
+    s3 = S3ApiServer(filer.address, filer.grpc_address)
+    s3.start()
+    iam_srv = IamApiServer(IdentityAccessManagement(),
+                           filer.grpc_address)
+    iam_srv.start()
+    try:
+        # auth disabled: anonymous works
+        anon = S3Client(s3.address)
+        anon.create_bucket("open")
+        anon.put_object("open", "k", b"v")
+        # rotate identities THROUGH THE IAM API
+        status, _ = iam_call(iam_srv.address, "CreateUser",
+                             UserName="ops")
+        assert status == 200
+        status, root = iam_call(iam_srv.address, "CreateAccessKey",
+                                UserName="ops")
+        assert status == 200
+        ak = root.findtext(".//AccessKeyId")
+        sk = root.findtext(".//SecretAccessKey")
+        status, _ = iam_call(
+            iam_srv.address, "PutUserPolicy", UserName="ops",
+            PolicyName="all",
+            PolicyDocument='{"Statement":[{"Action":["s3:*"]}]}')
+        assert status == 200
+        # the RUNNING gateway honors the new identity without restart
+        deadline = time.time() + 5
+        ok = False
+        while time.time() < deadline and not ok:
+            try:
+                authed = S3Client(s3.address, ak, sk)
+                authed.put_object("open", "authed.txt", b"hot")
+                ok = True
+            except S3ClientError:
+                time.sleep(0.1)
+        assert ok, "gateway never picked up the rotated identity"
+        # and with auth now enabled, a bogus key is rejected
+        import pytest as _pytest
+        with _pytest.raises(S3ClientError):
+            S3Client(s3.address, "AKIDBOGUS", "nope").put_object(
+                "open", "x", b"y")
+    finally:
+        iam_srv.stop()
+        s3.stop()

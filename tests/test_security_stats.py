@@ -159,3 +159,80 @@ def test_metrics_endpoint(secured_cluster):
     text = body.decode()
     assert "seaweedfs_volume_request_total" in text
     assert "seaweedfs_volume_server_volumes" in text
+
+
+def test_jwt_batch_key_range_scope():
+    """ADVICE fix: a count>1 assign token covers only its assigned
+    needle-key range, not every fid in the volume."""
+    from seaweedfs_tpu.security import JwtError, gen_jwt, verify_fid_jwt
+    from seaweedfs_tpu.storage.types import format_needle_id_cookie
+    import pytest
+    key = "batchsecret"
+    tok = gen_jwt(key, 60, "7", key_base=100, key_count=5)
+    for k in range(100, 105):
+        verify_fid_jwt(key, tok,
+                       f"7,{format_needle_id_cookie(k, 0xdeadbeef)}")
+    for k in (99, 105, 1):
+        with pytest.raises(JwtError):
+            verify_fid_jwt(key, tok,
+                           f"7,{format_needle_id_cookie(k, 0xdeadbeef)}")
+    # wrong volume rejected outright
+    with pytest.raises(JwtError):
+        verify_fid_jwt(key, tok,
+                       f"8,{format_needle_id_cookie(101, 0xdeadbeef)}")
+    # bare vid tokens (no range) keep their reference-compatible meaning
+    vid_tok = gen_jwt(key, 60, "7")
+    verify_fid_jwt(key, vid_tok,
+                   f"7,{format_needle_id_cookie(999, 1)}")
+
+
+def test_trailer_checksum_validation():
+    """ADVICE fix: every x-amz-checksum-* trailer algorithm is verified;
+    unsupported declared algorithms are rejected, not ignored."""
+    import base64
+    import hashlib
+    import zlib
+    import pytest
+    from seaweedfs_tpu.s3.auth import S3AuthError, _check_trailers
+    from seaweedfs_tpu.storage.crc import crc32c
+    payload = b"trailer-checked payload"
+    good = {
+        "x-amz-checksum-crc32": base64.b64encode(
+            zlib.crc32(payload).to_bytes(4, "big")),
+        "x-amz-checksum-crc32c": base64.b64encode(
+            crc32c(payload).to_bytes(4, "big")),
+        "x-amz-checksum-sha1": base64.b64encode(
+            hashlib.sha1(payload).digest()),
+        "x-amz-checksum-sha256": base64.b64encode(
+            hashlib.sha256(payload).digest()),
+    }
+    for name, want in good.items():
+        _check_trailers(name.encode() + b":" + want + b"\r\n", payload)
+        with pytest.raises(S3AuthError):  # corrupted payload detected
+            _check_trailers(name.encode() + b":" + want + b"\r\n",
+                            payload + b"X")
+    with pytest.raises(S3AuthError):      # unknown algorithm -> 400
+        _check_trailers(b"x-amz-checksum-crc64nvme:AAAA\r\n", payload)
+
+
+def test_signed_trailer_signature_verified():
+    """A STREAMING-*-TRAILER upload with a tampered trailer signature is
+    rejected when the signing context is present."""
+    import hashlib
+    import hmac as _hmac
+    import pytest
+    from seaweedfs_tpu.s3.auth import S3AuthError, _check_trailers
+    payload = b"abc"
+    k, scope, amz_date, prev = (b"k" * 32, "d/r/s3/aws4_request",
+                                "20260730T000000Z", "ff" * 32)
+    block = b"x-amz-meta-note:hi\n"
+    sts = "\n".join(["AWS4-HMAC-SHA256-TRAILER", amz_date, scope, prev,
+                     hashlib.sha256(block).hexdigest()])
+    sig = _hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+    raw = (b"x-amz-meta-note:hi\r\nx-amz-trailer-signature:"
+           + sig.encode() + b"\r\n")
+    _check_trailers(raw, payload, verify_ctx=(k, scope, amz_date, prev))
+    bad = raw.replace(sig.encode()[:4], b"0000")
+    with pytest.raises(S3AuthError):
+        _check_trailers(bad, payload,
+                        verify_ctx=(k, scope, amz_date, prev))
