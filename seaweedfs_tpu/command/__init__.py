@@ -315,6 +315,115 @@ def cmd_filer_sync(args) -> int:
     return 0
 
 
+def cmd_master_follower(args) -> int:
+    """Read-only master follower (command/master_follower.go): serves
+    lookups from a KeepConnected-fed vid cache, proxies writes."""
+    from ..master import MasterServer
+    m = MasterServer(host=args.ip, port=args.port,
+                     grpc_port=args.grpc_port, follow=args.masters)
+    m.start()
+    print(f"master.follower http {m.address} grpc {m.grpc_address} "
+          f"following {args.masters}")
+    _wait_forever()
+    m.stop()
+    return 0
+
+
+def cmd_filer_meta_backup(args) -> int:
+    """Continuous filer metadata backup (command/filer_meta_backup.go):
+    subscribe to the metadata stream and append every event to a JSONL
+    file; -restore replays a backup into the filer."""
+    from ..pb import ServerAddress
+    from ..pb.rpc import POOL, RpcError
+    addr = ServerAddress.parse(args.filer)
+    client = POOL.client(addr.grpc, "SeaweedFiler")
+    if args.restore:
+        n = 0
+        with open(args.o) as f:
+            for line in f:
+                ev = json.loads(line)
+                entry = ev.get("new_entry")
+                if entry:
+                    client.call("CreateEntry", {"entry": entry})
+                    n += 1
+                elif ev.get("old_entry"):
+                    old = ev["old_entry"]
+                    d, _, name = old["full_path"].rpartition("/")
+                    try:
+                        client.call("DeleteEntry", {
+                            "directory": d or "/", "name": name,
+                            "is_recursive": True,
+                            "ignore_recursive_error": True})
+                    except RpcError:
+                        pass
+        print(f"restored {n} entries from {args.o}")
+        return 0
+    since = 0
+    if os.path.exists(args.o):
+        with open(args.o) as f:
+            for line in f:
+                try:
+                    since = max(since, json.loads(line).get("ts_ns", 0))
+                except ValueError:
+                    pass
+    print(f"backing up {addr.grpc} metadata (prefix {args.path}) "
+          f"to {args.o} since_ns={since}")
+    try:
+        with open(args.o, "a") as f:
+            for msg in client.stream(
+                    "SubscribeMetadata",
+                    iter([{"since_ns": since,
+                           "path_prefix": args.path}])):
+                if "ping" in msg:
+                    f.flush()
+                    continue
+                f.write(json.dumps(msg, separators=(",", ":")) + "\n")
+                f.flush()
+    except (KeyboardInterrupt, RpcError):
+        pass    # filer went away / operator interrupt: exit cleanly
+    return 0
+
+
+def cmd_filer_remote_sync(args) -> int:
+    """Continuously push local changes under remote mounts back to their
+    remotes (command/filer_remote_sync.go; the -gateway variant of the
+    reference maps to the same push loop over /buckets mounts)."""
+    import time as _time
+
+    from ..pb import ServerAddress
+    from ..shell.command_remote import load_remote_mounts
+    addr = ServerAddress.parse(args.filer)
+
+    print(f"filer.remote.sync watching {args.dir or 'all mounts'} "
+          f"every {args.interval}s")
+    try:
+        while True:
+            for mount in load_remote_mounts(addr.grpc, args.master,
+                                            only_dir=args.dir):
+                try:
+                    pushed = mount.sync_to_remote()
+                    if pushed:
+                        print(f"pushed {pushed} objects from "
+                              f"{mount.mount_dir}")
+                except Exception as e:
+                    print(f"sync {mount.mount_dir} failed: {e}")
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_mount(args) -> int:
+    """FUSE-mount the filer namespace (weed mount, command/mount.go) via
+    the ctypes libfuse2 adapter."""
+    from ..mount.fuse_adapter import mount_and_serve
+    from ..pb import ServerAddress
+    addr = ServerAddress.parse(args.filer)
+    print(f"mounting {addr.grpc} at {args.dir} (ctrl-c to unmount)")
+    return mount_and_serve(addr.grpc, args.master, args.dir,
+                           foreground=True)
+
+
 def cmd_scaffold(args) -> int:
     """Print sample configs (command/scaffold.go)."""
     samples = {
@@ -480,6 +589,42 @@ def build_parser() -> argparse.ArgumentParser:
                        default="127.0.0.1:19333")
     fsync.add_argument("-path", default="/")
     fsync.set_defaults(fn=cmd_filer_sync)
+
+    mf = sub.add_parser("master.follower",
+                        help="read-only master follower "
+                             "(lookup offload)")
+    mf.add_argument("-ip", default="127.0.0.1")
+    mf.add_argument("-port", type=int, default=9433)
+    mf.add_argument("-grpc_port", type=int, default=0)
+    mf.add_argument("-masters", default="127.0.0.1:19333",
+                    help="comma-separated master gRPC addresses")
+    mf.set_defaults(fn=cmd_master_follower)
+
+    mb = sub.add_parser("filer.meta.backup",
+                        help="continuous filer metadata backup "
+                             "(JSONL; -restore replays)")
+    mb.add_argument("-filer", default="127.0.0.1:8888.18888")
+    mb.add_argument("-o", default="filer_meta_backup.jsonl")
+    mb.add_argument("-path", default="/")
+    mb.add_argument("-restore", action="store_true")
+    mb.set_defaults(fn=cmd_filer_meta_backup)
+
+    rs = sub.add_parser("filer.remote.sync",
+                        help="push local changes under remote mounts "
+                             "to the cloud")
+    rs.add_argument("-filer", default="127.0.0.1:8888.18888")
+    rs.add_argument("-master", default="127.0.0.1:19333")
+    rs.add_argument("-dir", default="",
+                    help="one mount dir (default: all mounts)")
+    rs.add_argument("-interval", type=float, default=5.0)
+    rs.set_defaults(fn=cmd_filer_remote_sync)
+
+    mnt = sub.add_parser("mount",
+                         help="FUSE-mount the filer namespace")
+    mnt.add_argument("-filer", default="127.0.0.1:8888.18888")
+    mnt.add_argument("-master", default="127.0.0.1:19333")
+    mnt.add_argument("-dir", required=True)
+    mnt.set_defaults(fn=cmd_mount)
 
     sc = sub.add_parser("scaffold", help="print sample configs")
     sc.add_argument("-config", default="")

@@ -64,6 +64,7 @@ class MasterServer:
                  auto_vacuum_interval: float = 0.0,
                  raft_dir: str | None = None,
                  election_timeout: float = 0.4,
+                 follow: str = "",
                  seed: int | None = None):
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024, seed=seed)
@@ -74,7 +75,14 @@ class MasterServer:
         self.jwt_expires_seconds = jwt_expires_seconds
         from ..stats import ServerMetrics
         self.metrics = ServerMetrics()
-        self.is_leader = not peers   # multi-master: raft elects
+        # `follow` makes this a read-only follower of an EXISTING cluster
+        # (weed master.follower, command/master_follower.go): it serves
+        # lookups from a KeepConnected-fed vid cache and proxies writes —
+        # no raft membership, no heartbeat ingestion.
+        self._follow = follow
+        self._follower_client = None
+        self._follow_leader_cache: "tuple[str, float] | None" = None
+        self.is_leader = not peers and not follow
         self.ha = None
         self._peers = peers or []
         self._raft_dir = raft_dir
@@ -109,6 +117,13 @@ class MasterServer:
     def start(self) -> None:
         self.http.start()
         self.rpc.start()
+        if self._follow:
+            from ..wdclient import MasterClient, resolve_leader
+            self._follower_client = MasterClient(
+                resolve_leader(self._follow),
+                client_name=self.grpc_address,
+                client_type="master_follower")
+            self._follower_client.start()
         if self._peers:
             from .ha import HaCoordinator, RaftSequencer
             self.ha = HaCoordinator(
@@ -135,6 +150,8 @@ class MasterServer:
 
     def stop(self) -> None:
         self._stop_vacuum.set()
+        if self._follower_client is not None:
+            self._follower_client.stop()
         if self.ha:
             self.ha.stop()
         self.http.stop()
@@ -142,6 +159,17 @@ class MasterServer:
 
     @property
     def leader_grpc(self) -> str:
+        if self._follow:
+            # cache the resolved leader briefly — a resolve RPC per
+            # proxied request would double every write's latency
+            now = time.time()
+            cached = self._follow_leader_cache
+            if cached and now - cached[1] < 5.0:
+                return cached[0]
+            from ..wdclient import resolve_leader
+            leader = resolve_leader(self._follow)
+            self._follow_leader_cache = (leader, now)
+            return leader
         return self.ha.leader_address() if self.ha else self.grpc_address
 
     # -- fault injection (SimCluster partition_master) ----------------------
@@ -261,6 +289,10 @@ class MasterServer:
 
     # -- lookup -------------------------------------------------------------
     def lookup(self, vid: int, collection: str = "") -> list[dict]:
+        if self._follower_client is not None:
+            # follower answers from its KeepConnected-fed cache — the
+            # whole point of master.follower: lookup traffic offload
+            return self._follower_client.lookup(vid)
         locs = self.topo.lookup(collection, vid)
         if not locs:
             # EC volumes are located by shard
@@ -466,7 +498,9 @@ class MasterServer:
 
     def _rpc_lookup_volume(self, req: dict) -> dict:
         self._check_partition()
-        if not self.is_leader and self.leader_grpc != self._self_grpc():
+        if self._follower_client is None \
+                and not self.is_leader \
+                and self.leader_grpc != self._self_grpc():
             # followers have no heartbeat-fed topology; ask the leader
             return POOL.client(self.leader_grpc, "Seaweed").call(
                 "LookupVolume", req)

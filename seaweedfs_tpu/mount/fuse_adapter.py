@@ -1,0 +1,336 @@
+"""FUSE kernel adapter — ctypes binding to libfuse.so.2, no fusepy.
+
+Closes the round-1 gap "FUSE ops layer without kernel adapter": the
+path-based WeedFS ops layer (weedfs.py, the analogue of weed/mount) is
+wired to the kernel through libfuse 2's high-level API
+(`fuse_main_real`), so `python -m seaweedfs_tpu mount -dir /mnt/x`
+is a real mount(2) like the reference's `weed mount` (command/mount.go,
+go-fuse).  x86_64 struct layouts; the fuse_operations table is the
+FUSE_USE_VERSION 26 prefix (libfuse copies min(op_size, sizeof) bytes,
+so trailing members we never use may be omitted).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import errno
+import os
+import threading
+from ctypes import (CFUNCTYPE, POINTER, Structure, c_byte, c_char_p,
+                    c_int, c_long, c_size_t, c_uint, c_uint64, c_ulong,
+                    c_void_p, memmove)
+
+from .weedfs import FuseError, WeedFS
+
+S_IFDIR = 0o40000
+S_IFREG = 0o100000
+
+c_off_t = c_long
+c_mode_t = c_uint
+c_dev_t = c_ulong
+c_uid_t = c_uint
+c_gid_t = c_uint
+
+
+class c_timespec(Structure):
+    _fields_ = [("tv_sec", c_long), ("tv_nsec", c_long)]
+
+
+class c_stat(Structure):
+    # x86_64 glibc struct stat
+    _fields_ = [
+        ("st_dev", c_ulong), ("st_ino", c_ulong), ("st_nlink", c_ulong),
+        ("st_mode", c_uint), ("st_uid", c_uint), ("st_gid", c_uint),
+        ("__pad0", c_uint), ("st_rdev", c_ulong), ("st_size", c_long),
+        ("st_blksize", c_long), ("st_blocks", c_long),
+        ("st_atim", c_timespec), ("st_mtim", c_timespec),
+        ("st_ctim", c_timespec), ("__reserved", c_long * 3)]
+
+
+class fuse_file_info(Structure):
+    _fields_ = [
+        ("flags", c_int), ("fh_old", c_ulong), ("writepage", c_int),
+        ("direct_io", c_uint, 1), ("keep_cache", c_uint, 1),
+        ("flush", c_uint, 1), ("nonseekable", c_uint, 1),
+        ("flock_release", c_uint, 1), ("padding", c_uint, 27),
+        ("fh", c_uint64), ("lock_owner", c_uint64)]
+
+
+fuse_fill_dir_t = CFUNCTYPE(c_int, c_void_p, c_char_p, POINTER(c_stat),
+                            c_off_t)
+
+_getattr_t = CFUNCTYPE(c_int, c_char_p, POINTER(c_stat))
+_readlink_t = CFUNCTYPE(c_int, c_char_p, c_char_p, c_size_t)
+_mknod_t = CFUNCTYPE(c_int, c_char_p, c_mode_t, c_dev_t)
+_mkdir_t = CFUNCTYPE(c_int, c_char_p, c_mode_t)
+_path_t = CFUNCTYPE(c_int, c_char_p)
+_path2_t = CFUNCTYPE(c_int, c_char_p, c_char_p)
+_chmod_t = CFUNCTYPE(c_int, c_char_p, c_mode_t)
+_chown_t = CFUNCTYPE(c_int, c_char_p, c_uid_t, c_gid_t)
+_truncate_t = CFUNCTYPE(c_int, c_char_p, c_off_t)
+_open_t = CFUNCTYPE(c_int, c_char_p, POINTER(fuse_file_info))
+_read_t = CFUNCTYPE(c_int, c_char_p, POINTER(c_byte), c_size_t, c_off_t,
+                    POINTER(fuse_file_info))
+_write_t = CFUNCTYPE(c_int, c_char_p, POINTER(c_byte), c_size_t,
+                     c_off_t, POINTER(fuse_file_info))
+_fsync_t = CFUNCTYPE(c_int, c_char_p, c_int, POINTER(fuse_file_info))
+_readdir_t = CFUNCTYPE(c_int, c_char_p, c_void_p, fuse_fill_dir_t,
+                       c_off_t, POINTER(fuse_file_info))
+_access_t = CFUNCTYPE(c_int, c_char_p, c_int)
+_create_t = CFUNCTYPE(c_int, c_char_p, c_mode_t,
+                      POINTER(fuse_file_info))
+_ftruncate_t = CFUNCTYPE(c_int, c_char_p, c_off_t,
+                         POINTER(fuse_file_info))
+_fgetattr_t = CFUNCTYPE(c_int, c_char_p, POINTER(c_stat),
+                        POINTER(fuse_file_info))
+_utimens_t = CFUNCTYPE(c_int, c_char_p, POINTER(c_timespec * 2))
+
+
+class fuse_operations(Structure):
+    # FUSE_USE_VERSION 26 layout prefix (through utimens/bmap + the flag
+    # bitfield word); fuse_main copies op_size bytes, trailing ops unused
+    _fields_ = [
+        ("getattr", _getattr_t), ("readlink", _readlink_t),
+        ("getdir", c_void_p), ("mknod", _mknod_t), ("mkdir", _mkdir_t),
+        ("unlink", _path_t), ("rmdir", _path_t),
+        ("symlink", _path2_t), ("rename", _path2_t),
+        ("link", _path2_t), ("chmod", _chmod_t), ("chown", _chown_t),
+        ("truncate", _truncate_t), ("utime", c_void_p),
+        ("open", _open_t), ("read", _read_t), ("write", _write_t),
+        ("statfs", c_void_p), ("flush", _open_t), ("release", _open_t),
+        ("fsync", _fsync_t),
+        ("setxattr", c_void_p), ("getxattr", c_void_p),
+        ("listxattr", c_void_p), ("removexattr", c_void_p),
+        ("opendir", _open_t), ("readdir", _readdir_t),
+        ("releasedir", _open_t), ("fsyncdir", _fsync_t),
+        ("init", c_void_p), ("destroy", c_void_p),
+        ("access", _access_t), ("create", _create_t),
+        ("ftruncate", _ftruncate_t), ("fgetattr", _fgetattr_t),
+        ("lock", c_void_p), ("utimens", _utimens_t),
+        ("bmap", c_void_p), ("flags", c_uint), ("ioctl", c_void_p)]
+
+
+def _load_libfuse():
+    name = ctypes.util.find_library("fuse")
+    if not name:
+        raise OSError("libfuse not found on this system")
+    return ctypes.CDLL(name)
+
+
+class FuseMount:
+    """One kernel mount of a WeedFS ops layer."""
+
+    def __init__(self, fs: WeedFS, mountpoint: str):
+        self.fs = fs
+        self.mountpoint = os.path.abspath(mountpoint)
+        self._libfuse = _load_libfuse()
+        self._ops = self._build_ops()
+
+    # -- op plumbing --------------------------------------------------------
+    def _guard(self, fn):
+        """Wrap an op: FuseError/OSError -> -errno, unexpected -> -EIO
+        (logged — a silent EIO is undebuggable)."""
+        def wrapper(*args):
+            try:
+                return fn(*args) or 0
+            except FuseError as e:
+                return -e.errno
+            except OSError as e:
+                return -(e.errno or errno.EIO)
+            except Exception:
+                from ..util.weedlog import logger
+                logger(__name__).exception("fuse op %s failed",
+                                           fn.__name__)
+                return -errno.EIO
+        return wrapper
+
+    def _fill_stat(self, st, attrs: dict) -> None:
+        memmove(st, b"\0" * ctypes.sizeof(c_stat), ctypes.sizeof(c_stat))
+        mode = attrs["mode"]
+        if attrs.get("is_dir"):
+            st.contents.st_mode = S_IFDIR | (mode & 0o7777)
+            st.contents.st_nlink = 2
+        else:
+            st.contents.st_mode = S_IFREG | (mode & 0o7777)
+            st.contents.st_nlink = 1
+        st.contents.st_size = attrs.get("size", 0)
+        st.contents.st_ino = attrs.get("inode", 0)
+        st.contents.st_uid = os.getuid()
+        st.contents.st_gid = os.getgid()
+        mtime = attrs.get("mtime", 0)
+        for field in ("st_atim", "st_mtim", "st_ctim"):
+            ts = getattr(st.contents, field)
+            ts.tv_sec = int(mtime)
+            ts.tv_nsec = int((mtime % 1) * 1e9)
+
+    def _build_ops(self) -> fuse_operations:
+        fs = self.fs
+
+        def op_getattr(path, st):
+            self._fill_stat(st, fs.getattr(path.decode()))
+
+        def op_readdir(path, buf, filler, offset, fi):
+            filler(buf, b".", None, 0)
+            filler(buf, b"..", None, 0)
+            for name in fs.readdir(path.decode()):
+                filler(buf, name.encode(), None, 0)
+
+        def op_mkdir(path, mode):
+            fs.mkdir(path.decode(), mode & 0o7777)
+
+        def op_unlink(path):
+            fs.unlink(path.decode())
+
+        def op_rmdir(path):
+            fs.rmdir(path.decode())
+
+        def op_rename(old, new):
+            fs.rename(old.decode(), new.decode())
+
+        def op_link(src, dst):
+            fs.link(src.decode(), dst.decode())
+
+        def op_chmod(path, mode):
+            fs.chmod(path.decode(), mode)
+
+        def op_chown(path, uid, gid):
+            return 0            # single-user mount; ownership is cosmetic
+
+        def op_truncate(path, size):
+            fs.truncate(path.decode(), size)
+
+        def op_ftruncate(path, size, fi):
+            fs.truncate(path.decode(), size)
+
+        def op_open(path, fi):
+            fs.lookup(path.decode())
+
+        def op_create(path, mode, fi):
+            fs.create(path.decode(), mode & 0o7777)
+
+        def op_read(path, buf, size, offset, fi):
+            data = fs.read(path.decode(), offset, size)
+            memmove(buf, data, len(data))
+            return len(data)
+
+        def op_write(path, buf, size, offset, fi):
+            data = ctypes.string_at(buf, size)
+            return fs.write(path.decode(), offset, data)
+
+        def op_flush(path, fi):
+            fs.flush(path.decode())
+
+        def op_release(path, fi):
+            fs.flush(path.decode())
+
+        def op_fsync(path, datasync, fi):
+            fs.flush(path.decode())
+
+        def op_access(path, amode):
+            fs.lookup(path.decode())
+
+        UTIME_NOW = (1 << 30) - 1
+        UTIME_OMIT = (1 << 30) - 2
+
+        def op_utimens(path, times):
+            mtime = None                 # None -> "now"
+            if times:
+                spec = times.contents[1]     # [atime, mtime]
+                if spec.tv_nsec == UTIME_OMIT:
+                    return 0             # atime-only touch: keep mtime
+                if spec.tv_nsec != UTIME_NOW:
+                    mtime = spec.tv_sec + spec.tv_nsec / 1e9
+            fs.utimens(path.decode(), mtime)
+
+        def op_fgetattr(path, st, fi):
+            self._fill_stat(st, fs.getattr(path.decode()))
+
+        ops = fuse_operations()
+        ops.getattr = _getattr_t(self._guard(op_getattr))
+        ops.readdir = _readdir_t(self._guard(op_readdir))
+        ops.mkdir = _mkdir_t(self._guard(op_mkdir))
+        ops.unlink = _path_t(self._guard(op_unlink))
+        ops.rmdir = _path_t(self._guard(op_rmdir))
+        ops.rename = _path2_t(self._guard(op_rename))
+        ops.link = _path2_t(self._guard(op_link))
+        ops.chmod = _chmod_t(self._guard(op_chmod))
+        ops.chown = _chown_t(self._guard(op_chown))
+        ops.truncate = _truncate_t(self._guard(op_truncate))
+        ops.ftruncate = _ftruncate_t(self._guard(op_ftruncate))
+        ops.open = _open_t(self._guard(op_open))
+        ops.create = _create_t(self._guard(op_create))
+        ops.read = _read_t(self._guard(op_read))
+        ops.write = _write_t(self._guard(op_write))
+        ops.flush = _open_t(self._guard(op_flush))
+        ops.release = _open_t(self._guard(op_release))
+        ops.fsync = _fsync_t(self._guard(op_fsync))
+        ops.access = _access_t(self._guard(op_access))
+        ops.utimens = _utimens_t(self._guard(op_utimens))
+        ops.fgetattr = _fgetattr_t(self._guard(op_fgetattr))
+        return ops
+
+    # -- lifecycle ----------------------------------------------------------
+    def serve(self, foreground: bool = True) -> int:
+        """Run fuse_main (blocks until unmounted).  -s: WeedFS ops are
+        already thread-safe but single-threaded keeps the ctypes
+        callbacks off libfuse's worker pool."""
+        os.makedirs(self.mountpoint, exist_ok=True)
+        args = [b"seaweedfs-tpu", self.mountpoint.encode(), b"-f", b"-s",
+                b"-o", b"default_permissions"]
+        argv = (c_char_p * len(args))(*args)
+        return self._libfuse.fuse_main_real(
+            len(args), argv, ctypes.byref(self._ops),
+            ctypes.sizeof(self._ops), None)
+
+    def unmount(self) -> None:
+        import subprocess
+        for cmd in (["fusermount", "-u", self.mountpoint],
+                    ["umount", self.mountpoint]):
+            try:
+                if subprocess.run(cmd, capture_output=True).returncode \
+                        == 0:
+                    return
+            except FileNotFoundError:
+                continue
+
+
+def mount_and_serve(filer_grpc: str, master_grpc: str, mountpoint: str,
+                    foreground: bool = True) -> int:
+    """`weed mount` equivalent: build the ops layer, serve until
+    unmounted."""
+    fs = WeedFS(filer_grpc, master_grpc)
+    fs.start()
+    try:
+        return FuseMount(fs, mountpoint).serve(foreground=foreground)
+    finally:
+        fs.stop()
+
+
+class BackgroundMount:
+    """Test/embedding helper: serve the mount in a daemon thread, wait
+    for the kernel mount to appear, fusermount -u on stop."""
+
+    def __init__(self, fs: WeedFS, mountpoint: str):
+        self.mount = FuseMount(fs, mountpoint)
+        self._thread: threading.Thread | None = None
+
+    def start(self, timeout: float = 5.0) -> bool:
+        self._thread = threading.Thread(target=self.mount.serve,
+                                        daemon=True)
+        self._thread.start()
+        import time
+        deadline = time.time() + timeout
+        mp = self.mount.mountpoint
+        while time.time() < deadline:
+            if os.path.ismount(mp):
+                return True
+            if not self._thread.is_alive():
+                return False
+            time.sleep(0.05)
+        return os.path.ismount(mp)
+
+    def stop(self) -> None:
+        self.mount.unmount()
+        if self._thread:
+            self._thread.join(timeout=3.0)

@@ -275,3 +275,49 @@ class WeedFS:
             blob = operation.read_file(self.master_grpc, fid)
             self._chunk_cache.put(fid, blob)
         return blob
+
+    def truncate(self, path: str, size: int) -> None:
+        """ftruncate(2): size 0 drops every chunk; a shorter size keeps
+        the surviving prefix as one rewritten chunk (weedfs_attr.go
+        setattr truncate path)."""
+        if path in self._open_writers:
+            self.flush(path)
+        entry = dict(self.lookup(path))
+        chunks = [FileChunk.from_dict(c) for c in entry.get("chunks", [])]
+        current = total_size(chunks)
+        if size == current:
+            return
+        if size == 0:
+            entry["chunks"] = []
+        elif size < current:
+            # rewrite the kept prefix chunk-by-chunk — bounded memory and
+            # the same chunk_size invariant as the write path
+            new_chunks = []
+            for off in range(0, size, self.chunk_size):
+                piece = self.read(path, off,
+                                  min(self.chunk_size, size - off))
+                new_chunks.append(self._upload_chunk(piece, off))
+            entry["chunks"] = new_chunks
+        else:   # extend: one zero byte at the end records the new size;
+                # read() zero-fills the sparse gap between chunks
+            entry["chunks"] = list(entry.get("chunks", [])) + [
+                self._upload_chunk(b"\0", size - 1)]
+        entry["attr"] = dict(entry["attr"], mtime=time.time())
+        self._filer().call("CreateEntry", {"entry": entry})
+        self.meta.upsert(entry)
+
+    def chmod(self, path: str, mode: int) -> None:
+        entry = dict(self.lookup(path))
+        old_mode = entry["attr"].get("mode", 0o660)
+        entry["attr"] = dict(entry["attr"],
+                             mode=(old_mode & ~0o7777) | (mode & 0o7777))
+        self._filer().call("UpdateEntry", {"entry": entry})
+        self.meta.upsert(entry)
+
+    def utimens(self, path: str, mtime: "float | None" = None) -> None:
+        entry = dict(self.lookup(path))
+        entry["attr"] = dict(entry["attr"],
+                             mtime=mtime if mtime is not None
+                             else time.time())
+        self._filer().call("UpdateEntry", {"entry": entry})
+        self.meta.upsert(entry)

@@ -29,6 +29,36 @@ IAM_CONFIG_PATH = "/etc/iam/identity.json"
 IAM_CONFIG_ATTR = "iam.config"   # extended attr carrying the json config
 
 
+def persist_identity_config(filer_grpc: str, cfg: dict) -> None:
+    """THE single write path for the identity config: filer KV (durable
+    copy) + the /etc/iam/identity.json entry whose metadata event makes
+    every subscribed S3 gateway hot-reload.  Used by the IAM API and the
+    shell's s3.configure — one contract, no hand-synced copies."""
+    import time as _time
+    payload = json.dumps(cfg)
+    client = POOL.client(filer_grpc, "SeaweedFiler")
+    client.call("KvPut", {"key": to_b64(IAM_CONFIG_KEY),
+                          "value": to_b64(payload.encode())})
+    now = _time.time()
+    client.call("CreateEntry", {"entry": {
+        "full_path": IAM_CONFIG_PATH,
+        "attr": {"mtime": now, "crtime": now, "mode": 0o600},
+        "chunks": [],
+        "extended": {IAM_CONFIG_ATTR: payload}}})
+
+
+def load_identity_config(filer_grpc: str) -> dict:
+    """Read the durable KV copy; {} when unset."""
+    try:
+        out = POOL.client(filer_grpc, "SeaweedFiler").call(
+            "KvGet", {"key": to_b64(IAM_CONFIG_KEY)})
+        if out.get("value"):
+            return json.loads(from_b64(out["value"]))
+    except (RpcError, ValueError):
+        pass
+    return {}
+
+
 def _resp(action: str, body_fn=None) -> bytes:
     root = ET.Element(f"{action}Response")
     if body_fn is not None:
@@ -78,20 +108,8 @@ class IamApiServer:
              "credentials": [{"accessKey": i.access_key,
                               "secretKey": i.secret_key}],
              "actions": i.actions} for i in self.iam.identities]}
-        payload = json.dumps(cfg)
         try:
-            client = POOL.client(self.filer_grpc, "SeaweedFiler")
-            client.call("KvPut", {"key": to_b64(IAM_CONFIG_KEY),
-                                  "value": to_b64(payload.encode())})
-            # ALSO write the config as a filer entry: its metadata event
-            # is what running S3 gateways subscribe to for hot-reload
-            import time as _time
-            now = _time.time()
-            client.call("CreateEntry", {"entry": {
-                "full_path": IAM_CONFIG_PATH,
-                "attr": {"mtime": now, "crtime": now, "mode": 0o600},
-                "chunks": [],
-                "extended": {IAM_CONFIG_ATTR: payload}}})
+            persist_identity_config(self.filer_grpc, cfg)
         except RpcError:
             pass
 

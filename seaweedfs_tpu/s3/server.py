@@ -66,6 +66,7 @@ class S3ApiServer:
         self.http = HttpServer(host, port)
         self.http.route("*", "/", self._dispatch)
         self._iam_stop = threading.Event()
+        self._quota_cache: dict[str, tuple[bool, float]] = {}
 
     def start(self) -> None:
         self.http.start()
@@ -80,15 +81,19 @@ class S3ApiServer:
         gateway picks them up from the filer metadata stream."""
         from ..pb.rpc import POOL, RpcError
         from .iam import IAM_CONFIG_ATTR, IAM_CONFIG_PATH
+        since_ns = 0    # resume point: reconnects must not replay the
+        #                 full history (stale configs could briefly
+        #                 resurrect revoked credentials)
         while not self._iam_stop.is_set():
             try:
                 stream = POOL.client(self.filer_grpc, "SeaweedFiler") \
                     .stream("SubscribeMetadata",
-                            iter([{"since_ns": 0,
+                            iter([{"since_ns": since_ns,
                                    "path_prefix": "/etc/iam"}]))
                 for msg in stream:
                     if self._iam_stop.is_set():
                         return
+                    since_ns = max(since_ns, msg.get("ts_ns") or 0)
                     new = msg.get("new_entry")
                     if not new or new.get("full_path") != IAM_CONFIG_PATH:
                         continue
@@ -267,7 +272,30 @@ class S3ApiServer:
         return (f"http://{self.filer_http}{BUCKETS_PATH}/"
                 + urllib.parse.quote(f"{bucket}/{key}"))
 
+    def _quota_exceeded(self, bucket: str) -> bool:
+        """Bucket write gate set by `s3.bucket.quota.check`
+        (command_s3_bucket_quota_check.go marks over-quota buckets
+        read-only).  Cached briefly — one filer lookup per bucket per
+        few seconds, not per PUT."""
+        now = time.time()
+        cached = self._quota_cache.get(bucket)
+        if cached and now - cached[1] < 3.0:
+            return cached[0]
+        exceeded = False
+        try:
+            entry = self._filer().call("LookupDirectoryEntry", {
+                "directory": BUCKETS_PATH, "name": bucket})["entry"]
+            exceeded = entry.get("extended", {}) \
+                .get("quota.exceeded") == "1"
+        except RpcError:
+            pass
+        self._quota_cache[bucket] = (exceeded, now)
+        return exceeded
+
     def _put_object(self, bucket: str, key: str, req: Request) -> Response:
+        denied = self._quota_response(bucket)
+        if denied:
+            return denied
         headers = {}
         if req.headers.get("Content-Type"):
             headers["Content-Type"] = req.headers["Content-Type"]
@@ -308,6 +336,9 @@ class S3ApiServer:
         return Response(204, b"")
 
     def _copy_object(self, bucket: str, key: str, req: Request) -> Response:
+        denied = self._quota_response(bucket)
+        if denied:
+            return denied
         src = urllib.parse.unquote(req.headers["X-Amz-Copy-Source"])
         src = src.lstrip("/")
         status, body, _ = http_request(
@@ -425,7 +456,17 @@ class S3ApiServer:
     def _uploads_dir(self, bucket: str, upload_id: str) -> str:
         return f"{BUCKETS_PATH}/{bucket}/{UPLOADS_DIR}/{upload_id}"
 
+    def _quota_response(self, bucket: str) -> "Response | None":
+        if self._quota_exceeded(bucket):
+            return Response(403, _error_xml(
+                "QuotaExceeded", f"bucket {bucket} is over quota"),
+                content_type="application/xml")
+        return None
+
     def _initiate_multipart(self, bucket: str, key: str) -> Response:
+        denied = self._quota_response(bucket)
+        if denied:
+            return denied
         upload_id = uuid.uuid4().hex
         self._filer().call("CreateEntry", {"entry": {
             "full_path": self._uploads_dir(bucket, upload_id),
@@ -439,6 +480,9 @@ class S3ApiServer:
         return Response(200, _xml(root), content_type="application/xml")
 
     def _upload_part(self, bucket: str, key: str, req: Request) -> Response:
+        denied = self._quota_response(bucket)
+        if denied:
+            return denied
         part = int(req.qs("partNumber"))
         upload_id = req.qs("uploadId")
         url = (f"http://{self.filer_http}"

@@ -2,6 +2,7 @@
 into the COMMANDS map; CommandEnv holds the master connection + admin lock."""
 
 from . import (command_collection, command_ec,  # noqa: F401
-               command_fs, command_maintenance,
-               command_volume)
+               command_fs, command_fs_extra, command_maintenance,
+               command_remote, command_s3_extra, command_volume,
+               command_volume_extra)
 from .commands import COMMANDS, CommandEnv, ShellError, run_command

@@ -1,0 +1,148 @@
+"""fs.* navigation/metadata verbs closing the round-1 gap: fs.cd, fs.pwd,
+fs.mv, fs.tree, fs.meta.cat, fs.meta.notify —
+weed/shell/command_fs_cd.go, _pwd.go, _mv.go, _tree.go, _meta_cat.go,
+_meta_notify.go.
+
+fs.cd/fs.pwd keep a per-shell working directory (env.cwd); paths given
+to these commands resolve relative to it."""
+
+from __future__ import annotations
+
+import json
+
+from ..pb.rpc import RpcError
+from .command_fs import _filer
+from .commands import CommandEnv, ShellError, command, parse_flags
+
+
+def _abspath(env: CommandEnv, path: str) -> str:
+    cwd = getattr(env, "cwd", "/")
+    if not path:
+        return cwd
+    if not path.startswith("/"):
+        path = cwd.rstrip("/") + "/" + path
+    # resolve . / ..
+    parts: list[str] = []
+    for seg in path.split("/"):
+        if seg in ("", "."):
+            continue
+        if seg == "..":
+            if parts:
+                parts.pop()
+        else:
+            parts.append(seg)
+    return "/" + "/".join(parts)
+
+
+def _lookup(env: CommandEnv, path: str) -> dict:
+    directory, _, name = path.rstrip("/").rpartition("/")
+    try:
+        return _filer(env).call("LookupDirectoryEntry", {
+            "directory": directory or "/", "name": name})["entry"]
+    except RpcError:
+        raise ShellError(f"{path} not found") from None
+
+
+@command("fs.cd", "change the shell working directory: fs.cd /path")
+def cmd_fs_cd(env: CommandEnv, args: list[str]) -> str:
+    target = _abspath(env, next(
+        (a for a in args if not a.startswith("-")), "/"))
+    if target != "/":
+        entry = _lookup(env, target)
+        if not entry["attr"].get("mode", 0) & 0o40000:
+            raise ShellError(f"{target} is not a directory")
+    env.cwd = target
+    return target
+
+
+@command("fs.pwd", "print the shell working directory")
+def cmd_fs_pwd(env: CommandEnv, args: list[str]) -> str:
+    return getattr(env, "cwd", "/")
+
+
+@command("fs.mv", "move/rename a filer entry: fs.mv /src /dst "
+                  "(POSIX rename through AtomicRenameEntry)")
+def cmd_fs_mv(env: CommandEnv, args: list[str]) -> str:
+    paths = [a for a in args if not a.startswith("-")]
+    if len(paths) != 2:
+        raise ShellError("usage: fs.mv <src> <dst>")
+    src, dst = (_abspath(env, p) for p in paths)
+    # dst being an existing directory means "move INTO it" (mv semantics)
+    try:
+        dentry = _lookup(env, dst)
+        if dentry["attr"].get("mode", 0) & 0o40000:
+            dst = dst.rstrip("/") + "/" + src.rstrip("/").rsplit("/")[-1]
+    except ShellError:
+        pass
+    src_dir, _, src_name = src.rstrip("/").rpartition("/")
+    dst_dir, _, dst_name = dst.rstrip("/").rpartition("/")
+    _filer(env).call("AtomicRenameEntry", {
+        "old_directory": src_dir or "/", "old_name": src_name,
+        "new_directory": dst_dir or "/", "new_name": dst_name})
+    return json.dumps({"moved": src, "to": dst})
+
+
+@command("fs.tree", "recursively print a filer tree: fs.tree [/path]")
+def cmd_fs_tree(env: CommandEnv, args: list[str]) -> str:
+    root = _abspath(env, next(
+        (a for a in args if not a.startswith("-")), ""))
+    lines: list[str] = [root]
+    counts = {"dirs": 0, "files": 0}
+
+    def walk(directory: str, indent: str):
+        try:
+            entries = [r["entry"] for r in _filer(env).stream(
+                "ListEntries", iter([{"directory": directory}]))]
+        except RpcError:
+            return
+        for i, e in enumerate(entries):
+            last = i == len(entries) - 1
+            name = e["full_path"].rsplit("/", 1)[-1]
+            is_dir = bool(e["attr"].get("mode", 0) & 0o40000)
+            counts["dirs" if is_dir else "files"] += 1
+            lines.append(f"{indent}{'└── ' if last else '├── '}{name}")
+            if is_dir:
+                walk(e["full_path"],
+                     indent + ("    " if last else "│   "))
+
+    walk(root, "")
+    lines.append(f"{counts['dirs']} directories, "
+                 f"{counts['files']} files")
+    return "\n".join(lines)
+
+
+@command("fs.meta.cat", "print one entry's full metadata as JSON: "
+                        "fs.meta.cat /path (command_fs_meta_cat.go)")
+def cmd_fs_meta_cat(env: CommandEnv, args: list[str]) -> str:
+    path = _abspath(env, next(
+        (a for a in args if not a.startswith("-")), ""))
+    return json.dumps(_lookup(env, path), indent=2, sort_keys=True)
+
+
+@command("fs.meta.notify",
+         "re-publish metadata events for every entry under a path "
+         "(primes subscribers/replication sinks; "
+         "command_fs_meta_notify.go): fs.meta.notify [/path]")
+def cmd_fs_meta_notify(env: CommandEnv, args: list[str]) -> str:
+    root = _abspath(env, next(
+        (a for a in args if not a.startswith("-")), ""))
+    client = _filer(env)
+    n = 0
+
+    def walk(directory: str):
+        nonlocal n
+        try:
+            entries = [r["entry"] for r in client.stream(
+                "ListEntries", iter([{"directory": directory}]))]
+        except RpcError:
+            return
+        for e in entries:
+            # an UpdateEntry with unchanged content flows through the
+            # normal notification path — subscribers see a fresh event
+            client.call("UpdateEntry", {"entry": e})
+            n += 1
+            if e["attr"].get("mode", 0) & 0o40000:
+                walk(e["full_path"])
+
+    walk(root)
+    return json.dumps({"notified": n})

@@ -1,0 +1,205 @@
+"""remote.* shell verbs — the cloud-drive operator surface
+(weed/shell/command_remote_configure.go, _mount.go, _cache.go,
+_uncache.go, _meta_sync.go, _unmount.go) over remote_storage.RemoteMount.
+
+Named remote-storage configurations live as the filer entry
+/etc/remote.conf (extended attr), mirroring how the reference keeps them
+in the filer so every shell/gateway sees the same set."""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ..pb.rpc import POOL, RpcError
+from ..remote_storage import RemoteMount, new_remote_storage
+from .command_fs import _filer
+from .commands import CommandEnv, ShellError, command, parse_flags
+
+REMOTE_CONF_PATH = "/etc/remote.conf"
+REMOTE_CONF_ATTR = "remote.conf"
+
+
+def load_conf(filer_grpc: str) -> dict:
+    """Read /etc/remote.conf — shared by the shell verbs and the
+    filer.remote.sync CLI (one schema, one parser)."""
+    directory, _, name = REMOTE_CONF_PATH.rpartition("/")
+    try:
+        entry = POOL.client(filer_grpc, "SeaweedFiler").call(
+            "LookupDirectoryEntry",
+            {"directory": directory, "name": name})["entry"]
+        return json.loads(entry.get("extended", {})
+                          .get(REMOTE_CONF_ATTR, "{}"))
+    except (RpcError, ValueError):
+        return {}
+
+
+def load_remote_mounts(filer_grpc: str, master_grpc: str,
+                       only_dir: str = "") -> list[RemoteMount]:
+    """Build RemoteMount objects for every configured mount."""
+    conf = load_conf(filer_grpc)
+    mounts = []
+    for mdir, spec in conf.get("_mounts", {}).items():
+        if only_dir and mdir != only_dir:
+            continue
+        cfg = dict(conf.get(spec["remote"], {}))
+        kind = cfg.pop("type", None)
+        if kind is None:
+            continue
+        mounts.append(RemoteMount(filer_grpc, master_grpc,
+                                  new_remote_storage(kind, **cfg), mdir))
+    return mounts
+
+
+def _load_conf(env: CommandEnv) -> dict:
+    _filer(env)     # raises the helpful "no filer configured" error
+    return load_conf(env.filer_grpc)
+
+
+def _save_conf(env: CommandEnv, conf: dict) -> None:
+    _filer(env).call("CreateEntry", {"entry": {
+        "full_path": REMOTE_CONF_PATH,
+        "attr": {"mtime": time.time(), "crtime": time.time(),
+                 "mode": 0o600},
+        "extended": {REMOTE_CONF_ATTR: json.dumps(conf)}}})
+
+
+def _remote_for(env: CommandEnv, name: str):
+    conf = _load_conf(env)
+    cfg = conf.get(name)
+    if cfg is None:
+        raise ShellError(f"remote {name!r} not configured "
+                         f"(run remote.configure)")
+    cfg = dict(cfg)
+    kind = cfg.pop("type")
+    return new_remote_storage(kind, **cfg)
+
+
+def _mount_for(env: CommandEnv, directory: str) -> RemoteMount:
+    conf = _load_conf(env)
+    mounts = conf.get("_mounts", {})
+    spec = mounts.get(directory)
+    if spec is None:
+        raise ShellError(f"{directory} is not a remote mount")
+    return RemoteMount(env.filer_grpc, env.master_grpc,
+                       _remote_for(env, spec["remote"]), directory)
+
+
+@command("remote.configure",
+         "define a named remote: -name n -type local -root /dir | "
+         "-type s3 -endpoint host:port -bucket b [-accessKey/-secretKey/"
+         "-prefix] [-delete]; no args lists")
+def cmd_remote_configure(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    conf = _load_conf(env)
+    name = flags.get("name", "")
+    if not name:
+        return json.dumps({k: v for k, v in conf.items()
+                           if k != "_mounts"})
+    if flags.get("delete") == "true":
+        conf.pop(name, None)
+        _save_conf(env, conf)
+        return f"deleted remote {name}"
+    kind = flags.get("type", "local")
+    cfg: dict = {"type": kind}
+    if kind == "local":
+        if not flags.get("root"):
+            raise ShellError("local remote needs -root")
+        cfg["root"] = flags["root"]
+    elif kind == "s3":
+        if not flags.get("endpoint") or not flags.get("bucket"):
+            raise ShellError("s3 remote needs -endpoint and -bucket")
+        cfg.update(endpoint=flags["endpoint"], bucket=flags["bucket"])
+        for src, dst in (("accessKey", "access_key"),
+                         ("secretKey", "secret_key"),
+                         ("prefix", "prefix")):
+            if flags.get(src):
+                cfg[dst] = flags[src]
+    else:
+        raise ShellError(f"unknown remote type {kind!r}")
+    conf[name] = cfg
+    _save_conf(env, conf)
+    return json.dumps({name: cfg})
+
+
+@command("remote.mount",
+         "mount a remote under a filer dir: -dir /path -remote name "
+         "(materializes metadata-only entries)")
+def cmd_remote_mount(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    directory = flags.get("dir", "")
+    name = flags.get("remote", "")
+    if not directory or not name:
+        raise ShellError("need -dir and -remote")
+    remote = _remote_for(env, name)
+    mount = RemoteMount(env.filer_grpc, env.master_grpc, remote,
+                        directory)
+    n = mount.mount()
+    conf = _load_conf(env)
+    conf.setdefault("_mounts", {})[directory] = {"remote": name}
+    _save_conf(env, conf)
+    return json.dumps({"mounted": directory, "remote": name,
+                       "entries": n})
+
+
+@command("remote.unmount",
+         "remove a remote mount and its (metadata) entries: -dir /path")
+def cmd_remote_unmount(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    directory = flags.get("dir", "")
+    conf = _load_conf(env)
+    if directory not in conf.get("_mounts", {}):
+        raise ShellError(f"{directory} is not a remote mount")
+    parent, _, name = directory.rstrip("/").rpartition("/")
+    _filer(env).call("DeleteEntry", {
+        "directory": parent or "/", "name": name,
+        "is_recursive": True, "ignore_recursive_error": True})
+    del conf["_mounts"][directory]
+    _save_conf(env, conf)
+    return json.dumps({"unmounted": directory})
+
+
+@command("remote.meta.sync",
+         "refresh mounted metadata from the remote: -dir /path")
+def cmd_remote_meta_sync(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    directory = flags.get("dir", "")
+    mount = _mount_for(env, directory)
+    n = mount.mount()       # re-list and upsert entries
+    return json.dumps({"dir": directory, "entries": n})
+
+
+@command("remote.cache",
+         "pull remote content into local chunks: -dir /path "
+         "[-include substr]")
+def cmd_remote_cache(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    directory = flags.get("dir", "")
+    include = flags.get("include", "")
+    mount = _mount_for(env, directory)
+    cached = []
+    for obj in mount.remote.list_objects():
+        if include and include not in obj["key"]:
+            continue
+        if not mount.is_cached(obj["key"]):
+            mount.cache(obj["key"])
+            cached.append(obj["key"])
+    return json.dumps({"dir": directory, "cached": cached})
+
+
+@command("remote.uncache",
+         "drop locally cached chunks, keep metadata: -dir /path "
+         "[-include substr]")
+def cmd_remote_uncache(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    directory = flags.get("dir", "")
+    include = flags.get("include", "")
+    mount = _mount_for(env, directory)
+    dropped = []
+    for obj in mount.remote.list_objects():
+        if include and include not in obj["key"]:
+            continue
+        if mount.is_cached(obj["key"]):
+            mount.uncache(obj["key"])
+            dropped.append(obj["key"])
+    return json.dumps({"dir": directory, "uncached": dropped})

@@ -1,0 +1,140 @@
+"""s3.* operator verbs closing the round-1 gap: s3.configure,
+s3.clean.uploads, s3.bucket.quota.check —
+weed/shell/command_s3_configure.go, command_s3_clean_uploads.go,
+command_s3_bucket_quota_check.go."""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ..pb.rpc import RpcError
+from .command_fs import BUCKETS_PATH, _filer
+from .commands import CommandEnv, ShellError, command, parse_flags
+
+UPLOADS_DIR = ".uploads"
+
+
+@command("s3.configure",
+         "manage S3 identities: -user name [-access_key ak -secret_key "
+         "sk] [-actions Read,Write,List,Tagging,Admin] [-delete]; no "
+         "args lists.  Running S3 gateways hot-reload the change.")
+def cmd_s3_configure(env: CommandEnv, args: list[str]) -> str:
+    from ..s3.iam import load_identity_config, persist_identity_config
+    flags = parse_flags(args)
+    _filer(env)    # fail early when no filer is configured
+    cfg = load_identity_config(env.filer_grpc) or {"identities": []}
+    user = flags.get("user", "")
+    if not user:
+        return json.dumps(cfg)
+    idents = [i for i in cfg.get("identities", [])
+              if i.get("name") != user]
+    if flags.get("delete") != "true":
+        ident = next((i for i in cfg.get("identities", [])
+                      if i.get("name") == user),
+                     {"name": user, "credentials": [], "actions": []})
+        if flags.get("access_key"):
+            ident["credentials"] = [{
+                "accessKey": flags["access_key"],
+                "secretKey": flags.get("secret_key", "")}]
+        if flags.get("actions"):
+            ident["actions"] = flags["actions"].split(",")
+        idents.append(ident)
+    cfg["identities"] = idents
+    persist_identity_config(env.filer_grpc, cfg)
+    return json.dumps(cfg)
+
+
+@command("s3.clean.uploads",
+         "delete stale multipart upload staging dirs: "
+         "[-timeAgo seconds, default 86400]")
+def cmd_s3_clean_uploads(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    cutoff = time.time() - float(flags.get("timeAgo", "86400"))
+    client = _filer(env)
+    removed: list[str] = []
+    try:
+        buckets = [r["entry"] for r in client.stream(
+            "ListEntries", iter([{"directory": BUCKETS_PATH}]))]
+    except RpcError:
+        buckets = []
+    for b in buckets:
+        if not b["attr"].get("mode", 0) & 0o40000:
+            continue
+        updir = f"{b['full_path']}/{UPLOADS_DIR}"
+        try:
+            uploads = [r["entry"] for r in client.stream(
+                "ListEntries", iter([{"directory": updir}]))]
+        except RpcError:
+            continue
+        for u in uploads:
+            if u["attr"].get("mtime", 0) < cutoff:
+                client.call("DeleteEntry", {
+                    "directory": updir,
+                    "name": u["full_path"].rsplit("/", 1)[-1],
+                    "is_recursive": True,
+                    "ignore_recursive_error": True})
+                removed.append(u["full_path"])
+    return json.dumps({"removed": removed})
+
+
+@command("s3.bucket.quota.check",
+         "enforce bucket quotas: walks usage, flips the bucket's "
+         "quota.exceeded marker that the S3 gateway write path refuses "
+         "on ([-bucket b] to check one)")
+def cmd_s3_bucket_quota_check(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    client = _filer(env)
+    only = flags.get("bucket", "")
+    report: dict[str, dict] = {}
+
+    def usage(directory: str) -> int:
+        total = 0
+        try:
+            for r in client.stream("ListEntries",
+                                   iter([{"directory": directory}])):
+                e = r["entry"]
+                if e["attr"].get("mode", 0) & 0o40000:
+                    total += usage(e["full_path"])
+                else:
+                    total += sum(c.get("size", 0)
+                                 for c in e.get("chunks", []))
+        except RpcError:
+            pass
+        return total
+
+    try:
+        buckets = [r["entry"] for r in client.stream(
+            "ListEntries", iter([{"directory": BUCKETS_PATH}]))]
+    except RpcError:
+        raise ShellError("no /buckets tree (no filer or no buckets)") \
+            from None
+    for b in buckets:
+        name = b["full_path"].rsplit("/", 1)[-1]
+        if only and name != only:
+            continue
+        if not b["attr"].get("mode", 0) & 0o40000:
+            continue
+        ext = b.get("extended", {})
+        quota = int(ext.get("quota.bytes") or 0)
+        if quota <= 0:
+            # quota removed: clear any stale exceeded marker so writes
+            # reopen
+            if ext.get("quota.exceeded") == "1":
+                ext.pop("quota.exceeded", None)
+                b["extended"] = ext
+                client.call("UpdateEntry", {"entry": b})
+            continue
+        used = usage(b["full_path"])
+        exceeded = used >= quota
+        was = ext.get("quota.exceeded") == "1"
+        if exceeded != was:
+            if exceeded:
+                ext["quota.exceeded"] = "1"
+            else:
+                ext.pop("quota.exceeded", None)
+            b["extended"] = ext
+            client.call("UpdateEntry", {"entry": b})
+        report[name] = {"used": used, "quota": quota,
+                        "exceeded": exceeded}
+    return json.dumps(report)

@@ -1,0 +1,121 @@
+"""Volume maintenance verbs closing the round-1 gap: volume.copy,
+volume.delete.empty, volume.server.leave, volume.tier.upload —
+weed/shell/command_volume_copy.go, command_volume_delete_empty.go,
+command_volume_server_leave.go, command_volume_tier_upload.go."""
+
+from __future__ import annotations
+
+import json
+import time
+
+from .commands import (CommandEnv, ShellError, command, iter_data_nodes,
+                       node_grpc, parse_flags)
+from .command_maintenance import _tier_backend_config
+
+
+def _holders(env: CommandEnv, vid: int) -> list[dict]:
+    topo = env.topology()
+    return [dn for _, _, dn in iter_data_nodes(topo)
+            if any(v["id"] == vid for v in dn["volumes"])]
+
+
+def _node_by_addr(env: CommandEnv, addr: str) -> dict:
+    for _, _, dn in iter_data_nodes(env.topology()):
+        if dn["id"] == addr or node_grpc(dn) == addr:
+            return dn
+    raise ShellError(f"volume server {addr} not found in topology")
+
+
+@command("volume.copy",
+         "copy a volume from one server to another: -volumeId N "
+         "-source host:port -target host:port "
+         "(command_volume_copy.go)")
+def cmd_volume_copy(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    env.confirm_is_locked()
+    vid = int(flags["volumeId"])
+    src = _node_by_addr(env, flags["source"])
+    dst = _node_by_addr(env, flags["target"])
+    vol = next((v for v in src["volumes"] if v["id"] == vid), None)
+    if vol is None:
+        raise ShellError(f"volume {vid} not on {flags['source']}")
+    env.volume_server(node_grpc(dst)).call(
+        "VolumeCopy", {"volume_id": vid,
+                       "collection": vol.get("collection", ""),
+                       "source_data_node": node_grpc(src)},
+        timeout=3600)
+    return json.dumps({"volume_id": vid, "from": src["id"],
+                       "to": dst["id"]})
+
+
+@command("volume.delete.empty",
+         "delete volumes with no live files everywhere: "
+         "[-quietFor seconds] -force (command_volume_delete_empty.go)")
+def cmd_volume_delete_empty(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    env.confirm_is_locked()
+    if flags.get("force") != "true":
+        raise ShellError("refusing without -force (dry run not useful "
+                         "on a topology dump; pass -force)")
+    quiet_for = float(flags.get("quietFor", "0"))
+    now = time.time()
+    deleted: list[int] = []
+    # collect (vid -> holders) of volumes empty on EVERY replica
+    by_vid: dict[int, list[tuple[dict, dict]]] = {}
+    for _, _, dn in iter_data_nodes(env.topology()):
+        for v in dn["volumes"]:
+            by_vid.setdefault(v["id"], []).append((dn, v))
+    for vid, pairs in sorted(by_vid.items()):
+        empty = all(
+            v.get("file_count", 0) - v.get("delete_count", 0) <= 0
+            and now - v.get("modified_at_second", 0) >= quiet_for
+            for _, v in pairs)
+        if not empty:
+            continue
+        for dn, v in pairs:
+            env.volume_server(node_grpc(dn)).call(
+                "VolumeDelete", {"volume_id": vid,
+                                 "collection": v.get("collection", "")})
+        deleted.append(vid)
+    return json.dumps({"deleted": deleted})
+
+
+@command("volume.server.leave",
+         "ask a volume server to leave the cluster (stops heartbeats, "
+         "data path stays up): -node host:grpcPort "
+         "(command_volume_server_leave.go)")
+def cmd_volume_server_leave(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    env.confirm_is_locked()
+    addr = flags.get("node", "")
+    if not addr:
+        raise ShellError("need -node host:grpcPort")
+    env.volume_server(addr).call("VolumeServerLeave", {})
+    return json.dumps({"left": addr})
+
+
+@command("volume.tier.upload",
+         "upload a sealed volume's .dat to remote storage KEEPING the "
+         "local copy (tier.move -keepLocalDatFile; "
+         "command_volume_tier_upload.go): -volumeId N -dest local|s3 ...")
+def cmd_volume_tier_upload(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    env.confirm_is_locked()
+    vid = int(flags["volumeId"])
+    holders = _holders(env, vid)
+    if not holders:
+        raise ShellError(f"volume {vid} not found")
+    cfg = _tier_backend_config(flags)
+    for dn in holders:
+        env.volume_server(node_grpc(dn)).call(
+            "VolumeMarkReadonly", {"volume_id": vid})
+    for dn in holders:
+        env.volume_server(node_grpc(dn)).call(
+            "VolumeTierMoveDatToRemote", {
+                "volume_id": vid,
+                "destination_backend": flags.get("dest", "local"),
+                "backend_config": cfg,
+                "keep_local_dat_file": True},
+            timeout=3600)
+    return json.dumps({"volume_id": vid, "uploaded": len(holders),
+                       "kept_local": True})

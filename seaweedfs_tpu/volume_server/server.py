@@ -100,6 +100,9 @@ class VolumeServer:
         self._hb_gen = 0        # bumped by heartbeat_now callers
         self._hb_acked_gen = 0  # generation of the last acked payload
         self._hb_inflight: list[int] = []  # gens of yielded payloads, FIFO
+        # volume.server.leave: stop heartbeating (master unregisters us)
+        # while data service stays up for drains (VolumeServerLeave RPC)
+        self._leaving = False
         # vid -> (ts, {shard_id: [grpc addresses]})
         self._ec_locations: dict[int, tuple[float, dict[int, list[str]]]] = {}
         # vid -> (ts, [location dicts]) — replica urls for write fan-out
@@ -151,12 +154,12 @@ class VolumeServer:
 
     def _heartbeat_loop(self) -> None:
         target_idx = 0
-        while not self._stop.is_set():
+        while not self._stop.is_set() and not self._leaving:
             try:
                 client = POOL.client(self.master_grpc, "Seaweed")
 
                 def requests():
-                    while not self._stop.is_set():
+                    while not self._stop.is_set() and not self._leaving:
                         # stamp which generation this payload reflects so
                         # heartbeat_now can wait for a POST-mutation ack
                         self._hb_inflight.append(self._hb_gen)
@@ -486,6 +489,7 @@ class VolumeServer:
                 "ReadVolumeFileStatus": self._rpc_volume_file_status,
                 "VolumeServerStatus": self._rpc_server_status,
                 "Ping": lambda req: {"ok": True},
+                "VolumeServerLeave": self._rpc_server_leave,
                 "VolumeCopy": self._rpc_volume_copy,
                 "VolumeTierMoveDatToRemote": self._rpc_tier_move_to,
                 "VolumeTierMoveDatFromRemote": self._rpc_tier_move_from,
@@ -654,6 +658,15 @@ class VolumeServer:
     def _rpc_volume_unmount(self, req: dict) -> dict:
         for loc in self.store.locations:
             loc.unload_volume(int(req["volume_id"]))
+        return {}
+
+    def _rpc_server_leave(self, req: dict) -> dict:
+        """Stop heartbeating so the master unregisters this server and
+        routes no new writes here; the data path stays up so an operator
+        can still drain/copy volumes off (volume_grpc_admin.go
+        VolumeServerLeave + shell command_volume_server_leave.go)."""
+        self._leaving = True
+        self._hb_wake.set()
         return {}
 
     def _rpc_volume_copy(self, req: dict) -> dict:

@@ -1,0 +1,270 @@
+"""Tests for the round-2 shell verbs: fs.cd/pwd/mv/tree/meta.cat/
+meta.notify, volume.copy/delete.empty/server.leave/tier.upload,
+remote.* (6), s3.configure/clean.uploads/bucket.quota.check."""
+
+import json
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation, shell
+from seaweedfs_tpu.testing import SimCluster
+from seaweedfs_tpu.util.http import http_request
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    with SimCluster(volume_servers=2, filers=1, s3=True,
+                    base_dir=str(tmp_path)) as c:
+        env = shell.CommandEnv(c.master_grpc)
+        env.filer_grpc = c.filers[0].grpc_address
+        env.master_grpc_http = None
+        yield c, env
+
+
+def put(c, path, data):
+    f = c.filers[0]
+    status, body, _ = http_request(f"http://{f.address}{path}",
+                                   method="POST", body=data)
+    assert status == 201, body
+
+
+def test_fs_cd_pwd_mv_tree_meta_cat(cluster):
+    c, env = cluster
+    put(c, "/w/a/one.txt", b"1")
+    put(c, "/w/two.txt", b"22")
+    assert shell.run_command(env, "fs.pwd") == "/"
+    assert shell.run_command(env, "fs.cd /w") == "/w"
+    assert shell.run_command(env, "fs.pwd") == "/w"
+    # relative paths resolve against cwd
+    meta = json.loads(shell.run_command(env, "fs.meta.cat two.txt"))
+    assert meta["full_path"] == "/w/two.txt"
+    assert meta["chunks"][0]["size"] == 2
+    # mv into an existing directory keeps the basename
+    shell.run_command(env, "fs.mv two.txt a")
+    assert json.loads(shell.run_command(
+        env, "fs.meta.cat /w/a/two.txt"))["full_path"] == "/w/a/two.txt"
+    tree = shell.run_command(env, "fs.tree /w")
+    assert "one.txt" in tree and "two.txt" in tree
+    assert "1 directories, 2 files" in tree
+    # plain rename
+    shell.run_command(env, "fs.mv /w/a/two.txt /w/a/renamed.txt")
+    assert "renamed.txt" in shell.run_command(env, "fs.tree /w")
+    assert shell.run_command(env, "fs.cd ..") == "/"
+
+
+def test_fs_meta_notify(cluster):
+    c, env = cluster
+    put(c, "/n/x.txt", b"x")
+    events = []
+    unsub = c.filers[0].filer.subscribe(
+        lambda ev: events.append(ev.to_dict()),
+        since_ts_ns=time.time_ns())
+    out = json.loads(shell.run_command(env, "fs.meta.notify /n"))
+    assert out["notified"] == 1
+    paths = [e["new_entry"]["full_path"] for e in events
+             if e.get("new_entry")]
+    assert "/n/x.txt" in paths
+    unsub()
+
+
+def test_volume_copy_and_server_leave(cluster):
+    c, env = cluster
+    fid = c.upload(b"copy me")
+    vid = int(fid.split(",")[0])
+    c.sync_heartbeats()
+    src_i = next(i for i, vs in enumerate(c.volume_servers)
+                 if vs.store.has_volume(vid))
+    dst_i = 1 - src_i
+    src, dst = c.volume_servers[src_i], c.volume_servers[dst_i]
+    shell.run_command(env, "lock")
+    out = json.loads(shell.run_command(
+        env, f"volume.copy -volumeId {vid} "
+             f"-source {src.grpc_address} -target {dst.grpc_address}"))
+    assert out["volume_id"] == vid
+    assert dst.store.has_volume(vid)
+    # leave: the dst server stops heartbeating; master forgets it
+    leader = c.masters[0]
+    assert len(leader.topo.data_nodes()) == 2
+    shell.run_command(env,
+                      f"volume.server.leave -node {dst.grpc_address}")
+    deadline = time.time() + 10
+    while time.time() < deadline \
+            and len(leader.topo.data_nodes()) > 1:
+        time.sleep(0.1)
+    assert len(leader.topo.data_nodes()) == 1
+    # data path still up on the departed server
+    assert dst.store.has_volume(vid)
+    shell.run_command(env, "unlock")
+
+
+def test_volume_delete_empty(cluster):
+    c, env = cluster
+    fid = c.upload(b"live data")
+    vid_live = int(fid.split(",")[0])
+    # grow a second collection volume and leave it empty
+    r = operation.assign(c.master_grpc, collection="scratch")
+    vid_empty = int(r.fid.split(",")[0])
+    operation.upload_data(r.url, r.fid, b"temp", jwt=r.auth)
+    operation.delete_file(c.master_grpc, r.fid)
+    c.sync_heartbeats()
+    shell.run_command(env, "lock")
+    out = json.loads(shell.run_command(
+        env, "volume.delete.empty -force"))
+    shell.run_command(env, "unlock")
+    assert vid_live not in out["deleted"]
+    assert vid_empty in out["deleted"]
+    assert c.read(fid) == b"live data"
+
+
+def test_volume_tier_upload_keeps_local(cluster, tmp_path):
+    c, env = cluster
+    fid = c.upload(b"tier upload")
+    vid = int(fid.split(",")[0])
+    c.sync_heartbeats()
+    cloud = tmp_path / "tier-up"
+    shell.run_command(env, "lock")
+    out = json.loads(shell.run_command(
+        env, f"volume.tier.upload -volumeId {vid} -dest local "
+             f"-destDir {cloud}"))
+    shell.run_command(env, "unlock")
+    assert out["kept_local"]
+    holder = next(vs for vs in c.volume_servers
+                  if vs.store.has_volume(vid))
+    v = holder.store.find_volume(vid)
+    # remote copy exists AND the local .dat is kept
+    assert os.path.exists(v.base_path + ".dat")
+    assert any(f.endswith(f"{vid}.dat")
+               for _, _, fs in os.walk(cloud) for f in fs)
+    assert c.read(fid) == b"tier upload"
+
+
+def test_remote_verbs_roundtrip(cluster, tmp_path):
+    c, env = cluster
+    cloud = tmp_path / "cloud"
+    cloud.mkdir()
+    (cloud / "docs").mkdir()
+    (cloud / "docs" / "r.txt").write_bytes(b"remote bytes")
+    out = json.loads(shell.run_command(
+        env, f"remote.configure -name mycloud -type local -root {cloud}"))
+    assert "mycloud" in out
+    listing = json.loads(shell.run_command(env, "remote.configure"))
+    assert listing["mycloud"]["type"] == "local"
+    out = json.loads(shell.run_command(
+        env, "remote.mount -dir /clouds/m -remote mycloud"))
+    assert out["entries"] == 1
+    meta = json.loads(shell.run_command(
+        env, "fs.meta.cat /clouds/m/docs/r.txt"))
+    assert meta["extended"]["remote.size"] == "12"
+    # cache pulls content into local chunks
+    out = json.loads(shell.run_command(
+        env, "remote.cache -dir /clouds/m"))
+    assert out["cached"] == ["docs/r.txt"]
+    meta = json.loads(shell.run_command(
+        env, "fs.meta.cat /clouds/m/docs/r.txt"))
+    assert meta["chunks"]
+    # uncache drops chunks, keeps metadata
+    out = json.loads(shell.run_command(
+        env, "remote.uncache -dir /clouds/m"))
+    assert out["uncached"] == ["docs/r.txt"]
+    meta = json.loads(shell.run_command(
+        env, "fs.meta.cat /clouds/m/docs/r.txt"))
+    assert not meta.get("chunks")
+    # meta.sync picks up new remote objects
+    (cloud / "new.bin").write_bytes(b"fresh")
+    out = json.loads(shell.run_command(
+        env, "remote.meta.sync -dir /clouds/m"))
+    assert out["entries"] == 2
+    json.loads(shell.run_command(
+        env, "fs.meta.cat /clouds/m/new.bin"))
+    # unmount removes the tree + mount record
+    json.loads(shell.run_command(env, "remote.unmount -dir /clouds/m"))
+    with pytest.raises(shell.ShellError):
+        shell.run_command(env, "fs.meta.cat /clouds/m/new.bin")
+    with pytest.raises(shell.ShellError):
+        shell.run_command(env, "remote.meta.sync -dir /clouds/m")
+
+
+def test_s3_configure_and_hot_reload(cluster):
+    c, env = cluster
+    from seaweedfs_tpu.s3.client import S3Client, S3ClientError
+    # anonymous works while no identities exist
+    anon = S3Client(c.s3_server.address)
+    anon.create_bucket("pre")
+    out = json.loads(shell.run_command(
+        env, "s3.configure -user ops -access_key AKIDOPS "
+             "-secret_key sekrit -actions Admin"))
+    assert out["identities"][0]["name"] == "ops"
+    # the RUNNING gateway hot-reloads the identity
+    deadline = time.time() + 5
+    ok = False
+    while time.time() < deadline and not ok:
+        try:
+            S3Client(c.s3_server.address, "AKIDOPS", "sekrit") \
+                .put_object("pre", "k", b"v")
+            ok = True
+        except S3ClientError:
+            time.sleep(0.1)
+    assert ok
+    # listing shows it; delete removes it
+    assert json.loads(shell.run_command(
+        env, "s3.configure"))["identities"]
+    json.loads(shell.run_command(env, "s3.configure -user ops -delete"))
+    assert not json.loads(shell.run_command(
+        env, "s3.configure"))["identities"]
+
+
+def test_s3_clean_uploads(cluster):
+    c, env = cluster
+    from seaweedfs_tpu.pb.rpc import POOL
+    filer = c.filers[0]
+    client = POOL.client(filer.grpc_address, "SeaweedFiler")
+    shell.run_command(env, "s3.bucket.create -name up")
+    old = time.time() - 100000
+    client.call("CreateEntry", {"entry": {
+        "full_path": "/buckets/up/.uploads/stale-upload",
+        "attr": {"mtime": old, "crtime": old, "mode": 0o40000 | 0o770}}})
+    client.call("CreateEntry", {"entry": {
+        "full_path": "/buckets/up/.uploads/fresh-upload",
+        "attr": {"mtime": time.time(), "crtime": time.time(),
+                 "mode": 0o40000 | 0o770}}})
+    out = json.loads(shell.run_command(env, "s3.clean.uploads"))
+    assert out["removed"] == ["/buckets/up/.uploads/stale-upload"]
+
+
+def test_s3_bucket_quota_check_enforces(cluster):
+    c, env = cluster
+    from seaweedfs_tpu.s3.client import S3Client, S3ClientError
+    cl = S3Client(c.s3_server.address)
+    cl.create_bucket("q")
+    cl.put_object("q", "big.bin", os.urandom(2 << 20))
+    shell.run_command(env, "s3.bucket.quota -name q -sizeMB 1")
+    out = json.loads(shell.run_command(
+        env, "s3.bucket.quota.check -bucket q"))
+    assert out["q"]["exceeded"]
+    # gateway refuses writes to an over-quota bucket (once its short
+    # quota-flag cache expires)
+    deadline = time.time() + 5
+    status = 0
+    while time.time() < deadline:
+        try:
+            cl.put_object("q", "more-denied.bin", b"x")
+            time.sleep(0.3)
+        except S3ClientError as e:
+            status = e.status
+            break
+    assert status == 403
+    # clearing the quota re-opens writes after the check
+    shell.run_command(env, "s3.bucket.quota -name q -sizeMB 0")
+    out = json.loads(shell.run_command(
+        env, "s3.bucket.quota.check -bucket q"))
+    assert out == {}       # no quota -> not reported
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            cl.put_object("q", "more.bin", b"x")
+            break
+        except S3ClientError:
+            time.sleep(0.3)
+    assert cl.get_object("q", "more.bin") == b"x"
