@@ -44,6 +44,8 @@ def main():
                     help="measure ONLY ec.rebuild reconstruct throughput "
                          "(4 lost shards); default measures encode as the "
                          "headline and rebuild as an extra metric")
+    ap.add_argument("--no-smallfile", action="store_true",
+                    help="skip the small-file data-path benchmark")
     args = ap.parse_args()
 
     import jax
@@ -117,6 +119,32 @@ def main():
 
     gbps = measure(np.asarray(rs_matrix.parity_bit_matrix(k, m)))
     rebuild_gbps = measure(rebuild_bits)
+
+    # small-file data path (reference README.md:528-575 `weed benchmark`:
+    # 15,708 writes/s / 47,019 reads/s, 1KB, c=16, on a 4-core i7 with a
+    # separate client process).  Here EVERYTHING — client workers, master,
+    # two volume servers — shares this host's cores; writes ride the
+    # raw-TCP fast path with batched assigns, reads the pipelined frames.
+    smallfile: dict = {}
+    if not args.no_smallfile:
+        try:
+            from seaweedfs_tpu.command.benchmark import run_benchmark
+            from seaweedfs_tpu.testing import SimCluster
+            n = 2000 if args.quick else 30000
+            with SimCluster(volume_servers=2, max_volumes=60) as cluster:
+                out = run_benchmark(cluster.master_grpc, n_files=n,
+                                    file_size=1024, concurrency=16,
+                                    quiet=True)
+            smallfile = {
+                "smallfile_write_rps": out["write"]["req_per_sec"],
+                "smallfile_write_p99_ms": out["write"].get("p99_ms"),
+                "smallfile_read_rps": out["read"]["req_per_sec"],
+                "smallfile_read_p99_ms": out["read"].get("p99_ms"),
+                "smallfile_ref_write_rps": 15708,
+                "smallfile_ref_read_rps": 47019,
+            }
+        except Exception as e:   # never fail the headline metric
+            smallfile = {"smallfile_error": str(e)[:200]}
     # at `gbps` GB/s of survivor bytes consumed, rebuilding a rack of 1000
     # 30GB volumes (BASELINE's ec.rebuild scenario) takes this many
     # seconds: k survivor shards of volume_size/k bytes each must stream
@@ -131,6 +159,7 @@ def main():
             "ec_rebuild_throughput_rs10_4_4lost_gbps": round(rebuild_gbps, 2),
             "ec_rebuild_1000x30GB_volumes_est_seconds":
                 round(rack_survivor_bytes / 1e9 / rebuild_gbps, 1),
+            **smallfile,
         },
     }))
     return 0

@@ -122,7 +122,8 @@ class MasterServer:
             self._follower_client = MasterClient(
                 resolve_leader(self._follow),
                 client_name=self.grpc_address,
-                client_type="master_follower")
+                client_type="master_follower",
+                masters=self._follow)
             self._follower_client.start()
         if self._peers:
             from .ha import HaCoordinator, RaftSequencer
@@ -253,6 +254,8 @@ class MasterServer:
             "replicas": [{"url": dn.url, "public_url": dn.public_url}
                          for dn in nodes[1:]],
         }
+        if getattr(main, "tcp_port", 0):
+            out["tcp_url"] = f"{main.ip}:{main.tcp_port}"
         if self.jwt_signing_key:
             # sign the write authorization (master_server_handlers.go:146);
             # a count>1 batch gets a token scoped to the assigned
@@ -303,7 +306,9 @@ class MasterServer:
                     seen[dn.url] = {"url": dn.url,
                                     "public_url": dn.public_url}
             return list(seen.values())
-        return [{"url": dn.url, "public_url": dn.public_url}
+        return [dict({"url": dn.url, "public_url": dn.public_url},
+                     **({"tcp_url": f"{dn.ip}:{dn.tcp_port}"}
+                        if getattr(dn, "tcp_port", 0) else {}))
                 for dn in locs]
 
     # -- heartbeat (master_grpc_server.go:21-183) ---------------------------
@@ -331,6 +336,7 @@ class MasterServer:
                 f"{hb['ip']}:{hb['port']}",
                 ip=hb["ip"], port=hb["port"],
                 grpc_port=hb.get("grpc_port", 0),
+                tcp_port=hb.get("tcp_port", 0),
                 public_url=hb.get("public_url", ""),
                 max_volumes=hb.get("max_volume_count", 7))
             LOG.info("volume server %s registered (dc=%s rack=%s)",

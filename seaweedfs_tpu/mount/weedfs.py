@@ -194,6 +194,19 @@ class WeedFS:
         self.meta.remove(old)
         self.meta.remove(new)
         self.inodes.move(old, new)
+        # re-key open writers: a later flush/release resolves the NEW
+        # path (the kernel tracks the node, not the old name) — dirty
+        # pages must follow the rename or close(2) silently drops them
+        with self._lock:
+            prefix = old.rstrip("/") + "/"
+            for path in list(self._open_writers):
+                if path == old:
+                    self._open_writers[new] = \
+                        self._open_writers.pop(old)
+                elif path.startswith(prefix):    # dir rename: children
+                    self._open_writers[new.rstrip("/") + "/"
+                                       + path[len(prefix):]] = \
+                        self._open_writers.pop(path)
 
     # -- file IO ------------------------------------------------------------
     def create(self, path: str, mode: int = 0o660) -> None:

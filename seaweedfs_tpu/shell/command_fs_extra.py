@@ -12,7 +12,7 @@ import json
 
 from ..pb.rpc import RpcError
 from .command_fs import _filer
-from .commands import CommandEnv, ShellError, command, parse_flags
+from .commands import CommandEnv, ShellError, command
 
 
 def _abspath(env: CommandEnv, path: str) -> str:

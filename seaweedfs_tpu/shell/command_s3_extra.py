@@ -9,10 +9,9 @@ import json
 import time
 
 from ..pb.rpc import RpcError
+from ..s3.server import UPLOADS_DIR
 from .command_fs import BUCKETS_PATH, _filer
 from .commands import CommandEnv, ShellError, command, parse_flags
-
-UPLOADS_DIR = ".uploads"
 
 
 @command("s3.configure",

@@ -82,11 +82,12 @@ class DataNode(Node):
 
     def __init__(self, node_id: str, ip: str = "", port: int = 0,
                  grpc_port: int = 0, public_url: str = "",
-                 max_volumes: int = 7):
+                 max_volumes: int = 7, tcp_port: int = 0):
         super().__init__(node_id)
         self.ip = ip
         self.port = port
         self.grpc_port = grpc_port
+        self.tcp_port = tcp_port    # raw-TCP data fast path (0 = off)
         self.public_url = public_url or f"{ip}:{port}"
         self.max_volumes = max_volumes
         self.volumes: dict[int, VolumeInfo] = {}

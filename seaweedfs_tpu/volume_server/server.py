@@ -21,6 +21,7 @@ volume_grpc_*.go:
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
@@ -110,11 +111,14 @@ class VolumeServer:
         self._register_http()
         self._register_rpc()
         self._public_url = public_url
+        from .tcp import TcpDataServer
+        self.tcp = TcpDataServer(self, host=host)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         self.http.start()
         self.rpc.start()
+        self.tcp.start()
         self.store.ip = self.http.host
         self.store.port = self.http.port
         self.store.public_url = self._public_url or self.http.address
@@ -126,6 +130,7 @@ class VolumeServer:
         self._stop.set()
         self.http.stop()
         self.rpc.stop()
+        self.tcp.stop()
         self.store.close()
 
     @property
@@ -141,7 +146,7 @@ class VolumeServer:
         hb = self.store.collect_heartbeat()
         return {
             "ip": self.http.host, "port": self.http.port,
-            "grpc_port": self.rpc.port,
+            "grpc_port": self.rpc.port, "tcp_port": self.tcp.port,
             "public_url": self.store.public_url,
             "data_center": self.data_center, "rack": self.rack,
             "max_volume_count": hb.max_volume_count,
@@ -374,6 +379,41 @@ class VolumeServer:
             if err:
                 return Response.error(f"replication failed: {err}", 500)
         return Response.json({"size": size}, status=202)
+
+    # -- raw-TCP data fast path (volume_server/tcp.py frames) --------------
+    def tcp_write(self, fid_str: str, body: bytes, jwt: str) -> dict:
+        """Same semantics as the HTTP write handler — jwt gate,
+        group-commit, replication fan-out — under TCP framing."""
+        from ..util.http import CIDict
+        fid = FileId.parse(fid_str)
+        req = Request(method="POST", path="",
+                      query={"jwt": [jwt]} if jwt else {},
+                      headers=CIDict(), body=body)
+        resp = self._write_needle(fid, req)
+        if resp.status >= 300:
+            raise ValueError(resp.body.decode(errors="replace"))
+        return json.loads(resp.body)
+
+    def tcp_read(self, fid_str: str) -> bytes:
+        from ..util.http import CIDict
+        fid = FileId.parse(fid_str)
+        req = Request(method="GET", path="", query={},
+                      headers=CIDict(), body=b"")
+        resp = self._read_needle(fid, req)
+        if resp.status >= 300:
+            raise ValueError(resp.body.decode(errors="replace"))
+        return resp.body
+
+    def tcp_delete(self, fid_str: str, jwt: str) -> dict:
+        from ..util.http import CIDict
+        fid = FileId.parse(fid_str)
+        req = Request(method="DELETE", path="",
+                      query={"jwt": [jwt]} if jwt else {},
+                      headers=CIDict(), body=b"")
+        resp = self._delete_needle(fid, req)
+        if resp.status >= 300:
+            raise ValueError(resp.body.decode(errors="replace"))
+        return json.loads(resp.body)
 
     def _replica_locations(self, vid: int) -> list[dict]:
         """Master lookup with the same staleness window as EC locations —

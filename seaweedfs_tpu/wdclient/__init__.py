@@ -40,8 +40,13 @@ def resolve_leader(masters: str, timeout: float = 2.0) -> str:
 
 class MasterClient:
     def __init__(self, master_grpc: str, client_name: str = "client",
-                 client_type: str = "client"):
+                 client_type: str = "client", masters: str = ""):
+        """masters: optional full comma-separated master list — on stream
+        failure the client re-resolves the leader from it instead of
+        retrying a possibly-dead address forever (masterclient.go leader
+        chase)."""
         self.master_grpc = master_grpc
+        self.masters = masters
         self.client_name = client_name
         self.client_type = client_type
         self._vid_map: dict[int, list[dict]] = {}
@@ -88,6 +93,12 @@ class MasterClient:
             except RpcError:
                 pass
             self._stop.wait(1.0)
+            if self.masters and not self._stop.is_set():
+                # the homed master may be dead; chase the current leader
+                try:
+                    self.master_grpc = resolve_leader(self.masters)
+                except Exception:
+                    pass
 
     def lookup(self, vid: int) -> list[dict]:
         with self._lock:
