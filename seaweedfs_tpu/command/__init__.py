@@ -33,7 +33,8 @@ def cmd_master(args) -> int:
     m = MasterServer(host=args.ip, port=args.port, grpc_port=args.grpc_port,
                      volume_size_limit_mb=args.volume_size_limit_mb,
                      default_replication=args.default_replication,
-                     jwt_signing_key=args.jwt_key, peers=peers)
+                     jwt_signing_key=resolve_jwt_key(args.jwt_key),
+                     peers=peers)
     m.start()
     print(f"master http {m.address} grpc {m.grpc_address}")
     _wait_forever()
@@ -49,7 +50,7 @@ def cmd_volume(args) -> int:
                       data_center=args.data_center, rack=args.rack,
                       max_volume_counts=[int(c) for c in
                                          args.max.split(",")],
-                      jwt_signing_key=args.jwt_key)
+                      jwt_signing_key=resolve_jwt_key(args.jwt_key))
     vs.start()
     print(f"volume server http {vs.url} grpc {vs.grpc_address}")
     _wait_forever()
@@ -98,13 +99,13 @@ def cmd_server(args) -> int:
     # gRPC rides the http port + 10000 convention (pb/server_address.go)
     m = MasterServer(host=args.ip, port=args.master_port,
                      grpc_port=args.master_port + 10000,
-                     jwt_signing_key=args.jwt_key)
+                     jwt_signing_key=resolve_jwt_key(args.jwt_key))
     m.start()
     vs = VolumeServer(m.grpc_address, args.dir.split(","), host=args.ip,
                       port=args.volume_port,
                       max_volume_counts=[int(c) for c in
                                          args.max.split(",")],
-                      jwt_signing_key=args.jwt_key)
+                      jwt_signing_key=resolve_jwt_key(args.jwt_key))
     vs.start()
     store_path = args.filer_store_path
     if store_path is None:
@@ -425,18 +426,34 @@ def cmd_mount(args) -> int:
 
 
 def cmd_scaffold(args) -> int:
-    """Print sample configs (command/scaffold.go)."""
-    samples = {
-        "s3": {"identities": [{
-            "name": "admin",
-            "credentials": [{"accessKey": "ACCESS_KEY",
-                             "secretKey": "SECRET_KEY"}],
-            "actions": ["Admin"]}]},
-        "filer": {"store": "sqlite", "store_path": "./filer.db"},
-        "security": {"jwt_signing_key": "", "white_list": []},
-    }
-    print(json.dumps(samples.get(args.config, samples), indent=2))
+    """Print sample configs (command/scaffold.go): TOML templates for
+    the layered config system (util/config.py), plus the legacy JSON
+    samples via -output json."""
+    if getattr(args, "output", "toml") == "json":
+        samples = {
+            "s3": {"identities": [{
+                "name": "admin",
+                "credentials": [{"accessKey": "ACCESS_KEY",
+                                 "secretKey": "SECRET_KEY"}],
+                "actions": ["Admin"]}]},
+            "filer": {"store": "sqlite", "store_path": "./filer.db"},
+            "security": {"jwt_signing_key": "", "white_list": []},
+        }
+        print(json.dumps(samples.get(args.config, samples), indent=2))
+        return 0
+    from ..util.config import scaffold as toml_scaffold
+    print(toml_scaffold(args.config))
     return 0
+
+
+def resolve_jwt_key(explicit: str) -> str:
+    """Flag > WEED_JWT_SIGNING_KEY env > security.toml [jwt.signing] key
+    (util/config.py layering: env overrides apply on top of the file;
+    reference util/config.go + viper env)."""
+    if explicit:
+        return explicit
+    from ..util.config import load_config
+    return str(load_config("security").get("jwt.signing.key") or "")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -628,6 +645,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     sc = sub.add_parser("scaffold", help="print sample configs")
     sc.add_argument("-config", default="")
+    sc.add_argument("-output", default="toml", choices=["toml", "json"])
     sc.set_defaults(fn=cmd_scaffold)
 
     ver = sub.add_parser("version")
@@ -653,6 +671,32 @@ def main(argv: list[str] | None = None) -> int:
             verbosity = int(a[3:])
             argv.pop(i)
             break
+    # global mTLS: -tls.dir <dir> expects ca.crt/cluster.crt/cluster.key
+    # (security/tls.py generate_cluster_certs layout; the reference wires
+    # the same through security.toml [grpc.*])
+    tls_set = False
+    for i, a in enumerate(list(argv)):
+        if a == "-tls.dir" and i + 1 < len(argv):
+            tls_dir = argv[i + 1]
+            del argv[i:i + 2]
+            from ..pb import rpc as rpc_mod
+            from ..security.tls import TlsConfig
+            rpc_mod.set_tls(TlsConfig(
+                os.path.join(tls_dir, "ca.crt"),
+                os.path.join(tls_dir, "cluster.crt"),
+                os.path.join(tls_dir, "cluster.key")))
+            tls_set = True
+            break
+    if not tls_set:
+        # security.toml [grpc] ca/cert/key (+ WEED_GRPC_* env overrides)
+        from ..util.config import load_config
+        sec = load_config("security")
+        if sec.get("grpc.ca"):
+            from ..pb import rpc as rpc_mod
+            from ..security.tls import TlsConfig
+            rpc_mod.set_tls(TlsConfig(str(sec["grpc.ca"]),
+                                      str(sec.get("grpc.cert") or ""),
+                                      str(sec.get("grpc.key") or "")))
     from ..util import weedlog
     weedlog.setup(verbosity)
     args = build_parser().parse_args(argv)

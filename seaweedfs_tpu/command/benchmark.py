@@ -81,12 +81,7 @@ def _mp_write_worker(args) -> tuple[list[float], list[str], int]:
         for fid in operation.derive_fids(r):
             t0 = time.time()
             try:
-                if r.tcp_url:     # raw-TCP fast path when advertised
-                    operation.upload_data_tcp(r.tcp_url, fid, payload,
-                                              jwt=r.auth)
-                else:
-                    operation.upload_data(r.url, fid, payload,
-                                          jwt=r.auth)
+                operation.upload_to(r, fid, payload)
                 lats.append(time.time() - t0)
                 fids.append(fid)
             except Exception:
@@ -198,12 +193,7 @@ def run_benchmark(master_grpc: str, n_files: int = 10000,
             for fid in operation.derive_fids(r):
                 t0 = time.time()
                 try:
-                    if r.tcp_url:   # raw-TCP fast path when advertised
-                        operation.upload_data_tcp(r.tcp_url, fid,
-                                                  payload, jwt=r.auth)
-                    else:
-                        operation.upload_data(r.url, fid, payload,
-                                              jwt=r.auth)
+                    operation.upload_to(r, fid, payload)
                     stats.add(time.time() - t0, file_size)
                     with fid_lock:
                         fids.append(fid)

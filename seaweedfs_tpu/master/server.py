@@ -303,8 +303,10 @@ class MasterServer:
             seen: dict[str, dict] = {}
             for nodes in by_shard.values():
                 for dn in nodes:
-                    seen[dn.url] = {"url": dn.url,
-                                    "public_url": dn.public_url}
+                    entry = {"url": dn.url, "public_url": dn.public_url}
+                    if getattr(dn, "tcp_port", 0):
+                        entry["tcp_url"] = f"{dn.ip}:{dn.tcp_port}"
+                    seen[dn.url] = entry
             return list(seen.values())
         return [dict({"url": dn.url, "public_url": dn.public_url},
                      **({"tcp_url": f"{dn.ip}:{dn.tcp_port}"}
@@ -408,6 +410,7 @@ class MasterServer:
         return {"volume_location": {
             "url": dn.url, "public_url": dn.public_url,
             "grpc_port": dn.grpc_port,
+            "tcp_port": getattr(dn, "tcp_port", 0),
             "new_vids" if is_add else "deleted_vids":
                 sorted(dn.volumes.keys()) + sorted(dn.ec_shards.keys()),
         }}

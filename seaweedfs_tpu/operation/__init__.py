@@ -164,10 +164,19 @@ def delete_file_tcp(tcp_addr: str, fid: str, jwt: str = "") -> dict:
     return json.loads(_tcp_call(tcp_addr, "D", fid, jwt))
 
 
+def upload_to(r: AssignResult, fid: str, data: bytes) -> dict:
+    """Upload one blob against an assign result, picking the raw-TCP
+    fast path when the server advertises one — THE fast-path selection
+    logic, shared by every client (benchmark, upload CLI, tests)."""
+    if r.tcp_url:
+        return upload_data_tcp(r.tcp_url, fid, data, jwt=r.auth)
+    return upload_data(r.url, fid, data, jwt=r.auth)
+
+
 def assign_and_upload(master_grpc: str, data: bytes, **kw) -> str:
     """-> fid (the one-call `weed upload` path)."""
     r = assign(master_grpc, **kw)
-    upload_data(r.url, r.fid, data, jwt=r.auth)
+    upload_to(r, r.fid, data)
     return r.fid
 
 

@@ -27,6 +27,37 @@ class RpcError(Exception):
     pass
 
 
+# process-global mTLS config (security/tls.py TlsConfig); when set, every
+# new RpcServer port and every new pooled channel is mutual-TLS — the
+# reference's security.toml [grpc.*] applies the same way, per process
+_TLS = None
+
+
+def set_tls(tls_config) -> None:
+    global _TLS
+    _TLS = tls_config
+    POOL.close()     # cached insecure channels must not outlive the flip
+
+
+def clear_tls() -> None:
+    global _TLS
+    _TLS = None
+    POOL.close()
+
+
+def _channel_credentials():
+    ca, cert, key = _TLS.read()
+    return grpc.ssl_channel_credentials(
+        root_certificates=ca, private_key=key, certificate_chain=cert)
+
+
+def _server_credentials():
+    ca, cert, key = _TLS.read()
+    return grpc.ssl_server_credentials(
+        [(key, cert)], root_certificates=ca,
+        require_client_auth=True)
+
+
 def to_b64(raw: bytes) -> str:
     return base64.b64encode(raw).decode("ascii")
 
@@ -98,8 +129,12 @@ class RpcServer:
         return h
 
     def start(self) -> int:
-        self.port = self._server.add_insecure_port(
-            f"{self.host}:{self._requested_port}")
+        target = f"{self.host}:{self._requested_port}"
+        if _TLS is not None:
+            self.port = self._server.add_secure_port(
+                target, _server_credentials())
+        else:
+            self.port = self._server.add_insecure_port(target)
         self._server.start()
         return self.port
 
@@ -118,10 +153,14 @@ class RpcClient:
                  channel: grpc.Channel | None = None):
         self.address = address
         self.service = service
-        self._channel = channel or grpc.insecure_channel(
-            address,
-            options=[("grpc.max_receive_message_length", 256 << 20),
-                     ("grpc.max_send_message_length", 256 << 20)])
+        if channel is None:
+            options = [("grpc.max_receive_message_length", 256 << 20),
+                       ("grpc.max_send_message_length", 256 << 20)]
+            channel = grpc.secure_channel(
+                address, _channel_credentials(), options=options) \
+                if _TLS is not None \
+                else grpc.insecure_channel(address, options=options)
+        self._channel = channel
 
     def call(self, method: str, payload: dict | None = None,
              timeout: float = 30.0) -> dict:
@@ -159,10 +198,14 @@ class GrpcConnectionPool:
         with self._lock:
             ch = self._channels.get(address)
             if ch is None:
-                ch = grpc.insecure_channel(
-                    address,
-                    options=[("grpc.max_receive_message_length", 256 << 20),
-                             ("grpc.max_send_message_length", 256 << 20)])
+                options = [
+                    ("grpc.max_receive_message_length", 256 << 20),
+                    ("grpc.max_send_message_length", 256 << 20)]
+                if _TLS is not None:
+                    ch = grpc.secure_channel(
+                        address, _channel_credentials(), options=options)
+                else:
+                    ch = grpc.insecure_channel(address, options=options)
                 self._channels[address] = ch
         return RpcClient(address, service, ch)
 

@@ -1,0 +1,98 @@
+"""mTLS for the gRPC mesh — per-role certificates on every channel.
+
+Capability-equivalent to weed/security/tls.go + scaffold/security.toml:
+every gRPC surface (master, volume, filer, shell/client) presents a
+certificate signed by the cluster CA and REQUIRES the peer to do the
+same; an uncredentialed client cannot open any control-plane channel.
+
+Files are operator-provided like the reference's security.toml
+[grpc.*] sections; `generate_cluster_certs` creates a throwaway CA +
+role cert for tests and bootstrap (the reference leaves generation to
+the operator's openssl).
+
+Wiring: pb/rpc.set_tls(TlsConfig) flips the process-global channel pool
+and every subsequently started RpcServer to mutual TLS — mirroring the
+reference where security.toml applies per process.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+from dataclasses import dataclass
+
+
+@dataclass
+class TlsConfig:
+    ca_path: str
+    cert_path: str
+    key_path: str
+
+    def read(self) -> tuple[bytes, bytes, bytes]:
+        with open(self.ca_path, "rb") as f:
+            ca = f.read()
+        with open(self.cert_path, "rb") as f:
+            cert = f.read()
+        with open(self.key_path, "rb") as f:
+            key = f.read()
+        return ca, cert, key
+
+
+def generate_cluster_certs(out_dir: str, role: str = "cluster",
+                           days: int = 1) -> TlsConfig:
+    """Self-signed CA + one role certificate (SAN: localhost/127.0.0.1)
+    — enough for an in-process cluster or a single-host bootstrap."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(out_dir, exist_ok=True)
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    def _name(cn: str) -> "x509.Name":
+        return x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+    ca_key = rsa.generate_private_key(public_exponent=65537,
+                                      key_size=2048)
+    ca_cert = (x509.CertificateBuilder()
+               .subject_name(_name("seaweedfs-tpu-ca"))
+               .issuer_name(_name("seaweedfs-tpu-ca"))
+               .public_key(ca_key.public_key())
+               .serial_number(x509.random_serial_number())
+               .not_valid_before(now)
+               .not_valid_after(now + datetime.timedelta(days=days))
+               .add_extension(x509.BasicConstraints(ca=True,
+                                                    path_length=0),
+                              critical=True)
+               .sign(ca_key, hashes.SHA256()))
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    san = x509.SubjectAlternativeName([
+        x509.DNSName("localhost"),
+        x509.IPAddress(ipaddress.ip_address("127.0.0.1"))])
+    cert = (x509.CertificateBuilder()
+            .subject_name(_name(f"seaweedfs-tpu-{role}"))
+            .issuer_name(ca_cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now)
+            .not_valid_after(now + datetime.timedelta(days=days))
+            .add_extension(san, critical=False)
+            .sign(ca_key, hashes.SHA256()))
+
+    ca_path = os.path.join(out_dir, "ca.crt")
+    cert_path = os.path.join(out_dir, f"{role}.crt")
+    key_path = os.path.join(out_dir, f"{role}.key")
+    with open(ca_path, "wb") as f:
+        f.write(ca_cert.public_bytes(serialization.Encoding.PEM))
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(key_path, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption()))
+    return TlsConfig(ca_path, cert_path, key_path)

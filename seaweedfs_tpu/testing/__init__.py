@@ -38,14 +38,31 @@ def free_port() -> int:
 
 
 class SimCluster:
+    DEFAULT_JWT_KEY = "simcluster-default-jwt"
+
     def __init__(self, masters: int = 1, volume_servers: int = 2,
                  filers: int = 0, s3: bool = False,
                  racks: int = 2, max_volumes: int = 30,
-                 pulse_seconds: float = 0.4, jwt_key: str = "",
+                 pulse_seconds: float = 0.4,
+                 jwt_key: "str | None" = None,
+                 tls: bool = False,
                  base_dir: "str | None" = None, seed: int = 0):
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="simcluster-")
         self.pulse = pulse_seconds
-        self.jwt_key = jwt_key
+        # JWT ON by default: the default deployment posture must exercise
+        # the write-token path (round-1 advisory).  Pass jwt_key="" to
+        # explicitly disable.
+        self.jwt_key = self.DEFAULT_JWT_KEY if jwt_key is None else jwt_key
+        # mTLS across the whole gRPC mesh (security/tls.py); flips the
+        # process-global channel pool for this cluster's lifetime
+        self.tls = tls
+        self._tls_config = None
+        if tls:
+            from ..pb import rpc as rpc_mod
+            from ..security.tls import generate_cluster_certs
+            self._tls_config = generate_cluster_certs(
+                os.path.join(self.base_dir, "certs"))
+            rpc_mod.set_tls(self._tls_config)
         self.max_volumes = max_volumes
         self.racks = racks
         self._seed = seed
@@ -134,6 +151,9 @@ class SimCluster:
                     m.stop()
                 except Exception:
                     pass
+        if self.tls:
+            from ..pb import rpc as rpc_mod
+            rpc_mod.clear_tls()
 
     def __enter__(self) -> "SimCluster":
         return self.start()

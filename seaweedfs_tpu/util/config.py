@@ -1,0 +1,136 @@
+"""Layered configuration — TOML files + env overrides.
+
+Capability-equivalent to weed/util/config.go + command/scaffold.go:18-27:
+- TOML files discovered in ./, ~/.seaweedfs/, /etc/seaweedfs/ (first hit
+  wins), named <kind>.toml: security.toml, filer.toml, master.toml, ...
+- `WEED_<SECTION>_<KEY>` environment overrides apply on top (the
+  reference's viper SetEnvPrefix("weed") + AutomaticEnv), e.g.
+  WEED_JWT_SIGNING_KEY, WEED_GRPC_CA — section and key joined by '_',
+  matched case-insensitively against the flattened TOML tree.
+- `seaweedfs_tpu scaffold -config <kind> -output toml` prints starting
+  templates (command/scaffold.go).
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+
+SEARCH_DIRS = [".", os.path.expanduser("~/.seaweedfs"), "/etc/seaweedfs"]
+ENV_PREFIX = "WEED_"
+
+
+def find_config_file(kind: str,
+                     search_dirs: "list[str] | None" = None
+                     ) -> "str | None":
+    for d in search_dirs or SEARCH_DIRS:
+        p = os.path.join(d, f"{kind}.toml")
+        if os.path.isfile(p):
+            return p
+    return None
+
+
+def _flatten(tree: dict, prefix: str = "") -> dict[str, object]:
+    out: dict[str, object] = {}
+    for k, v in tree.items():
+        key = f"{prefix}{k}".lower()
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "."))
+        else:
+            out[key] = v
+    return out
+
+
+def load_config(kind: str, search_dirs: "list[str] | None" = None,
+                env: "dict | None" = None) -> dict[str, object]:
+    """-> flattened {'section.key': value} with env overrides applied.
+
+    WEED_SECTION_KEY=value overrides 'section.key' (dots in the config
+    path map to underscores in the env name, case-insensitive); env keys
+    that match no file entry are ADDED (env can fully drive a config
+    with no file, command/scaffold.go:20-27)."""
+    flat: dict[str, object] = {}
+    path = find_config_file(kind, search_dirs)
+    if path:
+        with open(path, "rb") as f:
+            flat = _flatten(tomllib.load(f))
+    environ = os.environ if env is None else env
+    # env name -> dotted key: resolve against the file's keys AND the
+    # scaffold template's keys, so WEED_JWT_SIGNING_KEY finds
+    # 'jwt.signing.key' even when no file exists ("env can fully drive
+    # a config with no file")
+    by_env_name = {k.replace(".", "_").upper(): k for k in flat}
+    template = SCAFFOLDS.get(kind)
+    if template:
+        for k in _flatten(tomllib.loads(template)):
+            by_env_name.setdefault(k.replace(".", "_").upper(), k)
+    for name, value in environ.items():
+        if not name.startswith(ENV_PREFIX):
+            continue
+        suffix = name[len(ENV_PREFIX):]
+        key = by_env_name.get(suffix.upper(), suffix.lower())
+        flat[key] = _coerce(value, flat.get(key))
+    return flat
+
+
+def _coerce(value: str, like: object):
+    """Env strings adopt the type of the file value they override."""
+    if isinstance(like, bool):
+        return value.lower() in ("1", "true", "yes", "on")
+    if isinstance(like, int):
+        try:
+            return int(value)
+        except ValueError:
+            return value
+    if isinstance(like, float):
+        try:
+            return float(value)
+        except ValueError:
+            return value
+    return value
+
+
+SCAFFOLDS = {
+    "security": """\
+# security.toml — JWT write tokens + mTLS for the gRPC mesh
+[jwt.signing]
+key = ""            # non-empty enables master-signed write tokens
+expires_after_seconds = 10
+
+[grpc]
+ca = ""             # path to ca.crt; non-empty enables mutual TLS
+cert = ""           # this process's certificate
+key = ""            # this process's private key
+""",
+    "filer": """\
+# filer.toml — metadata store selection
+[filer.options]
+recursive_delete = false
+
+[memory]
+enabled = true
+
+[sqlite]
+enabled = false
+dbFile = "./filer.db"
+
+[lsm]
+enabled = false
+dir = "./filer-lsm"
+""",
+    "master": """\
+# master.toml — maintenance cron
+[master.maintenance]
+scripts = ""
+sleep_minutes = 17
+
+[master.volume_growth]
+copy_1 = 7
+copy_2 = 6
+copy_3 = 3
+""",
+}
+
+
+def scaffold(kind: str) -> str:
+    return SCAFFOLDS.get(kind) or "".join(SCAFFOLDS.values())

@@ -81,25 +81,32 @@ class TcpDataServer:
 
     def __init__(self, volume_server, host: str = "127.0.0.1"):
         self.vs = volume_server
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, 0))
-        self._sock.listen(128)
-        self.port = self._sock.getsockname()[1]
+        self.host = host
+        self.port = 0
+        self._sock: socket.socket | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     def start(self) -> None:
+        """Bind + listen here, not in __init__ — same lifecycle as the
+        sibling http/rpc servers (a constructed-but-never-started server
+        must not squat a listening socket)."""
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, 0))
+        self._sock.listen(128)
+        self.port = self._sock.getsockname()[1]
         self._thread = threading.Thread(target=self._accept_loop,
                                         daemon=True, name="vs-tcp")
         self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():

@@ -69,6 +69,9 @@ class MasterClient:
             return
         entry = {"url": loc["url"], "public_url": loc.get("public_url", ""),
                  "grpc_port": loc.get("grpc_port", 0)}
+        if loc.get("tcp_port"):
+            host = loc["url"].rsplit(":", 1)[0]
+            entry["tcp_url"] = f"{host}:{loc['tcp_port']}"
         with self._lock:
             for vid in loc.get("new_vids", []):
                 lst = self._vid_map.setdefault(int(vid), [])

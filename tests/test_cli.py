@@ -61,7 +61,14 @@ def test_shell_oneshot_cli(cluster, capsys):
 
 
 def test_scaffold_and_version(capsys):
-    assert main(["scaffold", "-config", "s3"]) == 0
+    # default output is now TOML templates (util/config.py layering)
+    import tomllib
+    assert main(["scaffold", "-config", "security"]) == 0
+    toml_out = capsys.readouterr().out
+    assert "jwt.signing" in toml_out
+    tomllib.loads(toml_out)
+    # legacy JSON samples stay available
+    assert main(["scaffold", "-config", "s3", "-output", "json"]) == 0
     cfg = json.loads(capsys.readouterr().out)
     assert cfg["identities"][0]["name"] == "admin"
     assert main(["version"]) == 0

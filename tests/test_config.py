@@ -1,0 +1,81 @@
+"""Layered config (util/config.py): TOML discovery, WEED_* env
+overrides, scaffold templates, and the security.toml -> jwt/TLS wiring
+— reference util/config.go + command/scaffold.go."""
+
+import os
+import subprocess
+import sys
+
+from seaweedfs_tpu.util.config import (find_config_file, load_config,
+                                       scaffold)
+
+
+def test_toml_discovery_first_dir_wins(tmp_path):
+    d1 = tmp_path / "one"
+    d2 = tmp_path / "two"
+    d1.mkdir()
+    d2.mkdir()
+    (d1 / "security.toml").write_text('[jwt.signing]\nkey = "from-one"\n')
+    (d2 / "security.toml").write_text('[jwt.signing]\nkey = "from-two"\n')
+    dirs = [str(d1), str(d2)]
+    assert find_config_file("security", dirs) == str(d1 / "security.toml")
+    cfg = load_config("security", dirs, env={})
+    assert cfg["jwt.signing.key"] == "from-one"
+    assert find_config_file("missing", dirs) is None
+    assert load_config("missing", dirs, env={}) == {}
+
+
+def test_env_overrides_and_typed_coercion(tmp_path):
+    (tmp_path / "master.toml").write_text(
+        "[master.volume_growth]\ncopy_1 = 7\n"
+        "[master.maintenance]\nsleep_minutes = 17\nenabled = true\n")
+    env = {
+        "WEED_MASTER_VOLUME_GROWTH_COPY_1": "9",     # int coercion
+        "WEED_MASTER_MAINTENANCE_ENABLED": "false",  # bool coercion
+        "WEED_BRAND_NEW_KEY": "added",               # env-only key
+        "IGNORED_VAR": "x",
+    }
+    cfg = load_config("master", [str(tmp_path)], env=env)
+    assert cfg["master.volume_growth.copy_1"] == 9
+    assert cfg["master.maintenance.enabled"] is False
+    assert cfg["master.maintenance.sleep_minutes"] == 17
+    assert cfg["brand_new_key"] == "added"
+    assert "ignored_var" not in cfg
+
+
+def test_scaffold_templates_parse():
+    import tomllib
+    for kind in ("security", "filer", "master"):
+        tomllib.loads(scaffold(kind))
+    assert "[jwt.signing]" in scaffold("security")
+
+
+def test_security_toml_drives_jwt(tmp_path):
+    """A server started with no -jwtKey picks the key up from
+    security.toml in the working directory (the reference's layering)."""
+    (tmp_path / "security.toml").write_text(
+        '[jwt.signing]\nkey = "toml-layer-key"\n')
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, '/root/repo'); "
+         "from seaweedfs_tpu.command import resolve_jwt_key; "
+         "print(resolve_jwt_key(''))"],
+        capture_output=True, text=True, cwd=str(tmp_path))
+    assert out.stdout.strip() == "toml-layer-key", out.stderr
+    # explicit flag wins over the file
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, '/root/repo'); "
+         "from seaweedfs_tpu.command import resolve_jwt_key; "
+         "print(resolve_jwt_key('flag-wins'))"],
+        capture_output=True, text=True, cwd=str(tmp_path))
+    assert out.stdout.strip() == "flag-wins"
+    # env override beats the file
+    env = dict(os.environ, WEED_JWT_SIGNING_KEY="env-wins")
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, '/root/repo'); "
+         "from seaweedfs_tpu.command import resolve_jwt_key; "
+         "print(resolve_jwt_key(''))"],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env)
+    assert out.stdout.strip() == "env-wins"

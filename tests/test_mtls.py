@@ -1,0 +1,56 @@
+"""mTLS across the gRPC mesh (security/tls.py + pb/rpc set_tls) and the
+JWT-on-by-default SimCluster posture — round-1 VERDICT item 8."""
+
+import grpc
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.pb.rpc import RpcClient, RpcError
+from seaweedfs_tpu.testing import SimCluster
+
+
+def test_mtls_cluster_end_to_end(tmp_path):
+    with SimCluster(volume_servers=2, tls=True,
+                    base_dir=str(tmp_path)) as c:
+        # the whole mesh (heartbeats, assigns, lookups) rides mutual TLS
+        fid = c.upload(b"over mTLS")
+        assert c.read(fid) == b"over mTLS"
+        # plaintext client: rejected during the handshake
+        ch = grpc.insecure_channel(c.master_grpc)
+        with pytest.raises(RpcError):
+            RpcClient(c.master_grpc, "Seaweed", ch).call(
+                "Assign", {"count": 1}, timeout=3)
+        # TLS client WITHOUT a client certificate: mutual auth refuses
+        ca, _, _ = c._tls_config.read()
+        creds = grpc.ssl_channel_credentials(root_certificates=ca)
+        ch2 = grpc.secure_channel(c.master_grpc, creds)
+        with pytest.raises(RpcError):
+            RpcClient(c.master_grpc, "Seaweed", ch2).call(
+                "Assign", {"count": 1}, timeout=3)
+
+
+def test_mtls_state_resets_after_cluster(tmp_path):
+    with SimCluster(volume_servers=1, tls=True,
+                    base_dir=str(tmp_path / "a")) as c:
+        assert c.read(c.upload(b"x")) == b"x"
+    # a later cluster runs plaintext again (global flag cleared)
+    with SimCluster(volume_servers=1,
+                    base_dir=str(tmp_path / "b")) as c2:
+        assert c2.read(c2.upload(b"y")) == b"y"
+
+
+def test_jwt_on_by_default(tmp_path):
+    """The default SimCluster posture requires master-signed write
+    tokens — an unauthenticated direct write to a volume server fails."""
+    from seaweedfs_tpu.util.http import http_request
+    with SimCluster(volume_servers=1, base_dir=str(tmp_path)) as c:
+        assert c.jwt_key, "jwt must be on by default"
+        r = operation.assign(c.master_grpc)
+        assert r.auth, "assign must return a signed token"
+        # without the token: 401
+        status, _, _ = http_request(f"http://{r.url}/{r.fid}",
+                                    method="POST", body=b"nope")
+        assert status == 401
+        # with it: accepted
+        operation.upload_data(r.url, r.fid, b"ok", jwt=r.auth)
+        assert operation.read_file(c.master_grpc, r.fid) == b"ok"
