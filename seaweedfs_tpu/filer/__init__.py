@@ -10,4 +10,7 @@ from .filechunks import (compact_file_chunks,
 from .filer import Filer, MetaEvent
 from .filerstore import (STORES, FilerStore, MemoryStore, NotFound,
                          SqliteStore, new_filer_store)
+from .lsm_store import LsmStore
+
+STORES["lsm"] = LsmStore
 from .server import FilerServer

@@ -109,8 +109,14 @@ class FilerServer:
         self.collection = collection
         self.replication = replication
         self.chunk_size = chunk_size
+        if store_kind == "lsm" and store_path in (":memory:", None, ""):
+            # the sqlite sentinel default would become a literal
+            # ':memory:' DIRECTORY for the lsm store — use its own
+            # default (matches the filer.toml scaffold)
+            store_path = "./filer-lsm"
         store = (new_filer_store(store_kind, store_path)
-                 if store_kind == "sqlite" else new_filer_store(store_kind))
+                 if store_kind in ("sqlite", "lsm")
+                 else new_filer_store(store_kind))
         self.filer = Filer(store, delete_chunks_fn=self._enqueue_deletion)
         # read-path chunk cache tiers (util/chunk_cache + reader_at.go);
         # fids are immutable so entries only ever age out by capacity

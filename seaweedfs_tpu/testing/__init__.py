@@ -57,12 +57,6 @@ class SimCluster:
         # process-global channel pool for this cluster's lifetime
         self.tls = tls
         self._tls_config = None
-        if tls:
-            from ..pb import rpc as rpc_mod
-            from ..security.tls import generate_cluster_certs
-            self._tls_config = generate_cluster_certs(
-                os.path.join(self.base_dir, "certs"))
-            rpc_mod.set_tls(self._tls_config)
         self.max_volumes = max_volumes
         self.racks = racks
         self._seed = seed
@@ -108,6 +102,23 @@ class SimCluster:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, timeout: float = 15.0) -> "SimCluster":
+        if self.tls:
+            # flip the process-global TLS state here (not __init__) and
+            # guarantee cleanup on ANY start failure — a leaked flip
+            # would break every later plaintext cluster in the process
+            from ..pb import rpc as rpc_mod
+            from ..security.tls import generate_cluster_certs
+            if self._tls_config is None:
+                self._tls_config = generate_cluster_certs(
+                    os.path.join(self.base_dir, "certs"))
+            rpc_mod.set_tls(self._tls_config)
+        try:
+            return self._start_inner(timeout)
+        except Exception:
+            self.stop()
+            raise
+
+    def _start_inner(self, timeout: float) -> "SimCluster":
         for m in self.masters:
             m.start()
         if self.peers:
@@ -197,10 +208,26 @@ class SimCluster:
                 vs.heartbeat_now()
 
     def upload(self, data: bytes, **kw) -> str:
-        return operation.assign_and_upload(self.master_grpc, data, **kw)
+        return self._retry(lambda: operation.assign_and_upload(
+            self.master_grpc, data, **kw))
 
     def read(self, fid: str) -> bytes:
-        return operation.read_file(self.master_grpc, fid)
+        return self._retry(lambda: operation.read_file(
+            self.master_grpc, fid))
+
+    @staticmethod
+    def _retry(fn, timeout: float = 8.0):
+        """Clients retry through elections — a raft leader change makes
+        master RPCs fail for a bounded window (clients in the reference
+        do the same via masterclient leader-chasing)."""
+        deadline = time.time() + timeout
+        while True:
+            try:
+                return fn()
+            except Exception:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.2)
 
     # -- fault injection ---------------------------------------------------
     def kill_master(self, i: int) -> None:

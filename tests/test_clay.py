@@ -1,0 +1,107 @@
+"""Clay MSR regenerating codes (ops/clay.py) — the last BASELINE.md
+stretch.  VERDICT round-1 done-criterion: a test showing FEWER than k
+shard-reads' worth of bytes repairs one lost shard vs RS(10,4)."""
+
+import itertools
+import random
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops.clay import ClayCode
+
+
+def _full_shards(c: ClayCode, rng, B: int = 8):
+    data = rng.integers(0, 256, size=(c.k, c.alpha, B), dtype=np.uint8)
+    parity = c.encode(data)
+    shards = {i: data[i] for i in range(c.k)}
+    shards.update({c.k + j: parity[j] for j in range(c.m)})
+    return data, shards
+
+
+def test_small_geometry_all_loss_patterns():
+    """k=4,m=2 (q=2,t=3,alpha=8, no shortening): every possible m-loss
+    pattern recovers bit-exactly."""
+    c = ClayCode(k=4, m=2)
+    assert (c.n0, c.alpha, c.virtual) == (6, 8, 0)
+    rng = np.random.default_rng(7)
+    data, shards = _full_shards(c, rng, B=16)
+    for lost in itertools.combinations(range(c.k + c.m), c.m):
+        rec = c.decode({i: v for i, v in shards.items()
+                        if i not in lost}, list(lost))
+        for e in lost:
+            assert np.array_equal(rec[e], shards[e]), (lost, e)
+
+
+def test_rs10_4_geometry_mds_recovery():
+    """(10,4) via shortening (n0=16, alpha=256, 2 virtual zero nodes):
+    sampled + adversarial 4-loss patterns recover bit-exactly."""
+    c = ClayCode(k=10, m=4)
+    assert (c.q, c.t, c.alpha, c.virtual, c.beta) == (4, 4, 256, 2, 64)
+    rng = np.random.default_rng(11)
+    data, shards = _full_shards(c, rng)
+    random.seed(3)
+    combos = random.sample(
+        list(itertools.combinations(range(14), 4)), 8)
+    combos += [(0, 1, 2, 3), (10, 11, 12, 13), (0, 5, 10, 13)]
+    for lost in combos:
+        rec = c.decode({i: v for i, v in shards.items()
+                        if i not in lost}, list(lost))
+        for e in lost:
+            assert np.array_equal(rec[e], shards[e]), (lost, e)
+    with pytest.raises(ValueError):
+        c.decode(shards, [0, 1, 2, 3, 4])
+
+
+def test_single_node_repair_reads_less_than_rs():
+    """THE regenerating-code property: one lost shard rebuilds from
+    beta=alpha/q symbols per helper — 832 symbol units total vs
+    RS(10,4)'s k*alpha=2560 (3.08x less repair IO), verified by
+    actually repairing from ONLY the planned reads."""
+    c = ClayCode(k=10, m=4)
+    rng = np.random.default_rng(23)
+    data, shards = _full_shards(c, rng)
+    assert c.repair_read_symbols() == 13 * 64 == 832
+    assert c.rs_repair_read_symbols() == 10 * 256 == 2560
+    assert c.repair_read_symbols() < c.rs_repair_read_symbols()
+    for lost in range(c.k + c.m):
+        plan = c.repair_plan(lost)
+        # the plan really is beta layers from every real helper
+        assert sum(len(zs) for zs in plan.values()) \
+            == c.repair_read_symbols()
+        assert all(len(zs) == c.beta for zs in plan.values())
+        helper_syms = {h: {z: shards[h][z] for z in zs}
+                       for h, zs in plan.items()}
+        got = c.repair(lost, helper_syms)
+        assert np.array_equal(got, shards[lost]), lost
+
+
+def test_repair_bytes_vs_rs_in_bytes():
+    """Byte accounting at a realistic symbol width: repairing one of a
+    256 KB-per-shard stripe reads 0.83 MB with Clay vs 2.56 MB with
+    RS — fewer bytes than k-1 whole shards, let alone k."""
+    c = ClayCode(k=10, m=4)
+    bytes_per_symbol = 1024          # 256 KB shard / 256 layers
+    clay_bytes = c.repair_read_symbols() * bytes_per_symbol
+    rs_bytes = c.rs_repair_read_symbols() * bytes_per_symbol
+    shard_bytes = c.alpha * bytes_per_symbol
+    assert clay_bytes == 832 * 1024
+    assert rs_bytes == 10 * shard_bytes
+    assert clay_bytes < (c.k - 1) * shard_bytes   # < k-1 shards even
+
+
+def test_systematic_and_zero_data():
+    """Data nodes store raw data; all-zero data encodes to all-zero
+    parity (linear code sanity)."""
+    c = ClayCode(k=4, m=2)
+    zero = np.zeros((c.k, c.alpha, 4), dtype=np.uint8)
+    parity = c.encode(zero)
+    assert not parity.any()
+    rng = np.random.default_rng(5)
+    data, shards = _full_shards(c, rng, B=4)
+    # linearity: encode(a ^ b) == encode(a) ^ encode(b)
+    data2 = rng.integers(0, 256, size=data.shape, dtype=np.uint8)
+    p1 = c.encode(data)
+    p2 = c.encode(data2)
+    p12 = c.encode(data ^ data2)
+    assert np.array_equal(p12, p1 ^ p2)

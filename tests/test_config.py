@@ -3,8 +3,11 @@ overrides, scaffold templates, and the security.toml -> jwt/TLS wiring
 — reference util/config.go + command/scaffold.go."""
 
 import os
+import pathlib
 import subprocess
 import sys
+
+REPO = str(pathlib.Path(__file__).resolve().parents[1])
 
 from seaweedfs_tpu.util.config import (find_config_file, load_config,
                                        scaffold)
@@ -57,7 +60,7 @@ def test_security_toml_drives_jwt(tmp_path):
         '[jwt.signing]\nkey = "toml-layer-key"\n')
     out = subprocess.run(
         [sys.executable, "-c",
-         "import sys; sys.path.insert(0, '/root/repo'); "
+         f"import sys; sys.path.insert(0, {REPO!r}); "
          "from seaweedfs_tpu.command import resolve_jwt_key; "
          "print(resolve_jwt_key(''))"],
         capture_output=True, text=True, cwd=str(tmp_path))
@@ -65,7 +68,7 @@ def test_security_toml_drives_jwt(tmp_path):
     # explicit flag wins over the file
     out = subprocess.run(
         [sys.executable, "-c",
-         "import sys; sys.path.insert(0, '/root/repo'); "
+         f"import sys; sys.path.insert(0, {REPO!r}); "
          "from seaweedfs_tpu.command import resolve_jwt_key; "
          "print(resolve_jwt_key('flag-wins'))"],
         capture_output=True, text=True, cwd=str(tmp_path))
@@ -74,7 +77,7 @@ def test_security_toml_drives_jwt(tmp_path):
     env = dict(os.environ, WEED_JWT_SIGNING_KEY="env-wins")
     out = subprocess.run(
         [sys.executable, "-c",
-         "import sys; sys.path.insert(0, '/root/repo'); "
+         f"import sys; sys.path.insert(0, {REPO!r}); "
          "from seaweedfs_tpu.command import resolve_jwt_key; "
          "print(resolve_jwt_key(''))"],
         capture_output=True, text=True, cwd=str(tmp_path), env=env)
