@@ -425,6 +425,20 @@ def cmd_mount(args) -> int:
                            foreground=True)
 
 
+def cmd_ftp(args) -> int:
+    """FTP gateway over the filer (beyond the reference: its ftpd is an
+    unimplemented stub, weed/ftpd/ftp_server.go)."""
+    from ..ftpd import FtpServer
+    from ..pb import ServerAddress
+    filer = ServerAddress.parse(args.filer)
+    srv = FtpServer(filer.url, filer.grpc, host=args.ip, port=args.port)
+    srv.start()
+    print(f"ftp gateway {srv.address}")
+    _wait_forever()
+    srv.stop()
+    return 0
+
+
 def cmd_scaffold(args) -> int:
     """Print sample configs (command/scaffold.go): TOML templates for
     the layered config system (util/config.py), plus the legacy JSON
@@ -642,6 +656,12 @@ def build_parser() -> argparse.ArgumentParser:
     mnt.add_argument("-master", default="127.0.0.1:19333")
     mnt.add_argument("-dir", required=True)
     mnt.set_defaults(fn=cmd_mount)
+
+    ftp = sub.add_parser("ftp", help="start an FTP gateway")
+    ftp.add_argument("-ip", default="127.0.0.1")
+    ftp.add_argument("-port", type=int, default=8021)
+    ftp.add_argument("-filer", default="127.0.0.1:8888.18888")
+    ftp.set_defaults(fn=cmd_ftp)
 
     sc = sub.add_parser("scaffold", help="print sample configs")
     sc.add_argument("-config", default="")

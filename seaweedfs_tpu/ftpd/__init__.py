@@ -1,24 +1,403 @@
-"""FTP gateway — skeleton, matching the reference's own state.
+"""FTP gateway — a working RFC 959 subset over the filer.
 
-The reference ships only an unimplemented driver stub
-(weed/ftpd/ftp_server.go:13-20, 81 lines: ftpserverlib wiring with every
-driver method returning 'not implemented').  The same honest skeleton
-here: the server shape exists so a driver can land, and start() explains
-what's missing instead of pretending.
+BEYOND the reference here: weed/ftpd/ftp_server.go:13-20 ships only an
+unimplemented driver stub (every ftpserverlib method returns "not
+implemented"); this is a functioning gateway speaking the protocol
+subset every common client uses — USER/PASS (anonymous or any
+credentials; authorization is the filer's concern), PWD/CWD/CDUP,
+TYPE, PASV (passive data connections only — the NAT-safe mode), LIST,
+NLST, RETR, STOR, DELE, MKD, RMD, RNFR/RNTO, SIZE, FEAT, SYST, NOOP,
+QUIT.
+
+Data flows through the filer HTTP surface (streamed chunked files,
+collection/TTL rules, replication — everything the namespace already
+does), exactly like the WebDAV gateway's adapter pattern
+(server/webdav_server.go).
 """
 
 from __future__ import annotations
 
+import socket
+import threading
+
+from ..pb.rpc import POOL, RpcError
+from ..util.http import http_request
+from ..util.weedlog import logger
+
+LOG = logger(__name__)
+
 
 class FtpServer:
-    def __init__(self, filer_grpc: str, host: str = "127.0.0.1",
-                 port: int = 8021):
+    def __init__(self, filer_http: str, filer_grpc: str,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.filer_http = filer_http
         self.filer_grpc = filer_grpc
         self.host = host
-        self.port = port
+        self._requested_port = port
+        self.port = 0
+        self._sock: "socket.socket | None" = None
+        self._stop = threading.Event()
 
+    # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
-        raise NotImplementedError(
-            "FTP driver is a skeleton in the reference too "
-            "(weed/ftpd/ftp_server.go); use the WebDAV or S3 gateway, or "
-            "implement the driver against seaweedfs_tpu.filer's gRPC API")
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self._requested_port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="ftpd").start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=_Session(self, conn).run,
+                             daemon=True).start()
+
+    # -- filer access -------------------------------------------------------
+    def _filer(self):
+        return POOL.client(self.filer_grpc, "SeaweedFiler")
+
+    def lookup(self, path: str) -> "dict | None":
+        directory, _, name = path.rstrip("/").rpartition("/")
+        if not name:
+            return {"full_path": "/", "attr": {"mode": 0o40000 | 0o770}}
+        try:
+            return self._filer().call("LookupDirectoryEntry", {
+                "directory": directory or "/", "name": name})["entry"]
+        except RpcError:
+            return None
+
+    def list_dir(self, path: str) -> list[dict]:
+        """Paginated — a single default-limit request would silently
+        truncate big directories at 1024 names."""
+        out: list[dict] = []
+        start = ""
+        while True:
+            try:
+                page = [r["entry"] for r in self._filer().stream(
+                    "ListEntries",
+                    iter([{"directory": path or "/",
+                           "start_from_file_name": start,
+                           "limit": 1024}]))]
+            except RpcError:
+                return out
+            out.extend(page)
+            if len(page) < 1024:
+                return out
+            start = page[-1]["full_path"].rsplit("/", 1)[-1]
+
+    @staticmethod
+    def _url_path(path: str) -> str:
+        import urllib.parse
+        return urllib.parse.quote(path, safe="/")
+
+    def read_file(self, path: str) -> "bytes | None":
+        entry = self.lookup(path)
+        if entry is None or _is_dir(entry):
+            return None      # RETR of a directory must 550, not JSON
+        status, body, _ = http_request(
+            f"http://{self.filer_http}{self._url_path(path)}")
+        return body if status == 200 else None
+
+    def write_file(self, path: str, data: bytes) -> bool:
+        status, _, _ = http_request(
+            f"http://{self.filer_http}{self._url_path(path)}",
+            method="POST", body=data)
+        return status in (200, 201)
+
+    def delete(self, path: str, recursive: bool) -> bool:
+        directory, _, name = path.rstrip("/").rpartition("/")
+        try:
+            self._filer().call("DeleteEntry", {
+                "directory": directory or "/", "name": name,
+                "is_recursive": recursive,
+                "ignore_recursive_error": False})
+            return True
+        except RpcError:
+            return False
+
+    def mkdir(self, path: str) -> bool:
+        import time
+        now = time.time()
+        try:
+            self._filer().call("CreateEntry", {"entry": {
+                "full_path": path.rstrip("/"),
+                "attr": {"mtime": now, "crtime": now,
+                         "mode": 0o40000 | 0o770}}})
+            return True
+        except RpcError:
+            return False
+
+    def rename(self, old: str, new: str) -> bool:
+        od, _, on = old.rstrip("/").rpartition("/")
+        nd, _, nn = new.rstrip("/").rpartition("/")
+        try:
+            self._filer().call("AtomicRenameEntry", {
+                "old_directory": od or "/", "old_name": on,
+                "new_directory": nd or "/", "new_name": nn})
+            return True
+        except RpcError:
+            return False
+
+
+def _is_dir(entry: dict) -> bool:
+    return bool(entry["attr"].get("mode", 0) & 0o40000)
+
+
+def _entry_size(entry: dict) -> int:
+    # max(offset+size), NOT sum(size): MVCC rewrites leave overlapping
+    # chunks (same semantics as filer/filechunks.total_size)
+    return max((c.get("offset", 0) + c.get("size", 0)
+                for c in entry.get("chunks", [])), default=0)
+
+
+class _Session:
+    """One FTP control connection."""
+
+    def __init__(self, server: FtpServer, conn: socket.socket):
+        self.srv = server
+        self.conn = conn
+        self.cwd = "/"
+        self.rnfr = ""
+        self._pasv: "socket.socket | None" = None
+
+    # -- plumbing -----------------------------------------------------------
+    def _send(self, line: str) -> None:
+        self.conn.sendall((line + "\r\n").encode())
+
+    def _abspath(self, arg: str) -> str:
+        path = arg if arg.startswith("/") else \
+            self.cwd.rstrip("/") + "/" + arg
+        parts: list[str] = []
+        for seg in path.split("/"):
+            if seg in ("", "."):
+                continue
+            if seg == "..":
+                if parts:
+                    parts.pop()
+            else:
+                parts.append(seg)
+        return "/" + "/".join(parts)
+
+    def _close_pasv(self) -> None:
+        if self._pasv is not None:
+            try:
+                self._pasv.close()
+            except OSError:
+                pass
+            self._pasv = None
+
+    def _open_data(self) -> "socket.socket | None":
+        if self._pasv is None:
+            return None
+        try:
+            data, _ = self._pasv.accept()
+            return data
+        except OSError:
+            return None
+        finally:
+            self._close_pasv()
+
+    # -- main loop ----------------------------------------------------------
+    def run(self) -> None:
+        try:
+            self._send("220 seaweedfs-tpu FTP ready")
+            buf = b""
+            while True:
+                chunk = self.conn.recv(4096)
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\r\n" in buf:
+                    line, buf = buf.split(b"\r\n", 1)
+                    if not self._dispatch(line.decode(errors="replace")):
+                        return
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            self._close_pasv()
+            try:
+                self.conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, line: str) -> bool:
+        cmd, _, arg = line.partition(" ")
+        cmd = cmd.upper()
+        handler = getattr(self, f"_cmd_{cmd.lower()}", None)
+        if handler is None:
+            self._send(f"502 {cmd} not implemented")
+            return True
+        return handler(arg) is not False
+
+    # -- commands -----------------------------------------------------------
+    def _cmd_user(self, arg):
+        self._send(f"331 password required for {arg or 'anonymous'}")
+
+    def _cmd_pass(self, arg):
+        self._send("230 logged in")
+
+    def _cmd_syst(self, arg):
+        self._send("215 UNIX Type: L8")
+
+    def _cmd_feat(self, arg):
+        self.conn.sendall(b"211-Features:\r\n SIZE\r\n PASV\r\n211 End\r\n")
+
+    def _cmd_type(self, arg):
+        self._send("200 type set")
+
+    def _cmd_noop(self, arg):
+        self._send("200 ok")
+
+    def _cmd_pwd(self, arg):
+        self._send(f'257 "{self.cwd}"')
+
+    def _cmd_cwd(self, arg):
+        target = self._abspath(arg)
+        entry = self.srv.lookup(target)
+        if entry is None or not _is_dir(entry):
+            self._send("550 no such directory")
+        else:
+            self.cwd = target
+            self._send("250 ok")
+
+    def _cmd_cdup(self, arg):
+        self.cwd = self._abspath("..")
+        self._send("250 ok")
+
+    def _cmd_pasv(self, arg):
+        self._close_pasv()      # never leak a prior listener
+        # advertise the CONTROL connection's local IP — binding 0.0.0.0
+        # or a hostname would otherwise produce an unusable 227 reply
+        ip = self.conn.getsockname()[0]
+        self._pasv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._pasv.bind((ip, 0))
+        self._pasv.listen(1)
+        port = self._pasv.getsockname()[1]
+        self._send(f"227 Entering Passive Mode "
+                   f"({ip.replace('.', ',')},{port >> 8},{port & 0xff})")
+
+    def _cmd_list(self, arg):
+        return self._list(arg, long=True)
+
+    def _cmd_nlst(self, arg):
+        return self._list(arg, long=False)
+
+    def _list(self, arg, long: bool):
+        path = self._abspath(arg) if arg and not arg.startswith("-") \
+            else self.cwd
+        data = self._open_data()
+        if data is None:
+            self._send("425 use PASV first")
+            return True
+        self._send("150 listing")
+        lines = []
+        for e in self.srv.list_dir(path):
+            name = e["full_path"].rsplit("/", 1)[-1]
+            if long:
+                kind = "d" if _is_dir(e) else "-"
+                size = _entry_size(e)
+                lines.append(f"{kind}rwxr-xr-x 1 weed weed "
+                             f"{size:>12} Jan  1 00:00 {name}")
+            else:
+                lines.append(name)
+        try:
+            data.sendall(("\r\n".join(lines) + "\r\n").encode()
+                         if lines else b"")
+        finally:
+            data.close()
+        self._send("226 done")
+
+    def _cmd_retr(self, arg):
+        path = self._abspath(arg)
+        blob = self.srv.read_file(path)
+        if blob is None:
+            self._close_pasv()   # don't strand the queued data conn
+            self._send("550 no such file")
+            return True
+        data = self._open_data()
+        if data is None:
+            self._send("425 use PASV first")
+            return True
+        self._send(f"150 opening data connection ({len(blob)} bytes)")
+        try:
+            data.sendall(blob)
+        finally:
+            data.close()
+        self._send("226 transfer complete")
+
+    def _cmd_stor(self, arg):
+        path = self._abspath(arg)
+        data = self._open_data()
+        if data is None:
+            self._send("425 use PASV first")
+            return True
+        self._send("150 ready")
+        chunks = []
+        while True:
+            piece = data.recv(1 << 16)
+            if not piece:
+                break
+            chunks.append(piece)
+        data.close()
+        if self.srv.write_file(path, b"".join(chunks)):
+            self._send("226 stored")
+        else:
+            self._send("550 store failed")
+
+    def _cmd_dele(self, arg):
+        if self.srv.delete(self._abspath(arg), recursive=False):
+            self._send("250 deleted")
+        else:
+            self._send("550 delete failed")
+
+    def _cmd_mkd(self, arg):
+        path = self._abspath(arg)
+        if self.srv.mkdir(path):
+            self._send(f'257 "{path}" created')
+        else:
+            self._send("550 mkdir failed")
+
+    def _cmd_rmd(self, arg):
+        if self.srv.delete(self._abspath(arg), recursive=False):
+            self._send("250 removed")
+        else:
+            self._send("550 rmdir failed")
+
+    def _cmd_rnfr(self, arg):
+        self.rnfr = self._abspath(arg)
+        self._send("350 ready for RNTO")
+
+    def _cmd_rnto(self, arg):
+        if self.rnfr and self.srv.rename(self.rnfr, self._abspath(arg)):
+            self._send("250 renamed")
+        else:
+            self._send("550 rename failed")
+        self.rnfr = ""
+
+    def _cmd_size(self, arg):
+        entry = self.srv.lookup(self._abspath(arg))
+        if entry is None or _is_dir(entry):
+            self._send("550 no such file")
+        else:
+            self._send(f"213 {_entry_size(entry)}")
+
+    def _cmd_quit(self, arg):
+        self._send("221 bye")
+        return False

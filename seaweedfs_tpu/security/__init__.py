@@ -73,13 +73,33 @@ def decode_jwt(signing_key: str, token: str) -> dict:
     return claims
 
 
+# decoded-token cache: a batch assign reuses ONE token for its whole
+# key range, so the write hot path would otherwise pay HMAC + json +
+# base64 per request for the same token (the range check stays per-fid)
+_TOKEN_CACHE: dict = {}
+_TOKEN_CACHE_MAX = 512
+
+
+def _decode_jwt_cached(signing_key: str, token: str) -> dict:
+    hit = _TOKEN_CACHE.get((signing_key, token))
+    if hit is not None:
+        if "exp" in hit and time.time() > hit["exp"]:
+            raise JwtError("token expired")
+        return hit
+    claims = decode_jwt(signing_key, token)
+    if len(_TOKEN_CACHE) >= _TOKEN_CACHE_MAX:
+        _TOKEN_CACHE.clear()
+    _TOKEN_CACHE[(signing_key, token)] = claims
+    return claims
+
+
 def verify_fid_jwt(signing_key: str, token: str, fid: str) -> None:
     """The volume-server write gate: token must be valid AND scoped to
     this fid — exact match, or a vid token whose KeyBase/KeyCount claims
     (batch assigns) cover the fid's needle key.  A bare vid token with no
     key range is accepted for backward compatibility (the reference's
     vid-wide tokens)."""
-    claims = decode_jwt(signing_key, token)
+    claims = _decode_jwt_cached(signing_key, token)
     claimed = claims.get("Fid", "")
     if not claimed or claimed == fid:
         return
