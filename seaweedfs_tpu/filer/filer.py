@@ -305,12 +305,75 @@ class Filer:
 
     def _load_hardlink(self, link_id: str) -> dict:
         import json as _json
-        return _json.loads(self.store.kv_get(self._hardlink_key(link_id)))
+        content = _json.loads(
+            self.store.kv_get(self._hardlink_key(link_id)))
+        if content.get("deleted"):
+            # tombstone: blocks resurrection by stale replicated shadows
+            raise KeyError(f"hardlink {link_id} deleted")
+        return content
+
+    HARDLINK_SYNC_DIR = "/.meta/hardlinks"
 
     def _save_hardlink(self, link_id: str, content: dict) -> None:
         import json as _json
+        import time as _time
+        content = dict(content)
+        # ALWAYS stamp: a record loaded from KV carries its old ts, and
+        # a stale stamp would turn last-writer-wins into
+        # last-delivered-wins (divergent counters)
+        content["ts_ns"] = _time.time_ns()
         self.store.kv_put(self._hardlink_key(link_id),
                           _json.dumps(content).encode())
+        # shadow ENTRY under a system path: its metadata event replicates
+        # the link record (incl. the nlink counter) to peer filers via
+        # the normal aggregator stream — closing the round-1 caveat that
+        # counters were per-origin-filer.  Last-writer-wins by ts_ns.
+        payload = _json.dumps(content)
+        shadow = Entry(
+            full_path=f"{self.HARDLINK_SYNC_DIR}/{link_id}",
+            attr=Attr(mtime=content["ts_ns"] / 1e9,
+                      crtime=content["ts_ns"] / 1e9, mode=0o600),
+            extended={"hardlink.record": payload})
+        self.store.insert_entry(shadow)
+        self._notify(None, shadow)
+
+    def _delete_hardlink_record(self, link_id: str) -> None:
+        """Last link died: drop the KV record and replicate a TOMBSTONE
+        shadow so peers drop theirs too (a silent local delete would
+        leave dead records serving freed chunk ids on peers)."""
+        import json as _json
+        import time as _time
+        ts = _time.time_ns()
+        tomb = _json.dumps({"deleted": True, "ts_ns": ts})
+        # tombstone stays IN the KV (not kv_delete): an older replicated
+        # shadow arriving later must not resurrect the record
+        self.store.kv_put(self._hardlink_key(link_id), tomb.encode())
+        shadow = Entry(
+            full_path=f"{self.HARDLINK_SYNC_DIR}/{link_id}",
+            attr=Attr(mtime=ts / 1e9, crtime=ts / 1e9, mode=0o600),
+            extended={"hardlink.record": tomb})
+        self.store.insert_entry(shadow)
+        self._notify(None, shadow)
+
+    def apply_peer_hardlink(self, link_id: str, payload: str) -> None:
+        """Aggregator hook: merge a peer's link record (newer ts wins;
+        tombstones delete)."""
+        import json as _json
+        try:
+            incoming = _json.loads(payload)
+        except ValueError:
+            return
+        with self._hardlink_lock:
+            try:
+                raw = self.store.kv_get(self._hardlink_key(link_id))
+                current = _json.loads(raw)   # incl. tombstones
+            except Exception:
+                current = {}
+            if incoming.get("ts_ns", 0) >= current.get("ts_ns", 0):
+                # tombstones are stored too — they must outlive (and
+                # block) any stale non-deleted shadow
+                self.store.kv_put(self._hardlink_key(link_id),
+                                  _json.dumps(incoming).encode())
 
     def _resolve_hardlink(self, entry: Entry) -> Entry:
         """Pointer entry -> full entry with the shared chunks/attr."""
@@ -376,8 +439,7 @@ class Filer:
                 return []
             counter = content.get("counter", 1) - 1
             if counter <= 0:
-                self.store.kv_delete(
-                    self._hardlink_key(entry.hard_link_id))
+                self._delete_hardlink_record(entry.hard_link_id)
                 return [FileChunk.from_dict(c)
                         for c in content["chunks"]]
             content["counter"] = counter

@@ -418,6 +418,14 @@ class FilerServer:
         old, new = event.get("old_entry"), event.get("new_entry")
         try:
             if new is not None:
+                path = new.get("full_path", "")
+                if path.startswith(self.filer.HARDLINK_SYNC_DIR + "/"):
+                    # peer's hardlink record shadow: merge into OUR KV
+                    # so nlink counters converge across filers
+                    self.filer.apply_peer_hardlink(
+                        path.rsplit("/", 1)[-1],
+                        new.get("extended", {}).get("hardlink.record",
+                                                    ""))
                 self.filer.store.insert_entry(Entry.from_dict(new))
             elif old is not None:
                 self.filer.store.delete_entry(old["full_path"])

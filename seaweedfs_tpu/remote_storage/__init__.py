@@ -156,6 +156,45 @@ class S3RemoteStorage:
         return {"key": key, "size": st["size"], "mtime": st["mtime"]}
 
 
+class PrefixedRemote:
+    """View of any RemoteStorageClient under a key prefix — how
+    remote.mount.buckets scopes one mount per top-level bucket."""
+
+    def __init__(self, inner: RemoteStorageClient, prefix: str):
+        self.inner = inner
+        self.prefix = prefix.rstrip("/") + "/"
+        self.name = getattr(inner, "name", "remote")
+
+    def list_objects(self, prefix: str = "") -> list[dict]:
+        out = []
+        for o in self.inner.list_objects(self.prefix + prefix):
+            o = dict(o)
+            o["key"] = o["key"][len(self.prefix):]
+            out.append(o)
+        return out
+
+    def read_object(self, key: str) -> bytes:
+        return self.inner.read_object(self.prefix + key)
+
+    def read_object_range(self, key: str, offset: int,
+                          size: int) -> bytes:
+        if hasattr(self.inner, "read_object_range"):
+            return self.inner.read_object_range(self.prefix + key,
+                                                offset, size)
+        return self.read_object(key)[offset:offset + size]
+
+    def write_object(self, key: str, data: bytes) -> None:
+        self.inner.write_object(self.prefix + key, data)
+
+    def delete_object(self, key: str) -> None:
+        self.inner.delete_object(self.prefix + key)
+
+    def stat_object(self, key: str) -> dict:
+        st = dict(self.inner.stat_object(self.prefix + key))
+        st["key"] = key
+        return st
+
+
 STORAGE_TYPES = {"local": LocalDirRemoteStorage, "s3": S3RemoteStorage}
 UNAVAILABLE = {"gcs": "gcs SDK not in image",
                "azure": "azure SDK not in image",
@@ -188,9 +227,11 @@ class RemoteMount:
         return f"{self.mount_dir}/{key}"
 
     # -- mount (shell remote.mount) ----------------------------------------
-    def mount(self) -> int:
+    def mount(self, objects: "list[dict] | None" = None) -> int:
         """Create the mount dir + one metadata-only entry per remote
-        object.  Returns entries created."""
+        object.  Returns entries created.  `objects` lets a caller that
+        already listed the remote (remote.mount.buckets mounts N
+        prefixes from ONE listing) skip the per-mount re-list."""
         self._filer().call("CreateEntry", {"entry": {
             "full_path": self.mount_dir,
             "attr": {"mtime": time.time(), "crtime": time.time(),
@@ -199,7 +240,8 @@ class RemoteMount:
                 {"type": getattr(self.remote, "name", "local")})},
         }})
         n = 0
-        for obj in self.remote.list_objects():
+        for obj in (self.remote.list_objects()
+                    if objects is None else objects):
             self._filer().call("CreateEntry", {"entry": {
                 "full_path": self._entry_path(obj["key"]),
                 "attr": {"mtime": obj["mtime"], "crtime": obj["mtime"],

@@ -12,7 +12,8 @@ import json
 import time
 
 from ..pb.rpc import POOL, RpcError
-from ..remote_storage import RemoteMount, new_remote_storage
+from ..remote_storage import (PrefixedRemote, RemoteMount,
+                              new_remote_storage)
 from .command_fs import _filer
 from .commands import CommandEnv, ShellError, command, parse_flags
 
@@ -46,8 +47,10 @@ def load_remote_mounts(filer_grpc: str, master_grpc: str,
         kind = cfg.pop("type", None)
         if kind is None:
             continue
-        mounts.append(RemoteMount(filer_grpc, master_grpc,
-                                  new_remote_storage(kind, **cfg), mdir))
+        remote = new_remote_storage(kind, **cfg)
+        if spec.get("key_prefix"):      # a remote.mount.buckets mount
+            remote = PrefixedRemote(remote, spec["key_prefix"])
+        mounts.append(RemoteMount(filer_grpc, master_grpc, remote, mdir))
     return mounts
 
 
@@ -81,8 +84,11 @@ def _mount_for(env: CommandEnv, directory: str) -> RemoteMount:
     spec = mounts.get(directory)
     if spec is None:
         raise ShellError(f"{directory} is not a remote mount")
-    return RemoteMount(env.filer_grpc, env.master_grpc,
-                       _remote_for(env, spec["remote"]), directory)
+    remote = _remote_for(env, spec["remote"])
+    if spec.get("key_prefix"):
+        remote = PrefixedRemote(remote, spec["key_prefix"])
+    return RemoteMount(env.filer_grpc, env.master_grpc, remote,
+                       directory)
 
 
 @command("remote.configure",
@@ -140,6 +146,39 @@ def cmd_remote_mount(env: CommandEnv, args: list[str]) -> str:
     _save_conf(env, conf)
     return json.dumps({"mounted": directory, "remote": name,
                        "entries": n})
+
+
+@command("remote.mount.buckets",
+         "mount every top-level bucket/prefix of a remote under a base "
+         "dir (command_remote_mount_buckets.go): -remote name "
+         "[-dir /buckets]")
+def cmd_remote_mount_buckets(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    name = flags.get("remote", "")
+    base = flags.get("dir", "/buckets").rstrip("/")
+    if not name:
+        raise ShellError("need -remote")
+    remote = _remote_for(env, name)
+    # ONE listing serves bucket discovery AND every per-bucket mount
+    by_bucket: dict[str, list[dict]] = {}
+    for obj in remote.list_objects():
+        if "/" not in obj["key"]:
+            continue
+        bucket, rest = obj["key"].split("/", 1)
+        by_bucket.setdefault(bucket, []).append(
+            dict(obj, key=rest))
+    conf = _load_conf(env)
+    mounted: dict[str, int] = {}
+    for bucket in sorted(by_bucket):
+        mdir = f"{base}/{bucket}"
+        scoped = PrefixedRemote(remote, bucket)
+        mount = RemoteMount(env.filer_grpc, env.master_grpc, scoped,
+                            mdir)
+        mounted[mdir] = mount.mount(objects=by_bucket[bucket])
+        conf.setdefault("_mounts", {})[mdir] = {
+            "remote": name, "key_prefix": bucket + "/"}
+    _save_conf(env, conf)
+    return json.dumps({"mounted": mounted})
 
 
 @command("remote.unmount",

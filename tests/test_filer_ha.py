@@ -156,3 +156,82 @@ def test_filer_conf_path_rules(stack):
     out = json.loads(shell.run_command(
         env, "fs.configure -locationPrefix /hot/ -delete"))
     assert out["locations"] == []
+
+
+def test_hardlink_counters_converge_across_filers(stack):
+    """Round-1 weak item: nlink was per-origin-filer.  Link records now
+    replicate through the aggregator (shadow entries under
+    /.meta/hardlinks), so a PEER filer reports the true counter."""
+    master, vs, f1, f2 = stack
+    http_request(f"http://{f1.address}/hl/base.txt", method="POST",
+                 body=b"shared content")
+    c1 = POOL.client(f1.grpc_address, "SeaweedFiler")
+    c1.call("CreateHardLink", {"src": "/hl/base.txt",
+                               "dst": "/hl/link1.txt"})
+    c1.call("CreateHardLink", {"src": "/hl/base.txt",
+                               "dst": "/hl/link2.txt"})
+    # filer 1 (origin) sees nlink == 3
+    e1 = c1.call("LookupDirectoryEntry", {
+        "directory": "/hl", "name": "base.txt"})["entry"]
+    assert e1.get("hard_link_counter") == 3
+    # filer 2 converges to the SAME counter via the aggregator
+    c2 = POOL.client(f2.grpc_address, "SeaweedFiler")
+    deadline = time.time() + 10
+    counter = 0
+    while time.time() < deadline:
+        try:
+            e2 = c2.call("LookupDirectoryEntry", {
+                "directory": "/hl", "name": "base.txt"})["entry"]
+            counter = e2.get("hard_link_counter", 0)
+            if counter == 3:
+                break
+        except Exception:
+            pass
+        time.sleep(0.1)
+    assert counter == 3
+    # content readable through the peer's resolved view
+    status, body, _ = http_request(
+        f"http://{f2.address}/hl/link2.txt")
+    assert status == 200 and body == b"shared content"
+
+
+def test_hardlink_delete_tombstone_replicates(stack):
+    """The last unlink replicates a tombstone: peers drop their link
+    record instead of serving freed chunk ids forever, and a stale
+    (older-ts) shadow cannot resurrect it."""
+    master, vs, f1, f2 = stack
+    http_request(f"http://{f1.address}/tomb/file.txt", method="POST",
+                 body=b"doomed")
+    c1 = POOL.client(f1.grpc_address, "SeaweedFiler")
+    c1.call("CreateHardLink", {"src": "/tomb/file.txt",
+                               "dst": "/tomb/link.txt"})
+    # wait for the record to land on f2
+    link_id = c1.call("LookupDirectoryEntry", {
+        "directory": "/tomb", "name": "file.txt"})["entry"]["hard_link_id"]
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            f2.filer._load_hardlink(link_id)
+            break
+        except Exception:
+            time.sleep(0.1)
+    # delete BOTH links on f1 -> last unlink writes the tombstone
+    for name in ("link.txt", "file.txt"):
+        c1.call("DeleteEntry", {"directory": "/tomb", "name": name,
+                                "is_recursive": False,
+                                "ignore_recursive_error": True})
+    deadline = time.time() + 10
+    gone = False
+    while time.time() < deadline and not gone:
+        try:
+            f2.filer._load_hardlink(link_id)
+            time.sleep(0.1)
+        except Exception:
+            gone = True
+    assert gone, "peer kept the dead hardlink record"
+    # a stale (old-ts) record cannot resurrect past the tombstone
+    import json as _json
+    f2.filer.apply_peer_hardlink(link_id, _json.dumps(
+        {"counter": 2, "chunks": [], "attr": {}, "ts_ns": 1}))
+    with pytest.raises(Exception):
+        f2.filer._load_hardlink(link_id)

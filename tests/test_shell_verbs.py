@@ -268,3 +268,29 @@ def test_s3_bucket_quota_check_enforces(cluster):
         except S3ClientError:
             time.sleep(0.3)
     assert cl.get_object("q", "more.bin") == b"x"
+
+
+def test_remote_mount_buckets(cluster, tmp_path):
+    """remote.mount.buckets: every top-level prefix of the remote mounts
+    as its own directory; cache works through the scoped view."""
+    c, env = cluster
+    cloud = tmp_path / "multi"
+    (cloud / "photos").mkdir(parents=True)
+    (cloud / "logs").mkdir()
+    (cloud / "photos" / "a.jpg").write_bytes(b"jpegish")
+    (cloud / "logs" / "app.log").write_bytes(b"line1")
+    shell.run_command(
+        env, f"remote.configure -name multi -type local -root {cloud}")
+    out = json.loads(shell.run_command(
+        env, "remote.mount.buckets -remote multi -dir /buckets"))
+    assert out["mounted"] == {"/buckets/logs": 1, "/buckets/photos": 1}
+    meta = json.loads(shell.run_command(
+        env, "fs.meta.cat /buckets/photos/a.jpg"))
+    assert meta["extended"]["remote.size"] == "7"
+    # cache pulls through the prefix-scoped remote
+    out = json.loads(shell.run_command(
+        env, "remote.cache -dir /buckets/logs"))
+    assert out["cached"] == ["app.log"]
+    meta = json.loads(shell.run_command(
+        env, "fs.meta.cat /buckets/logs/app.log"))
+    assert meta["chunks"]
