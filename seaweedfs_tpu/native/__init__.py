@@ -17,7 +17,7 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libseaweed_native.so")
-_SOURCES = ["crc32c.cpp", "needle_map.cpp"]
+_SOURCES = ["crc32c.cpp", "needle_map.cpp", "rs_gf256.cpp"]
 _lock = threading.Lock()
 _lib = None
 _tried = False
@@ -32,12 +32,16 @@ def build(force: bool = False) -> str | None:
         so_mtime = os.path.getmtime(_SO)
         if all(os.path.getmtime(s) <= so_mtime for s in srcs):
             return _SO
+    tmp = _SO + ".tmp"
     cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           "-o", _SO] + srcs
+           "-o", tmp] + srcs
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
     except Exception:
-        return None
+        # recompile failed: keep serving the existing (stale) .so rather
+        # than regressing every native path to the Python fallbacks
+        return _SO if os.path.exists(_SO) else None
     return _SO
 
 
@@ -56,7 +60,42 @@ def _load():
             return None
         _lib.sw_crc32c.restype = ctypes.c_uint32
         _lib.sw_crc32c.argtypes = [ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t]
+        try:
+            _lib.gf256_matmul.restype = None
+            _lib.gf256_matmul.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+            _lib.gf256_has_avx2.restype = ctypes.c_int
+        except AttributeError:
+            pass   # stale .so without the codec: crc still works
         return _lib
+
+
+def gf256_matmul(M, inputs, out=None):
+    """Native GF(2^8) matmul: out[mo, n] = M[mo, ki] * inputs[ki, n].
+    numpy uint8 arrays; returns out (allocated if not given), or raises
+    RuntimeError when the native library is unavailable."""
+    import numpy as np
+    lib_ = _load()
+    if lib_ is None or not hasattr(lib_, "gf256_matmul"):
+        raise RuntimeError("native gf256 codec unavailable")
+    M = np.ascontiguousarray(M, dtype=np.uint8)
+    inputs = np.ascontiguousarray(inputs, dtype=np.uint8)
+    mo, ki = M.shape
+    if inputs.shape[0] != ki:     # real check — asserts vanish under -O
+        raise ValueError(f"inputs rows {inputs.shape[0]} != ki {ki}")
+    n = inputs.shape[1]
+    if out is None:
+        out = np.empty((mo, n), dtype=np.uint8)
+    elif (out.dtype != np.uint8 or out.shape != (mo, n)
+          or not out.flags.c_contiguous):
+        # the C side writes mo*n raw bytes at the base pointer — a view
+        # or wrong dtype would corrupt unrelated memory
+        raise ValueError("out must be a C-contiguous uint8 [mo, n] array")
+    lib_.gf256_matmul(M.tobytes(), mo, ki,
+                      inputs.ctypes.data_as(ctypes.c_void_p),
+                      out.ctypes.data_as(ctypes.c_void_p), n)
+    return out
 
 
 def _crc32c(data: bytes, crc: int = 0) -> int:

@@ -11,8 +11,11 @@ Accepts/returns numpy uint8; shapes are [k, B] or batched [V, k, B].  Three
 backends:
   - "pallas": fused TPU kernel (ops/rs_pallas.py) — the fast path
   - "jax":    pure-XLA bit-plane matmul (ops/rs_jax.py) — runs anywhere
+  - "native": C++ AVX2 split-nibble codec (native/rs_gf256.cpp) — the
+              CPU fast path, klauspost-class single-core throughput
   - "numpy":  gf256 table matmul — tiny, the correctness oracle
-"auto" picks pallas on TPU, else jax.  B is padded to the lane/block multiple
+"auto" picks pallas on TPU; on CPU it prefers the native codec and falls
+back to jax when the .so cannot build.  B is padded to the lane/block multiple
 internally (zero columns encode independently, so padding is exact) and
 stripped on return.
 """
@@ -40,8 +43,15 @@ class RSCodec:
                  block_b: int = rs_pallas.DEFAULT_BLOCK_B,
                  interpret: bool = False):
         if backend == "auto":
-            backend = "pallas" if _tpu_available() else "jax"
-        if backend not in ("pallas", "jax", "numpy"):
+            if _tpu_available():
+                backend = "pallas"
+            else:
+                # CPU: the native AVX2 codec beats the XLA bit-plane
+                # path; fall back to jax when the .so can't build
+                from .. import native
+                backend = "native" if native.lib() is not None and \
+                    hasattr(native.lib(), "gf256_matmul") else "jax"
+        if backend not in ("pallas", "jax", "numpy", "native"):
             raise ValueError(f"unknown backend {backend!r}")
         self.k = data_shards
         self.m = parity_shards
@@ -69,9 +79,14 @@ class RSCodec:
         squeeze = inputs.ndim == 2
         if squeeze:
             inputs = inputs[None]
-        if self.backend == "numpy":
+        if self.backend in ("numpy", "native"):
             M = np.asarray(bits_shard_major)  # here: the GF matrix itself
-            out = np.stack([gf256.matmul(M, x) for x in inputs])
+            if self.backend == "native":
+                from .. import native
+                out = np.stack([native.gf256_matmul(M, x)
+                                for x in inputs])
+            else:
+                out = np.stack([gf256.matmul(M, x) for x in inputs])
             return out[0] if squeeze else out
         padded, b = self._pad(inputs)
         if self.backend == "pallas":
@@ -105,7 +120,7 @@ class RSCodec:
         """data [.., k, B] uint8 -> parity [.., m, B] uint8."""
         data = np.asarray(data, dtype=np.uint8)
         assert data.shape[-2] == self.k, f"expected {self.k} data shards"
-        if self.backend == "numpy":
+        if self.backend in ("numpy", "native"):
             return self._matmul(self.gen[self.k:], self.m, data)
         return self._matmul(self._parity_bits, self.m, data)
 
@@ -144,7 +159,7 @@ class RSCodec:
         D = rs_matrix.decode_matrix(self.gen, present, targets)
         chosen = np.stack([np.asarray(shards[i], dtype=np.uint8)
                            for i in present[:self.k]], axis=-2)
-        if self.backend == "numpy":
+        if self.backend in ("numpy", "native"):
             rec = self._matmul(D, len(targets), chosen)
         else:
             rec = self._matmul(rs_matrix.bit_matrix(D), len(targets), chosen)
