@@ -68,33 +68,39 @@ def main():
                                        dtype=jnp.uint8)
     )(jax.random.PRNGKey(0))
 
-    def measure(bits_8m_8k: np.ndarray) -> float:
-        """Sustained GB/s of shard-shaped input consumed by one [8m, 8k]
-        bit-matrix pass (encode and 4-loss rebuild share this shape)."""
-        pm = jnp.asarray(rs_pallas.to_plane_major(bits_8m_8k, m, k),
+    def measure(bits_rows_cols: np.ndarray, d=None, kk: int = k,
+                mm: int = m) -> float:
+        """Sustained GB/s of shard-shaped input consumed by one bit-matrix
+        pass — ONE timing harness for the headline, the rebuild matrix,
+        and the wide-stripe geometries (same warmup/async-drain
+        methodology for every number reported)."""
+        if d is None:
+            d = data
+        pm = jnp.asarray(rs_pallas.to_plane_major(bits_rows_cols, mm, kk),
                          dtype=jnp.int8)
-        sbits = jnp.asarray(bits_8m_8k)
+        sbits = jnp.asarray(bits_rows_cols)
 
         @jax.jit
-        def probe(d):
+        def probe(x):
             if on_tpu:
                 # opaque custom call: the full parity is always
                 # materialized, so a one-tile probe suffices for completion
-                p = rs_pallas.gf_matmul_bits_pallas_sm(pm, d,
+                p = rs_pallas.gf_matmul_bits_pallas_sm(pm, x,
                                                        block_b=args.block_b)
                 return p[0, :8, :128].astype(jnp.int32).sum()
             # CPU fallback is pure XLA: a sliced probe would let the
             # compiler DCE most of the work — keep the full reduction
-            p = rs_jax.gf_matmul_bits(sbits, jnp.moveaxis(d, 1, 0))
+            p = rs_jax.gf_matmul_bits(sbits, jnp.moveaxis(x, 1, 0))
             return jnp.sum(p.astype(jnp.int32))
 
-        float(probe(data))  # compile + warmup
+        float(probe(d))  # compile + warmup
         t0 = time.perf_counter()
-        futs = [probe(data) for _ in range(iters)]
+        futs = [probe(d) for _ in range(iters)]
         for f in futs:
             float(f)
         dt = (time.perf_counter() - t0) / iters
-        return V * k * B / 1e9 / dt
+        vv, bb = d.shape[1], d.shape[2]
+        return vv * kk * bb / 1e9 / dt
 
     # rebuild: reconstruct 4 lost shards from the 10 survivors — same
     # kernel, a decode matrix instead of the parity matrix (BASELINE's
@@ -119,6 +125,24 @@ def main():
 
     gbps = measure(np.asarray(rs_matrix.parity_bit_matrix(k, m)))
     rebuild_gbps = measure(rebuild_bits)
+
+    def measure_geometry(kk: int, mm: int) -> float:
+        """Encode throughput for another stripe geometry (the BASELINE
+        wide-stripe targets) at a comparable total byte volume."""
+        vv = max(8, (V * k // kk) // 8 * 8)
+        d = jax.jit(
+            lambda key: jax.random.randint(key, (kk, vv, B), 0, 256,
+                                           dtype=jnp.uint8)
+        )(jax.random.PRNGKey(1))
+        bits = np.asarray(rs_matrix.parity_bit_matrix(kk, mm))
+        return round(measure(bits, d, kk, mm), 2)
+
+    wide = {}
+    if not args.quick:
+        wide = {
+            "ec_encode_rs16_8_gbps": measure_geometry(16, 8),
+            "ec_encode_rs28_4_gbps": measure_geometry(28, 4),
+        }
 
     # small-file data path (reference README.md:528-575 `weed benchmark`:
     # 15,708 writes/s / 47,019 reads/s, 1KB, c=16, on a 4-core i7 with a
@@ -159,6 +183,7 @@ def main():
             "ec_rebuild_throughput_rs10_4_4lost_gbps": round(rebuild_gbps, 2),
             "ec_rebuild_1000x30GB_volumes_est_seconds":
                 round(rack_survivor_bytes / 1e9 / rebuild_gbps, 1),
+            **wide,
             **smallfile,
         },
     }))

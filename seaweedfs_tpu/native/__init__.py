@@ -38,9 +38,20 @@ def build(force: bool = False) -> str | None:
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _SO)
-    except Exception:
+    except Exception as e:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
         # recompile failed: keep serving the existing (stale) .so rather
-        # than regressing every native path to the Python fallbacks
+        # than regressing every native path to the Python fallbacks —
+        # but LOUDLY, or a broken source edit would test the old binary
+        import warnings
+        detail = getattr(e, "stderr", b"")
+        detail = detail.decode(errors="replace")[-400:] \
+            if isinstance(detail, bytes) else str(e)
+        warnings.warn(f"native rebuild failed, serving stale .so: "
+                      f"{detail}", RuntimeWarning)
         return _SO if os.path.exists(_SO) else None
     return _SO
 
@@ -82,6 +93,9 @@ def gf256_matmul(M, inputs, out=None):
     M = np.ascontiguousarray(M, dtype=np.uint8)
     inputs = np.ascontiguousarray(inputs, dtype=np.uint8)
     mo, ki = M.shape
+    if inputs.ndim != 2:          # a batched [V, ki, B] with V == ki
+        raise ValueError(          # would silently read garbage
+            f"inputs must be 2-D [ki, n], got shape {inputs.shape}")
     if inputs.shape[0] != ki:     # real check — asserts vanish under -O
         raise ValueError(f"inputs rows {inputs.shape[0]} != ki {ki}")
     n = inputs.shape[1]
