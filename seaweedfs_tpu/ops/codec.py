@@ -40,7 +40,7 @@ class RSCodec:
     def __init__(self, data_shards: int = rs_matrix.DEFAULT_DATA_SHARDS,
                  parity_shards: int = rs_matrix.DEFAULT_PARITY_SHARDS,
                  *, kind: str = "vandermonde", backend: str = "auto",
-                 block_b: int = rs_pallas.DEFAULT_BLOCK_B,
+                 block_b: int = rs_pallas.SM_DEFAULT_BLOCK_B,
                  interpret: bool = False):
         if backend == "auto":
             if _tpu_available():
@@ -67,7 +67,9 @@ class RSCodec:
     # -- helpers ---------------------------------------------------------
     def _pad(self, arr: np.ndarray) -> tuple[np.ndarray, int]:
         b = arr.shape[-1]
-        mult = self.block_b if self.backend == "pallas" else 128
+        # pallas rides the shard-major kernel via the vm wrapper, which
+        # splits each volume's byte axis into 8 sublane rows
+        mult = 8 * self.block_b if self.backend == "pallas" else 128
         pad = (-b) % mult
         if pad:
             arr = np.pad(arr, [(0, 0)] * (arr.ndim - 1) + [(0, pad)])
@@ -96,23 +98,31 @@ class RSCodec:
             else:
                 pm = jnp.asarray(
                     rs_pallas.to_plane_major(bits_shard_major, mo, ki),
-                    dtype=jnp.bfloat16)
-            out = rs_pallas.gf_matmul_bits_pallas(
-                pm, jnp.asarray(padded), block_b=self.block_b,
+                    dtype=jnp.int8)
+            # host-side relayout to the dense shard-major [KI, 8V, B/8]
+            # (free view for one volume) — see rs_pallas.to_sm_layout
+            lead = padded.shape[:-2]
+            sm = rs_pallas.to_sm_layout(padded)
+            dev = rs_pallas.gf_matmul_bits_pallas_sm(
+                pm, jnp.asarray(sm), block_b=self.block_b,
                 interpret=self.interpret)
+            out = rs_pallas.from_sm_layout(
+                np.asarray(jax.device_get(dev)), lead, padded.shape[-1])
         else:
-            out = rs_jax.gf_matmul_bits(jnp.asarray(bits_shard_major),
-                                        jnp.asarray(padded))
-        out = np.asarray(jax.device_get(out))[..., :b]
+            out = np.asarray(jax.device_get(rs_jax.gf_matmul_bits(
+                jnp.asarray(bits_shard_major), jnp.asarray(padded))))
+        out = out[..., :b]
         return out[0] if squeeze else out
 
     def _parity_bits_pm(self):
-        """Cached device-resident plane-major parity bit-matrix (pallas only)."""
+        """Cached device-resident plane-major parity bit-matrix (pallas only).
+        int8: doubles MXU throughput vs bf16 and is exact (0/1 operands,
+        partial sums <= 8K <= 2040 in the int32 accumulator)."""
         assert self.backend == "pallas"
         if self._parity_bits_dev is None:
             self._parity_bits_dev = jnp.asarray(
                 rs_pallas.to_plane_major(self._parity_bits, self.m, self.k),
-                dtype=jnp.bfloat16)
+                dtype=jnp.int8)
         return self._parity_bits_dev
 
     # -- public API ------------------------------------------------------
@@ -126,18 +136,15 @@ class RSCodec:
 
     def encode_jax(self, data: jax.Array) -> jax.Array:
         """Device-resident encode for jit/shard_map composition (jax arrays
-        in/out, no host copies).  B must already be lane-aligned."""
-        if self._parity_bits_dev is None:
-            if self.backend == "pallas":
-                self._parity_bits_dev = jnp.asarray(
-                    rs_pallas.to_plane_major(self._parity_bits, self.m, self.k),
-                    dtype=jnp.bfloat16)
-            else:
-                self._parity_bits_dev = jnp.asarray(self._parity_bits)
+        in/out, no host copies).  Pallas expects the dense shard-major
+        layout [K, 8V, B/8] (rs_pallas.to_sm_layout) and returns
+        [M, 8V, B/8]; the jax backend takes [..., K, B]."""
         if self.backend == "pallas":
-            return rs_pallas.gf_matmul_bits_pallas(
-                self._parity_bits_dev, data, block_b=self.block_b,
+            return rs_pallas.gf_matmul_bits_pallas_sm(
+                self._parity_bits_pm(), data, block_b=self.block_b,
                 interpret=self.interpret)
+        if self._parity_bits_dev is None:
+            self._parity_bits_dev = jnp.asarray(self._parity_bits)
         return rs_jax.gf_matmul_bits(self._parity_bits_dev, data)
 
     def reconstruct(self, shards: list[np.ndarray | None], *,

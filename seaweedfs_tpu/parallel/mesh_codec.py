@@ -19,8 +19,15 @@ drives an ICI-connected chip mesh:
   partials are XOR-combined with the bandwidth-optimal ring `xor_psum`,
   while the byte axis stays sharded over "b" (mode 2+3 combined).
 
+The per-device compute is the fused Pallas kernel on TPU meshes and the
+pure-XLA bit-plane matmul on CPU meshes (driver dryrun) — see
+sharded_codec.make_shard_parallel_matmul.  Batched [V, B] shard stacks fold
+onto the byte axis (stripe columns are independent), so a 1000-volume fleet
+rebuild is one device round per window, not a host-side loop per volume.
+
 All jitted executables are cached per (devices, k, m, kind) so server RPC
-handlers can construct MeshCodec freely per request.
+handlers can construct MeshCodec freely per request, and decode bit-matrices
+are cached per loss mask (they repeat across windows and volumes).
 """
 
 from __future__ import annotations
@@ -30,12 +37,11 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
 
-from ..ops import rs_jax, rs_matrix
+from ..ops import rs_jax, rs_matrix, rs_pallas
 from . import sharded_codec
-
-_LANE = 128  # TPU lane width: keep per-device byte blocks lane-aligned
 
 
 def default_ec_mesh(devices=None) -> Mesh:
@@ -55,17 +61,29 @@ def default_ec_mesh(devices=None) -> Mesh:
 
 @functools.lru_cache(maxsize=32)
 def _encode_fn(mesh: Mesh):
-    """Jitted byte-DP encode: (bits[8m, 8k], data[k, B]) -> [m, B] with B
-    sharded over every device (both mesh axes)."""
-    spec = NamedSharding(mesh, P(None, ("s", "b")))
+    """Jitted byte-DP encode: (bits, data[k, 8, B/8]) -> [m, 8, B/8] with
+    the trailing byte axis sharded over every device (both mesh axes).
 
-    @jax.jit
-    def enc(bits, data):
-        data = jax.lax.with_sharding_constraint(data, spec)
-        out = rs_jax.gf_matmul_bits(bits, data)
-        return jax.lax.with_sharding_constraint(out, spec)
+    Data rides the dense shard-major layout (rs_pallas.to_sm_layout — the
+    host-side view that keeps TPU u8 tiling unpadded); shard_map (not
+    auto-partitioned jit) so each device's local block runs the fused
+    Pallas kernel on TPU.  `bits` is the plane-major int8 matrix there and
+    the shard-major uint8 matrix on the CPU fallback."""
+    use_pallas = sharded_codec.mesh_is_tpu(mesh)
 
-    return enc
+    def _local(bits, data):
+        if use_pallas:
+            return rs_pallas.gf_matmul_bits_pallas_sm(bits, data)
+        k = data.shape[0]
+        out = rs_jax.gf_matmul_bits(bits, data.reshape(k, -1))
+        return out.reshape(out.shape[0], 8, -1)
+
+    mapped = shard_map(
+        _local, mesh=mesh,
+        in_specs=(P(None, None), P(None, None, ("s", "b"))),
+        out_specs=P(None, None, ("s", "b")),
+        check_vma=False)
+    return jax.jit(mapped)
 
 
 @functools.lru_cache(maxsize=32)
@@ -73,6 +91,17 @@ def _recon_fn(mesh: Mesh, k: int, m: int):
     """Jitted mode-2+3 reconstruct over ("s", "b"); returns (fn, k_pad)."""
     return sharded_codec.make_shard_parallel_matmul(
         mesh, "s", k, m, byte_axis="b")
+
+
+@functools.lru_cache(maxsize=4096)
+def _decode_bits_cached(k: int, m: int, kind: str, k_pad: int,
+                        present: tuple, chunk: tuple) -> np.ndarray:
+    """Padded decode bit-matrix per loss mask.  Masks repeat across rebuild
+    windows and across volumes in a fleet rebuild; the GF mat_inv +
+    bit-expansion is host-side work worth doing once per mask."""
+    gen = rs_matrix.generator_matrix(k, m, kind)
+    D = rs_matrix.decode_matrix(gen, list(present), list(chunk))
+    return sharded_codec.pad_decode_bits(np.asarray(D), m, k, k_pad)
 
 
 class MeshCodec:
@@ -88,10 +117,16 @@ class MeshCodec:
         self.kind = kind
         self.backend = "mesh"
         self.gen = rs_matrix.generator_matrix(self.k, self.m, kind)
-        self._parity_bits = jnp.asarray(
-            rs_matrix.parity_bit_matrix(self.k, self.m, kind))
-        self._n_dev = int(np.prod(list(self.mesh.shape.values())))
-        self._b_size = self.mesh.shape["b"]
+        pbits = rs_matrix.parity_bit_matrix(self.k, self.m, kind)
+        if sharded_codec.mesh_is_tpu(self.mesh):
+            self._parity_bits = jnp.asarray(
+                rs_pallas.to_plane_major(pbits, self.m, self.k),
+                dtype=jnp.int8)
+        else:
+            self._parity_bits = jnp.asarray(pbits)
+        self._enc_mult = sharded_codec.local_block_multiple(
+            self.mesh, ("s", "b"))
+        self._rec_mult = sharded_codec.local_block_multiple(self.mesh, ("b",))
 
     # -- helpers ---------------------------------------------------------
     def _pad_cols(self, arr: np.ndarray, mult: int) -> tuple[np.ndarray, int]:
@@ -117,9 +152,10 @@ class MeshCodec:
                 np.moveaxis(data, -2, 0)).reshape(self.k, -1)
         else:
             flat = data
-        padded, b = self._pad_cols(flat, self._n_dev * _LANE)
-        out = _encode_fn(self.mesh)(self._parity_bits, jnp.asarray(padded))
-        parity = np.asarray(jax.device_get(out))[:, :b]
+        padded, b = self._pad_cols(flat, self._enc_mult)
+        sm = padded.reshape(self.k, 8, -1)  # free host view -> dense tiling
+        out = _encode_fn(self.mesh)(self._parity_bits, jnp.asarray(sm))
+        parity = np.asarray(jax.device_get(out)).reshape(self.m, -1)[:, :b]
         if lead:
             parity = np.moveaxis(parity.reshape(self.m, *lead, -1), 0, -2)
         return np.ascontiguousarray(parity)
@@ -127,7 +163,11 @@ class MeshCodec:
     def reconstruct(self, shards: list[np.ndarray | None], *,
                     data_only: bool = False) -> list[np.ndarray]:
         """Fill None slots (enc.Reconstruct / enc.ReconstructData) with the
-        shard-axis-parallel ring-xor_psum kernel."""
+        shard-axis-parallel ring-xor_psum kernel.
+
+        Present shards may be [B] or batched [V, B] (one loss mask across
+        the batch): volumes fold onto the byte axis exactly as encode's
+        batch does, so a fleet rebuild is one device call per window."""
         if len(shards) != self.n:
             raise ValueError(f"expected {self.n} shard slots, got {len(shards)}")
         present = [i for i, s in enumerate(shards) if s is not None]
@@ -140,24 +180,28 @@ class MeshCodec:
             return list(shards)
         chosen = np.stack([np.asarray(shards[i], dtype=np.uint8)
                            for i in present[:self.k]], axis=0)
-        if chosen.ndim != 2:
-            raise ValueError("MeshCodec.reconstruct expects [B]-shaped shards")
+        if chosen.ndim not in (2, 3):
+            raise ValueError(
+                "MeshCodec.reconstruct expects [B] or [V, B] shards")
+        lead = chosen.shape[1:-1]  # () or (V,)
+        flat = chosen.reshape(self.k, -1)  # per-volume bytes stay contiguous
         fn, k_pad = _recon_fn(self.mesh, self.k, self.m)
-        full = np.zeros((k_pad, chosen.shape[-1]), dtype=np.uint8)
-        full[:self.k] = chosen
-        padded, b = self._pad_cols(full, self._b_size * _LANE)
-        dev_shards = jnp.asarray(padded)
+        full = np.zeros((k_pad, flat.shape[-1]), dtype=np.uint8)
+        full[:self.k] = flat
+        padded, b = self._pad_cols(full, self._rec_mult)
+        dev_shards = jnp.asarray(padded.reshape(k_pad, 8, -1))  # free view
+        present_key = tuple(present[:self.k])
         out = list(shards)
         # the cached executable produces m rows per call; chunk wider
         # target lists (possible for data_only bulk decodes of wide stripes)
         for i in range(0, len(targets), self.m):
             chunk = targets[i:i + self.m]
-            D = rs_matrix.decode_matrix(self.gen, present, chunk)
-            dec_bits = jnp.asarray(sharded_codec.pad_decode_bits(
-                np.asarray(D), self.m, self.k, k_pad))
+            dec_bits = jnp.asarray(_decode_bits_cached(
+                self.k, self.m, self.kind, k_pad, present_key, tuple(chunk)))
             rec = np.asarray(jax.device_get(fn(dec_bits, dev_shards)))
+            rec = rec.reshape(self.m, -1)[:, :b]
             for row, t in enumerate(chunk):
-                out[t] = np.ascontiguousarray(rec[row, :b])
+                out[t] = np.ascontiguousarray(rec[row].reshape(*lead, -1))
         return out
 
     def verify(self, shards: list[np.ndarray]) -> bool:

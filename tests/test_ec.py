@@ -250,6 +250,39 @@ def test_rebuild_missing_shards_byte_identical(volume_dir, codec):
             assert f.read() == want
 
 
+def test_rebuild_batch_across_volumes(volume_dir, codec):
+    """Fleet rebuild: volumes sharing (geometry, loss mask, size) rebuild
+    through batched [V, B] codec windows, byte-identical to per-volume
+    rebuilds; odd-sized volumes fall back to the single path."""
+    rng = np.random.default_rng(5)
+    # ~26KB shards + batch_bytes=4096 -> the grouped loop runs 7 windows
+    # including a final partial one (window floor is 4096)
+    sizes = [25 * GEO.small_row_size() + 700] * 3 + [GEO.small_row_size()]
+    bases, originals = [], {}
+    for vid, size in zip((7, 8, 9, 10), sizes):
+        base = os.path.join(volume_dir, str(vid))
+        with open(base + ".dat", "wb") as f:
+            f.write(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+        ec.write_ec_files(base, GEO, codec)
+        ec.save_volume_info(base, 3, dat_size=size,
+                            data_shards=GEO.data_shards,
+                            parity_shards=GEO.parity_shards,
+                            large_block_size=GEO.large_block_size,
+                            small_block_size=GEO.small_block_size)
+        bases.append(base)
+    for base in bases:
+        for s in (2, 5, 11):
+            with open(base + ec.to_ext(s), "rb") as f:
+                originals[(base, s)] = f.read()
+            os.remove(base + ec.to_ext(s))
+    out = ec.rebuild_ec_files_batch(bases, batch_bytes=4096)
+    for base in bases:
+        assert sorted(out[base]) == [2, 5, 11]
+    for (base, s), want in originals.items():
+        with open(base + ec.to_ext(s), "rb") as f:
+            assert f.read() == want, f"{base} shard {s}"
+
+
 def test_rebuild_noop_when_complete(volume_dir, codec):
     make_volume(volume_dir)
     base = encode(volume_dir, codec=codec)

@@ -58,9 +58,10 @@ def test_shard_parallel_encode(n_dev, k, m):
     data = rng.integers(0, 256, (k, B), dtype=np.uint8)
     padded = np.zeros((k_pad, B), dtype=np.uint8)
     padded[:k] = data
-    got = np.asarray(enc(jnp.asarray(padded)))
+    # sm layout: [k_pad, 8, B/8] (free host view, see rs_pallas.to_sm_layout)
+    got = np.asarray(enc(jnp.asarray(padded.reshape(k_pad, 8, -1))))
     want = gf256.matmul(rs_matrix.generator_matrix(k, m)[k:], data)
-    assert np.array_equal(got, want)
+    assert np.array_equal(got.reshape(m, B), want)
 
 
 def test_shard_parallel_reconstruct():
@@ -78,7 +79,8 @@ def test_shard_parallel_reconstruct():
     dec_bits = jnp.asarray(sharded_codec.pad_decode_bits(D, m, k, k_pad))
     chosen = np.zeros((k_pad, B), dtype=np.uint8)
     chosen[:k] = shards[present[:k]]
-    got = np.asarray(rec_fn(dec_bits, jnp.asarray(chosen)))
+    got = np.asarray(rec_fn(dec_bits, jnp.asarray(
+        chosen.reshape(k_pad, 8, -1)))).reshape(m, B)
     assert np.array_equal(got[:len(lost)], shards[lost])
 
     # same executable, different loss mask — no retrace beyond first call
@@ -88,5 +90,6 @@ def test_shard_parallel_reconstruct():
     dec_bits2 = jnp.asarray(sharded_codec.pad_decode_bits(D2, m, k, k_pad))
     chosen2 = np.zeros((k_pad, B), dtype=np.uint8)
     chosen2[:k] = shards[present2[:k]]
-    got2 = np.asarray(rec_fn(dec_bits2, jnp.asarray(chosen2)))
+    got2 = np.asarray(rec_fn(dec_bits2, jnp.asarray(
+        chosen2.reshape(k_pad, 8, -1)))).reshape(m, B)
     assert np.array_equal(got2[:len(lost2)], shards[lost2])
