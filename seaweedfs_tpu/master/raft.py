@@ -12,8 +12,10 @@ Standard raft (Ongaro & Ousterhout) with the safety-relevant details:
 - commit index advances only over majority matches *in the current term*
   (§5.4.2), with a no-op entry appended at leadership start so prior-term
   entries commit promptly;
-- leader lease step-down: a leader that cannot reach a quorum for one full
-  election timeout stops serving.  Combined with block-reserved sequence
+- leader lease step-down: a leader that cannot reach a quorum for two
+  election timeouts stops serving (2x tolerates scheduler jitter on loaded
+  hosts without flapping; safety never depends on the lease — see
+  _check_lease).  Combined with block-reserved sequence
   allocation (ha.py) a partitioned minority can never acknowledge an
   assign — the round-1 duplicate-fid window is closed by construction;
 - snapshot/compaction: the applied prefix folds into snapshot_fn()'s state
@@ -249,8 +251,12 @@ class RaftNode:
                 self._apply_committed()
 
     def _check_lease(self, now: float) -> None:
-        """Step down if no quorum of followers acked within a full election
-        timeout — a partitioned leader must stop serving."""
+        """Step down if no quorum of followers acked within 2x the election
+        timeout — a partitioned leader must stop serving.  The 2x factor is
+        deliberate: 1x flaps under scheduler jitter (4 heartbeat rounds),
+        and the lease is an availability optimization only — correctness
+        against duplicate fids is carried by block-reserved sequences
+        (ha.py), not by the serving window's length."""
         if self.quorum == 1:
             return
         acks = sorted((self._last_ack.get(p, 0.0) for p in self.peers

@@ -15,7 +15,9 @@ import base64
 import hashlib
 import hmac
 import json
+import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 
@@ -75,21 +77,36 @@ def decode_jwt(signing_key: str, token: str) -> dict:
 
 # decoded-token cache: a batch assign reuses ONE token for its whole
 # key range, so the write hot path would otherwise pay HMAC + json +
-# base64 per request for the same token (the range check stays per-fid)
-_TOKEN_CACHE: dict = {}
+# base64 per request for the same token (the range check stays per-fid).
+# Lock-guarded LRU shared by every server in the process: evicting one
+# entry at a time avoids the full-clear thundering herd, and the lock
+# keeps the OrderedDict's reorder-on-hit safe off the GIL's goodwill.
+_TOKEN_CACHE: "OrderedDict[tuple, dict]" = OrderedDict()
 _TOKEN_CACHE_MAX = 512
+_TOKEN_CACHE_LOCK = threading.Lock()
 
 
 def _decode_jwt_cached(signing_key: str, token: str) -> dict:
-    hit = _TOKEN_CACHE.get((signing_key, token))
+    key = (signing_key, token)
+    now = time.time()
+    with _TOKEN_CACHE_LOCK:
+        hit = _TOKEN_CACHE.get(key)
+        if hit is not None:
+            if "exp" in hit and now > hit["exp"]:
+                # evict, don't promote: a retried expired token must not
+                # pin a dead entry at MRU while live tokens fall off
+                del _TOKEN_CACHE[key]
+                hit = None  # decode_jwt below re-raises "token expired"
+            else:
+                _TOKEN_CACHE.move_to_end(key)
     if hit is not None:
-        if "exp" in hit and time.time() > hit["exp"]:
-            raise JwtError("token expired")
         return hit
     claims = decode_jwt(signing_key, token)
-    if len(_TOKEN_CACHE) >= _TOKEN_CACHE_MAX:
-        _TOKEN_CACHE.clear()
-    _TOKEN_CACHE[(signing_key, token)] = claims
+    with _TOKEN_CACHE_LOCK:
+        _TOKEN_CACHE[key] = claims
+        _TOKEN_CACHE.move_to_end(key)
+        while len(_TOKEN_CACHE) > _TOKEN_CACHE_MAX:
+            _TOKEN_CACHE.popitem(last=False)
     return claims
 
 

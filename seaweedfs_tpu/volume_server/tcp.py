@@ -36,6 +36,19 @@ LOG = logger(__name__)
 
 _HDR = struct.Struct("<BH")
 
+# Reject oversized frames BEFORE buffering the body: the port is
+# advertised and pre-auth, so an unauthenticated peer must not be able to
+# make the server allocate gigabytes per connection (JWT validation only
+# runs in the handler, after the body is read).  The filer write path
+# autochunks at 8MB; 64MB leaves ample headroom for direct blob writes.
+MAX_FRAME_BODY = 64 << 20
+
+
+class FrameTooLarge(ValueError):
+    def __init__(self, body_len: int):
+        super().__init__(
+            f"frame body {body_len} exceeds cap {MAX_FRAME_BODY}")
+
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
@@ -47,12 +60,15 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def read_frame(sock: socket.socket) -> tuple[str, str, str, bytes]:
+def read_frame(sock: socket.socket,
+               max_body: int = MAX_FRAME_BODY) -> tuple[str, str, str, bytes]:
     op, fid_len = _HDR.unpack(_recv_exact(sock, 3))
     fid = _recv_exact(sock, fid_len).decode()
     (jwt_len,) = struct.unpack("<H", _recv_exact(sock, 2))
     jwt = _recv_exact(sock, jwt_len).decode() if jwt_len else ""
     (body_len,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if body_len > max_body:
+        raise FrameTooLarge(body_len)
     body = _recv_exact(sock, body_len) if body_len else b""
     return chr(op), fid, jwt, body
 
@@ -121,7 +137,29 @@ class TcpDataServer:
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             while not self._stop.is_set():
-                op, fid, jwt, body = read_frame(conn)
+                try:
+                    op, fid, jwt, body = read_frame(conn)
+                except FrameTooLarge as e:
+                    # the stream is desynced past this point: best-effort
+                    # error reply, then drop.  The client has usually
+                    # already sendall()'d part of the body, and close()
+                    # with unread bytes in the receive buffer RSTs the
+                    # queued reply away — so flush a FIN and drain a
+                    # BOUNDED slice of the junk first (never the claimed
+                    # gigabytes; discarding costs no memory).
+                    try:
+                        write_reply(conn, 1, str(e).encode())
+                        conn.shutdown(socket.SHUT_WR)
+                        conn.settimeout(1.0)
+                        drained = 0
+                        while drained < (1 << 20):
+                            piece = conn.recv(64 << 10)
+                            if not piece:
+                                break
+                            drained += len(piece)
+                    except OSError:
+                        pass
+                    return
                 try:
                     payload = self._handle(op, fid, jwt, body)
                     write_reply(conn, 0, payload)

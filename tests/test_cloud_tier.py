@@ -133,6 +133,9 @@ def test_s3_sink_entry_shapes():
         def put_object(self, b, k, d):
             calls.append(("put", b, k, d))
 
+        def put_object_stream(self, b, k, fileobj, chunk=8 << 20):
+            calls.append(("put", b, k, fileobj.read()))
+
         def delete_object(self, b, k):
             calls.append(("del", b, k))
 
@@ -153,3 +156,27 @@ def test_s3_sink_entry_shapes():
     sink.delete_entry("/docs", True)
     assert ("del", "bk", "pre/docs/one") in calls
     assert ("del", "bk", "pre/docs/two") in calls
+
+
+def test_chunk_stream_reader():
+    """S3Sink's streaming reader: chunks stitched in offset order, sparse
+    holes zero-filled, byte-identical across read sizes."""
+    from seaweedfs_tpu.filer.entry import FileChunk
+    from seaweedfs_tpu.replication import _ChunkStream
+
+    blobs = {"1,a": b"abc", "1,b": b"de", "1,c": b"XYZ"}
+    chunks = [FileChunk(file_id="1,a", offset=0, size=3),
+              FileChunk(file_id="1,b", offset=5, size=2),   # hole 3..5
+              FileChunk(file_id="1,c", offset=7, size=3)]
+    want = b"abc\0\0deXYZ"
+    assert _ChunkStream(chunks, blobs.__getitem__).read() == want
+    for n in (1, 2, 4, 100):
+        s = _ChunkStream(chunks, blobs.__getitem__)
+        out = bytearray()
+        while True:
+            piece = s.read(n)
+            if not piece:
+                break
+            assert len(piece) <= n
+            out += piece
+        assert bytes(out) == want, n

@@ -43,6 +43,30 @@ def test_tcp_jwt_gate(cluster):
         operation.upload_data_tcp(r.tcp_url, r.fid, b"x")
 
 
+def test_tcp_oversized_frame_rejected_before_buffering(cluster):
+    """An unauthenticated peer declaring a near-4GiB body must get an
+    error reply and a closed connection BEFORE the server buffers
+    anything (memory-exhaustion guard on the advertised pre-auth port)."""
+    import socket
+    import struct
+
+    from seaweedfs_tpu.volume_server import tcp as tcplib
+
+    r = operation.assign(cluster.master_grpc)
+    host, port = r.tcp_url.split(":")
+    with socket.create_connection((host, int(port)), timeout=5) as s:
+        fid = r.fid.encode()
+        s.sendall(struct.pack("<BH", ord("W"), len(fid)) + fid
+                  + struct.pack("<H", 0)
+                  + struct.pack("<I", 0xF0000000)  # 3.75 GiB claim
+                  + b"\xAA" * 100_000)  # partial body already in flight
+        status, payload = tcplib.read_reply(s)
+        assert status == 1 and b"exceeds cap" in payload
+        # connection is dropped, not left waiting for 3.75 GiB
+        s.settimeout(5)
+        assert s.recv(1) == b""
+
+
 def test_tcp_pipelined_batches(cluster):
     c = cluster
     r = operation.assign(c.master_grpc, count=50)
