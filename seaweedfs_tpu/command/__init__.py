@@ -590,6 +590,34 @@ def build_parser() -> argparse.ArgumentParser:
     bk.add_argument("-dir", default="./backup")
     bk.set_defaults(fn=cmd_backup)
 
+    from .volume_tools import cmd_compact, cmd_export, cmd_fix
+    fx = sub.add_parser("fix",
+                        help="rebuild a volume's .idx from its .dat "
+                             "(offline; no server needed)")
+    fx.add_argument("-dir", default=".")
+    fx.add_argument("-collection", default="")
+    fx.add_argument("-volumeId", type=int, required=True)
+    fx.set_defaults(fn=cmd_fix)
+
+    cp = sub.add_parser("compact",
+                        help="offline vacuum of one volume")
+    cp.add_argument("-dir", default=".")
+    cp.add_argument("-collection", default="")
+    cp.add_argument("-volumeId", type=int, required=True)
+    cp.add_argument("-preallocate", type=int, default=0)
+    cp.set_defaults(fn=cmd_compact)
+
+    ex = sub.add_parser("export",
+                        help="export a volume's live files to a tar")
+    ex.add_argument("-dir", default=".")
+    ex.add_argument("-collection", default="")
+    ex.add_argument("-volumeId", type=int, required=True)
+    ex.add_argument("-o", default="export.tar", help="output tar path")
+    ex.add_argument("-newer", default="",
+                    help="only files modified after YYYY-MM-DDTHH:MM:SS")
+    ex.add_argument("-limit", type=int, default=0)
+    ex.set_defaults(fn=cmd_export)
+
     dav = sub.add_parser("webdav", help="start a WebDAV gateway")
     dav.add_argument("-ip", default="127.0.0.1")
     dav.add_argument("-port", type=int, default=7333)
@@ -620,6 +648,83 @@ def build_parser() -> argparse.ArgumentParser:
                        default="127.0.0.1:19333")
     fsync.add_argument("-path", default="/")
     fsync.set_defaults(fn=cmd_filer_sync)
+
+    from .filer_tools import (cmd_filer_backup, cmd_filer_cat,
+                              cmd_filer_copy, cmd_filer_meta_tail,
+                              cmd_filer_remote_gateway,
+                              cmd_filer_replicate)
+    fcp = sub.add_parser("filer.copy",
+                         help="parallel local-tree upload to the filer")
+    fcp.add_argument("sources", nargs="+",
+                     help="local files or directories")
+    fcp.add_argument("dest", help="http://filer:port/dest/dir/")
+    fcp.add_argument("-concurrency", type=int, default=8)
+    fcp.add_argument("-include", default="",
+                     help="only file names matching this glob")
+    fcp.add_argument("-verbose", action="store_true")
+    fcp.set_defaults(fn=cmd_filer_copy)
+
+    fct = sub.add_parser("filer.cat",
+                         help="print one filer file to stdout")
+    fct.add_argument("path", help="http://filer:port/path/to/file")
+    fct.set_defaults(fn=cmd_filer_cat)
+
+    fmt_ = sub.add_parser("filer.meta.tail",
+                          help="tail filer metadata events as JSON lines")
+    fmt_.add_argument("-filer", default="127.0.0.1:8888.18888")
+    fmt_.add_argument("-pathPrefix", default="/")
+    fmt_.add_argument("-pattern", default="",
+                      help="glob on the entry file name")
+    fmt_.add_argument("-timeAgo", type=float, default=0,
+                      help="start this many seconds in the past")
+    fmt_.add_argument("-limit", type=int, default=0,
+                      help="exit after N events (0 = forever)")
+    fmt_.add_argument("-until-ping", dest="until_ping",
+                      action="store_true",
+                      help="exit once caught up with the live tail")
+    fmt_.set_defaults(fn=cmd_filer_meta_tail)
+
+    def _backup_flags(p):
+        p.add_argument("-filer", default="127.0.0.1:8888.18888")
+        p.add_argument("-master", default="",
+                       help="chunk-read master (defaults to the "
+                            "filer's configured master)")
+        p.add_argument("-path", default="/")
+        p.add_argument("-targetDir", default="",
+                       help="replicate into this local directory")
+        p.add_argument("-targetS3Endpoint", default="")
+        p.add_argument("-targetS3Bucket", default="")
+        p.add_argument("-targetS3AccessKey", default="")
+        p.add_argument("-targetS3SecretKey", default="")
+        p.add_argument("-interval", type=float, default=2.0)
+        p.add_argument("-once", action="store_true",
+                       help="drain available events and exit")
+        p.add_argument("-maxEvents", type=int, default=0)
+
+    fbk = sub.add_parser("filer.backup",
+                         help="continuous one-way backup of a filer "
+                              "path into a local dir or S3 sink")
+    _backup_flags(fbk)
+    fbk.set_defaults(fn=cmd_filer_backup)
+
+    frp = sub.add_parser("filer.replicate",
+                         help="standalone replicator daemon (sink from "
+                              "flags or replication.toml)")
+    _backup_flags(frp)
+    frp.set_defaults(fn=cmd_filer_replicate)
+
+    frg = sub.add_parser("filer.remote.gateway",
+                         help="bind local buckets to a configured "
+                              "remote and push changes")
+    frg.add_argument("-filer", default="127.0.0.1:8888.18888")
+    frg.add_argument("-master", default="")
+    frg.add_argument("-dir", default="/buckets")
+    frg.add_argument("-createBucketAt", required=True,
+                     help="configured remote name")
+    frg.add_argument("-interval", type=float, default=2.0)
+    frg.add_argument("-rounds", type=int, default=0,
+                     help="exit after N rounds (0 = forever)")
+    frg.set_defaults(fn=cmd_filer_remote_gateway)
 
     mf = sub.add_parser("master.follower",
                         help="read-only master follower "

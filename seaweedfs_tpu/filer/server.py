@@ -402,6 +402,12 @@ class FilerServer:
                 "KvGet": self._rpc_kv_get,
                 "KvPut": self._rpc_kv_put,
                 "Statistics": lambda req: {},
+                # filer.proto GetFilerConfiguration: lets CLI tools
+                # (filer.backup, filer.remote.gateway) discover the
+                # master without a -master flag
+                "GetFilerConfiguration": lambda req: {
+                    "masters": [m.strip()
+                                for m in self._master_spec.split(",")]},
             },
             stream={
                 "ListEntries": self._rpc_list_entries,

@@ -54,17 +54,24 @@ def load_remote_mounts(filer_grpc: str, master_grpc: str,
     return mounts
 
 
+def save_conf(filer_grpc: str, conf: dict) -> None:
+    """Persist the remote config entry — the single writer for its
+    format (shell verbs AND filer.remote.gateway both use it)."""
+    POOL.client(filer_grpc, "SeaweedFiler").call("CreateEntry", {"entry": {
+        "full_path": REMOTE_CONF_PATH,
+        "attr": {"mtime": time.time(), "crtime": time.time(),
+                 "mode": 0o600},
+        "extended": {REMOTE_CONF_ATTR: json.dumps(conf)}}})
+
+
 def _load_conf(env: CommandEnv) -> dict:
     _filer(env)     # raises the helpful "no filer configured" error
     return load_conf(env.filer_grpc)
 
 
 def _save_conf(env: CommandEnv, conf: dict) -> None:
-    _filer(env).call("CreateEntry", {"entry": {
-        "full_path": REMOTE_CONF_PATH,
-        "attr": {"mtime": time.time(), "crtime": time.time(),
-                 "mode": 0o600},
-        "extended": {REMOTE_CONF_ATTR: json.dumps(conf)}}})
+    _filer(env)     # same helpful error
+    save_conf(env.filer_grpc, conf)
 
 
 def _remote_for(env: CommandEnv, name: str):

@@ -118,6 +118,18 @@ dbFile = "./filer.db"
 enabled = false
 dir = "./filer-lsm"
 """,
+    "replication": """\
+# replication.toml — filer.replicate sink selection (reference
+# scaffold: weed/command/scaffold/replication.toml)
+[sink.local]
+directory = ""      # non-empty: replicate into this local directory
+
+[sink.s3]
+endpoint = ""       # non-empty: replicate into this S3 endpoint
+bucket = ""
+access_key = ""
+secret_key = ""
+""",
     "master": """\
 # master.toml — maintenance cron
 [master.maintenance]

@@ -213,6 +213,8 @@ class _ConnPool:
                              timeout=timeout)
                 conns[key] = conn
             try:
+                if attempt and hasattr(body, "seek"):
+                    body.seek(0)  # streamed file body: rewind for resend
                 conn.request(method, path, body=body, headers=headers)
                 resp = conn.getresponse()
                 data = resp.read()

@@ -1,0 +1,159 @@
+"""Filer CLI verbs: filer.copy / filer.cat / filer.meta.tail /
+filer.backup / filer.replicate / filer.remote.gateway
+(reference weed/command/filer_copy.go, filer_cat.go, filer_meta_tail.go,
+filer_backup.go, filer_replication.go, filer_remote_gateway.go)."""
+
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu.command import main
+from seaweedfs_tpu.testing import SimCluster
+from seaweedfs_tpu.util.http import http_request
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    with SimCluster(volume_servers=1, filers=1,
+                    base_dir=str(tmp_path / "cluster")) as c:
+        yield c
+
+
+def _filer_arg(c):
+    f = c.filers[0]
+    host, port = f.address.split(":")
+    return f"{host}:{port}.{f.grpc_address.split(':')[1]}"
+
+
+def test_filer_copy_uploads_tree(cluster, tmp_path, capsys):
+    src = tmp_path / "tree"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.txt").write_bytes(b"alpha")
+    (src / "sub" / "b.bin").write_bytes(b"\x00\x01" * 300)
+    (src / "sub" / "c.log").write_bytes(b"not-included")
+
+    fa = cluster.filers[0].address
+    rc = main(["filer.copy", str(src), f"http://{fa}/ingest/"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["files"] == 3 and not out["errors"]
+    # directory source copies AS a directory (tree/…)
+    st, body, _ = http_request(f"http://{fa}/ingest/tree/a.txt")
+    assert (st, body) == (200, b"alpha")
+    st, body, _ = http_request(f"http://{fa}/ingest/tree/sub/b.bin")
+    assert (st, body) == (200, b"\x00\x01" * 300)
+
+    # include-glob filter
+    rc = main(["filer.copy", str(src), f"http://{fa}/filtered/",
+               "-include", "*.txt"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["files"] == 1
+
+    # names needing percent-encoding survive the trip
+    weird = tmp_path / "weird"
+    weird.mkdir()
+    (weird / "a b#c?.txt").write_bytes(b"odd name")
+    rc = main(["filer.copy", str(weird), f"http://{fa}/odd/"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["files"] == 1, out
+    from urllib.parse import quote
+    st, body, _ = http_request(
+        f"http://{fa}/odd/weird/{quote('a b#c?.txt')}")
+    assert (st, body) == (200, b"odd name")
+
+
+def test_filer_cat(cluster, tmp_path, capfdbinary):
+    fa = cluster.filers[0].address
+    payload = bytes(range(256)) * 10
+    st, _, _ = http_request(f"http://{fa}/docs/blob.bin", method="POST",
+                            body=payload)
+    assert st == 201
+    assert main(["filer.cat", f"http://{fa}/docs/blob.bin"]) == 0
+    assert capfdbinary.readouterr().out == payload
+
+
+def test_filer_meta_tail_sees_events(cluster, capsys):
+    fa = cluster.filers[0].address
+    for name in ("one.txt", "two.txt", "three.dat"):
+        st, _, _ = http_request(f"http://{fa}/watch/{name}",
+                                method="POST", body=b"x")
+        assert st == 201
+    rc = main(["filer.meta.tail", "-filer", _filer_arg(cluster),
+               "-pathPrefix", "/watch", "-timeAgo", "60",
+               "-pattern", "*.txt", "-until-ping"])
+    assert rc == 0
+    lines = [json.loads(l) for l in
+             capsys.readouterr().out.strip().splitlines() if l]
+    paths = {e["new_entry"]["full_path"] for e in lines if e.get("new_entry")}
+    assert "/watch/one.txt" in paths and "/watch/two.txt" in paths
+    assert all(not p.endswith(".dat") for p in paths)
+
+
+def test_filer_backup_converges_and_resumes(cluster, tmp_path, capsys):
+    fa = cluster.filers[0].address
+    target = tmp_path / "backup"
+    http_request(f"http://{fa}/data/f1.txt", method="POST", body=b"first")
+    args = ["filer.backup", "-filer", _filer_arg(cluster),
+            "-master", cluster.master_grpc, "-path", "/data",
+            "-targetDir", str(target), "-once"]
+    assert main(args) == 0
+    assert (target / "data" / "f1.txt").read_bytes() == b"first"
+    # resume: only NEW events applied on the second drain
+    first = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert first["applied"] >= 1
+    http_request(f"http://{fa}/data/f2.txt", method="POST", body=b"second")
+    assert main(args) == 0
+    second = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert (target / "data" / "f2.txt").read_bytes() == b"second"
+    assert second["applied"] <= first["applied"]
+
+
+def test_filer_replicate_sink_from_config(cluster, tmp_path, capsys,
+                                          monkeypatch):
+    """filer.replicate with no sink flags reads [sink.local] from the
+    layered config (env override form)."""
+    fa = cluster.filers[0].address
+    target = tmp_path / "replica"
+    monkeypatch.setenv("WEED_SINK_LOCAL_DIRECTORY", str(target))
+    http_request(f"http://{fa}/r/x.txt", method="POST", body=b"repl")
+    rc = main(["filer.replicate", "-filer", _filer_arg(cluster),
+               "-master", cluster.master_grpc, "-path", "/r", "-once"])
+    assert rc == 0
+    assert (target / "r" / "x.txt").read_bytes() == b"repl"
+
+
+def test_filer_remote_gateway_binds_and_pushes(cluster, tmp_path, capsys):
+    """New local buckets bind to the remote and their objects push;
+    deleting a bucket unbinds it."""
+    from seaweedfs_tpu import shell
+
+    fa = cluster.filers[0].address
+    remote_root = tmp_path / "remote"
+    remote_root.mkdir()
+    env = shell.CommandEnv(cluster.master_grpc)
+    shell.run_command(
+        env, f"fs.configure -filer {cluster.filers[0].grpc_address}")
+    out = shell.run_command(
+        env, f"remote.configure -name edge -type local -root {remote_root}")
+    assert "edge" in out
+    # create a bucket + object through the filer
+    st, _, _ = http_request(f"http://{fa}/buckets/photos/cat.jpg",
+                            method="POST", body=b"meow")
+    assert st == 201
+    rc = main(["filer.remote.gateway", "-filer", _filer_arg(cluster),
+               "-master", cluster.master_grpc,
+               "-createBucketAt", "edge", "-rounds", "1",
+               "-interval", "0.1"])
+    assert rc == 0
+    assert (remote_root / "photos" / "cat.jpg").read_bytes() == b"meow"
+    # bucket deletion unbinds on the next round
+    http_request(f"http://{fa}/buckets/photos/cat.jpg", method="DELETE")
+    http_request(f"http://{fa}/buckets/photos", method="DELETE")
+    rc = main(["filer.remote.gateway", "-filer", _filer_arg(cluster),
+               "-master", cluster.master_grpc,
+               "-createBucketAt", "edge", "-rounds", "1",
+               "-interval", "0.1"])
+    assert rc == 0
+    from seaweedfs_tpu.shell.command_remote import load_conf
+    conf = load_conf(cluster.filers[0].grpc_address)
+    assert "/buckets/photos" not in conf.get("_mounts", {})
