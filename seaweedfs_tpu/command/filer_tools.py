@@ -115,13 +115,19 @@ def cmd_filer_copy(args) -> int:
 
 
 def cmd_filer_cat(args) -> int:
-    """Stream one filer file to stdout (filer_cat.go)."""
+    """Stream one filer file to stdout (filer_cat.go) — 64KB chunks, so
+    a multi-GB file runs in constant memory."""
+    import shutil
+    import urllib.error
+    import urllib.request
     filer_http, path = _parse_filer_url(args.path)
-    status, body, _ = http_request(f"http://{filer_http}{path}")
-    if status != 200:
-        print(f"HTTP {status}: {body[:200]!r}", file=sys.stderr)
+    try:
+        with urllib.request.urlopen(
+                f"http://{filer_http}{quote(path)}") as resp:
+            shutil.copyfileobj(resp, sys.stdout.buffer, 64 * 1024)
+    except urllib.error.HTTPError as e:
+        print(f"HTTP {e.code}: {e.read()[:200]!r}", file=sys.stderr)
         return 1
-    sys.stdout.buffer.write(body)
     sys.stdout.buffer.flush()
     return 0
 
@@ -203,7 +209,13 @@ def _run_backup(args, *, loop: bool) -> int:
     print(f"backing up {addr.grpc}{args.path} -> {target_id}")
     try:
         while True:
-            worker.run_once()
+            try:
+                worker.run_once()
+            except RpcError as e:
+                # filer restarting / transient network error: the daemon
+                # retries from the persisted offset, it does not die
+                print(f"backup round failed, retrying: {e}",
+                      file=sys.stderr)
             time.sleep(args.interval)
     except KeyboardInterrupt:
         pass
@@ -249,20 +261,30 @@ def cmd_filer_remote_gateway(args) -> int:
     def local_buckets() -> "set[str] | None":
         """None on RPC failure — a transient filer error must read as
         'unknown', never as 'zero buckets', or one blip would mass-unbind
-        every mount."""
+        every mount.  Paginates past the filer's 1024-per-request limit
+        for the same reason: a truncated listing is a silent mass-unbind."""
         found = set()
+        start = ""
         try:
-            for msg in fclient.stream("ListEntries",
-                                      iter([{"directory": base}])):
-                e = msg.get("entry") or {}
-                mode = (e.get("attr") or {}).get("mode", 0)
-                if mode & 0o40000:
-                    found.add(e["full_path"].rpartition("/")[2])
+            while True:
+                batch = 0
+                for msg in fclient.stream(
+                        "ListEntries",
+                        iter([{"directory": base, "limit": 1024,
+                               "start_from_file_name": start}])):
+                    e = msg.get("entry") or {}
+                    name = e.get("full_path", "").rpartition("/")[2]
+                    batch += 1
+                    start = name
+                    mode = (e.get("attr") or {}).get("mode", 0)
+                    if mode & 0o40000:
+                        found.add(name)
+                if batch < 1024:
+                    return found
         except RpcError as e:
             print(f"bucket listing failed, skipping round: {e}",
                   file=sys.stderr)
             return None
-        return found
 
     rounds = 0
     print(f"filer.remote.gateway binding {base}/* -> remote "
@@ -289,11 +311,14 @@ def cmd_filer_remote_gateway(args) -> int:
                                     "key_prefix": bucket + "/"}
                     changed = True
                     print(f"bound new bucket {mdir}")
-            # only unbind mounts THIS gateway's remote owns — never touch
-            # another remote's mounts under the same base
+            # only unbind TOP-LEVEL bucket mounts THIS gateway's remote
+            # owns — never another remote's mounts, never nested mounts
+            # an operator made by hand under the same base
             for mdir in [m for m, spec in list(mounts.items())
                          if m.startswith(base + "/")
+                         and "/" not in m[len(base) + 1:]
                          and spec.get("remote") == args.createBucketAt
+                         and spec.get("key_prefix")
                          and m.rpartition("/")[2] not in buckets]:
                 del mounts[mdir]  # bucket deleted locally -> unbind
                 changed = True
@@ -303,10 +328,11 @@ def cmd_filer_remote_gateway(args) -> int:
             pushed = 0
             for mdir, spec in mounts.items():
                 if not mdir.startswith(base + "/") \
-                        or spec["remote"] != args.createBucketAt:
+                        or spec.get("remote") != args.createBucketAt:
                     continue
-                remote = PrefixedRemote(new_remote_storage(kind, **rconf),
-                                        spec["key_prefix"])
+                remote = new_remote_storage(kind, **rconf)
+                if spec.get("key_prefix"):  # bucket-scoped mount
+                    remote = PrefixedRemote(remote, spec["key_prefix"])
                 pushed += RemoteMount(addr.grpc, master, remote,
                                       mdir).sync_to_remote()
             if pushed:

@@ -36,6 +36,36 @@ def _tpu_available() -> bool:
         return False
 
 
+def gf_apply(M: np.ndarray, x: np.ndarray, *,
+             backend: str = "auto") -> np.ndarray:
+    """out[MO, B] = M ∘GF∘ x[KI, B] for an ARBITRARY GF(2^8) matrix —
+    the executor behind the clay/LRC flat-matrix paths (storage/ec/codes.py).
+
+    TPU: the bit-plane MXU matmul (ops/rs_jax) — unlike the Pallas
+    kernel, the [8MO, 8KI] bit matrix streams from HBM, so clay's
+    [m*alpha, k*alpha] (e.g. [1024, 2560]) sizes are fine.  CPU: the
+    native AVX2 codec, numpy tables as last resort.  Bytes are identical
+    on every path."""
+    if backend == "auto":
+        backend = "jax" if _tpu_available() else "native"
+    if backend == "native":
+        from .. import native
+        if native.lib() is not None and hasattr(native.lib(),
+                                                "gf256_matmul"):
+            return native.gf256_matmul(np.ascontiguousarray(M),
+                                       np.ascontiguousarray(x))
+        backend = "numpy"
+    if backend == "numpy":
+        return gf256.matmul(M, x)
+    bits = rs_matrix.bit_matrix(np.ascontiguousarray(M))
+    b = x.shape[-1]
+    pad = (-b) % 128
+    if pad:
+        x = np.pad(x, [(0, 0), (0, pad)])
+    out = rs_jax.encode(jnp.asarray(bits), jnp.asarray(x))
+    return np.asarray(jax.device_get(out))[:, :b]
+
+
 class RSCodec:
     def __init__(self, data_shards: int = rs_matrix.DEFAULT_DATA_SHARDS,
                  parity_shards: int = rs_matrix.DEFAULT_PARITY_SHARDS,

@@ -117,8 +117,13 @@ def _grpc_of_location(topo: dict, url: str) -> str:
 
 
 def do_ec_encode(env: CommandEnv, vid: int, collection: str = "",
-                 data_shards: int = 0, parity_shards: int = 0) -> dict:
-    """Full doEcEncode flow (command_ec_encode.go:95-188)."""
+                 data_shards: int = 0, parity_shards: int = 0,
+                 kind: str = "", lrc_locals: int = 0) -> dict:
+    """Full doEcEncode flow (command_ec_encode.go:95-188).
+
+    `kind` selects the code family beyond the reference's fixed RS:
+    "clay" (MSR, 1/q repair IO) or "lrc" (local groups; `lrc_locals`
+    local parities within parity_shards) — see storage/ec/codes.py."""
     topo = env.topology()
     locations = _volume_locations(env, vid)
     if not locations:
@@ -131,10 +136,13 @@ def do_ec_encode(env: CommandEnv, vid: int, collection: str = "",
     # generate shards on one replica (the TPU hot loop)
     gen_req = {"volume_id": vid, "collection": collection}
     n_total = TOTAL_SHARDS_COUNT
-    if data_shards or parity_shards:
+    if data_shards or parity_shards or kind:
         gen_req["data_shards"] = data_shards or 10
         gen_req["parity_shards"] = parity_shards or 4
         n_total = gen_req["data_shards"] + gen_req["parity_shards"]
+    if kind:
+        gen_req["code_kind"] = kind
+        gen_req["lrc_locals"] = lrc_locals
     env.volume_server(src_grpc).call("VolumeEcShardsGenerate", gen_req,
                                      timeout=3600)
     # spread + mount
@@ -231,7 +239,10 @@ def do_ec_rebuild(env: CommandEnv, vid: int, collection: str = "") -> dict:
 
 # -- commands --------------------------------------------------------------
 
-@command("ec.encode", "erasure-code volumes: -volumeId N | -collection c -fullPercent p -quietFor s")
+@command("ec.encode", "erasure-code volumes: -volumeId N | -collection c "
+                      "-fullPercent p -quietFor s [-dataShards k "
+                      "-parityShards m] [-kind rs|clay|lrc "
+                      "-lrcLocals l]")
 def cmd_ec_encode(env: CommandEnv, args: list[str]) -> str:
     flags = parse_flags(args)
     env.confirm_is_locked()
@@ -248,7 +259,9 @@ def cmd_ec_encode(env: CommandEnv, args: list[str]) -> str:
     results = [do_ec_encode(env, vid, flags.get("collection", ""),
                             data_shards=int(flags.get("dataShards", 0)),
                             parity_shards=int(flags.get("parityShards",
-                                                        0)))
+                                                        0)),
+                            kind=flags.get("kind", ""),
+                            lrc_locals=int(flags.get("lrcLocals", 0)))
                for vid in vids]
     return json.dumps({"encoded": results})
 

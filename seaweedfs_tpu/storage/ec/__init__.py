@@ -55,7 +55,9 @@ def geometry_from_vif(base_path: str,
             large_block_size=info.get("large_block_size",
                                       default.large_block_size),
             small_block_size=info.get("small_block_size",
-                                      default.small_block_size))
+                                      default.small_block_size),
+            code_kind=info.get("code_kind", "rs"),
+            lrc_locals=info.get("lrc_locals", 0))
     return default
 
 
@@ -75,7 +77,9 @@ def encode_volume_to_ec(base_path: str, version: int,
                      data_shards=geo.data_shards,
                      parity_shards=geo.parity_shards,
                      large_block_size=geo.large_block_size,
-                     small_block_size=geo.small_block_size)
+                     small_block_size=geo.small_block_size,
+                     code_kind=geo.code_kind,
+                     lrc_locals=geo.lrc_locals)
 
 
 def decode_ec_to_volume(base_path: str,

@@ -1,0 +1,233 @@
+"""Beyond-RS erasure-code families over the SAME shard-file layout:
+Clay (MSR regenerating) and LRC (local reconstruction), production-wired.
+
+The reference hard-codes RS(10,4) (erasure_coding/ec_encoder.go:17-19);
+here `EcGeometry.code_kind` selects the family and everything else —
+shard file names, .ecx, locate math, mounting, reads — is unchanged,
+because all three codes are systematic: data shards are byte-identical
+to RS's.  Only parity generation and rebuild differ.
+
+Symbol layout (clay): every `small_block_size` window of a shard is
+[alpha, win/alpha] layer-major — layer z of window w occupies bytes
+[w*small + z*win_a, +win_a) of the shard file.  Single-node repair
+therefore reads only the beta = alpha/q plane layers of each helper
+window — real partial-range file reads, the whole point of MSR codes
+(1/q the repair IO at identical storage overhead).
+
+Execution: the numpy oracles (ops/clay.py, ops/lrc.py) are matrix
+factories (ops/clay_matrix.py); the hot path is always one GF(2^8)
+matmul via ops.codec.gf_apply — bit-plane MXU on TPU, AVX2 native on
+CPU.  Same engine as RS, different matrices.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ...ops import clay_matrix, lrc
+from ...ops.codec import gf_apply
+from .layout import EcGeometry, to_ext
+
+
+def window_codec_for(geo: EcGeometry):
+    """The encode codec write_ec_files uses for non-RS kinds."""
+    if geo.code_kind == "clay":
+        return ClayWindowCodec(geo)
+    if geo.code_kind == "lrc":
+        return LrcWindowCodec(geo)
+    raise ValueError(f"unknown code_kind {geo.code_kind!r}")
+
+
+def lrc_geometry(geo: EcGeometry) -> lrc.LrcGeometry:
+    if not geo.lrc_locals or geo.data_shards % geo.lrc_locals:
+        raise ValueError(
+            f"lrc needs lrc_locals dividing k: k={geo.data_shards} "
+            f"l={geo.lrc_locals}")
+    return lrc.LrcGeometry(k=geo.data_shards, l=geo.lrc_locals,
+                           r=geo.parity_shards - geo.lrc_locals)
+
+
+class LrcWindowCodec:
+    """LRC is scalar (per byte column) like RS — encode is one matmul;
+    the local-repair advantage lives entirely in the rebuild planner."""
+
+    def __init__(self, geo: EcGeometry):
+        self.geo = geo
+        self.lgeo = lrc_geometry(geo)
+        self.k = geo.data_shards
+        self.m = geo.parity_shards
+        self.backend = "lrc"
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        assert data.shape[0] == self.k
+        G = lrc.generator_matrix(self.lgeo)
+        return gf_apply(np.ascontiguousarray(G[self.k:]), data)
+
+
+class ClayWindowCodec:
+    """Clay encode: each small-block window's [k, small] bytes viewed as
+    [k*alpha, small/alpha] symbols, one flat-generator matmul."""
+
+    def __init__(self, geo: EcGeometry):
+        self.geo = geo
+        self.k = geo.data_shards
+        self.m = geo.parity_shards
+        self.code = clay_matrix.code(self.k, self.m)
+        if geo.small_block_size % self.code.alpha:
+            raise ValueError(
+                f"small_block_size {geo.small_block_size} must be a "
+                f"multiple of clay alpha {self.code.alpha}")
+        self.backend = "clay"
+
+    def _flatten(self, data: np.ndarray) -> tuple[np.ndarray, int]:
+        """[k, W] (W = whole windows) -> [k*alpha, W/alpha] symbol rows."""
+        k, W = data.shape
+        small = self.geo.small_block_size
+        assert W % small == 0, \
+            f"window {W} not a multiple of small block {small}"
+        alpha = self.code.alpha
+        win_a = small // alpha
+        n_win = W // small
+        # [k, n_win, alpha, win_a] -> [k, alpha, n_win, win_a]: layer z of
+        # every window lands on symbol row k*alpha + z
+        v = data.reshape(k, n_win, alpha, win_a).transpose(0, 2, 1, 3)
+        return np.ascontiguousarray(v).reshape(k * alpha, -1), n_win
+
+    def _unflatten(self, flat: np.ndarray, rows: int, n_win: int
+                   ) -> np.ndarray:
+        alpha = self.code.alpha
+        win_a = self.geo.small_block_size // alpha
+        v = flat.reshape(rows, alpha, n_win, win_a).transpose(0, 2, 1, 3)
+        return np.ascontiguousarray(v).reshape(rows, -1)
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        flat, n_win = self._flatten(np.asarray(data, dtype=np.uint8))
+        G = clay_matrix.generator_flat(self.k, self.m)
+        parity = gf_apply(G, flat)
+        return self._unflatten(parity, self.m, n_win)
+
+
+# -- rebuild ---------------------------------------------------------------
+
+def rebuild_lrc(base_path: str, geo: EcGeometry, missing: list[int],
+                batch_bytes: int, stats: "dict | None" = None
+                ) -> list[int]:
+    """LRC rebuild: the planner picks the cheapest read set — one local
+    group for a single loss (k/l reads instead of k), globals otherwise
+    (ops/lrc.py plan_repair; Huang et al.'s LRC pyramid argument)."""
+    lgeo = lrc_geometry(geo)
+    n = geo.total_shards
+    have = [os.path.exists(base_path + to_ext(i)) for i in range(n)]
+    plan = lrc.plan_repair(lgeo, missing,
+                           available=[i for i in range(n) if have[i]])
+    inputs = {i: np.memmap(base_path + to_ext(i), dtype=np.uint8,
+                           mode="r") for i in plan.read_shards}
+    shard_size = len(next(iter(inputs.values())))
+    outputs = {i: open(base_path + to_ext(i), "wb") for i in missing}
+    bytes_read = 0
+    try:
+        for off in range(0, shard_size, batch_bytes):
+            width = min(batch_bytes, shard_size - off)
+            x = np.stack([np.asarray(inputs[i][off:off + width])
+                          for i in plan.read_shards])
+            bytes_read += x.size
+            rec = gf_apply(np.ascontiguousarray(plan.matrix), x)
+            for row, t in enumerate(plan.missing):
+                outputs[t].write(rec[row].tobytes())
+    finally:
+        for f in outputs.values():
+            f.close()
+    if stats is not None:
+        stats["bytes_read"] = bytes_read
+        stats["read_shards"] = list(plan.read_shards)
+        stats["plan_kind"] = plan.kind
+    return missing
+
+
+def rebuild_clay(base_path: str, geo: EcGeometry, missing: list[int],
+                 batch_bytes: int, stats: "dict | None" = None
+                 ) -> list[int]:
+    """Clay rebuild.  One loss: bandwidth-optimal repair reading ONLY
+    the beta plane layers of every helper window (partial-range reads —
+    beta/alpha = 1/q of each helper's bytes).  Multi-loss: flat decode
+    from k full survivors, same engine."""
+    code = clay_matrix.code(geo.data_shards, geo.parity_shards)
+    n = geo.total_shards
+    small = geo.small_block_size
+    alpha, win_a = code.alpha, small // code.alpha
+    have = [os.path.exists(base_path + to_ext(i)) for i in range(n)]
+    bytes_read = 0
+
+    if len(missing) == 1:
+        lost = missing[0]
+        helpers, plane, R = clay_matrix.repair_flat(
+            geo.data_shards, geo.parity_shards, lost)
+        inputs = {h: np.memmap(base_path + to_ext(h), dtype=np.uint8,
+                               mode="r") for h in helpers}
+        shard_size = len(next(iter(inputs.values())))
+        assert shard_size % small == 0, (shard_size, small)
+        wins_per_batch = max(1, batch_bytes // small)
+        plane_idx = np.asarray(plane)
+        with open(base_path + to_ext(lost), "wb") as out:
+            for w0 in range(0, shard_size // small, wins_per_batch):
+                wn = min(wins_per_batch, shard_size // small - w0)
+                # x rows: helper-major, plane-layer-minor (repair_flat's
+                # input order); columns: window-major, win_a-minor
+                x = np.empty((len(helpers) * len(plane), wn * win_a),
+                             dtype=np.uint8)
+                for hi, h in enumerate(helpers):
+                    span = inputs[h][w0 * small:(w0 + wn) * small]
+                    layers = span.reshape(wn, alpha, win_a)[:, plane_idx]
+                    # [wn, beta, win_a] -> [beta, wn*win_a]
+                    x[hi * len(plane):(hi + 1) * len(plane)] = \
+                        np.ascontiguousarray(
+                            layers.transpose(1, 0, 2)).reshape(
+                                len(plane), -1)
+                    bytes_read += layers.size
+                rec = gf_apply(R, x)  # [alpha, wn*win_a]
+                rec = np.ascontiguousarray(
+                    rec.reshape(alpha, wn, win_a).transpose(1, 0, 2))
+                out.write(rec.tobytes())
+        if stats is not None:
+            stats["bytes_read"] = bytes_read
+            stats["plan_kind"] = "clay-plane"
+            stats["helpers"] = list(helpers)
+            stats["layers_per_helper"] = len(plane)
+        return missing
+
+    # multi-loss: flat decode over k full survivors
+    present = tuple(i for i in range(n) if have[i])
+    D = clay_matrix.decode_flat(geo.data_shards, geo.parity_shards,
+                                present, tuple(missing))
+    chosen = present[:geo.data_shards]
+    inputs = {i: np.memmap(base_path + to_ext(i), dtype=np.uint8,
+                           mode="r") for i in chosen}
+    shard_size = len(next(iter(inputs.values())))
+    wins_per_batch = max(1, batch_bytes // small)
+    outputs = {i: open(base_path + to_ext(i), "wb") for i in missing}
+    try:
+        for w0 in range(0, shard_size // small, wins_per_batch):
+            wn = min(wins_per_batch, shard_size // small - w0)
+            x = np.empty((geo.data_shards * alpha, wn * win_a),
+                         dtype=np.uint8)
+            for ci, i in enumerate(chosen):
+                span = np.asarray(inputs[i][w0 * small:(w0 + wn) * small])
+                bytes_read += span.size
+                x[ci * alpha:(ci + 1) * alpha] = np.ascontiguousarray(
+                    span.reshape(wn, alpha, win_a).transpose(1, 0, 2)
+                ).reshape(alpha, -1)
+            rec = gf_apply(D, x)  # [len(missing)*alpha, wn*win_a]
+            for row, t in enumerate(missing):
+                part = rec[row * alpha:(row + 1) * alpha]
+                part = np.ascontiguousarray(
+                    part.reshape(alpha, wn, win_a).transpose(1, 0, 2))
+                outputs[t].write(part.tobytes())
+    finally:
+        for f in outputs.values():
+            f.close()
+    if stats is not None:
+        stats["bytes_read"] = bytes_read
+        stats["plan_kind"] = "clay-decode"
+    return missing

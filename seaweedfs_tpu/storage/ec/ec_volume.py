@@ -230,7 +230,19 @@ class EcVolume:
                               size: int) -> bytes:
         """Degraded read: gather [offset, offset+size) from >= k other
         shards, reconstruct the missing one in a single codec call
-        (recoverOneRemoteEcShardInterval store_ec.go:328-382)."""
+        (recoverOneRemoteEcShardInterval store_ec.go:328-382).
+
+        Kind dispatch: LRC repairs a single loss from its LOCAL GROUP
+        only (k/l interval reads instead of k); clay decodes from k
+        survivors over whole alpha-layer windows (the beta-plane partial
+        read path is reserved for rebuild, where helpers are local files
+        and scattered range reads are cheap — see codes.rebuild_clay)."""
+        if self.geo.code_kind == "lrc":
+            return self._reconstruct_interval_lrc(missing_shard, offset,
+                                                  size)
+        if self.geo.code_kind == "clay":
+            return self._reconstruct_interval_clay(missing_shard, offset,
+                                                   size)
         n = self.geo.total_shards
         shards: list[np.ndarray | None] = [None] * n
         got = 0
@@ -246,6 +258,83 @@ class EcVolume:
                 f"vol {self.volume_id} shard {missing_shard}: only {got} "
                 f"shards reachable, need {self.geo.data_shards}")
         return self.codec.reconstruct(shards)[missing_shard].tobytes()
+
+    def _reconstruct_interval_lrc(self, missing_shard: int, offset: int,
+                                  size: int) -> bytes:
+        """LRC is scalar, so exact intervals read from the repair plan's
+        shard set — one local group for a single loss.  If any group
+        member is ALSO unreachable, fall back to probing every shard and
+        re-planning globally over the set that actually answered (the
+        code tolerates any pattern the generator's rank allows)."""
+        from ...ops import lrc
+        from ...ops.codec import gf_apply
+        from .codes import lrc_geometry
+        lgeo = lrc_geometry(self.geo)
+        plan = lrc.plan_repair(lgeo, [missing_shard])
+        rows = []
+        for sid in plan.read_shards:
+            raw = self._read_local_or_remote(sid, offset, size)
+            if raw is None or len(raw) != size:
+                rows = None
+                break
+            rows.append(np.frombuffer(raw, dtype=np.uint8))
+        if rows is None:
+            # probe all shards; plan only over responders
+            got: dict[int, np.ndarray] = {}
+            for sid in range(self.geo.total_shards):
+                if sid == missing_shard:
+                    continue
+                raw = self._read_local_or_remote(sid, offset, size)
+                if raw is not None and len(raw) == size:
+                    got[sid] = np.frombuffer(raw, dtype=np.uint8)
+            try:
+                plan = lrc.plan_repair(lgeo, [missing_shard],
+                                       available=sorted(got))
+            except ValueError as e:
+                raise EcShardUnavailableError(
+                    f"vol {self.volume_id} shard {missing_shard}: "
+                    f"{e}") from None
+            rows = [got[sid] for sid in plan.read_shards]
+        out = gf_apply(np.ascontiguousarray(plan.matrix), np.stack(rows))
+        return out[0].tobytes()
+
+    def _reconstruct_interval_clay(self, missing_shard: int, offset: int,
+                                   size: int) -> bytes:
+        """Clay symbols live in [alpha, win_a] layers per small-block
+        window: align the read to whole windows, flat-decode from the
+        first k reachable survivors, slice the requested bytes."""
+        from ...ops import clay_matrix
+        from ...ops.codec import gf_apply
+        geo = self.geo
+        code = clay_matrix.code(geo.data_shards, geo.parity_shards)
+        small = geo.small_block_size
+        alpha, win_a = code.alpha, small // code.alpha
+        w0 = offset // small
+        w1 = -(-(offset + size) // small)
+        a_off, wn = w0 * small, w1 - w0
+        a_size = wn * small
+        present, blocks = [], []
+        for sid in range(geo.total_shards):
+            if sid == missing_shard or len(present) >= geo.data_shards:
+                continue
+            raw = self._read_local_or_remote(sid, a_off, a_size)
+            if raw is not None and len(raw) == a_size:
+                present.append(sid)
+                arr = np.frombuffer(raw, dtype=np.uint8)
+                blocks.append(np.ascontiguousarray(
+                    arr.reshape(wn, alpha, win_a).transpose(1, 0, 2)
+                ).reshape(alpha, -1))
+        if len(present) < geo.data_shards:
+            raise EcShardUnavailableError(
+                f"vol {self.volume_id} shard {missing_shard}: only "
+                f"{len(present)} shards reachable, need {geo.data_shards}")
+        D = clay_matrix.decode_flat(geo.data_shards, geo.parity_shards,
+                                    tuple(present), (missing_shard,))
+        rec = gf_apply(D, np.concatenate(blocks, axis=0))
+        window = np.ascontiguousarray(
+            rec.reshape(alpha, wn, win_a).transpose(1, 0, 2)).reshape(-1)
+        lo = offset - a_off
+        return window[lo:lo + size].tobytes()
 
     def read_interval(self, interval: Interval) -> bytes:
         shard_id, shard_offset = interval.to_shard_id_and_offset(self.geo)

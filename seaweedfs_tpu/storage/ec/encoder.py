@@ -42,6 +42,11 @@ def _codec_for(geo: EcGeometry, codec: RSCodec | None):
         if (codec.k, codec.m) != (geo.data_shards, geo.parity_shards):
             raise ValueError("codec geometry does not match EC geometry")
         return codec
+    if geo.code_kind != "rs":
+        # clay / lrc: the flat-matrix window codecs (codes.py) — same
+        # shard files, different parity math
+        from .codes import window_codec_for
+        return window_codec_for(geo)
     # production picker: the multi-chip MeshCodec whenever this process has
     # a device mesh (so ec.encode/ec.rebuild verbs and the
     # VolumeEcShardsGenerate/Rebuild RPCs ride it), single-chip RSCodec
@@ -123,13 +128,17 @@ def write_ec_files(base_path: str, geo: EcGeometry = DEFAULT_GEOMETRY,
 
 def rebuild_ec_files(base_path: str, geo: "EcGeometry | None" = None,
                      codec: RSCodec | None = None,
-                     batch_bytes: int = DEFAULT_BATCH_BYTES) -> list[int]:
+                     batch_bytes: int = DEFAULT_BATCH_BYTES,
+                     stats: "dict | None" = None) -> list[int]:
     """Regenerate every missing .ecNN from the surviving ones
-    (RebuildEcFiles ec_encoder.go:61/233).  Returns rebuilt shard ids."""
+    (RebuildEcFiles ec_encoder.go:61/233).  Returns rebuilt shard ids.
+
+    `stats`, when given, is filled with the rebuild's read accounting
+    ({"bytes_read", "plan_kind", ...}) — how the clay/LRC repair-IO
+    advantage is measured."""
     if geo is None:
         from . import geometry_from_vif
         geo = geometry_from_vif(base_path)
-    codec = _codec_for(geo, codec)
     n = geo.total_shards
     have = [os.path.exists(base_path + to_ext(i)) for i in range(n)]
     missing = [i for i in range(n) if not have[i]]
@@ -138,6 +147,15 @@ def rebuild_ec_files(base_path: str, geo: "EcGeometry | None" = None,
     if sum(have) < geo.data_shards:
         raise ValueError(
             f"need >= {geo.data_shards} shards to rebuild, have {sum(have)}")
+    if geo.code_kind == "clay" and codec is None:
+        from .codes import rebuild_clay
+        return rebuild_clay(base_path, geo, missing, batch_bytes,
+                            stats=stats)
+    if geo.code_kind == "lrc" and codec is None:
+        from .codes import rebuild_lrc
+        return rebuild_lrc(base_path, geo, missing, batch_bytes,
+                           stats=stats)
+    codec = _codec_for(geo, codec)
     inputs = {i: np.memmap(base_path + to_ext(i), dtype=np.uint8, mode="r")
               for i in range(n) if have[i]}
     shard_size = len(next(iter(inputs.values())))
@@ -145,18 +163,27 @@ def rebuild_ec_files(base_path: str, geo: "EcGeometry | None" = None,
         if len(arr) != shard_size:
             raise ValueError(f"shard {i} size {len(arr)} != {shard_size}")
     outputs = {i: open(base_path + to_ext(i), "wb") for i in missing}
+    used = [i for i in range(n) if have[i]][:geo.data_shards]
+    bytes_read = 0
     try:
         for off in range(0, shard_size, batch_bytes):
             width = min(batch_bytes, shard_size - off)
+            # memmap slices stay lazy; reconstruct materializes only the
+            # first k present shards it actually decodes from
             shards: list[np.ndarray | None] = [
-                np.asarray(inputs[i][off:off + width]) if have[i] else None
+                inputs[i][off:off + width] if have[i] else None
                 for i in range(n)]
+            bytes_read += len(used) * width
             rebuilt = codec.reconstruct(shards)
             for i in missing:
                 outputs[i].write(rebuilt[i].tobytes())
     finally:
         for f in outputs.values():
             f.close()
+    if stats is not None:
+        stats["bytes_read"] = bytes_read
+        stats["plan_kind"] = "rs-full"
+        stats["read_shards"] = used
     return missing
 
 
@@ -191,9 +218,12 @@ def rebuild_ec_files_batch(base_paths: list[str],
 
     out: dict[str, list[int]] = {b: [] for b in base_paths}
     for (geo, have, shard_size), bases in groups.items():
-        if len(bases) == 1:
-            out[bases[0]] = rebuild_ec_files(bases[0], geo,
-                                             batch_bytes=batch_bytes)
+        if len(bases) == 1 or geo.code_kind != "rs":
+            # clay/lrc volumes rebuild per-volume (their own reduced-IO
+            # paths in codes.py; the RSCodec [V, B] batching below is
+            # RS-specific)
+            for b in bases:
+                out[b] = rebuild_ec_files(b, geo, batch_bytes=batch_bytes)
             continue
         n = geo.total_shards
         missing = [i for i in range(n) if not have[i]]

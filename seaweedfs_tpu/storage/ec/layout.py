@@ -34,11 +34,21 @@ def to_ext(shard_id: int) -> str:
 
 @dataclass(frozen=True)
 class EcGeometry:
-    """One stripe configuration; the default matches the reference."""
+    """One stripe configuration; the default matches the reference.
+
+    `code_kind` selects the erasure code family (beyond the reference's
+    fixed RS): "rs" (default), "clay" (MSR regenerating code — same
+    shard sizes and fault tolerance, 1/q of the repair IO, ops/clay.py),
+    or "lrc" (local reconstruction code — single losses repair from one
+    local group, ops/lrc.py; parity_shards = lrc_locals local XORs +
+    globals).  Data shards are byte-identical across kinds (all three
+    are systematic), so reads and locate math never consult the kind."""
     data_shards: int = DATA_SHARDS_COUNT
     parity_shards: int = PARITY_SHARDS_COUNT
     large_block_size: int = LARGE_BLOCK_SIZE
     small_block_size: int = SMALL_BLOCK_SIZE
+    code_kind: str = "rs"
+    lrc_locals: int = 0
 
     @property
     def total_shards(self) -> int:

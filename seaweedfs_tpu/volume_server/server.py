@@ -853,20 +853,26 @@ class VolumeServer:
         LOG.info("ec encode volume %d (%d bytes) starting", vid,
                  v.content_size())
         geo = DEFAULT_GEOMETRY
-        if req.get("data_shards"):
-            # wide stripes: RS(28,4) / RS(16,8) etc (BASELINE targets)
+        if req.get("data_shards") or req.get("code_kind"):
+            # wide stripes RS(28,4)/RS(16,8) and the clay/lrc families
+            # (BASELINE targets beyond the reference's fixed RS(10,4))
             from ..storage.ec.layout import EcGeometry
             geo = EcGeometry(
-                data_shards=int(req["data_shards"]),
-                parity_shards=int(req.get("parity_shards", 4)))
+                data_shards=int(req.get("data_shards") or 10),
+                parity_shards=int(req.get("parity_shards", 4)),
+                code_kind=req.get("code_kind") or "rs",
+                lrc_locals=int(req.get("lrc_locals", 0)))
         ec_pkg.encode_volume_to_ec(v.base_path, version=v.version, geo=geo)
         return {}
 
     def _rpc_ec_rebuild(self, req: dict) -> dict:
         base = self._base_path(int(req["volume_id"]),
                                req.get("collection", ""))
-        rebuilt = ec_pkg.rebuild_ec_files(base)
-        return {"rebuilt_shard_ids": rebuilt}
+        stats: dict = {}
+        rebuilt = ec_pkg.rebuild_ec_files(base, stats=stats)
+        # stats surface the clay/LRC repair-IO advantage to operators
+        # (bytes_read, plan_kind) — see storage/ec/codes.py
+        return {"rebuilt_shard_ids": rebuilt, "rebuild_stats": stats}
 
     def _rpc_ec_copy(self, req: dict) -> dict:
         """Copy shard files from the source server via CopyFile streams
