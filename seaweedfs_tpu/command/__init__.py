@@ -822,6 +822,19 @@ def main(argv: list[str] | None = None) -> int:
             rpc_mod.set_tls(TlsConfig(str(sec["grpc.ca"]),
                                       str(sec.get("grpc.cert") or ""),
                                       str(sec.get("grpc.key") or "")))
+    # global profiling hooks on every verb (reference
+    # util/grace/pprof.go:11-55): -cpuprofile FILE / -memprofile FILE
+    prof_args = {}
+    for flag, key in (("-cpuprofile", "cpuprofile"),
+                      ("-memprofile", "memprofile")):
+        for i, a in enumerate(list(argv)):
+            if a == flag and i + 1 < len(argv):
+                prof_args[key] = argv[i + 1]
+                del argv[i:i + 2]
+                break
+    if prof_args:
+        from ..util.profiling import setup_profiling
+        setup_profiling(**prof_args)
     from ..util import weedlog
     weedlog.setup(verbosity)
     args = build_parser().parse_args(argv)

@@ -75,32 +75,35 @@ _TCP_LOCAL = _threading.local()
 
 
 def _tcp_sock(addr: str):
+    """-> (socket, buffered reader).  The reader (socket.makefile('rb'))
+    keeps reply parsing inside CPython's C BufferedReader — the recv
+    loops were a measurable slice of the per-read overhead."""
     import socket as _socket
     socks = getattr(_TCP_LOCAL, "socks", None)
     if socks is None:
         socks = _TCP_LOCAL.socks = {}
-    sock = socks.get(addr)
-    if sock is None:
+    pair = socks.get(addr)
+    if pair is None:
         host, _, port = addr.rpartition(":")
         sock = _socket.create_connection((host, int(port)), timeout=30)
         sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-        socks[addr] = sock
-    return sock
+        pair = socks[addr] = (sock, sock.makefile("rb"))
+    return pair
 
 
 def _tcp_call(addr: str, op: str, fid: str, jwt: str = "",
               body: bytes = b"") -> bytes:
-    from ..volume_server.tcp import read_reply, write_frame
+    from ..volume_server.tcp import read_reply_buf, write_frame
     try:
-        sock = _tcp_sock(addr)
+        sock, rf = _tcp_sock(addr)
         write_frame(sock, op, fid, jwt, body)
-        status, payload = read_reply(sock)
+        status, payload = read_reply_buf(rf)
     except (OSError, ConnectionError):
         # drop the broken connection; retry once on a fresh one
         getattr(_TCP_LOCAL, "socks", {}).pop(addr, None)
-        sock = _tcp_sock(addr)
+        sock, rf = _tcp_sock(addr)
         write_frame(sock, op, fid, jwt, body)
-        status, payload = read_reply(sock)
+        status, payload = read_reply_buf(rf)
     if status != 0:
         raise RuntimeError(
             f"tcp {op} {fid} @ {addr}: "
@@ -121,14 +124,14 @@ def upload_batch_tcp(tcp_addr: str, items: "list[tuple[str, bytes]]",
     ordering is guaranteed).  Amortizes syscalls across the batch —
     the dominant cost for 1KB blobs.  Returns error strings ('' = ok)
     per item."""
-    from ..volume_server.tcp import read_reply, write_frame
-    sock = _tcp_sock(tcp_addr)
+    from ..volume_server.tcp import read_reply_buf, write_frame
+    sock, rf = _tcp_sock(tcp_addr)
     try:
         for fid, data in items:
             write_frame(sock, "W", fid, jwt, data)
         out = []
         for _ in items:
-            status, payload = read_reply(sock)
+            status, payload = read_reply_buf(rf)
             out.append("" if status == 0
                        else payload.decode(errors="replace"))
         return out
@@ -140,14 +143,14 @@ def upload_batch_tcp(tcp_addr: str, items: "list[tuple[str, bytes]]",
 def read_batch_tcp(tcp_addr: str, fids: list[str]
                    ) -> "list[bytes | None]":
     """Pipelined reads; None for per-fid errors."""
-    from ..volume_server.tcp import read_reply, write_frame
-    sock = _tcp_sock(tcp_addr)
+    from ..volume_server.tcp import read_reply_buf, write_frame
+    sock, rf = _tcp_sock(tcp_addr)
     try:
         for fid in fids:
             write_frame(sock, "R", fid)
         out: "list[bytes | None]" = []
         for _ in fids:
-            status, payload = read_reply(sock)
+            status, payload = read_reply_buf(rf)
             out.append(payload if status == 0 else None)
         return out
     except (OSError, ConnectionError):

@@ -1,0 +1,67 @@
+"""cpuprofile/memprofile hooks for every server verb.
+
+Capability-equivalent to the reference's pprof setup
+(weed/util/grace/pprof.go:11-55: -cpuprofile/-memprofile flags writing
+pprof files on shutdown): `-cpuprofile FILE` records cProfile data and
+dumps pstats on exit (read with `python -m pstats FILE` or snakeviz);
+`-memprofile FILE` starts tracemalloc and writes the top allocation
+sites.  Both dump on normal exit AND on SIGTERM/SIGINT.
+
+Thread coverage: on CPython >= 3.12 cProfile rides sys.monitoring,
+which is PROCESS-GLOBAL — one enable() in the main thread captures
+every thread, including the HTTP/TCP handler threads where server work
+actually happens (verified by test_profiling_captures_handler_threads).
+That also means only one profiler can exist per process: -cpuprofile
+cannot be combined with an outer profiler."""
+
+from __future__ import annotations
+
+import atexit
+import cProfile
+import signal
+import tracemalloc
+
+_ACTIVE: dict = {}
+
+
+def setup_profiling(cpuprofile: str = "", memprofile: str = "") -> None:
+    if not (cpuprofile or memprofile) or _ACTIVE:
+        return
+    if cpuprofile:
+        prof = cProfile.Profile()
+        prof.enable()
+        _ACTIVE["cpu"] = (prof, cpuprofile)
+    if memprofile:
+        tracemalloc.start(25)
+        _ACTIVE["mem"] = memprofile
+    atexit.register(dump_profiles)
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        old = signal.getsignal(sig)
+
+        def handler(signum, frame, _old=old):
+            dump_profiles()
+            if _old is signal.SIG_IGN:
+                return           # was a no-op before; stay a no-op
+            if callable(_old):
+                _old(signum, frame)
+            else:                # SIG_DFL: default disposition is exit
+                raise SystemExit(128 + signum)
+        try:
+            signal.signal(sig, handler)
+        except ValueError:
+            pass  # non-main thread: atexit still covers normal exit
+
+
+def dump_profiles() -> None:
+    cpu = _ACTIVE.pop("cpu", None)
+    if cpu:
+        prof, path = cpu
+        prof.disable()
+        prof.dump_stats(path)
+    mem = _ACTIVE.pop("mem", None)
+    if mem:
+        snap = tracemalloc.take_snapshot()
+        with open(mem, "w") as f:
+            for stat in snap.statistics("lineno")[:100]:
+                f.write(f"{stat}\n")
+        tracemalloc.stop()

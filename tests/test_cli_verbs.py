@@ -12,6 +12,59 @@ from seaweedfs_tpu.testing import SimCluster
 from seaweedfs_tpu.util.http import http_request
 
 
+def test_profiling_hooks_write_files(tmp_path):
+    """-cpuprofile/-memprofile on any verb (the pprof analogue,
+    reference util/grace/pprof.go): dumps land on process exit and the
+    cpu profile loads with pstats."""
+    import pstats
+    import subprocess
+    import sys
+
+    import pathlib
+    repo_root = str(pathlib.Path(__file__).resolve().parents[1])
+    cpu, mem = str(tmp_path / "cpu.prof"), str(tmp_path / "mem.txt")
+    out = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu", "-cpuprofile", cpu,
+         "-memprofile", mem, "version"],
+        capture_output=True, cwd=repo_root, timeout=60)
+    assert out.returncode == 0, out.stderr
+    stats = pstats.Stats(cpu)
+    assert stats.total_calls > 0
+    assert (tmp_path / "mem.txt").read_text().strip()
+
+
+def test_profiling_captures_handler_threads(tmp_path):
+    """The -cpuprofile hook must see SERVER work, which runs on handler
+    threads: on CPython >= 3.12 cProfile is process-global (sys.monitoring),
+    so one profiler covers the TCP/HTTP threads too."""
+    import pathlib
+    import pstats
+    import subprocess
+    import sys
+
+    prof = str(tmp_path / "srv.prof")
+    code = f"""
+import random, sys
+from seaweedfs_tpu.util.profiling import setup_profiling
+setup_profiling(cpuprofile={prof!r})
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.testing import SimCluster
+with SimCluster(volume_servers=1, base_dir={str(tmp_path / 'c')!r}) as c:
+    r = operation.assign(c.master_grpc, count=50)
+    fids = operation.derive_fids(r)
+    for fid in fids:
+        operation.upload_to(r, fid, b"x" * 500)
+    for _ in range(300):
+        operation.read_file(c.master_grpc, random.choice(fids))
+"""
+    repo_root = str(pathlib.Path(__file__).resolve().parents[1])
+    out = subprocess.run([sys.executable, "-c", code], cwd=repo_root,
+                         capture_output=True, timeout=240)
+    assert out.returncode == 0, out.stderr[-2000:]
+    names = {f[2] for f in pstats.Stats(prof).stats}
+    assert "tcp_read" in names, sorted(names)[:40]  # server handler thread
+
+
 def test_master_follower_serves_lookups(tmp_path):
     with SimCluster(volume_servers=1, base_dir=str(tmp_path)) as c:
         fid = c.upload(b"follow me")
