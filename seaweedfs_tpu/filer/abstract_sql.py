@@ -1,0 +1,314 @@
+"""Abstract-SQL filer store — one shared CRUD layer the whole SQL family
+rides, so each database contributes only a dialect.
+
+Capability-equivalent to the reference's abstract_sql layer
+(weed/filer/abstract_sql/abstract_sql_store.go:1-365), which backs its
+mysql/mysql2/postgres/postgres2/sqlite stores: entries key on
+(dirhash, name) where dirhash is a 64-bit hash of the directory path
+(util.HashStringToLong's md5-prefix trick) so the primary index stays
+compact and range scans within one directory are contiguous; listing is
+a name-range scan with prefix filter; deletes and folder-children
+deletes are single statements; a filer_kv table carries the KV API; and
+mutations can be grouped in transactions (the rename path).
+
+The hash is an INDEX key, never a correctness key: the primary key is
+(dirhash, name, directory) — dirhash leads so directory scans stay
+contiguous and compact, but the full directory column disambiguates, so
+a 2^-64 hash collision costs one extra row comparison, not a replaced
+or wrong row.  (The reference keys on (dirhash, name) alone and
+silently overwrites on collision — abstract_sql_store.go:60-75; the
+wider key closes that.)
+
+Dialects provide connection setup + the few statements whose syntax
+differs (upsert, parameter placeholders).  SqliteStore (filerstore.py)
+is AbstractSqlStore over SqliteDialect; MySqlDialect / PostgresDialect
+make those databases config-only — their DBAPI drivers (pymysql,
+psycopg) are not in this image, so `connect` raises with instructions,
+but every statement they would run is exercised through the shared
+layer by the sqlite-backed store suite.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import struct
+import threading
+from contextlib import contextmanager
+
+from .entry import Entry
+from .filerstore import FilerStore, NotFound
+
+
+def _like_escape(s: str) -> str:
+    """Escape LIKE metacharacters with '!' — a char that needs no
+    string-literal escaping in ANY dialect (a literal ESCAPE '\\' is a
+    syntax error under MySQL's backslash-escaping literals)."""
+    return s.replace("!", "!!").replace("%", "!%").replace("_", "!_")
+
+
+def dir_hash(directory: str) -> int:
+    """Signed 64-bit hash of a directory path (the reference's
+    HashStringToLong shape: leading 8 bytes of md5, big-endian)."""
+    digest = hashlib.md5(directory.encode()).digest()[:8]
+    return struct.unpack(">q", digest)[0]
+
+
+class SqlDialect:
+    """Per-database syntax plug.  `ph` is the DBAPI paramstyle token."""
+    name = "abstract"
+    ph = "?"
+
+    # CREATE TABLE templates (run once at store construction)
+    create_meta = (
+        "CREATE TABLE IF NOT EXISTS filemeta ("
+        " dirhash BIGINT NOT NULL,"
+        " name TEXT NOT NULL,"
+        " directory TEXT NOT NULL,"
+        " meta TEXT NOT NULL,"
+        " PRIMARY KEY (dirhash, name, directory))")
+    create_kv = (
+        "CREATE TABLE IF NOT EXISTS filer_kv ("
+        " k BLOB PRIMARY KEY, v BLOB NOT NULL)")
+
+    def connect(self):
+        raise NotImplementedError
+
+    def upsert_meta_sql(self) -> str:
+        raise NotImplementedError
+
+    def upsert_kv_sql(self) -> str:
+        raise NotImplementedError
+
+
+class SqliteDialect(SqlDialect):
+    name = "sqlite"
+    ph = "?"
+
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+
+    def connect(self):
+        import sqlite3
+        return sqlite3.connect(self.path, check_same_thread=False)
+
+    def upsert_meta_sql(self) -> str:
+        return ("INSERT OR REPLACE INTO filemeta"
+                " (dirhash, name, directory, meta) VALUES (?, ?, ?, ?)")
+
+    def upsert_kv_sql(self) -> str:
+        return "INSERT OR REPLACE INTO filer_kv (k, v) VALUES (?, ?)"
+
+
+class MySqlDialect(SqlDialect):
+    """Config-only shell: plugs a pymysql/MySQLdb connection when one is
+    installed (reference filer/mysql/mysql_store.go rides abstract_sql
+    the same way)."""
+    name = "mysql"
+    ph = "%s"
+    create_kv = ("CREATE TABLE IF NOT EXISTS filer_kv ("
+                 " k VARBINARY(512) PRIMARY KEY, v LONGBLOB NOT NULL)")
+    create_meta = (
+        "CREATE TABLE IF NOT EXISTS filemeta ("
+        " dirhash BIGINT NOT NULL,"
+        " name VARCHAR(766) NOT NULL,"
+        " directory TEXT NOT NULL,"
+        " meta LONGTEXT NOT NULL,"
+        " PRIMARY KEY (dirhash, name, directory(255)))")
+
+    def __init__(self, **conn_kw):
+        self.conn_kw = conn_kw
+
+    def connect(self):
+        try:
+            import pymysql  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "mysql filer store needs the pymysql driver installed; "
+                "configuration is otherwise complete") from e
+        return pymysql.connect(**self.conn_kw)
+
+    def upsert_meta_sql(self) -> str:
+        return ("INSERT INTO filemeta (dirhash, name, directory, meta)"
+                " VALUES (%s, %s, %s, %s)"
+                " ON DUPLICATE KEY UPDATE directory=VALUES(directory),"
+                " meta=VALUES(meta)")
+
+    def upsert_kv_sql(self) -> str:
+        return ("INSERT INTO filer_kv (k, v) VALUES (%s, %s)"
+                " ON DUPLICATE KEY UPDATE v=VALUES(v)")
+
+
+class PostgresDialect(SqlDialect):
+    """Config-only shell for psycopg (reference filer/postgres)."""
+    name = "postgres"
+    ph = "%s"
+    create_kv = ("CREATE TABLE IF NOT EXISTS filer_kv ("
+                 " k BYTEA PRIMARY KEY, v BYTEA NOT NULL)")
+
+    def __init__(self, **conn_kw):
+        self.conn_kw = conn_kw
+
+    def connect(self):
+        try:
+            import psycopg  # type: ignore
+        except ImportError:
+            try:
+                import psycopg2 as psycopg  # type: ignore
+            except ImportError as e:
+                raise RuntimeError(
+                    "postgres filer store needs psycopg installed; "
+                    "configuration is otherwise complete") from e
+        return psycopg.connect(**self.conn_kw)
+
+    def upsert_meta_sql(self) -> str:
+        return ("INSERT INTO filemeta (dirhash, name, directory, meta)"
+                " VALUES (%s, %s, %s, %s)"
+                " ON CONFLICT (dirhash, name, directory) DO UPDATE SET"
+                " directory=EXCLUDED.directory, meta=EXCLUDED.meta")
+
+    def upsert_kv_sql(self) -> str:
+        return ("INSERT INTO filer_kv (k, v) VALUES (%s, %s)"
+                " ON CONFLICT (k) DO UPDATE SET v=EXCLUDED.v")
+
+
+class AbstractSqlStore(FilerStore):
+    """The shared CRUD engine (abstract_sql_store.go semantics)."""
+
+    def __init__(self, dialect: SqlDialect):
+        self.dialect = dialect
+        self.name = dialect.name
+        self._conn = dialect.connect()
+        self._lock = threading.RLock()
+        self._txn_depth = 0
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute(dialect.create_meta)
+            cur.execute(dialect.create_kv)
+            self._conn.commit()
+
+    # -- helpers ---------------------------------------------------------
+    def _split(self, full_path: str) -> tuple[str, str]:
+        p = full_path.rstrip("/") or "/"
+        if p == "/":
+            return "", "/"
+        d, n = p.rsplit("/", 1)
+        return d or "/", n
+
+    def _exec(self, sql: str, params: tuple = ()) -> list:
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute(sql, params)
+            rows = cur.fetchall() if cur.description else []
+            if not self._txn_depth:
+                self._conn.commit()
+            return rows
+
+    @contextmanager
+    def atomic(self):
+        """Group mutations into one transaction (the reference wraps
+        rename's delete+insert this way, abstract_sql_store.go
+        BeginTransaction/CommitTransaction)."""
+        with self._lock:
+            self._txn_depth += 1
+            try:
+                yield
+            except BaseException:
+                self._txn_depth -= 1
+                if not self._txn_depth:
+                    self._conn.rollback()
+                raise
+            else:
+                self._txn_depth -= 1
+                if not self._txn_depth:
+                    self._conn.commit()
+
+    # -- FilerStore API --------------------------------------------------
+    def insert_entry(self, entry: Entry) -> None:
+        d, n = self._split(entry.full_path)
+        self._exec(self.dialect.upsert_meta_sql(),
+                   (dir_hash(d), n, d, json.dumps(entry.to_dict())))
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        d, n = self._split(full_path)
+        ph = self.dialect.ph
+        rows = self._exec(
+            f"SELECT meta FROM filemeta WHERE dirhash={ph} AND name={ph}"
+            f" AND directory={ph}", (dir_hash(d), n, d))
+        if not rows:
+            raise NotFound(full_path)
+        return Entry.from_dict(json.loads(rows[0][0]))
+
+    def delete_entry(self, full_path: str) -> None:
+        d, n = self._split(full_path)
+        ph = self.dialect.ph
+        self._exec(
+            f"DELETE FROM filemeta WHERE dirhash={ph} AND name={ph}"
+            f" AND directory={ph}", (dir_hash(d), n, d))
+
+    def delete_folder_children(self, full_path: str) -> None:
+        # base="" for the root so the subtree pattern "/%" matches every
+        # nested directory ("/a", "/a/b", ...), not the nonexistent "//.."
+        base = full_path.rstrip("/")
+        ph = self.dialect.ph
+        # direct children hit the dirhash index; the deeper subtree needs
+        # the directory prefix match (same two-step as the reference's
+        # recursive delete)
+        self._exec(
+            f"DELETE FROM filemeta WHERE dirhash={ph} AND directory={ph}",
+            (dir_hash(base or "/"), base or "/"))
+        self._exec(
+            f"DELETE FROM filemeta WHERE directory LIKE {ph} ESCAPE '!'",
+            (_like_escape(base) + "/%",))
+
+    def list_directory_entries(self, dir_path: str, start_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        d = dir_path.rstrip("/") or "/"
+        ph = self.dialect.ph
+        op = ">=" if include_start else ">"
+        rows = self._exec(
+            f"SELECT meta FROM filemeta WHERE dirhash={ph}"
+            f" AND directory={ph} AND name {op} {ph}"
+            f" AND name LIKE {ph} ESCAPE '!'"
+            f" ORDER BY name LIMIT {ph}",
+            (dir_hash(d), d, start_name, _like_escape(prefix) + "%",
+             limit))
+        return [Entry.from_dict(json.loads(r[0])) for r in rows]
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self._exec(self.dialect.upsert_kv_sql(), (key, value))
+
+    def kv_get(self, key: bytes) -> bytes:
+        ph = self.dialect.ph
+        rows = self._exec(f"SELECT v FROM filer_kv WHERE k={ph}", (key,))
+        if not rows:
+            raise NotFound(repr(key))
+        return rows[0][0]
+
+    def kv_delete(self, key: bytes) -> None:
+        ph = self.dialect.ph
+        self._exec(f"DELETE FROM filer_kv WHERE k={ph}", (key,))
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+class SqliteStore(AbstractSqlStore):
+    """Durable single-node store: the abstract-SQL engine with the
+    sqlite dialect (reference filer/sqlite over abstract_sql)."""
+
+    def __init__(self, path: str = ":memory:"):
+        super().__init__(SqliteDialect(path))
+        self.name = "sqlite"
+
+
+def mysql_store(**conn_kw) -> AbstractSqlStore:
+    return AbstractSqlStore(MySqlDialect(**conn_kw))
+
+
+def postgres_store(**conn_kw) -> AbstractSqlStore:
+    return AbstractSqlStore(PostgresDialect(**conn_kw))

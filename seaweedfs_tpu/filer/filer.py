@@ -291,10 +291,18 @@ class Filer:
                       chunks=entry.chunks, extended=entry.extended,
                       hard_link_id=entry.hard_link_id,
                       hard_link_counter=entry.hard_link_counter)
-        self._ensure_parents(moved.parent_dir)
-        self.store.insert_entry(moved)
+        # insert+delete as ONE transaction on stores that support it
+        # (abstract_sql.atomic — the reference wraps AtomicRenameEntry in
+        # a store transaction, filer_grpc_server_rename.go): a crash
+        # between the two statements must not duplicate or lose the entry
+        from contextlib import nullcontext
+        txn = self.store.atomic() if hasattr(self.store, "atomic") \
+            else nullcontext()
+        with txn:
+            self._ensure_parents(moved.parent_dir)
+            self.store.insert_entry(moved)
+            self.store.delete_entry(old_path)
         self._notify(None, moved)
-        self.store.delete_entry(old_path)
         self._notify(entry, None)
 
     # -- hardlinks (filerstore_hardlink.go) --------------------------------

@@ -5,17 +5,16 @@ list + KV + txn interface) with the registration pattern of the per-backend
 packages (blank imports, server/filer_server.go:24-40) replaced by a
 STORES registry dict.
 
-Backends here: "memory" (sorted dict, the test store) and "sqlite"
-(sqlite3, the durable single-node store mirroring abstract_sql's
-one-table-schema: directory, name, meta).  The API shape matches the
-reference so leveldb/redis/mysql ports slot in later.
+Backends: "memory" (sorted dict, the test store); "sqlite" / "mysql" /
+"postgres" all riding the shared abstract-SQL engine (abstract_sql.py —
+the reference's filer/abstract_sql layer: dirhash keys, prefix listing,
+transactions); "lsm" (lsm_store.py).  The API shape matches the
+reference so further backends slot in as dialects or stores.
 """
 
 from __future__ import annotations
 
 import bisect
-import json
-import sqlite3
 import threading
 from .entry import Entry
 
@@ -142,110 +141,34 @@ class MemoryStore(FilerStore):
         self._kv.pop(key, None)
 
 
-class SqliteStore(FilerStore):
-    """Durable store over sqlite3 — the abstract_sql one-table schema
-    (filer/abstract_sql/abstract_sql_store.go; sqlite variant
-    filer/sqlite)."""
-    name = "sqlite"
-
-    def __init__(self, path: str = ":memory:"):
-        self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._lock = threading.RLock()
-        with self._lock:
-            self._conn.execute(
-                "CREATE TABLE IF NOT EXISTS filemeta ("
-                " directory TEXT NOT NULL, name TEXT NOT NULL,"
-                " meta TEXT NOT NULL, PRIMARY KEY (directory, name))")
-            self._conn.execute(
-                "CREATE TABLE IF NOT EXISTS filer_kv ("
-                " k BLOB PRIMARY KEY, v BLOB NOT NULL)")
-            self._conn.commit()
-
-    def _split(self, full_path: str) -> tuple[str, str]:
-        p = full_path.rstrip("/") or "/"
-        if p == "/":
-            return "", "/"
-        d, n = p.rsplit("/", 1)
-        return d or "/", n
-
-    def insert_entry(self, entry: Entry) -> None:
-        d, n = self._split(entry.full_path)
-        with self._lock:
-            self._conn.execute(
-                "INSERT OR REPLACE INTO filemeta (directory, name, meta)"
-                " VALUES (?, ?, ?)",
-                (d, n, json.dumps(entry.to_dict())))
-            self._conn.commit()
-
-    update_entry = insert_entry
-
-    def find_entry(self, full_path: str) -> Entry:
-        d, n = self._split(full_path)
-        with self._lock:
-            row = self._conn.execute(
-                "SELECT meta FROM filemeta WHERE directory=? AND name=?",
-                (d, n)).fetchone()
-        if row is None:
-            raise NotFound(full_path)
-        return Entry.from_dict(json.loads(row[0]))
-
-    def delete_entry(self, full_path: str) -> None:
-        d, n = self._split(full_path)
-        with self._lock:
-            self._conn.execute(
-                "DELETE FROM filemeta WHERE directory=? AND name=?", (d, n))
-            self._conn.commit()
-
-    def delete_folder_children(self, full_path: str) -> None:
-        base = full_path.rstrip("/")
-        with self._lock:
-            self._conn.execute(
-                "DELETE FROM filemeta WHERE directory=? OR directory LIKE ?",
-                (base or "/", base + "/%"))
-            self._conn.commit()
-
-    def list_directory_entries(self, dir_path: str, start_name: str = "",
-                               include_start: bool = False,
-                               limit: int = 1024,
-                               prefix: str = "") -> list[Entry]:
-        d = dir_path.rstrip("/") or "/"
-        op = ">=" if include_start else ">"
-        # escape LIKE metacharacters so a literal '%'/'_' in the prefix
-        # doesn't change the match (MemoryStore uses startswith)
-        esc = (prefix.replace("\\", "\\\\").replace("%", "\\%")
-               .replace("_", "\\_"))
-        sql = (f"SELECT meta FROM filemeta WHERE directory=? AND name {op} ?"
-               " AND name LIKE ? ESCAPE '\\' ORDER BY name LIMIT ?")
-        with self._lock:
-            rows = self._conn.execute(
-                sql, (d, start_name, esc + "%", limit)).fetchall()
-        return [Entry.from_dict(json.loads(r[0])) for r in rows]
-
-    def kv_put(self, key: bytes, value: bytes) -> None:
-        with self._lock:
-            self._conn.execute(
-                "INSERT OR REPLACE INTO filer_kv (k, v) VALUES (?, ?)",
-                (key, value))
-            self._conn.commit()
-
-    def kv_get(self, key: bytes) -> bytes:
-        with self._lock:
-            row = self._conn.execute(
-                "SELECT v FROM filer_kv WHERE k=?", (key,)).fetchone()
-        if row is None:
-            raise NotFound(repr(key))
-        return row[0]
-
-    def kv_delete(self, key: bytes) -> None:
-        with self._lock:
-            self._conn.execute("DELETE FROM filer_kv WHERE k=?", (key,))
-            self._conn.commit()
-
-    def close(self) -> None:
-        self._conn.close()
+# sqlite/mysql/postgres all ride the shared abstract-SQL engine
+# (abstract_sql.py) — imported lazily to keep the base-class module
+# cycle-free
+def _sqlite(*a, **kw):
+    from .abstract_sql import SqliteStore
+    return SqliteStore(*a, **kw)
 
 
-STORES = {"memory": MemoryStore, "sqlite": SqliteStore}
+def _mysql(**kw):
+    from .abstract_sql import mysql_store
+    return mysql_store(**kw)
+
+
+def _postgres(**kw):
+    from .abstract_sql import postgres_store
+    return postgres_store(**kw)
+
+
+STORES = {"memory": MemoryStore, "sqlite": _sqlite,
+          "mysql": _mysql, "postgres": _postgres}
+
+
+def __getattr__(name):
+    # back-compat: `from filer.filerstore import SqliteStore`
+    if name == "SqliteStore":
+        from .abstract_sql import SqliteStore
+        return SqliteStore
+    raise AttributeError(name)
 
 
 def new_filer_store(kind: str, *args, **kw) -> FilerStore:

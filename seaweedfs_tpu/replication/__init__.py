@@ -154,6 +154,26 @@ class _ChunkStream:
         return bytes(out)
 
 
+def stitch_chunks(entry: Entry, read_chunk):
+    """-> (stream, None) for non-overlapping chunks (a _ChunkStream the
+    sink can upload without buffering) or (None, bytes) for
+    MVCC-overlapping chunk lists, which need in-place overwrite
+    semantics (rare: autochunked writes never overlap).  The ONE policy
+    every object sink shares (S3/GCS/Azure/B2)."""
+    chunks = sorted(entry.chunks, key=lambda c: c.offset)
+    overlapping = any(a.offset + a.size > b.offset
+                      for a, b in zip(chunks, chunks[1:]))
+    if not overlapping:
+        return _ChunkStream(chunks, read_chunk), None
+    data = bytearray()
+    for c in chunks:
+        blob = read_chunk(c.file_id)
+        if len(data) < c.offset:      # sparse hole → zero fill
+            data.extend(b"\0" * (c.offset - len(data)))
+        data[c.offset:c.offset + len(blob)] = blob
+    return None, bytes(data)
+
+
 class S3Sink:
     """Replicate the namespace as objects into an S3 bucket
     (replication/sink/s3sink/s3_sink.go): entry path -> object key,
@@ -180,27 +200,16 @@ class S3Sink:
     def create_entry(self, entry: Entry, signature: str) -> None:
         if entry.is_directory():
             return              # S3 has no directories
-        chunks = sorted(entry.chunks, key=lambda c: c.offset)
-        overlapping = any(a.offset + a.size > b.offset
-                          for a, b in zip(chunks, chunks[1:]))
-        if overlapping:
-            # MVCC-overlapping chunk lists need in-place overwrite
-            # semantics; rare (autochunked writes never overlap), so the
-            # buffered path is acceptable there
-            data = bytearray()
-            for c in chunks:
-                chunk = self.read_chunk(c.file_id)
-                if len(data) < c.offset:      # sparse hole → zero fill
-                    data.extend(b"\0" * (c.offset - len(data)))
-                data[c.offset:c.offset + len(chunk)] = chunk
+        stream, data = stitch_chunks(entry, self.read_chunk)
+        if stream is not None:
+            # stream chunk-by-chunk (multipart beyond the first part) so
+            # a large file never materializes whole in this process
+            self.client.put_object_stream(
+                self.bucket, self._key(entry.full_path), stream,
+                chunk=8 << 20)
+        else:
             self.client.put_object(self.bucket,
-                                   self._key(entry.full_path), bytes(data))
-            return
-        # stream chunk-by-chunk (multipart beyond the first part) so a
-        # large file never materializes whole in this process
-        self.client.put_object_stream(
-            self.bucket, self._key(entry.full_path),
-            _ChunkStream(chunks, self.read_chunk), chunk=8 << 20)
+                                   self._key(entry.full_path), data)
 
     def update_entry(self, old: Entry, new: Entry, signature: str) -> None:
         self.create_entry(new, signature)
@@ -264,3 +273,22 @@ class Replicator:
                 path, bool(old.get("attr", {}).get("mode", 0) & 0o40000))
             return True
         return False
+
+
+# -- sink registry (the reference's blank-import driver registration,
+# replication/sink/*/: each package registers itself by name) -------------
+def new_sink(kind: str, **kw) -> ReplicationSink:
+    """Build a replication sink by name — filer/local/s3 in-tree,
+    gcs/azure/b2 as SDK-shaped shells (cloud_sinks.py; inject `client`
+    for the in-process fakes, omit it to use the real SDK)."""
+    if kind == "filer":
+        return FilerSink(**kw)
+    if kind == "local":
+        return LocalSink(**kw)
+    if kind == "s3":
+        return S3Sink(**kw)
+    if kind in ("gcs", "azure", "b2"):
+        from .cloud_sinks import AzureSink, B2Sink, GcsSink
+        return {"gcs": GcsSink, "azure": AzureSink,
+                "b2": B2Sink}[kind](**kw)
+    raise ValueError(f"unknown replication sink {kind!r}")
