@@ -431,9 +431,18 @@ def cmd_ftp(args) -> int:
     from ..ftpd import FtpServer
     from ..pb import ServerAddress
     filer = ServerAddress.parse(args.filer)
-    srv = FtpServer(filer.url, filer.grpc, host=args.ip, port=args.port)
+    users = {args.user: args.password} if args.user else None
+    if users is None and args.ip not in ("127.0.0.1", "localhost", "::1"):
+        print("WARNING: ftp gateway bound to a routable address with NO "
+              "credentials configured — ANY client gets full read/write "
+              "over the filer namespace.  Pass -user/-password (and "
+              "-tls.cert/-tls.key for FTPS).", file=sys.stderr)
+    srv = FtpServer(filer.url, filer.grpc, host=args.ip, port=args.port,
+                    users=users, tls_cert=args.tls_cert,
+                    tls_key=args.tls_key)
     srv.start()
-    print(f"ftp gateway {srv.address}")
+    print(f"ftp gateway {srv.address}"
+          + (" (FTPS available)" if args.tls_cert else ""))
     _wait_forever()
     srv.stop()
     return 0
@@ -766,6 +775,13 @@ def build_parser() -> argparse.ArgumentParser:
     ftp.add_argument("-ip", default="127.0.0.1")
     ftp.add_argument("-port", type=int, default=8021)
     ftp.add_argument("-filer", default="127.0.0.1:8888.18888")
+    ftp.add_argument("-user", default="",
+                     help="require this login (default: OPEN ACCESS — "
+                          "safe only on loopback)")
+    ftp.add_argument("-password", default="")
+    ftp.add_argument("-tls.cert", dest="tls_cert", default="",
+                     help="server certificate: enables AUTH TLS (FTPS)")
+    ftp.add_argument("-tls.key", dest="tls_key", default="")
     ftp.set_defaults(fn=cmd_ftp)
 
     sc = sub.add_parser("scaffold", help="print sample configs")

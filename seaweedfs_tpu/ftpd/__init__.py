@@ -3,11 +3,18 @@
 BEYOND the reference here: weed/ftpd/ftp_server.go:13-20 ships only an
 unimplemented driver stub (every ftpserverlib method returns "not
 implemented"); this is a functioning gateway speaking the protocol
-subset every common client uses — USER/PASS (anonymous or any
-credentials; authorization is the filer's concern), PWD/CWD/CDUP,
-TYPE, PASV (passive data connections only — the NAT-safe mode), LIST,
-NLST, RETR, STOR, DELE, MKD, RMD, RNFR/RNTO, SIZE, FEAT, SYST, NOOP,
-QUIT.
+subset every common client uses — USER/PASS, PWD/CWD/CDUP, TYPE,
+PASV (passive data connections only — the NAT-safe mode), LIST, NLST,
+RETR, STOR (with REST resume for both), DELE, MKD, RMD, RNFR/RNTO,
+SIZE, FEAT, SYST, NOOP, QUIT — plus explicit FTPS (RFC 4217 AUTH
+TLS / PBSZ / PROT P) when a certificate is configured.
+
+ACCESS CONTROL: with no `users` configured the gateway accepts ANY
+USER/PASS and grants full read/write over the filer namespace — safe
+on the 127.0.0.1 default bind, WIDE OPEN if bound to a routable
+address.  Pass `users={name: password}` to require credentials (the
+CLI verb prints a loud warning when binding non-loopback without
+them).
 
 Data flows through the filer HTTP surface (streamed chunked files,
 collection/TTL rules, replication — everything the namespace already
@@ -18,6 +25,7 @@ does), exactly like the WebDAV gateway's adapter pattern
 from __future__ import annotations
 
 import socket
+import ssl
 import threading
 
 from ..pb.rpc import POOL, RpcError
@@ -29,12 +37,19 @@ LOG = logger(__name__)
 
 class FtpServer:
     def __init__(self, filer_http: str, filer_grpc: str,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 users: "dict[str, str] | None" = None,
+                 tls_cert: str = "", tls_key: str = ""):
         self.filer_http = filer_http
         self.filer_grpc = filer_grpc
         self.host = host
         self._requested_port = port
         self.port = 0
+        self.users = users          # None -> open access (see module doc)
+        self.ssl_ctx: "ssl.SSLContext | None" = None
+        if tls_cert:
+            self.ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self.ssl_ctx.load_cert_chain(tls_cert, tls_key or None)
         self._sock: "socket.socket | None" = None
         self._stop = threading.Event()
 
@@ -175,6 +190,10 @@ class _Session:
         self.conn = conn
         self.cwd = "/"
         self.rnfr = ""
+        self.rest = 0            # REST offset for the next RETR/STOR
+        self.user = ""
+        self.authed = server.users is None   # open access unless users set
+        self.prot_p = False      # PROT P: TLS on data connections
         self._pasv: "socket.socket | None" = None
 
     # -- plumbing -----------------------------------------------------------
@@ -214,6 +233,36 @@ class _Session:
         finally:
             self._close_pasv()
 
+    @staticmethod
+    def _close_data(data: socket.socket) -> None:
+        """Close a data connection; TLS sockets get a proper close_notify
+        first (ftplib's PROT P transfers call unwrap() and error on a
+        bare FIN)."""
+        if isinstance(data, ssl.SSLSocket):
+            try:
+                data.unwrap()
+            except (OSError, ssl.SSLError, ValueError):
+                pass
+        try:
+            data.close()
+        except OSError:
+            pass
+
+    def _wrap_data(self, data: socket.socket) -> "socket.socket | None":
+        """PROT P handshake — AFTER the 150 reply: ftplib (and most
+        clients) only begin their client-side TLS handshake once the
+        preliminary reply arrives, so wrapping earlier deadlocks."""
+        if not (self.prot_p and self.srv.ssl_ctx is not None):
+            return data
+        try:
+            return self.srv.ssl_ctx.wrap_socket(data, server_side=True)
+        except (OSError, ssl.SSLError):
+            try:
+                data.close()
+            except OSError:
+                pass
+            return None
+
     # -- main loop ----------------------------------------------------------
     def run(self) -> None:
         try:
@@ -237,6 +286,10 @@ class _Session:
             except OSError:
                 pass
 
+    # commands usable before login completes
+    PRE_AUTH = {"USER", "PASS", "QUIT", "FEAT", "SYST", "NOOP",
+                "AUTH", "PBSZ", "PROT"}
+
     def _dispatch(self, line: str) -> bool:
         cmd, _, arg = line.partition(" ")
         cmd = cmd.upper()
@@ -244,20 +297,80 @@ class _Session:
         if handler is None:
             self._send(f"502 {cmd} not implemented")
             return True
+        if not self.authed and cmd not in self.PRE_AUTH:
+            self._send("530 please login with USER and PASS")
+            return True
         return handler(arg) is not False
 
     # -- commands -----------------------------------------------------------
     def _cmd_user(self, arg):
-        self._send(f"331 password required for {arg or 'anonymous'}")
+        self.user = arg or "anonymous"
+        self._send(f"331 password required for {self.user}")
 
     def _cmd_pass(self, arg):
-        self._send("230 logged in")
+        if self.srv.users is None:
+            self.authed = True
+            self._send("230 logged in")
+            return
+        import hmac as _hmac
+        # constant-time compare; unknown users take the same path so
+        # neither timing nor branch reveals valid usernames
+        want = self.srv.users.get(self.user, "")
+        if _hmac.compare_digest(want.encode(), (arg or "").encode()) \
+                and self.user in self.srv.users:
+            self.authed = True
+            self._send("230 logged in")
+        else:
+            self.authed = False
+            self._send("530 login incorrect")
+
+    # -- explicit FTPS (RFC 4217) ------------------------------------------
+    def _cmd_auth(self, arg):
+        if arg.upper() != "TLS":
+            self._send("504 only AUTH TLS is supported")
+            return True
+        if self.srv.ssl_ctx is None:
+            self._send("534 TLS not configured on this server")
+            return True
+        if isinstance(self.conn, ssl.SSLSocket):
+            # RFC 4217: AUTH must be rejected once TLS is active — a
+            # second wrap would block forever in a TLS-in-TLS handshake
+            self._send("534 TLS already active")
+            return True
+        self._send("234 proceed with TLS handshake")
+        try:
+            self.conn = self.srv.ssl_ctx.wrap_socket(self.conn,
+                                                     server_side=True)
+        except (OSError, ssl.SSLError):
+            return False         # handshake failed: drop the session
+        return True
+
+    def _cmd_pbsz(self, arg):
+        self._send("200 PBSZ=0")
+
+    def _cmd_prot(self, arg):
+        if arg.upper() == "P":
+            if self.srv.ssl_ctx is None:
+                self._send("536 TLS not configured")
+            else:
+                self.prot_p = True
+                self._send("200 protection set to private")
+        elif arg.upper() == "C":
+            self.prot_p = False
+            self._send("200 protection set to clear")
+        else:
+            self._send("504 unsupported protection level")
 
     def _cmd_syst(self, arg):
         self._send("215 UNIX Type: L8")
 
     def _cmd_feat(self, arg):
-        self.conn.sendall(b"211-Features:\r\n SIZE\r\n PASV\r\n211 End\r\n")
+        feats = [" SIZE", " PASV", " REST STREAM"]
+        if self.srv.ssl_ctx is not None:
+            feats += [" AUTH TLS", " PBSZ", " PROT"]
+        self.conn.sendall(("211-Features:\r\n"
+                           + "\r\n".join(feats)
+                           + "\r\n211 End\r\n").encode())
 
     def _cmd_type(self, arg):
         self._send("200 type set")
@@ -307,6 +420,10 @@ class _Session:
             self._send("425 use PASV first")
             return True
         self._send("150 listing")
+        data = self._wrap_data(data)
+        if data is None:
+            self._send("425 data TLS handshake failed")
+            return True
         lines = []
         for e in self.srv.list_dir(path):
             name = e["full_path"].rsplit("/", 1)[-1]
@@ -321,42 +438,93 @@ class _Session:
             data.sendall(("\r\n".join(lines) + "\r\n").encode()
                          if lines else b"")
         finally:
-            data.close()
+            self._close_data(data)
         self._send("226 done")
+
+    def _cmd_rest(self, arg):
+        """REST STREAM (RFC 3659): the next RETR/STOR resumes at this
+        byte offset."""
+        try:
+            rest = int(arg)
+        except ValueError:
+            self._send("501 bad offset")
+            return True
+        if rest < 0:
+            # a negative offset would slice from the END on RETR and
+            # truncate the existing file on STOR — silent corruption
+            self._send("501 offset must be non-negative")
+            return True
+        self.rest = rest
+        self._send(f"350 restarting at {self.rest}")
 
     def _cmd_retr(self, arg):
         path = self._abspath(arg)
+        offset, self.rest = self.rest, 0
         blob = self.srv.read_file(path)
         if blob is None:
             self._close_pasv()   # don't strand the queued data conn
             self._send("550 no such file")
             return True
+        if offset > len(blob):
+            self._close_pasv()
+            self._send("551 restart point past end of file")
+            return True
+        blob = blob[offset:]
         data = self._open_data()
         if data is None:
             self._send("425 use PASV first")
             return True
         self._send(f"150 opening data connection ({len(blob)} bytes)")
+        data = self._wrap_data(data)
+        if data is None:
+            self._send("425 data TLS handshake failed")
+            return True
         try:
             data.sendall(blob)
         finally:
-            data.close()
+            self._close_data(data)
         self._send("226 transfer complete")
 
     def _cmd_stor(self, arg):
         path = self._abspath(arg)
+        offset, self.rest = self.rest, 0
         data = self._open_data()
         if data is None:
             self._send("425 use PASV first")
             return True
         self._send("150 ready")
+        data = self._wrap_data(data)
+        if data is None:
+            self._send("425 data TLS handshake failed")
+            return True
         chunks = []
+        aborted = False
         while True:
-            piece = data.recv(1 << 16)
+            try:
+                piece = data.recv(1 << 16)
+            except ssl.SSLError:
+                # ragged EOF without close_notify = aborted transfer; a
+                # clean ftplib shutdown surfaces as recv() == b"" instead.
+                # Committing the partial body would record a truncated
+                # upload as success.
+                aborted = True
+                break
             if not piece:
                 break
             chunks.append(piece)
-        data.close()
-        if self.srv.write_file(path, b"".join(chunks)):
+        self._close_data(data)
+        if aborted:
+            self._send("426 transfer aborted; nothing stored")
+            return True
+        body = b"".join(chunks)
+        if offset:
+            # resume upload: splice the new bytes over the existing file
+            # at the restart point (zero-fill any gap)
+            existing = self.srv.read_file(path) or b""
+            if len(existing) < offset:
+                existing += b"\0" * (offset - len(existing))
+            body = existing[:offset] + body
+        if self.srv.write_file(path, body):
             self._send("226 stored")
         else:
             self._send("550 store failed")

@@ -98,3 +98,83 @@ def test_ftp_review_fixes(ftp):
     with pytest.raises(ftplib.error_perm):
         client.retrbinary("RETR /nope.bin", lambda b: None)
     assert client.nlst("/dirs") == []      # session still healthy
+
+
+# -- round 3: FTPS (AUTH TLS), REST resume, credentials --------------------
+
+@pytest.fixture()
+def ftps(tmp_path):
+    """Cluster + TLS-enabled, credentialed FTP gateway + FTP_TLS client."""
+    import ssl
+
+    from seaweedfs_tpu.security.tls import generate_cluster_certs
+
+    certs = generate_cluster_certs(str(tmp_path / "certs"))
+    with SimCluster(volume_servers=1, filers=1,
+                    base_dir=str(tmp_path / "c")) as c:
+        srv = FtpServer(c.filers[0].address, c.filers[0].grpc_address,
+                        users={"weed": "s3cr3t"},
+                        tls_cert=certs.cert_path, tls_key=certs.key_path)
+        srv.start()
+        ctx = ssl.create_default_context(cafile=certs.ca_path)
+        ctx.check_hostname = False  # cert SAN is localhost/127.0.0.1
+        client = ftplib.FTP_TLS(context=ctx)
+        client.connect(srv.host, srv.port, timeout=10)
+        yield c, srv, client
+        try:
+            client.quit()
+        except Exception:
+            pass
+        srv.stop()
+
+
+def test_ftps_tls_roundtrip(ftps):
+    """AUTH TLS control channel + PROT P data channel: store and read
+    back byte-exact over encrypted connections (RFC 4217)."""
+    c, srv, client = ftps
+    client.auth()               # AUTH TLS handshake
+    client.login("weed", "s3cr3t")
+    client.prot_p()             # encrypted data connections
+    payload = bytes(range(256)) * 64
+    client.storbinary("STOR /sec/data.bin", io.BytesIO(payload))
+    buf = io.BytesIO()
+    client.retrbinary("RETR /sec/data.bin", buf.write)
+    assert buf.getvalue() == payload
+    # same namespace over HTTP
+    st, body, _ = http_request(
+        f"http://{c.filers[0].address}/sec/data.bin")
+    assert (st, body) == (200, payload)
+
+
+def test_ftp_credentials_enforced(ftps):
+    c, srv, client = ftps
+    client.auth()
+    with pytest.raises(ftplib.error_perm, match="530"):
+        client.login("weed", "wrong")
+    # unauthenticated commands are refused
+    with pytest.raises(ftplib.error_perm, match="530"):
+        client.mkd("/nope")
+    client.login("weed", "s3cr3t")
+    assert client.pwd() == "/"
+
+
+def test_ftp_rest_resume_download_and_upload(ftp):
+    """REST offset applies to the next RETR (resume download) and STOR
+    (resume upload splices at the restart point)."""
+    c, srv, client = ftp
+    payload = b"0123456789" * 1000
+    client.storbinary("STOR /r/file.bin", io.BytesIO(payload))
+    # resume download from byte 4000
+    buf = io.BytesIO()
+    client.retrbinary("RETR /r/file.bin", buf.write, rest=4000)
+    assert buf.getvalue() == payload[4000:]
+    # resume upload: overwrite the tail from byte 6000
+    tail = b"X" * 1500
+    client.storbinary("STOR /r/file.bin", io.BytesIO(tail), rest=6000)
+    buf = io.BytesIO()
+    client.retrbinary("RETR /r/file.bin", buf.write)
+    assert buf.getvalue() == payload[:6000] + tail
+    # restart point past EOF is a clean 551, not garbage
+    with pytest.raises(ftplib.error_perm, match="551"):
+        client.retrbinary("RETR /r/file.bin", buf.write,
+                          rest=10 ** 9)

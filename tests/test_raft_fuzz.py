@@ -1,0 +1,247 @@
+"""Raft adversarial fuzz (VERDICT r2 #9): a seeded randomized scheduler
+drives 5 nodes through partitions, heals, restarts, message delays and
+drops, while the safety invariants the raft exists for are checked
+continuously:
+
+1. at most ONE leader per term, ever;
+2. an acknowledged (committed) command is never lost;
+3. every node applies the same command sequence (prefix property).
+
+The transport seam (RaftNode._call) is replaced by an in-process fuzz
+network, so message fate — delay, drop, partition — is drawn from ONE
+seeded rng: failures reproduce by seed.  This replaces the trust the
+reference places in hashicorp/raft (weed/server/raft_server.go:64-150)
+with direct adversarial evidence against our own implementation."""
+
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.master import raft as raft_mod
+from seaweedfs_tpu.master.raft import LEADER, NotLeaderError, RaftNode
+from seaweedfs_tpu.pb.rpc import RpcError
+
+N_NODES = 5
+HB = 0.03
+ELECTION = 0.15
+
+
+class FuzzNet:
+    """Seeded message scheduler: per-call delay, drop, and pairwise
+    partitions, routed straight to the target node's handlers."""
+
+    def __init__(self, seed: int, max_delay: float = 0.05,
+                 drop_p: float = 0.05):
+        self.rng = random.Random(seed)
+        self.max_delay = max_delay
+        self.drop_p = drop_p
+        self.nodes: dict[str, RaftNode] = {}
+        self.cut: set[frozenset] = set()   # blocked pairs
+        self.lock = threading.Lock()
+
+    def wire(self, node: RaftNode) -> None:
+        self.nodes[node.self_addr] = node
+        src = node.self_addr
+
+        def call(peer, method, req, timeout, _src=src):
+            return self._deliver(_src, peer, method, req)
+        node._call = call
+
+    def _deliver(self, src: str, dst: str, method: str, req: dict):
+        with self.lock:
+            if frozenset((src, dst)) in self.cut:
+                raise RpcError(f"partitioned {src}->{dst}")
+            delay = self.rng.uniform(0, self.max_delay)
+            drop = self.rng.random() < self.drop_p
+        if delay:
+            time.sleep(delay)
+        if drop:
+            raise RpcError("dropped")
+        node = self.nodes.get(dst)
+        if node is None or node._stop.is_set():
+            raise RpcError(f"{dst} down")
+        handler = {"RequestVote": node.handle_request_vote,
+                   "AppendEntries": node.handle_append_entries,
+                   "InstallSnapshot": node.handle_install_snapshot}[method]
+        return handler(req)
+
+    def partition(self, group_a: list[str], group_b: list[str]) -> None:
+        with self.lock:
+            for a in group_a:
+                for b in group_b:
+                    self.cut.add(frozenset((a, b)))
+
+    def heal(self) -> None:
+        with self.lock:
+            self.cut.clear()
+
+
+class Machine:
+    """Replicated state machine: an append-only id list that survives
+    snapshot/restore, so each node's FULL applied sequence is checkable
+    even across restarts and log compaction."""
+
+    def __init__(self):
+        self.ids: list[int] = []
+        self.lock = threading.Lock()
+
+    def apply(self, cmd: dict):
+        with self.lock:
+            self.ids.append(cmd["id"])
+        return cmd["id"]
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            return {"ids": list(self.ids)}
+
+    def restore(self, state: dict) -> None:
+        with self.lock:
+            self.ids = list(state.get("ids", []))
+
+
+def make_node(addr, peers, net, machines, state_root, seed):
+    # a FRESH machine every (re)start: a real crash loses the in-memory
+    # state machine, which must rebuild purely from the persisted
+    # snapshot + log replay (reusing the object would mask — or fake —
+    # double-applies)
+    m = machines[addr] = Machine()
+    node = RaftNode(addr, peers, apply_fn=m.apply,
+                    snapshot_fn=m.snapshot, restore_fn=m.restore,
+                    heartbeat_interval=HB, election_timeout=ELECTION,
+                    state_dir=os.path.join(state_root, addr),
+                    max_log_entries=64, seed=seed)
+    net.wire(node)
+    return node
+
+
+def run_fuzz(seed: int, sim_seconds: float, tmp_path) -> None:
+    rng = random.Random(seed * 7919 + 1)
+    net = FuzzNet(seed)
+    machines: dict[str, Machine] = {}
+    addrs = [f"n{i}" for i in range(N_NODES)]
+    nodes = {a: make_node(a, addrs, net, machines, str(tmp_path), seed + i)
+             for i, a in enumerate(addrs)}
+    for n in nodes.values():
+        n.start()
+
+    leaders_by_term: dict[int, set[str]] = {}
+    violations: list[str] = []
+    acked: set[int] = set()
+    stop = threading.Event()
+
+    def observer():
+        while not stop.is_set():
+            for a, n in list(nodes.items()):
+                if n._stop.is_set():
+                    continue
+                with n._lock:
+                    role, term = n.role, n.term
+                if role == LEADER:
+                    claim = leaders_by_term.setdefault(term, set())
+                    claim.add(a)
+                    if len(claim) > 1:
+                        violations.append(
+                            f"term {term} has leaders {sorted(claim)}")
+            time.sleep(0.004)
+
+    next_id = [0]
+
+    def writer():
+        while not stop.is_set():
+            leader = next((n for n in nodes.values()
+                           if not n._stop.is_set() and n.role == LEADER),
+                          None)
+            if leader is None:
+                time.sleep(0.01)
+                continue
+            cid = next_id[0]
+            next_id[0] += 1
+            try:
+                leader.propose({"id": cid}, timeout=1.0)
+                acked.add(cid)
+            except (NotLeaderError, RpcError):
+                pass  # unacknowledged: may or may not survive — legal
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=observer, daemon=True),
+               threading.Thread(target=writer, daemon=True),
+               threading.Thread(target=writer, daemon=True)]
+    for t in threads:
+        t.start()
+
+    deadline = time.time() + sim_seconds
+    while time.time() < deadline:
+        event = rng.random()
+        if event < 0.35:        # minority partition
+            k = rng.choice([1, 2])
+            minority = rng.sample(addrs, k)
+            rest = [a for a in addrs if a not in minority]
+            net.partition(minority, rest)
+        elif event < 0.55:      # heal everything
+            net.heal()
+        elif event < 0.70:      # restart a random node (persisted state)
+            victim = rng.choice(addrs)
+            nodes[victim].stop()
+            time.sleep(rng.uniform(0.02, 0.15))
+            nodes[victim] = make_node(victim, addrs, net, machines,
+                                      str(tmp_path), seed + 100)
+            nodes[victim].start()
+        elif event < 0.85:      # random asymmetric link cuts
+            a, b = rng.sample(addrs, 2)
+            net.partition([a], [b])
+        # else: let it run
+        time.sleep(rng.uniform(0.05, 0.25))
+        assert not violations, violations
+
+    # quiesce: heal, stop chaos, let the cluster converge
+    net.heal()
+    conv_deadline = time.time() + 10
+    while time.time() < conv_deadline:
+        live = [n for n in nodes.values() if not n._stop.is_set()]
+        if any(n.role == LEADER for n in live):
+            commits = {n.commit_index for n in live}
+            applied = {n.last_applied for n in live}
+            if len(commits) == 1 and applied == commits:
+                break
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+
+    assert not violations, violations
+
+    # invariant 3: identical applied sequences (prefix property collapses
+    # to equality after convergence)
+    seqs = {a: list(machines[a].ids) for a in addrs
+            if not nodes[a]._stop.is_set()}
+    longest = max(seqs.values(), key=len)
+    for a, s in seqs.items():
+        assert s == longest[:len(s)], \
+            f"{a} applied sequence diverges at {next(i for i in range(min(len(s), len(longest))) if s[i] != longest[i])}"
+    assert len(set(longest)) == len(longest), "command applied twice"
+
+    # invariant 2: every acknowledged command survived somewhere durable —
+    # present in the converged majority's sequence
+    surviving = set(longest)
+    lost = acked - surviving
+    assert not lost, f"{len(lost)} acked commands lost: {sorted(lost)[:10]}"
+
+    for n in nodes.values():
+        n.stop()
+
+
+@pytest.mark.parametrize("seed", [11, 29, 47])
+def test_raft_fuzz_seeded(seed, tmp_path):
+    """~6s of seeded chaos per seed; failures reproduce by seed."""
+    run_fuzz(seed, sim_seconds=6.0, tmp_path=tmp_path)
+
+
+@pytest.mark.skipif(not os.environ.get("RAFT_FUZZ_LONG"),
+                    reason="long soak: set RAFT_FUZZ_LONG=1 "
+                           "(~35s sim-time, run before releases)")
+def test_raft_fuzz_long_soak(tmp_path):
+    run_fuzz(int(os.environ.get("RAFT_FUZZ_SEED", "1009")),
+             sim_seconds=35.0, tmp_path=tmp_path)

@@ -76,6 +76,14 @@ def test_raft_churn_soak(tmp_path):
         c.restart_master(victim)
         time.sleep(1.0)
 
+        # under ambient suite load the writers can be starved during the
+        # churn itself; give them a calm window AFTER the churn until the
+        # activity floor is met (bounded wait, so a real liveness bug
+        # still fails below)
+        calm_deadline = time.time() + 20
+        while len(acked) <= 10 and time.time() < calm_deadline:
+            time.sleep(0.25)
+
         stop.set()
         for t in threads:
             t.join(timeout=10)
