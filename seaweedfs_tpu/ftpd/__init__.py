@@ -4,7 +4,8 @@ BEYOND the reference here: weed/ftpd/ftp_server.go:13-20 ships only an
 unimplemented driver stub (every ftpserverlib method returns "not
 implemented"); this is a functioning gateway speaking the protocol
 subset every common client uses — USER/PASS, PWD/CWD/CDUP, TYPE,
-PASV (passive data connections only — the NAT-safe mode), LIST, NLST,
+PASV/EPSV (passive, the NAT-safe mode) and PORT/EPRT (active), LIST,
+NLST,
 RETR, STOR (with REST resume for both), DELE, MKD, RMD, RNFR/RNTO,
 SIZE, FEAT, SYST, NOOP, QUIT — plus explicit FTPS (RFC 4217 AUTH
 TLS / PBSZ / PROT P) when a certificate is configured.
@@ -195,6 +196,7 @@ class _Session:
         self.authed = server.users is None   # open access unless users set
         self.prot_p = False      # PROT P: TLS on data connections
         self._pasv: "socket.socket | None" = None
+        self._active: "tuple[str, int] | None" = None  # PORT/EPRT target
 
     # -- plumbing -----------------------------------------------------------
     def _send(self, line: str) -> None:
@@ -223,6 +225,16 @@ class _Session:
             self._pasv = None
 
     def _open_data(self) -> "socket.socket | None":
+        if self._active is not None:
+            # active mode: WE connect to the client's advertised port
+            target, self._active = self._active, None
+            try:
+                data = socket.create_connection(target, timeout=10)
+                data.settimeout(None)   # connect timeout only — a slow
+                # client mid-transfer must not kill the session
+                return data
+            except OSError:
+                return None
         if self._pasv is None:
             return None
         try:
@@ -365,7 +377,7 @@ class _Session:
         self._send("215 UNIX Type: L8")
 
     def _cmd_feat(self, arg):
-        feats = [" SIZE", " PASV", " REST STREAM"]
+        feats = [" SIZE", " PASV", " EPSV", " EPRT", " REST STREAM"]
         if self.srv.ssl_ctx is not None:
             feats += [" AUTH TLS", " PBSZ", " PROT"]
         self.conn.sendall(("211-Features:\r\n"
@@ -394,17 +406,70 @@ class _Session:
         self.cwd = self._abspath("..")
         self._send("250 ok")
 
-    def _cmd_pasv(self, arg):
+    def _open_pasv_listener(self) -> tuple[str, int]:
+        """Fresh passive listener on the control connection's local IP
+        (binding 0.0.0.0 or a hostname would produce an unusable
+        advertisement); clears any stale PORT/EPRT target so a client's
+        active->passive fallback uses the listener it was just promised."""
         self._close_pasv()      # never leak a prior listener
-        # advertise the CONTROL connection's local IP — binding 0.0.0.0
-        # or a hostname would otherwise produce an unusable 227 reply
+        self._active = None
         ip = self.conn.getsockname()[0]
         self._pasv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._pasv.bind((ip, 0))
         self._pasv.listen(1)
-        port = self._pasv.getsockname()[1]
+        return ip, self._pasv.getsockname()[1]
+
+    def _cmd_pasv(self, arg):
+        ip, port = self._open_pasv_listener()
         self._send(f"227 Entering Passive Mode "
                    f"({ip.replace('.', ',')},{port >> 8},{port & 0xff})")
+
+    def _cmd_epsv(self, arg):
+        """RFC 2428 extended passive mode (the form IPv6-capable clients
+        prefer)."""
+        _, port = self._open_pasv_listener()
+        self._send(f"229 Entering Extended Passive Mode (|||{port}|)")
+
+    def _set_active(self, ip: str, port: int) -> bool:
+        """PORT/EPRT target gate: only the control connection's peer —
+        anything else is the classic FTP bounce/SSRF primitive (the
+        server would open data connections to arbitrary internal hosts
+        on the attacker's behalf)."""
+        peer = self.conn.getpeername()[0]
+        if ip != peer:
+            self._send("501 data connection target must be the "
+                       "control connection's address")
+            return False
+        self._close_pasv()
+        self._active = (ip, port)
+        return True
+
+    def _cmd_port(self, arg):
+        """Active mode: client advertises h1,h2,h3,h4,p1,p2."""
+        try:
+            parts = [int(x) for x in arg.split(",")]
+            if len(parts) != 6 or not all(0 <= x <= 255 for x in parts):
+                raise ValueError
+            ip = ".".join(str(x) for x in parts[:4])
+            port = (parts[4] << 8) | parts[5]
+        except ValueError:
+            self._send("501 bad PORT argument")
+            return True
+        if self._set_active(ip, port):
+            self._send("200 PORT ok")
+
+    def _cmd_eprt(self, arg):
+        """RFC 2428 extended active mode: |1|ip|port|."""
+        try:
+            _, proto, ip, port, _ = arg.split(arg[0])
+            if proto != "1":
+                raise ValueError
+            port = int(port)
+        except (ValueError, IndexError):
+            self._send("522 only |1|ip|port| supported")
+            return True
+        if self._set_active(ip, port):
+            self._send("200 EPRT ok")
 
     def _cmd_list(self, arg):
         return self._list(arg, long=True)
@@ -417,7 +482,7 @@ class _Session:
             else self.cwd
         data = self._open_data()
         if data is None:
-            self._send("425 use PASV first")
+            self._send("425 use PASV/EPSV/PORT first")
             return True
         self._send("150 listing")
         data = self._wrap_data(data)
@@ -472,7 +537,7 @@ class _Session:
         blob = blob[offset:]
         data = self._open_data()
         if data is None:
-            self._send("425 use PASV first")
+            self._send("425 use PASV/EPSV/PORT first")
             return True
         self._send(f"150 opening data connection ({len(blob)} bytes)")
         data = self._wrap_data(data)
@@ -490,7 +555,7 @@ class _Session:
         offset, self.rest = self.rest, 0
         data = self._open_data()
         if data is None:
-            self._send("425 use PASV first")
+            self._send("425 use PASV/EPSV/PORT first")
             return True
         self._send("150 ready")
         data = self._wrap_data(data)

@@ -178,3 +178,46 @@ def test_ftp_rest_resume_download_and_upload(ftp):
     with pytest.raises(ftplib.error_perm, match="551"):
         client.retrbinary("RETR /r/file.bin", buf.write,
                           rest=10 ** 9)
+
+
+def test_ftp_active_mode_and_epsv(ftp):
+    """PORT (active: server connects to the client) and EPSV (extended
+    passive) both carry transfers."""
+    c, srv, client = ftp
+    payload = b"active-mode-bytes" * 50
+    client.set_pasv(False)      # ftplib sends PORT/EPRT
+    client.storbinary("STOR /am/f.bin", io.BytesIO(payload))
+    buf = io.BytesIO()
+    client.retrbinary("RETR /am/f.bin", buf.write)
+    assert buf.getvalue() == payload
+    # EPSV explicitly
+    client.set_pasv(True)
+    resp = client.sendcmd("EPSV")
+    assert resp.startswith("229")
+    import re
+    port = int(re.search(r"\|\|\|(\d+)\|", resp).group(1))
+    import socket as _s
+    data = _s.create_connection((srv.host, port), timeout=5)
+    client.voidcmd("TYPE I")
+    conn_resp = client.sendcmd("RETR /am/f.bin")
+    assert conn_resp.startswith("150")
+    got = b""
+    while True:
+        piece = data.recv(65536)
+        if not piece:
+            break
+        got += piece
+    data.close()
+    client.voidresp()
+    assert got == payload
+
+
+def test_ftp_port_bounce_rejected(ftp):
+    """PORT/EPRT targets other than the control connection's peer are
+    refused — the classic FTP bounce/SSRF primitive."""
+    c, srv, client = ftp
+    resp = client.sendcmd("NOOP")  # control conn established
+    with pytest.raises(ftplib.error_perm, match="501"):
+        client.sendcmd("PORT 10,1,2,3,0,80")
+    with pytest.raises(ftplib.error_perm, match="501"):
+        client.sendcmd("EPRT |1|10.1.2.3|80|")
