@@ -159,8 +159,13 @@ def _postgres(**kw):
     return postgres_store(**kw)
 
 
+def _redis(**kw):
+    from .redis_store import RedisStore
+    return RedisStore(**kw)
+
+
 STORES = {"memory": MemoryStore, "sqlite": _sqlite,
-          "mysql": _mysql, "postgres": _postgres}
+          "mysql": _mysql, "postgres": _postgres, "redis": _redis}
 
 
 def __getattr__(name):

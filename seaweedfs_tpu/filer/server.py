@@ -114,9 +114,19 @@ class FilerServer:
             # ':memory:' DIRECTORY for the lsm store — use its own
             # default (matches the filer.toml scaffold)
             store_path = "./filer-lsm"
-        store = (new_filer_store(store_kind, store_path)
-                 if store_kind in ("sqlite", "lsm")
-                 else new_filer_store(store_kind))
+        if store_kind in ("sqlite", "lsm"):
+            store = new_filer_store(store_kind, store_path)
+        elif store_kind == "redis":
+            # connection params come from filer.toml's [redis] section
+            # (+ WEED_REDIS_* env overrides) — the scaffold's keys are
+            # live, not documentation
+            from ..util.config import load_config
+            conf = load_config("filer")
+            store = new_filer_store(
+                "redis", host=str(conf.get("redis.host", "localhost")),
+                port=int(conf.get("redis.port", 6379) or 6379))
+        else:
+            store = new_filer_store(store_kind)
         self.filer = Filer(store, delete_chunks_fn=self._enqueue_deletion)
         # read-path chunk cache tiers (util/chunk_cache + reader_at.go);
         # fids are immutable so entries only ever age out by capacity

@@ -1,10 +1,13 @@
 """Notification — publish filer metadata events to message queues.
 
 Capability-equivalent to weed/notification/*: a MessageQueue interface with
-pluggable backends selected by config.  Backends here: "log" (stdout/glog
-analogue), "memory" (in-process queue, the test backend and the shape the
-Kafka/SQS/PubSub adapters implement — those SDKs aren't in the image, so
-they register as unavailable rather than import-failing).
+pluggable backends selected by config.  Backends: "log" (stdout/glog
+analogue), "memory" (in-process queue, the test backend), and SDK-shaped
+drivers for Kafka / AWS SQS / GCP Pub/Sub — each mirrors its SDK's
+publish surface, is conformance-tested against an in-process fake, and
+constructs the REAL SDK client when none is injected (raising a clear
+RuntimeError when the SDK isn't installed, so real brokers are
+config-only).  Only reference-internal backends stay in UNAVAILABLE.
 """
 
 from __future__ import annotations
@@ -82,11 +85,65 @@ class KafkaQueue:
         self.producer.flush()
 
 
-QUEUES = {"log": LogQueue, "memory": MemoryQueue, "kafka": KafkaQueue}
+class SqsQueue:
+    """AWS SQS driver (reference notification/aws_sqs/aws_sqs_pub.go).
+
+    `client` must expose boto3's SQS client surface —
+    `.send_message(QueueUrl=..., MessageBody=..., MessageAttributes=...)`
+    — injected by tests; omitted, the real boto3 is imported (RuntimeError
+    with instructions when absent, so a real queue is config-only)."""
+    name = "aws_sqs"
+
+    def __init__(self, queue_url: str, client=None, region: str = ""):
+        self.queue_url = queue_url
+        if client is None:
+            try:
+                import boto3  # type: ignore
+            except ImportError as e:
+                raise RuntimeError(
+                    "aws_sqs notification backend needs boto3 installed; "
+                    "configuration is otherwise complete") from e
+            client = boto3.client("sqs", region_name=region or None)
+        self.client = client
+
+    def send_message(self, key: str, message: dict) -> None:
+        self.client.send_message(
+            QueueUrl=self.queue_url,
+            MessageBody=json.dumps(message, default=str),
+            MessageAttributes={"key": {"DataType": "String",
+                                       "StringValue": key or "/"}})
+
+
+class PubSubQueue:
+    """GCP Pub/Sub driver (reference notification/google_pub_sub).
+
+    `publisher` must expose google-cloud-pubsub's PublisherClient
+    surface — `.publish(topic, data=bytes, **attrs)`."""
+    name = "gcp_pub_sub"
+
+    def __init__(self, topic: str, publisher=None):
+        self.topic = topic
+        if publisher is None:
+            try:
+                from google.cloud import pubsub_v1  # type: ignore
+            except ImportError as e:
+                raise RuntimeError(
+                    "gcp_pub_sub notification backend needs "
+                    "google-cloud-pubsub installed; configuration is "
+                    "otherwise complete") from e
+            publisher = pubsub_v1.PublisherClient()
+        self.publisher = publisher
+
+    def send_message(self, key: str, message: dict) -> None:
+        self.publisher.publish(
+            self.topic, data=json.dumps(message, default=str).encode(),
+            key=key or "/")
+
+
+QUEUES = {"log": LogQueue, "memory": MemoryQueue, "kafka": KafkaQueue,
+          "aws_sqs": SqsQueue, "gcp_pub_sub": PubSubQueue}
 UNAVAILABLE = {
-    "aws_sqs": "boto3 not in image",
-    "gcp_pub_sub": "google-cloud-pubsub not in image",
-    "gocdk_pub_sub": "reference-only backend",
+    "gocdk_pub_sub": "reference-only backend (Go CDK portability shim)",
 }
 
 

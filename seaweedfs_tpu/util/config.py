@@ -117,6 +117,16 @@ dbFile = "./filer.db"
 [lsm]
 enabled = false
 dir = "./filer-lsm"
+
+[redis]
+enabled = false       # needs redis-py installed (config-only here)
+host = "localhost"
+port = 6379
+
+[mysql]
+enabled = false       # abstract-SQL dialect; needs pymysql
+[postgres]
+enabled = false       # abstract-SQL dialect; needs psycopg
 """,
     "replication": """\
 # replication.toml — filer.replicate sink selection (reference

@@ -1,0 +1,166 @@
+"""Redis filer store (filer/redis_store.py — the reference's
+universal_redis sorted-set design) against an in-process fake with the
+redis-py surface, plus the SQS/PubSub queue shells."""
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer import Filer
+from seaweedfs_tpu.filer.entry import Attr, Entry
+from seaweedfs_tpu.filer.filerstore import STORES, NotFound
+from seaweedfs_tpu.filer.redis_store import RedisStore
+
+
+class FakeRedis:
+    """The slice of redis-py the store uses: strings + sorted sets with
+    lexical range queries."""
+
+    def __init__(self):
+        self.kv: dict[str, bytes] = {}
+        self.zsets: dict[str, list[str]] = {}
+
+    def set(self, k, v):
+        self.kv[k] = v.encode() if isinstance(v, str) else bytes(v)
+
+    def get(self, k):
+        return self.kv.get(k)
+
+    def delete(self, *keys):
+        for k in keys:
+            self.kv.pop(k, None)
+            self.zsets.pop(k, None)
+
+    def zadd(self, key, mapping):
+        import bisect
+        zs = self.zsets.setdefault(key, [])
+        for member in mapping:
+            i = bisect.bisect_left(zs, member)
+            if i >= len(zs) or zs[i] != member:
+                zs.insert(i, member)
+
+    def zrem(self, key, *members):
+        zs = self.zsets.get(key, [])
+        for m in members:
+            if m in zs:
+                zs.remove(m)
+
+    def zrangebylex(self, key, lo, hi, start=0, num=None):
+        zs = self.zsets.get(key, [])
+        def ok(m):
+            if lo != "-":
+                bound, op = lo[1:], lo[0]
+                if op == "[" and m < bound:
+                    return False
+                if op == "(" and m <= bound:
+                    return False
+            if hi != "+":
+                bound, op = hi[1:], hi[0]
+                if op == "[" and m > bound:
+                    return False
+                if op == "(" and m >= bound:
+                    return False
+            return True
+        out = [m for m in zs if ok(m)]
+        if num is not None:
+            out = out[start:start + num]
+        return out
+
+
+@pytest.fixture()
+def store():
+    return RedisStore(client=FakeRedis())
+
+
+def test_registry_has_redis():
+    assert "redis" in STORES
+
+
+def test_redis_store_is_config_only_without_driver():
+    with pytest.raises(RuntimeError, match="installed"):
+        STORES["redis"](host="example", port=6379)
+
+
+def test_crud_listing_pagination_prefix(store, ):
+    """The same contract the parametrized store suite checks, through
+    the sorted-set listing path."""
+    f = Filer(store)
+    now = time.time()
+    for name in ("b", "a", "c", "ab"):
+        f.create_entry(Entry(full_path=f"/dir/{name}",
+                             attr=Attr(mtime=now, crtime=now)))
+    assert [e.name for e in f.list_entries("/dir")] == ["a", "ab", "b", "c"]
+    assert [e.name for e in f.list_entries("/dir", start_name="a",
+                                           limit=2)] == ["ab", "b"]
+    assert [e.name for e in f.list_entries("/dir", prefix="a")] \
+        == ["a", "ab"]
+    assert f.find_entry("/dir").is_directory()
+    f.delete_entry("/dir/b")
+    with pytest.raises(NotFound):
+        store.find_entry("/dir/b")
+    assert [e.name for e in f.list_entries("/dir")] == ["a", "ab", "c"]
+
+
+def test_recursive_delete(store):
+    f = Filer(store)
+    now = time.time()
+    for p in ("/x/a/f1", "/x/a/b/f2", "/x/f3", "/y/keep"):
+        f.create_entry(Entry(full_path=p, attr=Attr(mtime=now, crtime=now)))
+    store.delete_folder_children("/x")
+    for p in ("/x/a", "/x/a/f1", "/x/a/b", "/x/a/b/f2", "/x/f3"):
+        with pytest.raises(NotFound):
+            store.find_entry(p)
+    assert store.find_entry("/y/keep")  # sibling untouched
+
+
+def test_kv_roundtrip(store):
+    store.kv_put(b"\x00key", b"value\xff")
+    assert store.kv_get(b"\x00key") == b"value\xff"
+    store.kv_delete(b"\x00key")
+    with pytest.raises(NotFound):
+        store.kv_get(b"\x00key")
+
+
+# -- queue driver shells ---------------------------------------------------
+
+def test_sqs_queue_shape():
+    from seaweedfs_tpu.notification import new_message_queue
+    sent = []
+
+    class FakeSqs:
+        def send_message(self, QueueUrl, MessageBody, MessageAttributes):
+            sent.append((QueueUrl, MessageBody, MessageAttributes))
+
+    q = new_message_queue("aws_sqs", queue_url="https://sqs/q",
+                          client=FakeSqs())
+    q.send_message("/p/x", {"ts_ns": 3})
+    url, body, attrs = sent[0]
+    assert url == "https://sqs/q"
+    assert json.loads(body)["ts_ns"] == 3
+    assert attrs["key"]["StringValue"] == "/p/x"
+
+
+def test_pubsub_queue_shape():
+    from seaweedfs_tpu.notification import new_message_queue
+    sent = []
+
+    class FakePublisher:
+        def publish(self, topic, data, **attrs):
+            sent.append((topic, data, attrs))
+
+    q = new_message_queue("gcp_pub_sub", topic="projects/p/topics/t",
+                          publisher=FakePublisher())
+    q.send_message("/p/y", {"ts_ns": 9})
+    topic, data, attrs = sent[0]
+    assert topic == "projects/p/topics/t"
+    assert json.loads(data)["ts_ns"] == 9
+    assert attrs["key"] == "/p/y"
+
+
+def test_queues_config_only_without_sdks():
+    from seaweedfs_tpu.notification import new_message_queue
+    with pytest.raises(RuntimeError, match="installed"):
+        new_message_queue("aws_sqs", queue_url="u")
+    with pytest.raises(Exception, match="installed|credentials|default"):
+        new_message_queue("gcp_pub_sub", topic="t")
