@@ -81,12 +81,19 @@ def cmd_s3(args) -> int:
         iam = IdentityAccessManagement()
     from ..pb import ServerAddress
     filer = ServerAddress.parse(args.filer)
+    audit = None
+    if args.auditLog:
+        from ..s3.audit import AuditLog
+        audit = AuditLog(args.auditLog)
     s3 = S3ApiServer(filer.url, filer.grpc, host=args.ip, port=args.port,
-                     iam=iam)
+                     iam=iam, audit_log=audit)
     s3.start()
-    print(f"s3 api {s3.address}")
+    print(f"s3 api {s3.address}"
+          + (f" (audit log: {args.auditLog})" if audit else ""))
     _wait_forever()
     s3.stop()
+    if audit:
+        audit.close()
     return 0
 
 
@@ -121,8 +128,12 @@ def cmd_server(args) -> int:
              f"volume {vs.url}", f"filer {f.address}"]
     s3srv = None
     if args.s3:
+        audit = None
+        if getattr(args, "s3_audit_log", ""):
+            from ..s3.audit import AuditLog
+            audit = AuditLog(args.s3_audit_log)
         s3srv = S3ApiServer(f.address, f.grpc_address, host=args.ip,
-                            port=args.s3_port)
+                            port=args.s3_port, audit_log=audit)
         s3srv.start()
         parts.append(f"s3 {s3srv.address}")
     print("server started: " + ", ".join(parts))
@@ -530,6 +541,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("-port", type=int, default=8333)
     s.add_argument("-filer", default="127.0.0.1:8888.18888")
     s.add_argument("-config", default="")
+    s.add_argument("-auditLog", default="",
+                   help="append one JSON line per request to this file "
+                        "(the reference's -auditLogConfig access log)")
     s.set_defaults(fn=cmd_s3)
 
     srv = sub.add_parser("server", help="master + volume + filer (+ s3)")
@@ -542,6 +556,9 @@ def build_parser() -> argparse.ArgumentParser:
                      default=8888)
     srv.add_argument("-s3", action="store_true")
     srv.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
+    srv.add_argument("-s3.auditLog", dest="s3_audit_log", default="",
+                     help="S3 access log (JSON lines) for the embedded "
+                          "gateway")
     srv.add_argument("-dir", default="./data")
     srv.add_argument("-max", default="7")
     srv.add_argument("-filer.store", dest="filer_store", default="sqlite")

@@ -1,0 +1,63 @@
+"""S3 access/audit logging — the reference's `-auditLogConfig` path
+(weed/s3api/auth_credentials.go wiring + the fluent-based access log the
+compose example ships, docker/compose/local-auditlog-compose.yml).
+
+The reference emits one structured record per S3 request through a
+fluent client; here the emitter writes JSON lines to a file (or any
+`write(str)` sink — a fluent forwarder socket wrapper satisfies the
+same interface), with the reference record's fields: time, remote,
+requester, method, bucket, key, action, status, bytes, duration."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+
+class AuditLog:
+    def __init__(self, path: str = "", sink=None):
+        """`path`: append JSON lines to this file.  `sink`: any object
+        with write(str) (takes precedence; used by tests and fluent
+        forwarders)."""
+        self._lock = threading.Lock()
+        if sink is not None:
+            self._sink = sink
+            self._close = getattr(sink, "close", lambda: None)
+        elif path:
+            f = open(path, "a", buffering=1)  # line-buffered
+            self._sink = f
+            self._close = f.close
+        else:
+            raise ValueError("AuditLog needs a path or a sink")
+
+    def record(self, *, remote: str, requester: str, method: str,
+               bucket: str, key: str, action: str, status: int,
+               nbytes: int, duration_ms: float,
+               forwarded_for: str = "") -> None:
+        entry = {
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "remote": remote,
+            "requester": requester,
+            "method": method,
+            "bucket": bucket,
+            "key": key,
+            "action": action,
+            "status": status,
+            "bytes": nbytes,
+            "duration_ms": round(duration_ms, 2),
+        }
+        if forwarded_for:
+            entry["forwarded_for"] = forwarded_for
+        line = json.dumps(entry, separators=(",", ":")) + "\n"
+        with self._lock:
+            try:
+                self._sink.write(line)
+            except (OSError, ValueError):
+                pass  # a full disk must not fail the data path
+
+    def close(self) -> None:
+        try:
+            self._close()
+        except OSError:
+            pass

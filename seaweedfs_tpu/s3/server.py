@@ -59,10 +59,12 @@ def _error_xml(code: str, message: str, resource: str = "") -> bytes:
 class S3ApiServer:
     def __init__(self, filer_http: str, filer_grpc: str,
                  host: str = "127.0.0.1", port: int = 0,
-                 iam: IdentityAccessManagement | None = None):
+                 iam: IdentityAccessManagement | None = None,
+                 audit_log=None):
         self.filer_http = filer_http
         self.filer_grpc = filer_grpc
         self.iam = iam or IdentityAccessManagement()
+        self.audit = audit_log      # s3/audit.py AuditLog or None
         self.http = HttpServer(host, port)
         self.http.route("*", "/", self._dispatch)
         self._iam_stop = threading.Event()
@@ -125,13 +127,44 @@ class S3ApiServer:
 
     # -- routing (s3api_server.go registerRouter) --------------------------
     def _dispatch(self, req: Request) -> Response:
+        if self.audit is None:
+            return self._dispatch_inner(req)
+        t0 = time.time()
+        resp = None
+        try:
+            resp = self._dispatch_inner(req)
+            return resp
+        finally:
+            status = resp.status if resp is not None else 500
+            # bytes: request size for uploads, response size for reads —
+            # never the error XML's length for a rejected PUT
+            if req.method in ("PUT", "POST"):
+                nbytes = len(req.body or b"")
+            else:
+                nbytes = len(resp.body) if resp is not None                     and resp.body else 0
+            self.audit.record(
+                # the SOCKET address — X-Forwarded-For is client-supplied
+                # and must not launder the forensic field (it is recorded
+                # separately when present)
+                remote=req.remote_addr,
+                forwarded_for=req.headers.get("X-Forwarded-For", ""),
+                requester=getattr(req, "_audit_requester", "anonymous"),
+                method=req.method,
+                bucket=getattr(req, "_audit_bucket", ""),
+                key=getattr(req, "_audit_key", ""),
+                action=req.method.lower(), status=status, nbytes=nbytes,
+                duration_ms=(time.time() - t0) * 1000)
+
+    def _dispatch_inner(self, req: Request) -> Response:
         path = urllib.parse.unquote(req.path)
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
+        req._audit_bucket, req._audit_key = bucket, key  # ONE parse
         try:
             ident = self.iam.authenticate(req.method, req.path, req.query,
                                           req.headers, req.body)
+            req._audit_requester = ident.name  # for the audit record
             from .auth import STREAMING_SENTINELS
             if req.headers.get("X-Amz-Content-Sha256") \
                     in STREAMING_SENTINELS:

@@ -47,6 +47,7 @@ class Request:
     query: dict[str, list[str]]
     headers: CIDict
     body: bytes
+    remote_addr: str = ""  # client IP (audit logging)
 
     def qs(self, key: str, default: str = "") -> str:
         vals = self.query.get(key)
@@ -99,7 +100,8 @@ class HttpServer:
                     query=urllib.parse.parse_qs(parsed.query,
                                                 keep_blank_values=True),
                     headers=CIDict(self.headers.items()),
-                    body=body)
+                    body=body,
+                    remote_addr=self.client_address[0])
                 handler = outer._match(self.command, parsed.path)
                 if handler is None:
                     resp = Response.error("not found", 404)
