@@ -164,8 +164,19 @@ def _redis(**kw):
     return RedisStore(**kw)
 
 
+def _mongo(**kw):
+    from .kv_stores import MongoStore
+    return MongoStore(**kw)
+
+
+def _etcd(**kw):
+    from .kv_stores import EtcdStore
+    return EtcdStore(**kw)
+
+
 STORES = {"memory": MemoryStore, "sqlite": _sqlite,
-          "mysql": _mysql, "postgres": _postgres, "redis": _redis}
+          "mysql": _mysql, "postgres": _postgres, "redis": _redis,
+          "mongo": _mongo, "etcd": _etcd}
 
 
 def __getattr__(name):

@@ -1,0 +1,179 @@
+"""Mongo + etcd filer stores (filer/kv_stores.py) against in-process
+fakes shaped like pymongo / etcd3 — one shared contract suite."""
+
+import json
+import re
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer import Filer
+from seaweedfs_tpu.filer.entry import Attr, Entry
+from seaweedfs_tpu.filer.filerstore import STORES, NotFound
+from seaweedfs_tpu.filer.kv_stores import EtcdStore, MongoStore
+
+
+# -- pymongo-shaped fake ---------------------------------------------------
+
+class FakeCollection:
+    def __init__(self):
+        self.docs: list[dict] = []
+
+    def _match(self, doc, flt):
+        for k, cond in flt.items():
+            v = doc.get(k)
+            if isinstance(cond, dict):
+                for op, arg in cond.items():
+                    if op == "$regex":
+                        if not re.search(arg, v or ""):
+                            return False
+                    elif op == "$gt":
+                        if not (v is not None and v > arg):
+                            return False
+                    elif op == "$gte":
+                        if not (v is not None and v >= arg):
+                            return False
+                    else:
+                        raise AssertionError(f"unsupported op {op}")
+            elif v != cond:
+                return False
+        return True
+
+    def replace_one(self, flt, doc, upsert=False):
+        for i, d in enumerate(self.docs):
+            if self._match(d, flt):
+                self.docs[i] = doc
+                return
+        assert upsert
+        self.docs.append(doc)
+
+    def find_one(self, flt):
+        for d in self.docs:
+            if self._match(d, flt):
+                return d
+        return None
+
+    def find(self, flt):
+        rows = [d for d in self.docs if self._match(d, flt)]
+
+        class Cursor:
+            def sort(self, key, direction):
+                rows.sort(key=lambda d: d[key],
+                          reverse=direction < 0)
+                return self
+
+            def limit(self, n):
+                del rows[n:]
+                return self
+
+            def __iter__(self):
+                return iter(list(rows))
+        return Cursor()
+
+    def delete_one(self, flt):
+        for i, d in enumerate(self.docs):
+            if self._match(d, flt):
+                del self.docs[i]
+                return
+
+    def delete_many(self, flt):
+        self.docs[:] = [d for d in self.docs if not self._match(d, flt)]
+
+
+class FakeMongoDb:
+    def __init__(self):
+        self.filemeta = FakeCollection()
+        self.filer_kv = FakeCollection()
+
+
+# -- etcd3-shaped fake -----------------------------------------------------
+
+class _Meta:
+    def __init__(self, key: str):
+        self.key = key.encode()
+
+
+class FakeEtcd:
+    def __init__(self):
+        self.kv: dict[str, bytes] = {}
+
+    def put(self, key, value):
+        self.kv[key] = value.encode() if isinstance(value, str) \
+            else bytes(value)
+
+    def get(self, key):
+        v = self.kv.get(key)
+        return (v, _Meta(key) if v is not None else None)
+
+    def delete(self, key):
+        self.kv.pop(key, None)
+
+    def get_prefix(self, prefix):
+        for k in sorted(self.kv):
+            if k.startswith(prefix):
+                yield self.kv[k], _Meta(k)
+
+
+@pytest.fixture(params=["mongo", "etcd"])
+def store(request):
+    if request.param == "mongo":
+        return MongoStore(client=FakeMongoDb())
+    return EtcdStore(client=FakeEtcd())
+
+
+def test_registry_has_both():
+    assert {"mongo", "etcd"} <= set(STORES)
+
+
+@pytest.mark.parametrize("kind", ["mongo", "etcd"])
+def test_config_only_without_driver(kind):
+    with pytest.raises(RuntimeError, match="installed"):
+        STORES[kind](host="db.example")
+
+
+def test_contract_crud_listing(store):
+    f = Filer(store)
+    now = time.time()
+    for name in ("b", "a", "c", "ab"):
+        f.create_entry(Entry(full_path=f"/dir/{name}",
+                             attr=Attr(mtime=now, crtime=now)))
+    assert [e.name for e in f.list_entries("/dir")] == ["a", "ab", "b", "c"]
+    assert [e.name for e in f.list_entries("/dir", start_name="a",
+                                           limit=2)] == ["ab", "b"]
+    assert [e.name for e in f.list_entries("/dir", prefix="a")] \
+        == ["a", "ab"]
+    assert f.find_entry("/dir").is_directory()
+    f.delete_entry("/dir/b")
+    with pytest.raises(NotFound):
+        store.find_entry("/dir/b")
+
+
+def test_contract_recursive_delete(store):
+    f = Filer(store)
+    now = time.time()
+    for p in ("/x/a/f1", "/x/a/b/f2", "/x/f3", "/y/keep"):
+        f.create_entry(Entry(full_path=p, attr=Attr(mtime=now, crtime=now)))
+    store.delete_folder_children("/x")
+    for p in ("/x/a", "/x/a/f1", "/x/a/b/f2", "/x/f3"):
+        with pytest.raises(NotFound):
+            store.find_entry(p)
+    assert store.find_entry("/y/keep")
+
+
+def test_contract_kv(store):
+    store.kv_put(b"\x01k", b"v\x00v")
+    assert store.kv_get(b"\x01k") == b"v\x00v"
+    store.kv_delete(b"\x01k")
+    with pytest.raises(NotFound):
+        store.kv_get(b"\x01k")
+
+
+def test_contract_update_overwrites(store):
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/u/x", attr=Attr(mtime=1, crtime=1)))
+    e = store.find_entry("/u/x")
+    e.attr.mtime = 99
+    store.update_entry(e)
+    assert store.find_entry("/u/x").attr.mtime == 99
+    # upsert path stays single-entry
+    assert len([x for x in store.list_directory_entries("/u")]) == 1
