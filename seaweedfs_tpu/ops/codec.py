@@ -22,6 +22,8 @@ stripped on return.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -105,9 +107,15 @@ class RSCodec:
             arr = np.pad(arr, [(0, 0)] * (arr.ndim - 1) + [(0, pad)])
         return arr, b
 
-    def _matmul(self, bits_shard_major: np.ndarray, mo: int,
-                inputs: np.ndarray) -> np.ndarray:
-        """Dispatch out = M ∘GF∘ inputs[..., KI, B] to the chosen backend."""
+    def _matmul_begin(self, bits_shard_major: np.ndarray, mo: int,
+                      inputs: np.ndarray):
+        """Dispatch out = M ∘GF∘ inputs[..., KI, B] to the chosen backend.
+
+        Returns a zero-arg fetch() -> np.ndarray.  On device backends the
+        transfer + kernel are ISSUED here (JAX dispatch is async) and only
+        fetch() blocks on the result — the seam the pipelined disk paths in
+        storage/ec/encoder.py use to overlap disk reads, device compute and
+        shard-file writes."""
         squeeze = inputs.ndim == 2
         if squeeze:
             inputs = inputs[None]
@@ -119,7 +127,8 @@ class RSCodec:
                                 for x in inputs])
             else:
                 out = np.stack([gf256.matmul(M, x) for x in inputs])
-            return out[0] if squeeze else out
+            res = out[0] if squeeze else out
+            return lambda: res
         padded, b = self._pad(inputs)
         if self.backend == "pallas":
             ki = padded.shape[-2]
@@ -132,17 +141,29 @@ class RSCodec:
             # host-side relayout to the dense shard-major [KI, 8V, B/8]
             # (free view for one volume) — see rs_pallas.to_sm_layout
             lead = padded.shape[:-2]
+            bp = padded.shape[-1]  # scalar only — don't pin padded in fetch
             sm = rs_pallas.to_sm_layout(padded)
             dev = rs_pallas.gf_matmul_bits_pallas_sm(
                 pm, jnp.asarray(sm), block_b=self.block_b,
                 interpret=self.interpret)
-            out = rs_pallas.from_sm_layout(
-                np.asarray(jax.device_get(dev)), lead, padded.shape[-1])
-        else:
-            out = np.asarray(jax.device_get(rs_jax.gf_matmul_bits(
-                jnp.asarray(bits_shard_major), jnp.asarray(padded))))
-        out = out[..., :b]
-        return out[0] if squeeze else out
+
+            def fetch():
+                out = rs_pallas.from_sm_layout(
+                    np.asarray(jax.device_get(dev)), lead, bp)
+                out = out[..., :b]
+                return out[0] if squeeze else out
+            return fetch
+        dev = rs_jax.gf_matmul_bits(
+            jnp.asarray(bits_shard_major), jnp.asarray(padded))
+
+        def fetch():
+            out = np.asarray(jax.device_get(dev))[..., :b]
+            return out[0] if squeeze else out
+        return fetch
+
+    def _matmul(self, bits_shard_major: np.ndarray, mo: int,
+                inputs: np.ndarray) -> np.ndarray:
+        return self._matmul_begin(bits_shard_major, mo, inputs)()
 
     def _parity_bits_pm(self):
         """Cached device-resident plane-major parity bit-matrix (pallas only).
@@ -158,11 +179,20 @@ class RSCodec:
     # -- public API ------------------------------------------------------
     def encode(self, data: np.ndarray) -> np.ndarray:
         """data [.., k, B] uint8 -> parity [.., m, B] uint8."""
+        return self.encode_begin(data)()
+
+    def encode_begin(self, data: np.ndarray):
+        """Issue the encode asynchronously; returns fetch() -> parity.
+
+        Device backends return immediately after dispatching the
+        host->device copy + kernel; only fetch() blocks.  CPU backends
+        compute eagerly and fetch() is a no-op — same contract either way,
+        so pipeline code needs no backend branches."""
         data = np.asarray(data, dtype=np.uint8)
         assert data.shape[-2] == self.k, f"expected {self.k} data shards"
         if self.backend in ("numpy", "native"):
-            return self._matmul(self.gen[self.k:], self.m, data)
-        return self._matmul(self._parity_bits, self.m, data)
+            return self._matmul_begin(self.gen[self.k:], self.m, data)
+        return self._matmul_begin(self._parity_bits, self.m, data)
 
     def encode_jax(self, data: jax.Array) -> jax.Array:
         """Device-resident encode for jit/shard_map composition (jax arrays
@@ -183,6 +213,12 @@ class RSCodec:
         enc.Reconstruct / enc.ReconstructData (ec_encoder.go:270,
         store_ec.go:360).  `shards` has length k+m; present entries must share
         one [B] or [V, B] shape."""
+        return self.reconstruct_begin(shards, data_only=data_only)()
+
+    def reconstruct_begin(self, shards: list[np.ndarray | None], *,
+                          data_only: bool = False):
+        """Async form of reconstruct: issues the decode matmul, returns
+        fetch() -> filled shard list (see encode_begin for the contract)."""
         if len(shards) != self.n:
             raise ValueError(f"expected {self.n} shard slots, got {len(shards)}")
         present = [i for i, s in enumerate(shards) if s is not None]
@@ -192,21 +228,39 @@ class RSCodec:
             raise ValueError(
                 f"too few shards to reconstruct: {len(present)} < {self.k}")
         if not targets:
-            return list(shards)
-        D = rs_matrix.decode_matrix(self.gen, present, targets)
+            res = list(shards)
+            return lambda: res
+        D = _decode_matrix_cached(self.k, self.m, self.kind,
+                                  tuple(present), tuple(targets))
         chosen = np.stack([np.asarray(shards[i], dtype=np.uint8)
                            for i in present[:self.k]], axis=-2)
         if self.backend in ("numpy", "native"):
-            rec = self._matmul(D, len(targets), chosen)
+            raw = self._matmul_begin(D, len(targets), chosen)
         else:
-            rec = self._matmul(rs_matrix.bit_matrix(D), len(targets), chosen)
-        out = list(shards)
-        for row, t in enumerate(targets):
-            out[t] = np.ascontiguousarray(rec[..., row, :])
-        return out
+            raw = self._matmul_begin(rs_matrix.bit_matrix(D), len(targets),
+                                     chosen)
+
+        def fetch():
+            rec = raw()
+            out = list(shards)
+            for row, t in enumerate(targets):
+                out[t] = np.ascontiguousarray(rec[..., row, :])
+            return out
+        return fetch
 
     def verify(self, shards: list[np.ndarray]) -> bool:
         """Check parity consistency (reference enc.Verify)."""
         data = np.stack(shards[:self.k], axis=-2)
         parity = np.stack(shards[self.k:], axis=-2)
         return bool(np.array_equal(self.encode(data), parity))
+
+
+@functools.lru_cache(maxsize=1024)
+def _decode_matrix_cached(k: int, m: int, kind: str,
+                          present: tuple, targets: tuple) -> np.ndarray:
+    """Loss masks repeat across rebuild windows; the GF inversion is host
+    work worth one pass per mask (keyed by geometry, not codec instance, so
+    per-call RSCodecs share hits and are not pinned by the cache —
+    MeshCodec._decode_bits_cached is the same pattern)."""
+    gen = rs_matrix.generator_matrix(k, m, kind)
+    return rs_matrix.decode_matrix(gen, list(present), list(targets))

@@ -143,6 +143,12 @@ class MeshCodec:
         Leading batch axes fold into the byte axis: stripe columns are
         independent, so a [V, k, B] batch is exactly a [k, V*B] encode.
         """
+        return self.encode_begin(data)()
+
+    def encode_begin(self, data: np.ndarray):
+        """Issue the mesh encode asynchronously; returns fetch() -> parity.
+        Same contract as RSCodec.encode_begin — the seam the pipelined disk
+        paths use to overlap IO with device compute."""
         data = np.asarray(data, dtype=np.uint8)
         assert data.shape[-2] == self.k, f"expected {self.k} data shards"
         lead = data.shape[:-2]
@@ -155,10 +161,15 @@ class MeshCodec:
         padded, b = self._pad_cols(flat, self._enc_mult)
         sm = padded.reshape(self.k, 8, -1)  # free host view -> dense tiling
         out = _encode_fn(self.mesh)(self._parity_bits, jnp.asarray(sm))
-        parity = np.asarray(jax.device_get(out)).reshape(self.m, -1)[:, :b]
-        if lead:
-            parity = np.moveaxis(parity.reshape(self.m, *lead, -1), 0, -2)
-        return np.ascontiguousarray(parity)
+
+        def fetch():
+            parity = np.asarray(jax.device_get(out)).reshape(
+                self.m, -1)[:, :b]
+            if lead:
+                parity = np.moveaxis(
+                    parity.reshape(self.m, *lead, -1), 0, -2)
+            return np.ascontiguousarray(parity)
+        return fetch
 
     def reconstruct(self, shards: list[np.ndarray | None], *,
                     data_only: bool = False) -> list[np.ndarray]:
@@ -168,6 +179,13 @@ class MeshCodec:
         Present shards may be [B] or batched [V, B] (one loss mask across
         the batch): volumes fold onto the byte axis exactly as encode's
         batch does, so a fleet rebuild is one device call per window."""
+        return self.reconstruct_begin(shards, data_only=data_only)()
+
+    def reconstruct_begin(self, shards: list[np.ndarray | None], *,
+                          data_only: bool = False):
+        """Async form of reconstruct: every per-chunk device call is issued
+        before returning; fetch() drains them (RSCodec.encode_begin
+        contract)."""
         if len(shards) != self.n:
             raise ValueError(f"expected {self.n} shard slots, got {len(shards)}")
         present = [i for i, s in enumerate(shards) if s is not None]
@@ -177,7 +195,8 @@ class MeshCodec:
             raise ValueError(
                 f"too few shards to reconstruct: {len(present)} < {self.k}")
         if not targets:
-            return list(shards)
+            res = list(shards)
+            return lambda: res
         chosen = np.stack([np.asarray(shards[i], dtype=np.uint8)
                            for i in present[:self.k]], axis=0)
         if chosen.ndim not in (2, 3):
@@ -191,18 +210,24 @@ class MeshCodec:
         padded, b = self._pad_cols(full, self._rec_mult)
         dev_shards = jnp.asarray(padded.reshape(k_pad, 8, -1))  # free view
         present_key = tuple(present[:self.k])
-        out = list(shards)
         # the cached executable produces m rows per call; chunk wider
         # target lists (possible for data_only bulk decodes of wide stripes)
+        pending = []
         for i in range(0, len(targets), self.m):
             chunk = targets[i:i + self.m]
             dec_bits = jnp.asarray(_decode_bits_cached(
                 self.k, self.m, self.kind, k_pad, present_key, tuple(chunk)))
-            rec = np.asarray(jax.device_get(fn(dec_bits, dev_shards)))
-            rec = rec.reshape(self.m, -1)[:, :b]
-            for row, t in enumerate(chunk):
-                out[t] = np.ascontiguousarray(rec[row].reshape(*lead, -1))
-        return out
+            pending.append((chunk, fn(dec_bits, dev_shards)))
+
+        def fetch():
+            out = list(shards)
+            for chunk, dev in pending:
+                rec = np.asarray(jax.device_get(dev))
+                rec = rec.reshape(self.m, -1)[:, :b]
+                for row, t in enumerate(chunk):
+                    out[t] = np.ascontiguousarray(rec[row].reshape(*lead, -1))
+            return out
+        return fetch
 
     def verify(self, shards: list[np.ndarray]) -> bool:
         data = np.stack(shards[:self.k], axis=-2)

@@ -23,6 +23,8 @@ inconsistent edge.  We use `>=` on both sides so every size round-trips.
 from __future__ import annotations
 
 import os
+import queue as _queue
+import threading
 
 import numpy as np
 
@@ -35,6 +37,74 @@ from .layout import DEFAULT_GEOMETRY, EcGeometry, to_ext
 # large enough to saturate the MXU and amortize host->device transfer,
 # small enough to double-buffer in HBM.
 DEFAULT_BATCH_BYTES = 8 * 1024 * 1024
+
+# Batches in flight between the reading/submitting producer and the
+# shard-file writer thread.  2 = classic double buffering: while the device
+# encodes batch N and the writer drains N-1, the producer reads N+1 from
+# disk.  More depth buys nothing once the slowest stage is saturated and
+# costs host RAM (depth * k * batch_bytes pinned).
+PIPELINE_DEPTH = 2
+
+
+def _begin_encode(codec, data: np.ndarray):
+    """codec.encode_begin when the codec has one (RSCodec/MeshCodec issue
+    the device work and defer the blocking fetch); eager fallback keeps
+    custom/window codecs on the same contract."""
+    begin = getattr(codec, "encode_begin", None)
+    if begin is not None:
+        return begin(data)
+    parity = codec.encode(data)
+    return lambda: parity
+
+
+def _begin_reconstruct(codec, shards):
+    begin = getattr(codec, "reconstruct_begin", None)
+    if begin is not None:
+        return begin(shards)
+    out = codec.reconstruct(shards)
+    return lambda: out
+
+
+def _pipelined(produce, consume, depth: int = PIPELINE_DEPTH) -> None:
+    """Run `produce` (a generator issuing async device work per item) against
+    `consume(item)` on a writer thread, `depth` items in flight.
+
+    The producer runs on the calling thread: it reads the next window from
+    disk and submits its codec call while the device chews the previous one
+    and the writer blocks in fetch()/file-writes — the overlap the
+    reference gets from its goroutine pipelines (ec_encoder.go's batch loop
+    is synchronous; SURVEY §7(b) flags the overlap as the hard part).  A
+    bounded queue keeps at most `depth` batches of host buffers alive, and
+    writes happen in submission order (single consumer, FIFO queue), which
+    append-only shard files require."""
+    q: _queue.Queue = _queue.Queue(maxsize=depth)
+    errs: list[BaseException] = []
+
+    def writer():
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            if not errs:
+                try:
+                    consume(item)
+                except BaseException as e:  # surfaced to the caller below
+                    errs.append(e)
+            # after an error keep draining so the producer never deadlocks
+            # on a full queue
+
+    t = threading.Thread(target=writer, name="ec-writer")
+    t.start()
+    try:
+        for item in produce:
+            if errs:
+                break
+            q.put(item)
+    finally:
+        q.put(None)
+        t.join()
+    if errs:
+        raise errs[0]
 
 
 def _codec_for(geo: EcGeometry, codec: RSCodec | None):
@@ -55,26 +125,45 @@ def _codec_for(geo: EcGeometry, codec: RSCodec | None):
     return codec_for_devices(geo.data_shards, geo.parity_shards)
 
 
-def _encode_rows(dat: np.memmap, start: int, block: int, n_rows: int,
-                 codec: RSCodec, geo: EcGeometry, outputs) -> None:
-    """Encode n_rows rows of `block`-sized stripes starting at .dat offset
-    `start`; append each shard's blocks to its file."""
+def _iter_encode_batches(dat, dat_size: int, geo: EcGeometry,
+                         batch_bytes: int):
+    """Yield the [k, width] data matrices write_ec_files encodes, in shard
+    append order: large rows first (column slices gathered across the k
+    1GB blocks), then batched small rows, zero-padding the final partial
+    row exactly like encodeDataOneBatch (ec_encoder.go:173)."""
     k = geo.data_shards
-    row = block * k
-    raw = np.zeros(n_rows * row, dtype=np.uint8)
-    avail = min(len(dat) - start, n_rows * row)
-    if avail > 0:
-        raw[:avail] = dat[start:start + avail]
-    # [n_rows, k, block] -> data[s] of row r at stripes[r, s]
-    stripes = raw.reshape(n_rows, k, block)
-    # batch all rows into one [k, n_rows*block] matrix: column order must
-    # keep each row's block contiguous per shard -> transpose to [k, rows, b]
-    data = np.ascontiguousarray(stripes.transpose(1, 0, 2)).reshape(k, -1)
-    parity = codec.encode(data)  # [m, n_rows*block]
-    for s in range(k):
-        outputs[s].write(data[s].tobytes())
-    for p in range(geo.parity_shards):
-        outputs[k + p].write(parity[p].tobytes())
+    pos = 0
+    remaining = dat_size
+    large_row = geo.large_row_size()
+    while remaining >= large_row:
+        # one large row = k x 1GB; stream it in batch_bytes column slices
+        for col in range(0, geo.large_block_size, batch_bytes):
+            width = min(batch_bytes, geo.large_block_size - col)
+            # a column slice of a large row is NOT contiguous in .dat;
+            # gather the k slices into a [k, width] matrix
+            data = np.empty((k, width), dtype=np.uint8)
+            for s in range(k):
+                off = pos + s * geo.large_block_size + col
+                data[s] = dat[off:off + width]
+            yield data
+        pos += large_row
+        remaining -= large_row
+    small_row = geo.small_row_size()
+    rows_per_batch = max(1, batch_bytes // geo.small_block_size)
+    block = geo.small_block_size
+    while remaining > 0:
+        n_rows = min(rows_per_batch,
+                     (remaining + small_row - 1) // small_row)
+        raw = np.zeros(n_rows * small_row, dtype=np.uint8)
+        avail = min(dat_size - pos, n_rows * small_row)
+        if avail > 0:
+            raw[:avail] = dat[pos:pos + avail]
+        # [n_rows, k, block] -> [k, n_rows*block]: batch the rows while
+        # keeping each row's block contiguous per shard
+        stripes = raw.reshape(n_rows, k, block)
+        yield np.ascontiguousarray(stripes.transpose(1, 0, 2)).reshape(k, -1)
+        pos += n_rows * small_row
+        remaining -= min(remaining, n_rows * small_row)
 
 
 def write_ec_files(base_path: str, geo: EcGeometry = DEFAULT_GEOMETRY,
@@ -82,45 +171,32 @@ def write_ec_files(base_path: str, geo: EcGeometry = DEFAULT_GEOMETRY,
                    batch_bytes: int = DEFAULT_BATCH_BYTES) -> None:
     """<base>.dat -> <base>.ec00 .. (WriteEcFiles ec_encoder.go:57).
 
-    Walks large rows first, then small rows for the tail, zero-padding the
-    final partial row exactly like encodeDataOneBatch (ec_encoder.go:173)."""
+    Pipelined: the calling thread reads batch N+1 from .dat and submits its
+    encode while the device computes batch N and a writer thread appends
+    batch N-1's shards — disk in, TPU, disk out all busy at once (the
+    reference's encodeDatFile loop is strictly serial, ec_encoder.go:162)."""
     codec = _codec_for(geo, codec)
     dat_size = os.path.getsize(base_path + ".dat")
     dat = np.memmap(base_path + ".dat", dtype=np.uint8, mode="r") \
         if dat_size else np.zeros(0, dtype=np.uint8)
     outputs = [open(base_path + to_ext(i), "wb")
                for i in range(geo.total_shards)]
+    k = geo.data_shards
+
+    def produce():
+        for data in _iter_encode_batches(dat, dat_size, geo, batch_bytes):
+            yield data, _begin_encode(codec, data)
+
+    def consume(item):
+        data, fetch = item
+        for s in range(k):
+            outputs[s].write(data[s])
+        parity = fetch()
+        for p in range(geo.parity_shards):
+            outputs[k + p].write(parity[p])
+
     try:
-        pos = 0
-        remaining = dat_size
-        large_row = geo.large_row_size()
-        while remaining >= large_row:
-            # one large row = k x 1GB; stream it in batch_bytes column slices
-            for col in range(0, geo.large_block_size, batch_bytes):
-                width = min(batch_bytes, geo.large_block_size - col)
-                # a column slice of a large row is NOT contiguous in .dat;
-                # gather the k slices into a [k, width] matrix
-                k = geo.data_shards
-                data = np.empty((k, width), dtype=np.uint8)
-                for s in range(k):
-                    off = pos + s * geo.large_block_size + col
-                    data[s] = dat[off:off + width]
-                parity = codec.encode(data)
-                for s in range(k):
-                    outputs[s].write(data[s].tobytes())
-                for p in range(geo.parity_shards):
-                    outputs[k + p].write(parity[p].tobytes())
-            pos += large_row
-            remaining -= large_row
-        small_row = geo.small_row_size()
-        rows_per_batch = max(1, batch_bytes // geo.small_block_size)
-        while remaining > 0:
-            n_rows = min(rows_per_batch,
-                         (remaining + small_row - 1) // small_row)
-            _encode_rows(dat, pos, geo.small_block_size, n_rows, codec,
-                         outputs=outputs, geo=geo)
-            pos += n_rows * small_row
-            remaining -= min(remaining, n_rows * small_row)
+        _pipelined(produce(), consume)
     finally:
         for f in outputs:
             f.close()
@@ -164,8 +240,9 @@ def rebuild_ec_files(base_path: str, geo: "EcGeometry | None" = None,
             raise ValueError(f"shard {i} size {len(arr)} != {shard_size}")
     outputs = {i: open(base_path + to_ext(i), "wb") for i in missing}
     used = [i for i in range(n) if have[i]][:geo.data_shards]
-    bytes_read = 0
-    try:
+    bytes_read = len(used) * shard_size
+
+    def produce():
         for off in range(0, shard_size, batch_bytes):
             width = min(batch_bytes, shard_size - off)
             # memmap slices stay lazy; reconstruct materializes only the
@@ -173,10 +250,15 @@ def rebuild_ec_files(base_path: str, geo: "EcGeometry | None" = None,
             shards: list[np.ndarray | None] = [
                 inputs[i][off:off + width] if have[i] else None
                 for i in range(n)]
-            bytes_read += len(used) * width
-            rebuilt = codec.reconstruct(shards)
-            for i in missing:
-                outputs[i].write(rebuilt[i].tobytes())
+            yield _begin_reconstruct(codec, shards)
+
+    def consume(fetch):
+        rebuilt = fetch()
+        for i in missing:
+            outputs[i].write(rebuilt[i])
+
+    try:
+        _pipelined(produce(), consume)
     finally:
         for f in outputs.values():
             f.close()
@@ -241,17 +323,24 @@ def rebuild_ec_files_batch(base_paths: list[str],
         # regardless of group size (a 1000-volume group must not multiply
         # the window); the 4KB floor only bounds syscall count
         window = max(4096, batch_bytes // max(1, len(bases)))
-        try:
+
+        def produce():
             for off in range(0, shard_size, window):
                 width = min(window, shard_size - off)
                 shards: list[np.ndarray | None] = [
                     np.stack([np.asarray(inputs[b][i][off:off + width])
                               for b in bases]) if have[i] else None
                     for i in range(n)]
-                rebuilt = codec.reconstruct(shards)  # missing -> [V, width]
-                for i in missing:
-                    for vi, b in enumerate(bases):
-                        outputs[b][i].write(rebuilt[i][vi].tobytes())
+                yield _begin_reconstruct(codec, shards)
+
+        def consume(fetch):
+            rebuilt = fetch()  # missing -> [V, width]
+            for i in missing:
+                for vi, b in enumerate(bases):
+                    outputs[b][i].write(rebuilt[i][vi])
+
+        try:
+            _pipelined(produce(), consume)
         finally:
             for b in bases:
                 for f in outputs[b].values():
