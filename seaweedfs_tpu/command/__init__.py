@@ -13,18 +13,31 @@ import json
 import os
 import signal
 import sys
-import time
+import threading
 
 
 def _wait_forever():
-    try:
-        signal.pause()
-    except (KeyboardInterrupt, AttributeError):
+    """Block until SIGINT/SIGTERM, then return so the caller runs its
+    orderly .stop() chain and exits 0 (the real-process cluster gate
+    asserts that clean-shutdown contract)."""
+    woke = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
         try:
-            while True:
-                time.sleep(3600)
-        except KeyboardInterrupt:
+            signal.signal(sig, lambda *_: woke.set())
+        except (ValueError, OSError):  # non-main thread / platform quirk
             pass
+    try:
+        woke.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        # restore defaults so a SECOND signal can still kill a shutdown
+        # that wedges in the callers' .stop() chain
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                signal.signal(sig, signal.SIG_DFL)
+            except (ValueError, OSError):
+                pass
 
 
 def cmd_master(args) -> int:

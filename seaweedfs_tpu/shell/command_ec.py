@@ -234,7 +234,11 @@ def do_ec_rebuild(env: CommandEnv, vid: int, collection: str = "") -> dict:
                        {"volume_id": vid, "collection": collection,
                         "shard_ids": stale})
     return {"volume_id": vid, "rebuilt": rebuilt,
-            "rebuilder": rebuilder_id}
+            "rebuilder": rebuilder_id,
+            # repair-IO accounting (bytes_read, plan_kind, helpers):
+            # operators see the clay/LRC reduced-read plans in the verb
+            # output, mirrored by the /metrics rebuild counters
+            "rebuild_stats": out.get("rebuild_stats", {})}
 
 
 # -- commands --------------------------------------------------------------

@@ -153,6 +153,17 @@ class ServerMetrics:
             "seaweedfs_s3_request_total", "s3 requests", ["action"])
         self.volume_count = r.gauge(
             "seaweedfs_volume_server_volumes", "volumes on this server")
+        # repair-IO accounting per rebuild plan (rs-full / clay-plane /
+        # clay-decode / lrc-local / lrc-global): makes the clay/LRC
+        # reduced-read advantage observable in production, not just in
+        # bench extras (stats/metrics.go counter analogue)
+        self.ec_rebuild_bytes_read = r.counter(
+            "seaweedfs_volume_ec_rebuild_read_bytes_total",
+            "bytes read from surviving shards by EC rebuilds",
+            ["plan_kind"])
+        self.ec_rebuilds = r.counter(
+            "seaweedfs_volume_ec_rebuild_total",
+            "EC shard rebuilds executed", ["plan_kind"])
 
     def render(self) -> str:
         return self.registry.render()

@@ -22,6 +22,7 @@ CPU.  Same engine as RS, different matrices.
 
 from __future__ import annotations
 
+import functools
 import os
 
 import numpy as np
@@ -68,7 +69,12 @@ class LrcWindowCodec:
 
 class ClayWindowCodec:
     """Clay encode: each small-block window's [k, small] bytes viewed as
-    [k*alpha, small/alpha] symbols, one flat-generator matmul."""
+    [k, alpha, small/alpha] layer-major symbols, encoded by the STRUCTURED
+    path (ops/clay_structured.py: uncouple -> one [m, k0] layer-MDS matmul
+    -> couple) — ~alpha x fewer GF multiplies than the flat [m*alpha,
+    k*alpha] generator, bit-identical output.  On TPU the whole transform
+    (transposes included) runs jitted on device; encode_begin defers only
+    the parity fetch so write_ec_files pipelines it."""
 
     def __init__(self, geo: EcGeometry):
         self.geo = geo
@@ -81,32 +87,47 @@ class ClayWindowCodec:
                 f"multiple of clay alpha {self.code.alpha}")
         self.backend = "clay"
 
-    def _flatten(self, data: np.ndarray) -> tuple[np.ndarray, int]:
-        """[k, W] (W = whole windows) -> [k*alpha, W/alpha] symbol rows."""
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        return self.encode_begin(data)()
+
+    def encode_begin(self, data: np.ndarray):
+        data = np.asarray(data, dtype=np.uint8)
         k, W = data.shape
         small = self.geo.small_block_size
+        assert k == self.k, f"expected {self.k} data shards"
         assert W % small == 0, \
             f"window {W} not a multiple of small block {small}"
+        from ...ops import clay_structured
+        from ...ops.codec import _tpu_available
+        if _tpu_available():
+            import jax
+            import jax.numpy as jnp
+            fn = _clay_device_fn(self.k, self.m, small)
+            dev = fn(jnp.asarray(data))
+
+            def fetch():
+                return np.asarray(jax.device_get(dev))
+            return fetch
         alpha = self.code.alpha
         win_a = small // alpha
         n_win = W // small
-        # [k, n_win, alpha, win_a] -> [k, alpha, n_win, win_a]: layer z of
-        # every window lands on symbol row k*alpha + z
-        v = data.reshape(k, n_win, alpha, win_a).transpose(0, 2, 1, 3)
-        return np.ascontiguousarray(v).reshape(k * alpha, -1), n_win
+        sym = np.ascontiguousarray(
+            data.reshape(k, n_win, alpha, win_a).transpose(0, 2, 1, 3)
+        ).reshape(k, alpha, -1)
+        par = clay_structured.encode_np(self.k, self.m, sym)
+        parity = np.ascontiguousarray(
+            par.reshape(self.m, alpha, n_win, win_a).transpose(0, 2, 1, 3)
+        ).reshape(self.m, W)
+        return lambda: parity
 
-    def _unflatten(self, flat: np.ndarray, rows: int, n_win: int
-                   ) -> np.ndarray:
-        alpha = self.code.alpha
-        win_a = self.geo.small_block_size // alpha
-        v = flat.reshape(rows, alpha, n_win, win_a).transpose(0, 2, 1, 3)
-        return np.ascontiguousarray(v).reshape(rows, -1)
 
-    def encode(self, data: np.ndarray) -> np.ndarray:
-        flat, n_win = self._flatten(np.asarray(data, dtype=np.uint8))
-        G = clay_matrix.generator_flat(self.k, self.m)
-        parity = gf_apply(G, flat)
-        return self._unflatten(parity, self.m, n_win)
+@functools.lru_cache(maxsize=8)
+def _clay_device_fn(k: int, m: int, small: int):
+    import jax
+
+    from ...ops import clay_structured
+    return jax.jit(functools.partial(
+        clay_structured.encode_device, k, m, small=small))
 
 
 # -- rebuild ---------------------------------------------------------------

@@ -57,6 +57,27 @@ def _begin_encode(codec, data: np.ndarray):
     return lambda: parity
 
 
+def _pipeline_depth(codec) -> int:
+    """Read-ahead depth for the disk loops.
+
+    Worth paying for when the codec dispatches to a device (the fetch wait
+    and h2d/d2h transfers overlap disk IO) or the host has cores to spare.
+    On a single-core host with a CPU codec every stage is the same core's
+    CPU time, and the producer/writer GIL ping-pong measurably LOSES
+    throughput (~2x on the 2GB stream bench) — run inline instead."""
+    backend = getattr(codec, "backend", "")
+    device_backed = backend in ("pallas", "jax", "mesh") or (
+        backend in ("clay", "lrc") and _codec_tpu_available())
+    if device_backed or (os.cpu_count() or 1) > 1:
+        return PIPELINE_DEPTH
+    return 0
+
+
+def _codec_tpu_available() -> bool:
+    from ...ops.codec import _tpu_available
+    return _tpu_available()
+
+
 def _begin_reconstruct(codec, shards):
     begin = getattr(codec, "reconstruct_begin", None)
     if begin is not None:
@@ -76,7 +97,13 @@ def _pipelined(produce, consume, depth: int = PIPELINE_DEPTH) -> None:
     is synchronous; SURVEY §7(b) flags the overlap as the hard part).  A
     bounded queue keeps at most `depth` batches of host buffers alive, and
     writes happen in submission order (single consumer, FIFO queue), which
-    append-only shard files require."""
+    append-only shard files require.
+
+    depth <= 0 runs inline with no writer thread (see _pipeline_depth)."""
+    if depth <= 0:
+        for item in produce:
+            consume(item)
+        return
     q: _queue.Queue = _queue.Queue(maxsize=depth)
     errs: list[BaseException] = []
 
@@ -125,23 +152,50 @@ def _codec_for(geo: EcGeometry, codec: RSCodec | None):
     return codec_for_devices(geo.data_shards, geo.parity_shards)
 
 
+class _BufferPool:
+    """Cycled preallocated [k, batch] gather buffers.
+
+    Fresh 80MB numpy allocations per batch mean mmap + first-touch page
+    faults + munmap every iteration — measurably dominant on this host
+    class.  The pipeline holds at most PIPELINE_DEPTH queued batches plus
+    one in the writer and one being produced, so `depth + 2` cycled
+    buffers are never overwritten while still in flight."""
+
+    def __init__(self, n: int, shape: tuple):
+        self._bufs = [np.empty(shape, dtype=np.uint8) for _ in range(n)]
+        self._i = 0
+
+    def next(self) -> np.ndarray:
+        buf = self._bufs[self._i]
+        self._i = (self._i + 1) % len(self._bufs)
+        return buf
+
+
 def _iter_encode_batches(dat, dat_size: int, geo: EcGeometry,
                          batch_bytes: int):
     """Yield the [k, width] data matrices write_ec_files encodes, in shard
     append order: large rows first (column slices gathered across the k
     1GB blocks), then batched small rows, zero-padding the final partial
-    row exactly like encodeDataOneBatch (ec_encoder.go:173)."""
+    row exactly like encodeDataOneBatch (ec_encoder.go:173).
+
+    Yielded arrays are views into a cycled buffer pool: each is gathered
+    from .dat in ONE copy pass and stays valid until PIPELINE_DEPTH + 1
+    further batches have been yielded."""
     k = geo.data_shards
     pos = 0
     remaining = dat_size
     large_row = geo.large_row_size()
+    # small-row batches are at least one whole block wide even when
+    # batch_bytes is smaller (n_rows floors at 1)
+    pool = _BufferPool(PIPELINE_DEPTH + 2,
+                       (k, max(batch_bytes, geo.small_block_size)))
     while remaining >= large_row:
         # one large row = k x 1GB; stream it in batch_bytes column slices
         for col in range(0, geo.large_block_size, batch_bytes):
             width = min(batch_bytes, geo.large_block_size - col)
             # a column slice of a large row is NOT contiguous in .dat;
             # gather the k slices into a [k, width] matrix
-            data = np.empty((k, width), dtype=np.uint8)
+            data = pool.next()[:, :width]
             for s in range(k):
                 off = pos + s * geo.large_block_size + col
                 data[s] = dat[off:off + width]
@@ -154,14 +208,22 @@ def _iter_encode_batches(dat, dat_size: int, geo: EcGeometry,
     while remaining > 0:
         n_rows = min(rows_per_batch,
                      (remaining + small_row - 1) // small_row)
-        raw = np.zeros(n_rows * small_row, dtype=np.uint8)
-        avail = min(dat_size - pos, n_rows * small_row)
-        if avail > 0:
-            raw[:avail] = dat[pos:pos + avail]
-        # [n_rows, k, block] -> [k, n_rows*block]: batch the rows while
-        # keeping each row's block contiguous per shard
-        stripes = raw.reshape(n_rows, k, block)
-        yield np.ascontiguousarray(stripes.transpose(1, 0, 2)).reshape(k, -1)
+        width = n_rows * block
+        data = pool.next()[:, :width]
+        # gather [k, n_rows*block] directly: shard s of row r sits at
+        # .dat offset pos + r*small_row + s*block (one slice copy each,
+        # no intermediate zeros + transpose materialization)
+        for r in range(n_rows):
+            row_off = pos + r * small_row
+            for s in range(k):
+                o = row_off + s * block
+                dst = data[s, r * block:(r + 1) * block]
+                n = min(block, max(0, dat_size - o))
+                if n > 0:
+                    dst[:n] = dat[o:o + n]
+                if n < block:
+                    dst[n:] = 0    # zero-pad the final partial row
+        yield data
         pos += n_rows * small_row
         remaining -= min(remaining, n_rows * small_row)
 
@@ -196,7 +258,7 @@ def write_ec_files(base_path: str, geo: EcGeometry = DEFAULT_GEOMETRY,
             outputs[k + p].write(parity[p])
 
     try:
-        _pipelined(produce(), consume)
+        _pipelined(produce(), consume, _pipeline_depth(codec))
     finally:
         for f in outputs:
             f.close()
@@ -258,7 +320,7 @@ def rebuild_ec_files(base_path: str, geo: "EcGeometry | None" = None,
             outputs[i].write(rebuilt[i])
 
     try:
-        _pipelined(produce(), consume)
+        _pipelined(produce(), consume, _pipeline_depth(codec))
     finally:
         for f in outputs.values():
             f.close()
@@ -270,7 +332,8 @@ def rebuild_ec_files(base_path: str, geo: "EcGeometry | None" = None,
 
 
 def rebuild_ec_files_batch(base_paths: list[str],
-                           batch_bytes: int = DEFAULT_BATCH_BYTES
+                           batch_bytes: int = DEFAULT_BATCH_BYTES,
+                           codec: RSCodec | None = None
                            ) -> dict[str, list[int]]:
     """Fleet rebuild: regenerate missing shards across MANY volumes with
     batched [V, B] codec calls.
@@ -305,11 +368,14 @@ def rebuild_ec_files_batch(base_paths: list[str],
             # paths in codes.py; the RSCodec [V, B] batching below is
             # RS-specific)
             for b in bases:
-                out[b] = rebuild_ec_files(b, geo, batch_bytes=batch_bytes)
+                out[b] = rebuild_ec_files(
+                    b, geo,
+                    codec=codec if geo.code_kind == "rs" else None,
+                    batch_bytes=batch_bytes)
             continue
         n = geo.total_shards
         missing = [i for i in range(n) if not have[i]]
-        codec = _codec_for(geo, None)
+        group_codec = _codec_for(geo, codec)
         inputs = {b: {i: np.memmap(b + to_ext(i), dtype=np.uint8, mode="r")
                       for i in range(n) if have[i]} for b in bases}
         for b in bases:
@@ -331,7 +397,7 @@ def rebuild_ec_files_batch(base_paths: list[str],
                     np.stack([np.asarray(inputs[b][i][off:off + width])
                               for b in bases]) if have[i] else None
                     for i in range(n)]
-                yield _begin_reconstruct(codec, shards)
+                yield _begin_reconstruct(group_codec, shards)
 
         def consume(fetch):
             rebuilt = fetch()  # missing -> [V, width]
@@ -340,7 +406,7 @@ def rebuild_ec_files_batch(base_paths: list[str],
                     outputs[b][i].write(rebuilt[i][vi])
 
         try:
-            _pipelined(produce(), consume)
+            _pipelined(produce(), consume, _pipeline_depth(group_codec))
         finally:
             for b in bases:
                 for f in outputs[b].values():

@@ -915,7 +915,13 @@ class VolumeServer:
         stats: dict = {}
         rebuilt = ec_pkg.rebuild_ec_files(base, stats=stats)
         # stats surface the clay/LRC repair-IO advantage to operators
-        # (bytes_read, plan_kind) — see storage/ec/codes.py
+        # (bytes_read, plan_kind) — see storage/ec/codes.py — both in the
+        # RPC reply (shell ec.rebuild prints it) and /metrics counters
+        if rebuilt and stats.get("plan_kind"):
+            self.metrics.ec_rebuilds.inc(stats["plan_kind"])
+            self.metrics.ec_rebuild_bytes_read.inc(
+                stats["plan_kind"], value=float(stats.get("bytes_read",
+                                                          0)))
         return {"rebuilt_shard_ids": rebuilt, "rebuild_stats": stats}
 
     def _rpc_ec_copy(self, req: dict) -> dict:

@@ -1,0 +1,175 @@
+"""Real-process cluster gate (VERDICT r3 #4).
+
+Everything else in tests/ drives servers in-process through SimCluster
+(great for fault injection, but it never proves the actual daemons
+boot).  This spawns the four daemons exactly as an operator would —
+`python -m seaweedfs_tpu master|volume|filer|s3` as separate OS
+processes, the reference's docker-compose local-dev topology
+(docker/compose/local-dev-compose.yml) mirrored by
+deploy/docker-compose.yml — waits for HTTP readiness, then runs the
+daily-driver flows against them over the network:
+
+  blob write/read (master assign + volume post, the weed upload path),
+  filer PUT/GET, S3 put/get, shell `ec.encode` + read-after-encode,
+  and SIGINT shutdown with exit code 0.
+
+One test, marked slow-ish (~30-60s of subprocess imports on 1 core):
+the point is the boot contract, not coverage — the flows themselves are
+covered in depth by the in-process suites."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url: str, timeout: float = 5.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def _wait_http(url: str, deadline: float, accept_4xx: bool = True) -> None:
+    last: Exception | None = None
+    while time.time() < deadline:
+        try:
+            _get(url, timeout=2)
+            return
+        except urllib.error.HTTPError as e:
+            if accept_4xx and e.code < 500:
+                return
+            last = e
+        except Exception as e:  # conn refused while booting
+            last = e
+        time.sleep(0.5)
+    raise AssertionError(f"not ready: {url} ({last})")
+
+
+def _spawn(args: list[str], logf) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", *args],
+        cwd=REPO, stdout=logf, stderr=subprocess.STDOUT,
+        start_new_session=True)
+
+
+def test_real_process_cluster(tmp_path):
+    mp, vp, fp, sp = (_free_port() for _ in range(4))
+    mg, vg, fg = (_free_port() for _ in range(3))
+    logs = {n: open(tmp_path / f"{n}.log", "wb") for n in
+            ("master", "volume", "filer", "s3")}
+    vol_dir = tmp_path / "vol"
+    vol_dir.mkdir()
+    procs: dict[str, subprocess.Popen] = {}
+    try:
+        procs["master"] = _spawn(
+            ["master", "-port", str(mp), "-grpc_port", str(mg),
+             "-volumeSizeLimitMB", "64"], logs["master"])
+        procs["volume"] = _spawn(
+            ["volume", "-port", str(vp), "-grpc_port", str(vg),
+             "-dir", str(vol_dir), "-max", "5",
+             "-mserver", f"127.0.0.1:{mg}"], logs["volume"])
+        procs["filer"] = _spawn(
+            ["filer", "-port", str(fp), "-grpc_port", str(fg),
+             "-master", f"127.0.0.1:{mg}",
+             "-store_path", str(tmp_path / "filer.db")], logs["filer"])
+        procs["s3"] = _spawn(
+            ["s3", "-port", str(sp),
+             "-filer", f"127.0.0.1:{fp}.{fg}"], logs["s3"])
+        deadline = time.time() + 120
+        _wait_http(f"http://127.0.0.1:{mp}/dir/status", deadline)
+        _wait_http(f"http://127.0.0.1:{vp}/status", deadline)
+        _wait_http(f"http://127.0.0.1:{fp}/", deadline)
+        _wait_http(f"http://127.0.0.1:{sp}/", deadline)
+
+        # -- blob write/read (assign + upload + direct volume GET) -----
+        from seaweedfs_tpu import operation
+        payload = os.urandom(4096)
+        fid = None
+        for _ in range(40):   # volume needs a heartbeat to be assignable
+            try:
+                fid = operation.assign_and_upload(
+                    f"127.0.0.1:{mg}", payload)
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert fid, "assign+upload never succeeded"
+        lookup = json.loads(_get(
+            f"http://127.0.0.1:{mp}/dir/lookup?volumeId={fid.split(',')[0]}"))
+        pub = lookup["locations"][0]["public_url"]
+        assert _get(f"http://{pub}/{fid}") == payload
+
+        # -- filer PUT/GET over HTTP -----------------------------------
+        body = b"real-process filer object " * 100
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fp}/dir/hello.txt", data=body,
+            method="PUT")
+        last = None
+        for _ in range(3):   # the 1-core box can stall mid-boot
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    assert r.status in (200, 201)
+                last = None
+                break
+            except urllib.error.URLError as e:
+                last = e
+                time.sleep(2)
+        assert last is None, f"filer PUT failed: {last}"
+        assert _get(f"http://127.0.0.1:{fp}/dir/hello.txt") == body
+
+        # -- S3 put/get (IAM disabled -> open) -------------------------
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{sp}/bkt", method="PUT")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status in (200, 201)
+        obj = os.urandom(2000)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{sp}/bkt/a/b.bin", data=obj, method="PUT")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+        assert _get(f"http://127.0.0.1:{sp}/bkt/a/b.bin") == obj
+
+        # -- shell ec.encode against the live cluster ------------------
+        from seaweedfs_tpu import shell
+        env = shell.CommandEnv(f"127.0.0.1:{mg}")
+        shell.run_command(env, "lock")
+        vid = int(fid.split(",")[0])
+        out = json.loads(shell.run_command(
+            env, f"ec.encode -volumeId {vid}"))
+        assert out["encoded"][0]["volume_id"] == vid
+        shell.run_command(env, "unlock")
+        time.sleep(1.5)   # next heartbeat republishes ec shard locations
+        assert _get(f"http://{pub}/{fid}") == payload, \
+            "read after ec.encode"
+
+        # -- clean shutdown: SIGINT -> orderly stop -> exit 0 ----------
+        for name in ("s3", "filer", "volume", "master"):
+            procs[name].send_signal(signal.SIGINT)
+        for name, p in procs.items():
+            assert p.wait(timeout=30) == 0, \
+                f"{name} exited {p.returncode}"
+        procs.clear()
+    finally:
+        for name, p in procs.items():
+            p.kill()
+        for f in logs.values():
+            f.close()
+        for name in ("master", "volume", "filer", "s3"):
+            log = (tmp_path / f"{name}.log").read_bytes()
+            if log:
+                print(f"--- {name} ---\n{log.decode(errors='replace')}")
