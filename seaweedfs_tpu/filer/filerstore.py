@@ -141,6 +141,29 @@ class MemoryStore(FilerStore):
         self._kv.pop(key, None)
 
 
+def split_path(full_path: str) -> tuple[str, str]:
+    """(directory, name) of a normalized path — shared by every
+    directory/name-keyed store (kv_stores, more_stores)."""
+    p = full_path.rstrip("/") or "/"
+    if p == "/":
+        return "", "/"
+    d, n = p.rsplit("/", 1)
+    return d or "/", n
+
+
+def lex_increment(b: bytes) -> bytes:
+    """Smallest key greater than every key prefixed by b — the range-end
+    computation every seek-paginated store shares (etcd clientv3's
+    GetPrefixRangeEnd)."""
+    out = bytearray(b)
+    while out:
+        if out[-1] < 0xFF:
+            out[-1] += 1
+            return bytes(out)
+        out.pop()
+    return b"\xff" * 9
+
+
 # sqlite/mysql/postgres all ride the shared abstract-SQL engine
 # (abstract_sql.py) — imported lazily to keep the base-class module
 # cycle-free
@@ -174,9 +197,30 @@ def _etcd(**kw):
     return EtcdStore(**kw)
 
 
+def _cassandra(**kw):
+    from .more_stores import CassandraStore
+    return CassandraStore(**kw)
+
+
+def _hbase(**kw):
+    from .more_stores import HBaseStore
+    return HBaseStore(**kw)
+
+
+def _elastic7(**kw):
+    from .more_stores import Elastic7Store
+    return Elastic7Store(**kw)
+
+
+def _tikv(**kw):
+    from .more_stores import TikvStore
+    return TikvStore(**kw)
+
+
 STORES = {"memory": MemoryStore, "sqlite": _sqlite,
           "mysql": _mysql, "postgres": _postgres, "redis": _redis,
-          "mongo": _mongo, "etcd": _etcd}
+          "mongo": _mongo, "etcd": _etcd, "cassandra": _cassandra,
+          "hbase": _hbase, "elastic7": _elastic7, "tikv": _tikv}
 
 
 def __getattr__(name):

@@ -22,15 +22,9 @@ from __future__ import annotations
 import json
 
 from .entry import Entry
-from .filerstore import FilerStore, NotFound
+from .filerstore import FilerStore, NotFound, lex_increment, split_path
 
-
-def _split(full_path: str) -> tuple[str, str]:
-    p = full_path.rstrip("/") or "/"
-    if p == "/":
-        return "", "/"
-    d, n = p.rsplit("/", 1)
-    return d or "/", n
+_split = split_path
 
 
 class MongoStore(FilerStore):
@@ -171,17 +165,34 @@ class EtcdStore(FilerStore):
                                include_start: bool = False,
                                limit: int = 1024,
                                prefix: str = "") -> list[Entry]:
+        """Seek-based pagination: each page is ONE key-ordered range read
+        starting at the page boundary (etcd range reads are key-sorted,
+        clientv3.WithRange semantics — the reference's etcd_store.go does
+        the same), so walking a directory is O(dir) total, not O(dir^2)
+        re-scans of the prefix.  `prefix` narrows the range itself;
+        exclusive-of-start seeks to start_name + NUL (the smallest key
+        strictly after it — NUL is the store's own separator, so no
+        entry name contains it)."""
         d = dir_path.rstrip("/") or "/"
+        base = f"{self.META}{d}\x00"
+        if start_name:
+            range_start = base + start_name + \
+                ("" if include_start else "\x00")
+        else:
+            range_start = base + prefix
+        range_end = _lex_increment(base + prefix if prefix else base)
         out: list[Entry] = []
-        for value, meta in self.client.get_prefix(f"{self.META}{d}\x00"):
+        get_range = getattr(self.client, "get_range", None)
+        if get_range is not None:
+            it = get_range(range_start, range_end, limit=limit)
+        else:
+            # degraded client: prefix scan, still range-filtered here
+            it = (pair for pair in self.client.get_prefix(base)
+                  if range_start <= _meta_key(pair[1]) < range_end)
+        for value, meta in it:
             name = _meta_key(meta).split("\x00", 1)[1]
             if prefix and not name.startswith(prefix):
                 continue
-            if start_name:
-                if include_start and name < start_name:
-                    continue
-                if not include_start and name <= start_name:
-                    continue
             if isinstance(value, bytes):
                 value = value.decode()
             out.append(Entry.from_dict(json.loads(value)))
@@ -206,3 +217,8 @@ def _meta_key(meta) -> str:
     """etcd3 metadata exposes the key as bytes at `.key`."""
     k = meta.key if hasattr(meta, "key") else meta
     return k.decode() if isinstance(k, bytes) else k
+
+
+def _lex_increment(s: str) -> str:
+    """filerstore.lex_increment over the etcd store's str keys."""
+    return lex_increment(s.encode()).decode(errors="surrogateescape")

@@ -1,0 +1,447 @@
+"""Cassandra, HBase, Elasticsearch-7 and TiKV filer stores — the last
+absent families of the reference's store matrix
+(weed/filer/cassandra/cassandra_store.go, hbase/hbase_store.go,
+elastic/v7/elastic_store.go + elastic_store_kv.go,
+tikv/tikv_store.go).
+
+Same config-only shell pattern as the rest of the matrix
+(abstract_sql.py dialects, redis_store.py, kv_stores.py): each store
+speaks the narrow slice of its real driver's surface, takes a `client`
+injection point shaped exactly like that driver (in-process fakes in
+tests/test_more_stores.py run the shared conformance contract), and
+with no client injected imports the real driver and raises a clear
+RuntimeError when absent — the drivers are not installable in this
+image, so these are deliberately configuration-complete, not
+network-tested (COVERAGE.md carries the caveat).
+
+Schemas (re-designed, not copied):
+- cassandra: `filemeta(directory, name, meta, PRIMARY KEY(directory,
+  name))` — partition per directory, clustering by name, so listings
+  are single-partition slice queries; `filer_kv(key, value)`.
+- hbase: one table, rows keyed `dir NUL name` in column `f:m`; key
+  order makes listings scans and subtree deletes range deletes.
+- elastic7: one `filemeta` index, doc id = urlsafe-b64(full path),
+  fields directory/name/meta keyword-indexed; listings are filtered,
+  sorted searches; `filer_kv` index for the KV API.
+- tikv: raw KV, meta keys `m<dir> NUL <name>`, kv keys `k<hex>`;
+  listings are bounded scans, subtree deletes are delete_range.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+
+from .entry import Entry
+from .filerstore import (FilerStore, NotFound, lex_increment as _inc_bytes,
+                         split_path as _split)
+
+
+def _child(base: str, name: str) -> str:
+    return (base.rstrip("/") or "") + "/" + name
+
+
+class CassandraStore(FilerStore):
+    """`client`: a cassandra-driver Session-shaped object —
+    `execute(cql, params)` with %s placeholders returning iterable rows
+    (mappings or 2-tuples)."""
+    name = "cassandra"
+
+    def __init__(self, client=None, **conn_kw):
+        if client is None:
+            try:
+                import cassandra.cluster  # type: ignore
+            except ImportError as e:
+                raise RuntimeError(
+                    "cassandra filer store needs cassandra-driver "
+                    "installed; configuration is otherwise complete"
+                ) from e
+            client = cassandra.cluster.Cluster(
+                **conn_kw).connect("seaweedfs")
+        self.session = client
+
+    @staticmethod
+    def _row(r, *fields):
+        if isinstance(r, dict):
+            return tuple(r[f] for f in fields)
+        return tuple(r[:len(fields)])
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, n = _split(entry.full_path)
+        self.session.execute(
+            "INSERT INTO filemeta (directory, name, meta) "
+            "VALUES (%s, %s, %s)",
+            (d, n, json.dumps(entry.to_dict())))
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        d, n = _split(full_path)
+        rows = list(self.session.execute(
+            "SELECT meta FROM filemeta WHERE directory=%s AND name=%s",
+            (d, n)))
+        if not rows:
+            raise NotFound(full_path)
+        (meta,) = self._row(rows[0], "meta")
+        return Entry.from_dict(json.loads(meta))
+
+    def delete_entry(self, full_path: str) -> None:
+        d, n = _split(full_path)
+        self.session.execute(
+            "DELETE FROM filemeta WHERE directory=%s AND name=%s", (d, n))
+
+    def delete_folder_children(self, full_path: str) -> None:
+        # partition per directory: recurse through child DIRECTORY
+        # partitions only (the partition key cannot be range-scanned;
+        # recursing into plain files would cost 2 empty round-trips per
+        # file), then drop this one
+        base = full_path.rstrip("/") or "/"
+        for r in list(self.session.execute(
+                "SELECT name, meta FROM filemeta WHERE directory=%s",
+                (base,))):
+            name, meta = self._row(r, "name", "meta")
+            if Entry.from_dict(json.loads(meta)).is_directory():
+                self.delete_folder_children(_child(base, name))
+        self.session.execute(
+            "DELETE FROM filemeta WHERE directory=%s", (base,))
+
+    def list_directory_entries(self, dir_path: str, start_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        d = dir_path.rstrip("/") or "/"
+        cql = "SELECT name, meta FROM filemeta WHERE directory=%s"
+        params: list = [d]
+        if start_name:
+            cql += " AND name >= %s" if include_start else " AND name > %s"
+            params.append(start_name)
+        elif prefix:
+            cql += " AND name >= %s"
+            params.append(prefix)
+        if prefix:
+            cql += " AND name < %s"
+            params.append(_inc_bytes(prefix.encode()).decode(
+                errors="surrogateescape"))
+        cql += " LIMIT %s"
+        params.append(limit)
+        out = []
+        for r in self.session.execute(cql, tuple(params)):
+            name, meta = self._row(r, "name", "meta")
+            if prefix and not name.startswith(prefix):
+                continue
+            out.append(Entry.from_dict(json.loads(meta)))
+        return out
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self.session.execute(
+            "INSERT INTO filer_kv (key, value) VALUES (%s, %s)",
+            (key.hex(), value))
+
+    def kv_get(self, key: bytes) -> bytes:
+        rows = list(self.session.execute(
+            "SELECT value FROM filer_kv WHERE key=%s", (key.hex(),)))
+        if not rows:
+            raise NotFound(repr(key))
+        (v,) = self._row(rows[0], "value")
+        return bytes(v)
+
+    def kv_delete(self, key: bytes) -> None:
+        self.session.execute(
+            "DELETE FROM filer_kv WHERE key=%s", (key.hex(),))
+
+
+class HBaseStore(FilerStore):
+    """`client`: a happybase Connection-shaped object — `table(name)`
+    returning tables with put/row/delete/scan(row_start, row_stop,
+    limit)."""
+    name = "hbase"
+
+    COL = b"f:m"
+
+    def __init__(self, client=None, table: str = "seaweedfs", **conn_kw):
+        if client is None:
+            try:
+                import happybase  # type: ignore
+            except ImportError as e:
+                raise RuntimeError(
+                    "hbase filer store needs happybase installed; "
+                    "configuration is otherwise complete") from e
+            client = happybase.Connection(**conn_kw)
+        self.table = client.table(table)
+
+    @staticmethod
+    def _rowkey(d: str, n: str) -> bytes:
+        return f"{d or '/'}\x00{n}".encode()
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, n = _split(entry.full_path)
+        self.table.put(self._rowkey(d, n),
+                       {self.COL: json.dumps(entry.to_dict()).encode()})
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        d, n = _split(full_path)
+        row = self.table.row(self._rowkey(d, n))
+        if not row:
+            raise NotFound(full_path)
+        return Entry.from_dict(json.loads(row[self.COL]))
+
+    def delete_entry(self, full_path: str) -> None:
+        d, n = _split(full_path)
+        self.table.delete(self._rowkey(d, n))
+
+    def delete_folder_children(self, full_path: str) -> None:
+        base = full_path.rstrip("/")
+        for start in (f"{base or '/'}\x00".encode(),
+                      f"{base}/".encode()):
+            for key, _ in list(self.table.scan(
+                    row_start=start, row_stop=_inc_bytes(start))):
+                self.table.delete(key)
+
+    def list_directory_entries(self, dir_path: str, start_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        d = dir_path.rstrip("/") or "/"
+        base = f"{d}\x00".encode()
+        if start_name:
+            start = base + start_name.encode() + \
+                (b"" if include_start else b"\x00")
+        else:
+            start = base + prefix.encode()
+        stop = _inc_bytes(base + prefix.encode() if prefix else base)
+        out = []
+        for key, data in self.table.scan(row_start=start, row_stop=stop,
+                                         limit=limit):
+            name = key.decode().split("\x00", 1)[1]
+            if prefix and not name.startswith(prefix):
+                continue
+            out.append(Entry.from_dict(json.loads(data[self.COL])))
+            if len(out) >= limit:
+                break
+        return out
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self.table.put(b"\x00kv\x00" + key, {self.COL: value})
+
+    def kv_get(self, key: bytes) -> bytes:
+        row = self.table.row(b"\x00kv\x00" + key)
+        if not row:
+            raise NotFound(repr(key))
+        return row[self.COL]
+
+    def kv_delete(self, key: bytes) -> None:
+        self.table.delete(b"\x00kv\x00" + key)
+
+
+class Elastic7Store(FilerStore):
+    """`client`: an elasticsearch-py (v7) shaped object — index/get/
+    delete/search/delete_by_query keyword-argument API."""
+    name = "elastic7"
+
+    META_INDEX = "filemeta"
+    KV_INDEX = "filer_kv"
+
+    def __init__(self, client=None, **conn_kw):
+        if client is None:
+            try:
+                import elasticsearch  # type: ignore
+            except ImportError as e:
+                raise RuntimeError(
+                    "elastic7 filer store needs elasticsearch installed; "
+                    "configuration is otherwise complete") from e
+            client = elasticsearch.Elasticsearch(**conn_kw)
+        self.es = client
+        self._ensure_mappings()
+
+    def _ensure_mappings(self) -> None:
+        """directory/name must be KEYWORD fields: dynamic text mapping
+        tokenizes paths (term queries miss) and forbids sorting.  The
+        reference creates its index with an explicit mapping too
+        (elastic_store.go initialize)."""
+        indices = getattr(self.es, "indices", None)
+        create = getattr(indices, "create", None)
+        if create is None:      # narrow injected fakes map exactly
+            return
+        try:
+            create(index=self.META_INDEX, body={"mappings": {
+                "properties": {
+                    "directory": {"type": "keyword"},
+                    "name": {"type": "keyword"},
+                    "meta": {"type": "keyword", "index": False},
+                }}}, ignore=400)   # 400 = already exists
+            create(index=self.KV_INDEX, body={"mappings": {
+                "properties": {"v": {"type": "keyword",
+                                     "index": False}}}}, ignore=400)
+        except Exception:
+            # index may pre-exist on a cluster rejecting `ignore`
+            pass
+
+    @staticmethod
+    def _id(full_path: str) -> str:
+        p = full_path.rstrip("/") or "/"
+        return base64.urlsafe_b64encode(p.encode()).decode()
+
+    @staticmethod
+    def _missing(e: Exception) -> bool:
+        """Only a 404/NotFoundError means 'no such document'; anything
+        else (connection refused, timeouts, 5xx) must propagate — a
+        transient outage reported as NotFound would let create paths
+        clobber existing metadata."""
+        return (getattr(e, "status_code", None) == 404
+                or type(e).__name__ == "NotFoundError"
+                or isinstance(e, KeyError))
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, n = _split(entry.full_path)
+        self.es.index(index=self.META_INDEX, id=self._id(entry.full_path),
+                      body={"directory": d or "/", "name": n,
+                            "meta": json.dumps(entry.to_dict())})
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        try:
+            doc = self.es.get(index=self.META_INDEX,
+                              id=self._id(full_path))
+        except Exception as e:
+            if self._missing(e):
+                raise NotFound(full_path) from e
+            raise
+        if not doc or not doc.get("found", True):
+            raise NotFound(full_path)
+        return Entry.from_dict(json.loads(doc["_source"]["meta"]))
+
+    def delete_entry(self, full_path: str) -> None:
+        try:
+            self.es.delete(index=self.META_INDEX, id=self._id(full_path))
+        except Exception as e:
+            if not self._missing(e):
+                raise
+
+    def delete_folder_children(self, full_path: str) -> None:
+        base = full_path.rstrip("/") or "/"
+        self.es.delete_by_query(index=self.META_INDEX, body={
+            "query": {"term": {"directory": base}}})
+        self.es.delete_by_query(index=self.META_INDEX, body={
+            "query": {"prefix": {"directory": base.rstrip("/") + "/"}}})
+
+    def list_directory_entries(self, dir_path: str, start_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        d = dir_path.rstrip("/") or "/"
+        must: list[dict] = [{"term": {"directory": d}}]
+        if prefix:
+            must.append({"prefix": {"name": prefix}})
+        if start_name:
+            op = "gte" if include_start else "gt"
+            must.append({"range": {"name": {op: start_name}}})
+        res = self.es.search(index=self.META_INDEX, body={
+            "query": {"bool": {"filter": must}},
+            "sort": [{"name": "asc"}], "size": limit})
+        return [Entry.from_dict(json.loads(h["_source"]["meta"]))
+                for h in res["hits"]["hits"]]
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self.es.index(index=self.KV_INDEX, id=key.hex(),
+                      body={"v": base64.b64encode(value).decode()})
+
+    def kv_get(self, key: bytes) -> bytes:
+        try:
+            doc = self.es.get(index=self.KV_INDEX, id=key.hex())
+        except Exception as e:
+            if self._missing(e):
+                raise NotFound(repr(key)) from e
+            raise
+        if not doc or not doc.get("found", True):
+            raise NotFound(repr(key))
+        return base64.b64decode(doc["_source"]["v"])
+
+    def kv_delete(self, key: bytes) -> None:
+        try:
+            self.es.delete(index=self.KV_INDEX, id=key.hex())
+        except Exception as e:
+            if not self._missing(e):
+                raise
+
+
+class TikvStore(FilerStore):
+    """`client`: a tikv-client RawKV-shaped object — put/get/delete over
+    bytes, `scan(start, end, limit) -> [(key, value)]`, and
+    `delete_range(start, end)`."""
+    name = "tikv"
+
+    def __init__(self, client=None, **conn_kw):
+        if client is None:
+            try:
+                import tikv_client  # type: ignore
+            except ImportError as e:
+                raise RuntimeError(
+                    "tikv filer store needs tikv-client installed; "
+                    "configuration is otherwise complete") from e
+            client = tikv_client.RawClient.connect(**conn_kw)
+        self.client = client
+
+    @staticmethod
+    def _key(d: str, n: str) -> bytes:
+        return b"m" + (d or "/").encode() + b"\x00" + n.encode()
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, n = _split(entry.full_path)
+        self.client.put(self._key(d, n),
+                        json.dumps(entry.to_dict()).encode())
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        d, n = _split(full_path)
+        v = self.client.get(self._key(d, n))
+        if v is None:
+            raise NotFound(full_path)
+        return Entry.from_dict(json.loads(v))
+
+    def delete_entry(self, full_path: str) -> None:
+        d, n = _split(full_path)
+        self.client.delete(self._key(d, n))
+
+    def delete_folder_children(self, full_path: str) -> None:
+        base = full_path.rstrip("/")
+        for start in (b"m" + (base or "/").encode() + b"\x00",
+                      b"m" + base.encode() + b"/"):
+            self.client.delete_range(start, _inc_bytes(start))
+
+    def list_directory_entries(self, dir_path: str, start_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        d = dir_path.rstrip("/") or "/"
+        base = b"m" + d.encode() + b"\x00"
+        if start_name:
+            start = base + start_name.encode() + \
+                (b"" if include_start else b"\x00")
+        else:
+            start = base + prefix.encode()
+        end = _inc_bytes(base + prefix.encode() if prefix else base)
+        out = []
+        for key, value in self.client.scan(start, end, limit):
+            name = key.decode().split("\x00", 1)[1]
+            if prefix and not name.startswith(prefix):
+                continue
+            out.append(Entry.from_dict(json.loads(value)))
+            if len(out) >= limit:
+                break
+        return out
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self.client.put(b"k" + key, value)
+
+    def kv_get(self, key: bytes) -> bytes:
+        v = self.client.get(b"k" + key)
+        if v is None:
+            raise NotFound(repr(key))
+        return bytes(v)
+
+    def kv_delete(self, key: bytes) -> None:
+        self.client.delete(b"k" + key)

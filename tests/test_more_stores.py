@@ -1,0 +1,287 @@
+"""Cassandra / HBase / Elastic7 / TiKV filer stores
+(filer/more_stores.py) against in-process fakes shaped like their real
+drivers — the same conformance contract the rest of the store matrix
+runs (test_kv_stores.py, test_redis_store.py)."""
+
+import re
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer import Filer
+from seaweedfs_tpu.filer.entry import Attr, Entry
+from seaweedfs_tpu.filer.filerstore import STORES, NotFound
+from seaweedfs_tpu.filer.more_stores import (CassandraStore,
+                                             Elastic7Store, HBaseStore,
+                                             TikvStore)
+
+
+# -- cassandra-driver Session fake -----------------------------------------
+
+class FakeCqlSession:
+    """Supports exactly the CQL the store issues: single-partition
+    INSERT/SELECT/DELETE on filemeta(directory, name, meta) and
+    filer_kv(key, value), with clustering-order name slices + LIMIT."""
+
+    def __init__(self):
+        self.filemeta: dict[tuple[str, str], str] = {}
+        self.filer_kv: dict[str, bytes] = {}
+
+    def execute(self, cql, params=()):
+        c = " ".join(cql.split())
+        if c.startswith("INSERT INTO filemeta"):
+            d, n, meta = params
+            self.filemeta[(d, n)] = meta
+            return []
+        if c.startswith("INSERT INTO filer_kv"):
+            k, v = params
+            self.filer_kv[k] = bytes(v)
+            return []
+        if c.startswith("SELECT meta FROM filemeta"):
+            d, n = params
+            got = self.filemeta.get((d, n))
+            return [] if got is None else [{"meta": got}]
+        if c.startswith("SELECT value FROM filer_kv"):
+            got = self.filer_kv.get(params[0])
+            return [] if got is None else [{"value": got}]
+        if c.startswith("DELETE FROM filer_kv"):
+            self.filer_kv.pop(params[0], None)
+            return []
+        if c.startswith("DELETE FROM filemeta WHERE directory=%s AND"):
+            self.filemeta.pop((params[0], params[1]), None)
+            return []
+        if c.startswith("DELETE FROM filemeta WHERE directory=%s"):
+            for key in [k for k in self.filemeta if k[0] == params[0]]:
+                del self.filemeta[key]
+            return []
+        m = re.match(
+            r"SELECT name(?:, meta)? FROM filemeta WHERE directory=%s"
+            r"(?P<conds>.*?)(?: LIMIT %s)?$", c)
+        assert m, c
+        params = list(params)
+        d = params.pop(0)
+        rows = sorted((n, meta) for (dd, n), meta in self.filemeta.items()
+                      if dd == d)
+        for cond in re.findall(r"AND name (>=|>|<) %s", m["conds"]):
+            arg = params.pop(0)
+            op = {">": lambda n, a: n > a, ">=": lambda n, a: n >= a,
+                  "<": lambda n, a: n < a}[cond]
+            rows = [(n, meta) for n, meta in rows if op(n, arg)]
+        if "LIMIT" in c:
+            rows = rows[:params.pop(0)]
+        if c.startswith("SELECT name, meta"):
+            return [{"name": n, "meta": meta} for n, meta in rows]
+        return [{"name": n} for n, _ in rows]
+
+
+# -- happybase fakes -------------------------------------------------------
+
+class FakeHBaseTable:
+    def __init__(self):
+        self.rows: dict[bytes, dict] = {}
+
+    def put(self, row, data):
+        self.rows[row] = dict(data)
+
+    def row(self, row):
+        return self.rows.get(row, {})
+
+    def delete(self, row):
+        self.rows.pop(row, None)
+
+    def scan(self, row_start=b"", row_stop=None, limit=None):
+        n = 0
+        for k in sorted(self.rows):
+            if k < row_start:
+                continue
+            if row_stop is not None and k >= row_stop:
+                break
+            yield k, self.rows[k]
+            n += 1
+            if limit and n >= limit:
+                break
+
+
+class FakeHBase:
+    def __init__(self):
+        self._tables = {}
+
+    def table(self, name):
+        return self._tables.setdefault(name, FakeHBaseTable())
+
+
+# -- elasticsearch-py (v7) fake --------------------------------------------
+
+class FakeEs:
+    def __init__(self):
+        self.indices: dict[str, dict[str, dict]] = {}
+
+    def index(self, index, id, body):
+        self.indices.setdefault(index, {})[id] = dict(body)
+
+    def get(self, index, id):
+        docs = self.indices.get(index, {})
+        if id not in docs:
+            raise KeyError(id)          # driver raises NotFoundError
+        return {"found": True, "_source": docs[id]}
+
+    def delete(self, index, id):
+        self.indices.get(index, {}).pop(id, None)
+
+    def _match(self, doc, clause):
+        if "term" in clause:
+            ((f, v),) = clause["term"].items()
+            return doc.get(f) == v
+        if "prefix" in clause:
+            ((f, v),) = clause["prefix"].items()
+            return str(doc.get(f, "")).startswith(v)
+        if "range" in clause:
+            ((f, conds),) = clause["range"].items()
+            v = doc.get(f)
+            for op, arg in conds.items():
+                if op == "gt" and not v > arg:
+                    return False
+                if op == "gte" and not v >= arg:
+                    return False
+            return True
+        raise AssertionError(clause)
+
+    def _filtered(self, index, query):
+        docs = self.indices.get(index, {})
+        clauses = query["bool"]["filter"] if "bool" in query else [query]
+        return [(i, d) for i, d in docs.items()
+                if all(self._match(d, cl) for cl in clauses)]
+
+    def search(self, index, body):
+        hits = self._filtered(index, body["query"])
+        for spec in reversed(body.get("sort", [])):
+            ((f, order),) = spec.items()
+            hits.sort(key=lambda p: p[1].get(f),
+                      reverse=order == "desc")
+        hits = hits[:body.get("size", 10)]
+        return {"hits": {"hits": [{"_id": i, "_source": d}
+                                  for i, d in hits]}}
+
+    def delete_by_query(self, index, body):
+        for i, _ in self._filtered(index, body["query"]):
+            self.indices[index].pop(i, None)
+
+
+# -- tikv RawClient fake ---------------------------------------------------
+
+class FakeTikv:
+    def __init__(self):
+        self.kv: dict[bytes, bytes] = {}
+
+    def put(self, k, v):
+        self.kv[bytes(k)] = bytes(v)
+
+    def get(self, k):
+        return self.kv.get(bytes(k))
+
+    def delete(self, k):
+        self.kv.pop(bytes(k), None)
+
+    def scan(self, start, end, limit):
+        out = []
+        for k in sorted(self.kv):
+            if start <= k < end:
+                out.append((k, self.kv[k]))
+                if limit and len(out) >= limit:
+                    break
+        return out
+
+    def delete_range(self, start, end):
+        for k in [k for k in self.kv if start <= k < end]:
+            del self.kv[k]
+
+
+FACTORIES = {
+    "cassandra": lambda: CassandraStore(client=FakeCqlSession()),
+    "hbase": lambda: HBaseStore(client=FakeHBase()),
+    "elastic7": lambda: Elastic7Store(client=FakeEs()),
+    "tikv": lambda: TikvStore(client=FakeTikv()),
+}
+
+
+@pytest.fixture(params=sorted(FACTORIES))
+def store(request):
+    return FACTORIES[request.param]()
+
+
+def test_registry_has_all():
+    assert {"cassandra", "hbase", "elastic7", "tikv"} <= set(STORES)
+
+
+@pytest.mark.parametrize("kind", sorted(FACTORIES))
+def test_config_only_without_driver(kind):
+    with pytest.raises(RuntimeError, match="installed"):
+        STORES[kind](host="db.example")
+
+
+def test_contract_crud_listing(store):
+    f = Filer(store)
+    now = time.time()
+    for name in ("b", "a", "c", "ab"):
+        f.create_entry(Entry(full_path=f"/dir/{name}",
+                             attr=Attr(mtime=now, crtime=now)))
+    assert [e.name for e in f.list_entries("/dir")] == ["a", "ab", "b", "c"]
+    assert [e.name for e in f.list_entries("/dir", start_name="a",
+                                           limit=2)] == ["ab", "b"]
+    assert [e.name for e in f.list_entries("/dir", prefix="a")] \
+        == ["a", "ab"]
+    assert f.find_entry("/dir").is_directory()
+    f.delete_entry("/dir/b")
+    with pytest.raises(NotFound):
+        store.find_entry("/dir/b")
+
+
+def test_contract_recursive_delete(store):
+    f = Filer(store)
+    now = time.time()
+    for p in ("/x/a/f1", "/x/a/b/f2", "/x/f3", "/y/keep"):
+        f.create_entry(Entry(full_path=p, attr=Attr(mtime=now, crtime=now)))
+    store.delete_folder_children("/x")
+    for p in ("/x/a", "/x/a/f1", "/x/a/b/f2", "/x/f3"):
+        with pytest.raises(NotFound):
+            store.find_entry(p)
+    assert store.find_entry("/y/keep")
+
+
+def test_contract_kv(store):
+    store.kv_put(b"\x01k", b"v\x00v")
+    assert store.kv_get(b"\x01k") == b"v\x00v"
+    store.kv_delete(b"\x01k")
+    with pytest.raises(NotFound):
+        store.kv_get(b"\x01k")
+
+
+def test_contract_update_overwrites(store):
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/u/x", attr=Attr(mtime=1, crtime=1)))
+    e = store.find_entry("/u/x")
+    e.attr.mtime = 99
+    store.update_entry(e)
+    assert store.find_entry("/u/x").attr.mtime == 99
+    assert len(list(store.list_directory_entries("/u"))) == 1
+
+
+def test_contract_paginated_walk(store):
+    """Page-by-page walk with start_name cursors — every store family
+    must paginate with server-side seeks (range/slice/scan), mirroring
+    tests/test_kv_stores.py's etcd accounting test."""
+    f = Filer(store)
+    now = time.time()
+    n, page = 300, 37
+    for i in range(n):
+        f.create_entry(Entry(full_path=f"/big/e{i:04d}",
+                             attr=Attr(mtime=now, crtime=now)))
+    seen, cursor = [], ""
+    while True:
+        entries = store.list_directory_entries("/big", start_name=cursor,
+                                               limit=page)
+        if not entries:
+            break
+        seen += [e.name for e in entries]
+        cursor = entries[-1].name
+    assert seen == [f"e{i:04d}" for i in range(n)]
