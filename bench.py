@@ -392,7 +392,10 @@ def main():
 
                 from seaweedfs_tpu.ops import clay_structured
                 small = 1 << 20          # production small block
-                wps = 16 << 20           # bytes per shard per call
+                # bench-scale calls: the tunnel charges ~60-100ms fixed
+                # per dispatched call, so small calls measure overhead,
+                # not the kernel (BENCH_NOTES.md round-3 finding)
+                wps = 128 << 20          # bytes per shard per call
                 cfn = jax.jit(_ft.partial(
                     clay_structured.encode_device, k, m, small=small))
                 cd = jax.jit(lambda key: jax.random.randint(

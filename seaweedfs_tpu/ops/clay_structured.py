@@ -132,36 +132,73 @@ def _gf_const_mul(const: int, x):
 
 
 @functools.lru_cache(maxsize=8)
-def _device_consts(k: int, m: int) -> tuple:
+def _r_bits(k: int, m: int) -> np.ndarray:
+    """R's bit-matrix (numpy on purpose: caching device arrays that may
+    first materialize inside a jit trace leaks tracers)."""
+    from . import rs_matrix
+    c = code(k, m)
+    return rs_matrix.bit_matrix(np.ascontiguousarray(c.gen[c.k0:]))
+
+
+@functools.lru_cache(maxsize=8)
+def _r_bits_plane_major(k: int, m: int) -> np.ndarray:
+    """R's bit-matrix in the plane-major form the fused Pallas kernel
+    consumes (rs_pallas.to_plane_major); numpy for the same reason."""
+    from . import rs_pallas
+    c = code(k, m)
+    return rs_pallas.to_plane_major(_r_bits(k, m), m, c.k0)
+
+
+def _layer_mds_matmul(k: int, m: int, u, k0: int):
+    """u [k0, N] -> [m, N] through the GF bit-plane engine.
+
+    On TPU this is the fused shard-major Pallas kernel — bit planes are
+    expanded in VMEM, so it runs at the RS headline rate instead of
+    materializing 8x int8 planes + an int32 accumulator in HBM (the
+    XLA path measured ~2 GB/s end to end; the kernel path is what makes
+    the structured encode actually alpha-times faster in practice, not
+    just in FLOP counts).  CPU (tests, shard_map dryrun) keeps XLA."""
     import jax.numpy as jnp
 
-    from . import rs_matrix
-    unc_src, unc_mask, R, cpl_src, cpl_mask, det_inv = encode_parts(k, m)
-    return (jnp.asarray(unc_src), jnp.asarray(unc_mask),
-            jnp.asarray(rs_matrix.bit_matrix(R)),
-            jnp.asarray(cpl_src), jnp.asarray(cpl_mask), det_inv)
+    from . import rs_jax, rs_pallas
+    from .codec import _tpu_available
+    on_tpu = _tpu_available()
+    n = u.shape[-1]
+    if not on_tpu:
+        return rs_jax.gf_matmul_bits(jnp.asarray(_r_bits(k, m)), u,
+                                     dot_dtype=jnp.int8)
+    block = 8 * rs_pallas.SM_DEFAULT_BLOCK_B
+    pad = (-n) % block
+    if pad:
+        u = jnp.pad(u, ((0, 0), (0, pad)))
+    sm = u.reshape(k0, 8, -1)   # device relayout: one HBM-speed copy
+    out = rs_pallas.gf_matmul_bits_pallas_sm(
+        jnp.asarray(_r_bits_plane_major(k, m), dtype=jnp.int8), sm)
+    out = out.reshape(m, -1)
+    return out[:, :n] if pad else out
 
 
-def _pair_swap(arr, q: int, t: int, y: int):
+def _pair_swap(arr, q: int, t: int, y: int, off: int = 0):
     """The clay companion permutation at grid row y, as a TRANSPOSE.
 
-    arr [q, q, .., q, b']: axis 0 is the node's x coordinate, axes
-    1..t are the layer digits z_{t-1} .. z_0.  The companion of cell
-    (x, z) swaps x with digit z_y — i.e. axis 0 with axis 1 + (t-1-y).
-    A static transpose runs at HBM copy speed where a row gather
-    (jnp.take over 3072 rows) lowered ~20x slower."""
+    arr [q, <off axes>, q, .., q, ..]: axis 0 is the node's x
+    coordinate; after `off` spectator axes come the layer digits
+    z_{t-1} .. z_0.  The companion of cell (x, z) swaps x with digit
+    z_y — i.e. axis 0 with axis 1 + off + (t-1-y).  A static transpose
+    runs at HBM copy speed where a row gather (jnp.take over 3072 rows)
+    lowered ~20x slower."""
     import jax.numpy as jnp
-    return jnp.swapaxes(arr, 0, 1 + (t - 1 - y))
+    return jnp.swapaxes(arr, 0, 1 + off + (t - 1 - y))
 
 
-def _diag_mask(q: int, t: int, y: int):
-    """Boolean [q, q, .., q, 1] mask of diagonal cells (x == z_y) in the
-    _pair_swap layout (uncoupled == stored there)."""
+def _diag_mask(q: int, t: int, y: int, off: int = 0):
+    """Boolean [q, 1*off, q, .., q, 1, 1] mask of diagonal cells
+    (x == z_y) in the _pair_swap layout (uncoupled == stored there)."""
     import jax
     import jax.numpy as jnp
-    shape = (q,) * (1 + t) + (1,)
+    shape = (q,) + (1,) * off + (q,) * t + (1, 1)
     x = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
-    zy = jax.lax.broadcasted_iota(jnp.int32, shape, 1 + (t - 1 - y))
+    zy = jax.lax.broadcasted_iota(jnp.int32, shape, 1 + off + (t - 1 - y))
     return x == zy
 
 
@@ -169,44 +206,47 @@ def encode_device(k: int, m: int, data, *, small: int):
     """Jittable structured encode over raw window bytes.
 
     data [k, W] uint8 (W a multiple of the small block) laid out as
-    write_ec_files streams it; returns parity [m, W].  The symbol
-    transpose ([k, n_win, α, w_a] -> [k, α, n_win·w_a]) rides the device
-    (HBM-bandwidth copies) instead of the host, and the coupling
-    permutations are axis swaps (_pair_swap), not gathers.  Byte-axis
-    parallel throughout — safe under shard_map when W is split on window
+    write_ec_files streams it; returns parity [m, W] in the same layout.
+
+    Everything runs in the volume's NATURAL layout — no layer-gather
+    transpose at either end, which measured ~100 ms per 160MB on its own
+    (the whole throughput budget): the per-layer MDS matmul applies the
+    same matrix to every column, so column ORDER is irrelevant to it,
+    and the uncouple/couple steps address the layer structure in place
+    by splitting each window's alpha axis into its q-ary digits
+    ([k0, n_win, q, .., q, w_a1, 128]).  Two more layout rules hold the
+    throughput: the trailing two dims stay a dense (w_a1, 128) u8 tile
+    (digit-sized trailing dims pad 8x in HBM), and the companion
+    permutation is an axis swap, not a gather.  Byte-axis parallel
+    throughout — safe under shard_map when W splits on window
     boundaries."""
     import jax.numpy as jnp
 
-    from . import rs_jax
     c = code(k, m)
     alpha, k0, q, t = c.alpha, c.k0, c.q, c.t
-    r_bits = _device_consts(k, m)[2]
     w = data.shape[-1]
     n_win, w_a = w // small, small // alpha
-    b = n_win * w_a
-    sym = data.reshape(k, n_win, alpha, w_a).transpose(0, 2, 1, 3) \
-        .reshape(k, alpha, b)
+    inner = 128 if w_a % 128 == 0 else 1
+    w_i = w_a // inner
     flat_c = jnp.concatenate(
-        [sym, jnp.zeros((k0 - k, alpha, b), jnp.uint8)])
-    # [k0, alpha, b] -> [y, x, z_{t-1}, .., z_0, b] (node i = y*q + x;
+        [data.reshape(k, n_win, alpha, w_i, inner),
+         jnp.zeros((k0 - k, n_win, alpha, w_i, inner), jnp.uint8)])
+    # -> [y, x, n_win, z_{t-1}, .., z_0, w_i, inner] (node i = y*q + x;
     # digit z_{t-1} owns the largest stride of the layer index)
-    v = flat_c.reshape(t - 1, q, *((q,) * t), b)
+    v = flat_c.reshape(t - 1, q, n_win, *((q,) * t), w_i, inner)
     u_rows = []
     for y in range(t - 1):
         s = v[y]
-        comp = _pair_swap(s, q, t, y)
-        mask = _diag_mask(q, t, y)
+        comp = _pair_swap(s, q, t, y, off=1)
+        mask = _diag_mask(q, t, y, off=1)
         u_rows.append(jnp.where(mask, s,
                                 s ^ _gf_const_mul(GAMMA, comp)))
-    u = jnp.stack(u_rows).reshape(k0, alpha * b)
-    # int8 planes: half the HBM traffic of bf16 and exact (0/1 operands,
-    # partial sums <= 8*k0 accumulated in int32)
-    u_par = rs_jax.gf_matmul_bits(r_bits, u, dot_dtype=jnp.int8)
+    u = jnp.stack(u_rows).reshape(k0, w)
+    u_par = _layer_mds_matmul(k, m, u, k0)
     # parity row y = t-1: companions pair within the row, axis swap again
-    p = u_par.reshape(q, *((q,) * t), b)
-    comp = _pair_swap(p, q, t, t - 1)
-    mask = _diag_mask(q, t, t - 1)
+    p = u_par.reshape(q, n_win, *((q,) * t), w_i, inner)
+    comp = _pair_swap(p, q, t, t - 1, off=1)
+    mask = _diag_mask(q, t, t - 1, off=1)
     c_par = jnp.where(mask, p, _gf_const_mul(
         int(c._det_inv), p ^ _gf_const_mul(GAMMA, comp)))
-    return c_par.reshape(m, alpha, n_win, w_a).transpose(0, 2, 1, 3) \
-        .reshape(m, w)
+    return c_par.reshape(m, w)

@@ -124,8 +124,6 @@ class MeshCodec:
                 dtype=jnp.int8)
         else:
             self._parity_bits = jnp.asarray(pbits)
-        self._enc_mult = sharded_codec.local_block_multiple(
-            self.mesh, ("s", "b"))
         self._rec_mult = sharded_codec.local_block_multiple(self.mesh, ("b",))
 
     # -- helpers ---------------------------------------------------------
@@ -158,17 +156,15 @@ class MeshCodec:
                 np.moveaxis(data, -2, 0)).reshape(self.k, -1)
         else:
             flat = data
-        padded, b = self._pad_cols(flat, self._enc_mult)
-        sm = padded.reshape(self.k, 8, -1)  # free host view -> dense tiling
-        out = _encode_fn(self.mesh)(self._parity_bits, jnp.asarray(sm))
+        inner = _mesh_matmul_begin(self.mesh, self._parity_bits, self.m,
+                                   flat)
+        if not lead:
+            return inner
 
         def fetch():
-            parity = np.asarray(jax.device_get(out)).reshape(
-                self.m, -1)[:, :b]
-            if lead:
-                parity = np.moveaxis(
-                    parity.reshape(self.m, *lead, -1), 0, -2)
-            return np.ascontiguousarray(parity)
+            parity = inner()
+            return np.ascontiguousarray(np.moveaxis(
+                parity.reshape(self.m, *lead, -1), 0, -2))
         return fetch
 
     def reconstruct(self, shards: list[np.ndarray | None], *,
@@ -235,15 +231,93 @@ class MeshCodec:
         return bool(np.array_equal(self.encode(data), parity))
 
 
+@functools.lru_cache(maxsize=16)
+def _clay_mesh_fn(mesh: Mesh, k: int, m: int, small: int):
+    """Jitted byte-DP clay encode: the structured encode_device runs
+    per device under shard_map with the window axis split over every
+    mesh device — clay's whole transform (uncouple, layer-MDS matmul,
+    couple) is window-local, so no collectives."""
+    from ..ops import clay_structured
+
+    def local(data):
+        return clay_structured.encode_device(k, m, data, small=small)
+
+    mapped = shard_map(local, mesh=mesh,
+                       in_specs=P(None, ("s", "b")),
+                       out_specs=P(None, ("s", "b")), check_vma=False)
+    return jax.jit(mapped)
+
+
+def clay_mesh_encode_begin(k: int, m: int, data: np.ndarray, small: int,
+                           mesh: Mesh | None = None):
+    """Multi-chip clay window encode; returns fetch() -> parity [m, W].
+
+    W pads up to whole windows per device (clay is linear, so zero
+    windows encode to zero parity and the pad strips off)."""
+    mesh = mesh if mesh is not None else default_ec_mesh()
+    n_dev = mesh.devices.size
+    w = data.shape[-1]
+    pad = (-w) % (small * n_dev)
+    if pad:
+        data = np.pad(data, ((0, 0), (0, pad)))
+    dev = _clay_mesh_fn(mesh, k, m, small)(jnp.asarray(data))
+
+    def fetch():
+        out = np.asarray(jax.device_get(dev))
+        return np.ascontiguousarray(out[:, :w]) if pad else out
+    return fetch
+
+
+def _mesh_matmul_begin(mesh: Mesh, bits_dev, mo: int, flat: np.ndarray):
+    """Shared core of every mesh byte-DP encode (MeshCodec RS parity and
+    the generic/LRC matrix path): pad to the mesh's local block multiple,
+    dense shard-major relayout, dispatch, deferred fetch+strip."""
+    mult = sharded_codec.local_block_multiple(mesh, ("s", "b"))
+    ki = flat.shape[0]
+    b = flat.shape[-1]
+    pad = (-b) % mult
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    sm = flat.reshape(ki, 8, -1)   # free host view -> dense tiling
+    out = _encode_fn(mesh)(bits_dev, jnp.asarray(sm))
+
+    def fetch():
+        parity = np.asarray(jax.device_get(out)).reshape(mo, -1)[:, :b]
+        return np.ascontiguousarray(parity)
+    return fetch
+
+
+def gf_mesh_encode_begin(M: np.ndarray, data: np.ndarray,
+                         mesh: Mesh | None = None):
+    """Generic parity = M ∘GF∘ data[ki, B] with the byte axis split over
+    every mesh device — the LRC window codec's multi-chip path (LRC
+    encode is scalar per byte column, exactly like RS, just a different
+    matrix).  Returns fetch() -> [mo, B]."""
+    mesh = mesh if mesh is not None else default_ec_mesh()
+    mo, ki = M.shape
+    bits = rs_matrix.bit_matrix(np.ascontiguousarray(M))
+    if sharded_codec.mesh_is_tpu(mesh):
+        bits_dev = jnp.asarray(rs_pallas.to_plane_major(bits, mo, ki),
+                               dtype=jnp.int8)
+    else:
+        bits_dev = jnp.asarray(bits)
+    return _mesh_matmul_begin(mesh, bits_dev, mo, data)
+
+
+def multi_device_host() -> bool:
+    """One definition of 'this process sees a device mesh' shared by the
+    RS picker and the clay/LRC window codecs."""
+    try:
+        return len(jax.devices()) > 1
+    except RuntimeError:
+        return False
+
+
 def codec_for_devices(k: int, m: int, *, kind: str = "vandermonde"):
     """The production codec picker: MeshCodec when this process sees more
     than one device (driver dryrun, multi-chip hosts), single-chip RSCodec
     (pallas on TPU, XLA elsewhere) otherwise."""
-    try:
-        multi = len(jax.devices()) > 1
-    except RuntimeError:
-        multi = False
-    if multi:
+    if multi_device_host():
         return MeshCodec(k, m, kind=kind)
     from ..ops.codec import RSCodec
     return RSCodec(k, m, kind=kind)
