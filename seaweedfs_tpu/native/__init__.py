@@ -130,3 +130,70 @@ crc32c = _crc32c if _crc_available() else None
 def lib():
     """The raw ctypes CDLL, or None."""
     return _load()
+
+
+# -- CPython extension for the TCP frame hot loop --------------------------
+# (separate .so: it links against Python.h, unlike the plain-ABI library)
+
+_FP_SO = os.path.join(_DIR, "_seaweed_fastpath.so")
+_fp = None
+_fp_tried = False
+
+
+def _build_fastpath() -> "str | None":
+    src = os.path.join(_DIR, "fastpath.c")
+    if not os.path.exists(src):
+        return None
+    if os.path.exists(_FP_SO) and \
+            os.path.getmtime(src) <= os.path.getmtime(_FP_SO):
+        return _FP_SO
+    import sysconfig
+    inc = sysconfig.get_paths()["include"]
+    tmp = _FP_SO + ".tmp"
+    cmd = ["gcc", "-O2", "-march=native", "-shared", "-fPIC",
+           f"-I{inc}", src, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _FP_SO)
+    except Exception as e:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        # same invariant as build(): serving a stale .so is better than
+        # regressing to the Python fallbacks, but NEVER silently — a
+        # broken source edit must not quietly test the old binary
+        import warnings
+        detail = getattr(e, "stderr", b"")
+        detail = detail.decode(errors="replace")[-400:] \
+            if isinstance(detail, bytes) else str(e)
+        warnings.warn(f"fastpath rebuild failed, "
+                      f"{'serving stale .so' if os.path.exists(_FP_SO) else 'disabled'}: "
+                      f"{detail}", RuntimeWarning)
+        return _FP_SO if os.path.exists(_FP_SO) else None
+    return _FP_SO
+
+
+def fastpath():
+    """The _seaweed_fastpath extension module (C frame loop), or None —
+    callers (volume_server/tcp.py, operation) fall back to the Python
+    frame codecs when the build is unavailable."""
+    global _fp, _fp_tried
+    with _lock:
+        if _fp_tried:
+            return _fp
+        _fp_tried = True
+        so = _build_fastpath()
+        if so is None:
+            return None
+        try:
+            from importlib.machinery import ExtensionFileLoader
+            from importlib.util import module_from_spec, spec_from_loader
+            loader = ExtensionFileLoader("_seaweed_fastpath", so)
+            spec = spec_from_loader("_seaweed_fastpath", loader)
+            mod = module_from_spec(spec)
+            loader.exec_module(mod)
+            _fp = mod
+        except Exception:
+            _fp = None
+        return _fp

@@ -72,38 +72,71 @@ def upload_data(url_or_server: str, fid: str, data: bytes,
 import threading as _threading
 
 _TCP_LOCAL = _threading.local()
+_FP_CACHE: list = []   # [module | None], resolved once — native.fastpath()
+                       # takes a process-global lock per call
+
+
+def _fastpath():
+    if not _FP_CACHE:
+        from .. import native
+        _FP_CACHE.append(native.fastpath())
+    return _FP_CACHE[0]
 
 
 def _tcp_sock(addr: str):
-    """-> (socket, buffered reader).  The reader (socket.makefile('rb'))
-    keeps reply parsing inside CPython's C BufferedReader — the recv
-    loops were a measurable slice of the per-read overhead."""
+    """-> (socket, buffered reader, C conn ctx | None).  Reply parsing
+    happens in the native C frame loop when available (one C call per
+    round trip, native/fastpath.c), else inside CPython's C
+    BufferedReader — the Python recv loops were a measurable slice of
+    the per-read overhead."""
     import socket as _socket
     socks = getattr(_TCP_LOCAL, "socks", None)
     if socks is None:
         socks = _TCP_LOCAL.socks = {}
-    pair = socks.get(addr)
-    if pair is None:
+    trio = socks.get(addr)
+    if trio is None:
         host, _, port = addr.rpartition(":")
         sock = _socket.create_connection((host, int(port)), timeout=30)
         sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-        pair = socks[addr] = (sock, sock.makefile("rb"))
-    return pair
+        fp = _fastpath()
+        ctx = rf = None
+        if fp is not None:
+            # the C loop needs a BLOCKING fd (a Python-level timeout
+            # flips the socket non-blocking and raw recv sees EAGAIN);
+            # keep the 30s guard at the OS level instead
+            import struct as _struct
+            sock.settimeout(None)
+            tv = _struct.pack("ll", 30, 0)
+            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVTIMEO, tv)
+            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDTIMEO, tv)
+            ctx = fp.conn_new(sock.fileno())
+        else:
+            # only built when the C ctx is absent: two readers on one
+            # socket would steal bytes from each other
+            rf = sock.makefile("rb")
+        trio = socks[addr] = (sock, rf, ctx)
+    return trio
+
+
+def _tcp_call_once(addr: str, op: str, fid: str, jwt: str,
+                   body: bytes) -> tuple[int, bytes]:
+    sock, rf, ctx = _tcp_sock(addr)
+    if ctx is not None:
+        return _fastpath().request(
+            ctx, ord(op), fid.encode(), jwt.encode(), body)
+    from ..volume_server.tcp import read_reply_buf, write_frame
+    write_frame(sock, op, fid, jwt, body)
+    return read_reply_buf(rf)
 
 
 def _tcp_call(addr: str, op: str, fid: str, jwt: str = "",
               body: bytes = b"") -> bytes:
-    from ..volume_server.tcp import read_reply_buf, write_frame
     try:
-        sock, rf = _tcp_sock(addr)
-        write_frame(sock, op, fid, jwt, body)
-        status, payload = read_reply_buf(rf)
+        status, payload = _tcp_call_once(addr, op, fid, jwt, body)
     except (OSError, ConnectionError):
         # drop the broken connection; retry once on a fresh one
         getattr(_TCP_LOCAL, "socks", {}).pop(addr, None)
-        sock, rf = _tcp_sock(addr)
-        write_frame(sock, op, fid, jwt, body)
-        status, payload = read_reply_buf(rf)
+        status, payload = _tcp_call_once(addr, op, fid, jwt, body)
     if status != 0:
         raise RuntimeError(
             f"tcp {op} {fid} @ {addr}: "
@@ -125,13 +158,13 @@ def upload_batch_tcp(tcp_addr: str, items: "list[tuple[str, bytes]]",
     the dominant cost for 1KB blobs.  Returns error strings ('' = ok)
     per item."""
     from ..volume_server.tcp import read_reply_buf, write_frame
-    sock, rf = _tcp_sock(tcp_addr)
+    sock, rf, ctx = _tcp_sock(tcp_addr)
     try:
         for fid, data in items:
             write_frame(sock, "W", fid, jwt, data)
         out = []
         for _ in items:
-            status, payload = read_reply_buf(rf)
+            status, payload = _read_reply_any(rf, ctx)
             out.append("" if status == 0
                        else payload.decode(errors="replace"))
         return out
@@ -140,17 +173,27 @@ def upload_batch_tcp(tcp_addr: str, items: "list[tuple[str, bytes]]",
         raise
 
 
+def _read_reply_any(rf, ctx):
+    """One reply via the C conn when it exists (its userspace buffer and
+    the Python BufferedReader must never both read the same socket), the
+    buffered reader otherwise."""
+    if ctx is not None:
+        return _fastpath().read_reply(ctx)
+    from ..volume_server.tcp import read_reply_buf
+    return read_reply_buf(rf)
+
+
 def read_batch_tcp(tcp_addr: str, fids: list[str]
                    ) -> "list[bytes | None]":
     """Pipelined reads; None for per-fid errors."""
-    from ..volume_server.tcp import read_reply_buf, write_frame
-    sock, rf = _tcp_sock(tcp_addr)
+    from ..volume_server.tcp import write_frame
+    sock, rf, ctx = _tcp_sock(tcp_addr)
     try:
         for fid in fids:
             write_frame(sock, "R", fid)
         out: "list[bytes | None]" = []
         for _ in fids:
-            status, payload = read_reply_buf(rf)
+            status, payload = _read_reply_any(rf, ctx)
             out.append(payload if status == 0 else None)
         return out
     except (OSError, ConnectionError):

@@ -210,6 +210,15 @@ class Store:
             raise NotFoundError(f"volume {vid} not found")
         return v.read_needle(n_id, cookie)
 
+    def read_volume_needle_data(self, vid: int, n_id: int,
+                                cookie: int | None = None) -> bytes:
+        """Blob bytes via the native fast parse (volume.read_needle_data)
+        — the TCP read handler's path."""
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        return v.read_needle_data(n_id, cookie)
+
     def delete_volume_needle(self, vid: int, n_id: int,
                              cookie: int | None = None) -> int:
         v = self.find_volume(vid)

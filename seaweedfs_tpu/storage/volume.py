@@ -258,6 +258,12 @@ class Volume:
         if nv is None or nv.offset == 0 or t.size_is_deleted(nv.size):
             raise NotFoundError(f"needle {n_id:x} not found in volume {self.id}")
         n = Needle.read_from(self.data_backend, nv.offset, nv.size, self.version)
+        self._check_read_needle(n, n_id, cookie)
+        return n
+
+    def _check_read_needle(self, n: Needle, n_id: int,
+                           cookie: "int | None") -> None:
+        """Post-parse read checks, shared by the full and fast paths."""
         if cookie is not None and n.cookie != cookie:
             raise CookieMismatchError(
                 f"cookie mismatch for needle {n_id:x}")
@@ -265,7 +271,39 @@ class Volume:
             expire = n.last_modified + n.ttl.minutes() * 60
             if n.ttl.minutes() and time.time() > expire:
                 raise NotFoundError(f"needle {n_id:x} expired")
-        return n
+
+    def read_needle_data(self, n_id: int,
+                         cookie: "int | None" = None) -> bytes:
+        """Fast-path blob read: just the data bytes.
+
+        The plain-blob common case (no name/mime/ttl/pairs flags) parses
+        + CRC-checks + cookie-checks in ONE native call
+        (native/fastpath.c needle_data); rich needles, v1 volumes and
+        every error path fall back to read_needle, which re-raises the
+        precise error types.  The TCP data server's read handler rides
+        this — the frame protocol can only return bytes anyway."""
+        from .. import native
+        fp = native.fastpath()
+        if fp is None:
+            return bytes(self.read_needle(n_id, cookie).data)
+        with self._lock:
+            nv = self.nm.get(n_id)
+        if nv is None or nv.offset == 0 or t.size_is_deleted(nv.size):
+            raise NotFoundError(
+                f"needle {n_id:x} not found in volume {self.id}")
+        raw = self.data_backend.read_at(
+            t.get_actual_size(nv.size, self.version), nv.offset)
+        try:
+            return fp.needle_data(raw, nv.size, self.version,
+                                  -1 if cookie is None else cookie)
+        except ValueError:
+            # rich needle (flags set) or a mismatch: hydrate from the
+            # buffer ALREADY read — no second disk read — and let the
+            # Python parser/checks raise the precise error types
+            n = Needle()
+            n.read_bytes(raw, nv.offset, nv.size, self.version)
+            self._check_read_needle(n, n_id, cookie)
+            return bytes(n.data)
 
     def has_needle(self, n_id: int) -> bool:
         nv = self.nm.get(n_id)

@@ -421,13 +421,13 @@ class VolumeServer:
             t0 = time.time()
             self.metrics.volume_requests.inc("read")
             try:
-                n = self.store.read_volume_needle(fid.volume_id, fid.key,
-                                                  fid.cookie)
+                data = self.store.read_volume_needle_data(
+                    fid.volume_id, fid.key, fid.cookie)
             except NotFoundError:
                 raise ValueError("not found") from None
             self.metrics.volume_latency.observe("read",
                                                 value=time.time() - t0)
-            return bytes(n.data)
+            return data
         from ..util.http import CIDict
         req = Request(method="GET", path="", query={},
                       headers=CIDict(), body=b"")

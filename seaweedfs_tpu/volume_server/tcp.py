@@ -110,6 +110,29 @@ def write_reply(sock: socket.socket, status: int, payload: bytes) -> None:
     sock.sendall(struct.pack("<BI", status, len(payload)) + payload)
 
 
+def _reply_error_and_drain(conn: socket.socket, msg: str,
+                           send_err) -> None:
+    """Oversize-frame teardown, shared by the Python and native serve
+    loops.  The stream is desynced past an oversize header: best-effort
+    error reply, then drop.  The client has usually already sendall()'d
+    part of the body, and close() with unread bytes in the receive
+    buffer RSTs the queued reply away — so flush a FIN and drain a
+    BOUNDED slice of the junk first (never the claimed gigabytes;
+    discarding costs no memory)."""
+    try:
+        send_err(msg.encode())
+        conn.shutdown(socket.SHUT_WR)
+        conn.settimeout(1.0)
+        drained = 0
+        while drained < (1 << 20):
+            piece = conn.recv(64 << 10)
+            if not piece:
+                break
+            drained += len(piece)
+    except OSError:
+        pass
+
+
 class TcpDataServer:
     """Accept loop + per-connection worker threads over the volume
     server's existing write/read/delete internals."""
@@ -154,31 +177,20 @@ class TcpDataServer:
                              daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        from .. import native
+        fp = native.fastpath()
+        if fp is not None:
+            self._serve_conn_native(conn, fp)
+            return
         rf = conn.makefile("rb")
         try:
             while not self._stop.is_set():
                 try:
                     op, fid, jwt, body = read_frame_buf(rf)
                 except FrameTooLarge as e:
-                    # the stream is desynced past this point: best-effort
-                    # error reply, then drop.  The client has usually
-                    # already sendall()'d part of the body, and close()
-                    # with unread bytes in the receive buffer RSTs the
-                    # queued reply away — so flush a FIN and drain a
-                    # BOUNDED slice of the junk first (never the claimed
-                    # gigabytes; discarding costs no memory).
-                    try:
-                        write_reply(conn, 1, str(e).encode())
-                        conn.shutdown(socket.SHUT_WR)
-                        conn.settimeout(1.0)
-                        drained = 0
-                        while drained < (1 << 20):
-                            piece = conn.recv(64 << 10)
-                            if not piece:
-                                break
-                            drained += len(piece)
-                    except OSError:
-                        pass
+                    _reply_error_and_drain(
+                        conn, str(e),
+                        lambda msg: write_reply(conn, 1, msg))
                     return
                 try:
                     payload = self._handle(op, fid, jwt, body)
@@ -188,6 +200,38 @@ class TcpDataServer:
         except (ConnectionError, OSError):
             pass
         finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_conn_native(self, conn: socket.socket, fp) -> None:
+        """The C frame loop (native/fastpath.c): one C call parses the
+        whole frame (GIL released while blocked in recv), one writes the
+        whole reply — ~8 Python-level calls per op collapse to 2.  The
+        oversize-frame handling mirrors the Python loop: bounded drain,
+        error reply, drop (the stream is desynced)."""
+        ctx = fp.conn_new(conn.fileno())
+        try:
+            while not self._stop.is_set():
+                try:
+                    op, fid_b, jwt_b, body = fp.read_frame(ctx,
+                                                           MAX_FRAME_BODY)
+                except ValueError as e:    # C-side FrameTooLarge
+                    _reply_error_and_drain(
+                        conn, str(e),
+                        lambda msg: fp.write_reply(ctx, 1, msg))
+                    return
+                try:
+                    payload = self._handle(chr(op), fid_b.decode(),
+                                           jwt_b.decode(), body)
+                    fp.write_reply(ctx, 0, payload)
+                except Exception as e:
+                    fp.write_reply(ctx, 1, str(e).encode())
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            del ctx            # frees the C buffer before the fd closes
             try:
                 conn.close()
             except OSError:
