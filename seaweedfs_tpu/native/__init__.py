@@ -176,24 +176,29 @@ def _build_fastpath() -> "str | None":
 
 def fastpath():
     """The _seaweed_fastpath extension module (C frame loop), or None —
-    callers (volume_server/tcp.py, operation) fall back to the Python
-    frame codecs when the build is unavailable."""
+    callers (volume_server/tcp.py, operation, storage/needle.py) fall
+    back to the Python codecs when the build is unavailable.  Lock-free
+    after first resolution: this sits on per-frame hot paths."""
     global _fp, _fp_tried
+    if _fp_tried:
+        return _fp
     with _lock:
         if _fp_tried:
             return _fp
-        _fp_tried = True
         so = _build_fastpath()
-        if so is None:
-            return None
-        try:
-            from importlib.machinery import ExtensionFileLoader
-            from importlib.util import module_from_spec, spec_from_loader
-            loader = ExtensionFileLoader("_seaweed_fastpath", so)
-            spec = spec_from_loader("_seaweed_fastpath", loader)
-            mod = module_from_spec(spec)
-            loader.exec_module(mod)
-            _fp = mod
-        except Exception:
-            _fp = None
+        if so is not None:
+            try:
+                from importlib.machinery import ExtensionFileLoader
+                from importlib.util import (module_from_spec,
+                                            spec_from_loader)
+                loader = ExtensionFileLoader("_seaweed_fastpath", so)
+                spec = spec_from_loader("_seaweed_fastpath", loader)
+                mod = module_from_spec(spec)
+                loader.exec_module(mod)
+                _fp = mod
+            except Exception:
+                _fp = None
+        # publish _fp BEFORE the tried flag: the lock-free fast path
+        # must never observe tried=True with _fp still unset
+        _fp_tried = True
         return _fp

@@ -453,6 +453,80 @@ done:
     return out;
 }
 
+static void wr_be32(unsigned char *p, uint32_t v)
+{
+    p[0] = v >> 24;
+    p[1] = v >> 16;
+    p[2] = v >> 8;
+    p[3] = v;
+}
+
+/* needle_record(cookie, nid, data:buffer, version, append_at_ns)
+ *   -> (record bytes, size, checksum) for the plain-blob common case
+ *      (flags 0, non-empty data) — the write-side twin of needle_data:
+ *      header + body + masked CRC32C + v3 timestamp + the reference's
+ *      pad-to-8 quirk (8, not 0, when already aligned) in one call.
+ */
+static PyObject *py_needle_record(PyObject *self, PyObject *args)
+{
+    unsigned int cookie;
+    unsigned long long nid, ts;
+    int version;
+    Py_buffer data;
+    if (!PyArg_ParseTuple(args, "IKy*iK", &cookie, &nid, &data, &version,
+                          &ts))
+        return NULL;
+    if ((version != 2 && version != 3) || data.len == 0
+        || (uint64_t)data.len > 0xFFFFFFF0ull) {
+        PyBuffer_Release(&data);
+        PyErr_SetString(PyExc_ValueError, "needle fast-build fallback");
+        return NULL;
+    }
+    uint32_t size = 4 + (uint32_t)data.len + 1;
+    size_t total = 16 + size + 4 + (version == 3 ? 8 : 0);
+    size_t pad = 8 - (total % 8); /* 8 when aligned: reference quirk */
+    PyObject *out = PyBytes_FromStringAndSize(NULL,
+                                              (Py_ssize_t)(total + pad));
+    if (!out) {
+        PyBuffer_Release(&data);
+        return NULL;
+    }
+    unsigned char *p = (unsigned char *)PyBytes_AS_STRING(out);
+    wr_be32(p, cookie);
+    p[4] = nid >> 56;
+    p[5] = nid >> 48;
+    p[6] = nid >> 40;
+    p[7] = nid >> 32;
+    p[8] = nid >> 24;
+    p[9] = nid >> 16;
+    p[10] = nid >> 8;
+    p[11] = nid;
+    wr_be32(p + 12, size);
+    wr_be32(p + 16, (uint32_t)data.len);
+    memcpy(p + 20, data.buf, (size_t)data.len);
+    p[20 + data.len] = 0; /* flags */
+    uint32_t crc = crc32c_buf((const unsigned char *)data.buf,
+                              (size_t)data.len);
+    uint32_t masked =
+        (((crc >> 15) | (crc << 17)) + 0xA282EAD8u) & 0xFFFFFFFFu;
+    wr_be32(p + 16 + size, masked);
+    size_t off = 16 + size + 4;
+    if (version == 3) {
+        p[off] = ts >> 56;
+        p[off + 1] = ts >> 48;
+        p[off + 2] = ts >> 40;
+        p[off + 3] = ts >> 32;
+        p[off + 4] = ts >> 24;
+        p[off + 5] = ts >> 16;
+        p[off + 6] = ts >> 8;
+        p[off + 7] = ts;
+        off += 8;
+    }
+    memset(p + off, 0, pad);
+    PyBuffer_Release(&data);
+    return Py_BuildValue("NII", out, size, masked);
+}
+
 static PyMethodDef Methods[] = {
     {"conn_new", py_conn_new, METH_VARARGS,
      "conn_new(fd, bufsize=65536) -> capsule"},
@@ -466,6 +540,9 @@ static PyMethodDef Methods[] = {
      "read_reply(conn) -> (status, payload)"},
     {"needle_data", py_needle_data, METH_VARARGS,
      "needle_data(raw, size, version, cookie) -> data bytes"},
+    {"needle_record", py_needle_record, METH_VARARGS,
+     "needle_record(cookie, nid, data, version, ts) "
+     "-> (record, size, checksum)"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -72,15 +72,11 @@ def upload_data(url_or_server: str, fid: str, data: bytes,
 import threading as _threading
 
 _TCP_LOCAL = _threading.local()
-_FP_CACHE: list = []   # [module | None], resolved once — native.fastpath()
-                       # takes a process-global lock per call
 
 
 def _fastpath():
-    if not _FP_CACHE:
-        from .. import native
-        _FP_CACHE.append(native.fastpath())
-    return _FP_CACHE[0]
+    from .. import native
+    return native.fastpath()   # lock-free after first resolution
 
 
 def _tcp_sock(addr: str):

@@ -136,6 +136,25 @@ class Needle:
     def to_bytes(self, version: int = t.CURRENT_VERSION) -> bytes:
         """Serialize the full padded record (prepareWriteBuffer,
         needle_read_write.go:41-133). Sets self.size/checksum."""
+        if not self.flags and self.data and version != t.VERSION1 \
+                and 0 <= self.cookie <= 0xFFFFFFFF \
+                and 0 <= self.id < (1 << 64) \
+                and 0 <= self.append_at_ns < (1 << 64):
+            # (range guards keep behavior identical to the Python
+            # path, which raises struct.error on out-of-range fields
+            # instead of silently wrapping)
+            from .. import native
+            fp = native.fastpath()
+            if fp is not None:
+                try:
+                    # plain blob: header + body + CRC + pad in one C
+                    # call (the write twin of the read fast parse)
+                    raw, self.size, self.checksum = fp.needle_record(
+                        self.cookie, self.id, self.data, version,
+                        self.append_at_ns)
+                    return raw
+                except ValueError:
+                    pass   # odd version/shape: full path below
         self.checksum = masked_value(crc32c(self.data))
         out = bytearray()
         if version == t.VERSION1:

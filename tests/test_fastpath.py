@@ -99,6 +99,30 @@ def test_needle_data_wrong_cookie_raises(tmp_path):
         v.read_needle_data(0x31, 8)
 
 
+def test_needle_record_matches_python_serializer(tmp_path):
+    """C record builder == the Python to_bytes, byte for byte, for both
+    versions and odd sizes (padding quirk included)."""
+    from seaweedfs_tpu.storage import types as t
+    from seaweedfs_tpu.storage.needle import Needle
+
+    import seaweedfs_tpu.native as native_mod
+    for version in (t.VERSION2, t.VERSION3):
+        for size in (1, 7, 8, 1024, 4095):
+            n1 = Needle(id=0x1234, cookie=0x55, data=b"q" * size,
+                        append_at_ns=123456789)
+            fast = n1.to_bytes(version)           # C path (flags == 0)
+            n2 = Needle(id=0x1234, cookie=0x55, data=b"q" * size,
+                        append_at_ns=123456789)
+            saved = native_mod._fp
+            native_mod._fp = None                 # force the Python path
+            try:
+                slow = n2.to_bytes(version)
+            finally:
+                native_mod._fp = saved
+            assert fast == slow, (version, size)
+            assert (n1.size, n1.checksum) == (n2.size, n2.checksum)
+
+
 def test_needle_data_crc_corruption_detected(tmp_path):
     from seaweedfs_tpu.storage.needle import CrcError, Needle
     v = _volume(tmp_path)
