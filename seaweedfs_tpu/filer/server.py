@@ -33,6 +33,15 @@ from .filechunks import read_views, total_size
 from .filer import Filer
 from .filerstore import NotFound, new_filer_store
 
+
+def _upload_chunk(r, data: bytes, ttl: str = "") -> dict:
+    """Chunk upload to the assigned volume server through the shared
+    fast-path selector (operation.upload_to: raw TCP when advertised,
+    HTTP when the frame can't express the request or the port is
+    dead)."""
+    return operation.upload_to(r, r.fid, data, ttl=ttl)
+
+
 CHUNK_SIZE = 8 * 1024 * 1024  # autochunk size (filer_server.go option)
 FILER_CONF_PATH = "/etc/seaweedfs/filer.conf"
 FILER_CONF_TTL = 5.0  # hot-reload window
@@ -257,16 +266,16 @@ class FilerServer:
             collection=rule.get("collection") or self.collection,
             ttl=ttl))
         # the needle must carry the ttl too — needle expiry on read
-        # (storage/volume.py) is what actually retires the data
-        out = operation.upload_data(r.url, r.fid, data, jwt=r.auth,
-                                    ttl=ttl)
+        # (storage/volume.py) is what actually retires the data; the
+        # TCP frame cannot express ttl, so ttl'd chunks stay on HTTP
+        out = _upload_chunk(r, data, ttl=ttl)
         return FileChunk(file_id=r.fid, offset=offset, size=len(data),
                          modified_ts_ns=ts_ns, etag=out.get("eTag", ""))
 
     def _save_manifest_blob(self, data: bytes) -> tuple[str, str]:
         r = self._with_master(lambda m: operation.assign(
             m, replication=self.replication, collection=self.collection))
-        out = operation.upload_data(r.url, r.fid, data, jwt=r.auth)
+        out = _upload_chunk(r, data)
         return r.fid, out.get("eTag", "")
 
     def _read_chunk_blob(self, fid: str) -> bytes:

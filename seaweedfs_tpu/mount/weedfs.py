@@ -222,7 +222,8 @@ class WeedFS:
         r = operation.assign(self.master_grpc,
                              replication=self.replication,
                              collection=self.collection)
-        operation.upload_data(r.url, r.fid, data, jwt=r.auth)
+        # shared fast-path selector: raw TCP when advertised, HTTP else
+        operation.upload_to(r, r.fid, data)
         return {"file_id": r.fid, "offset": logical_offset,
                 "size": len(data), "modified_ts_ns": time.time_ns()}
 

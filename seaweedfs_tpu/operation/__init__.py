@@ -206,13 +206,29 @@ def delete_file_tcp(tcp_addr: str, fid: str, jwt: str = "") -> dict:
     return json.loads(_tcp_call(tcp_addr, "D", fid, jwt))
 
 
-def upload_to(r: AssignResult, fid: str, data: bytes) -> dict:
+# tcp addresses whose connects recently failed -> retry-after timestamp.
+# Without this, an advertised-but-firewalled port costs every upload a
+# full connect timeout before the HTTP fallback.
+_TCP_DEAD: dict = {}
+_TCP_DEAD_TTL = 60.0
+
+
+def upload_to(r: AssignResult, fid: str, data: bytes,
+              ttl: str = "") -> dict:
     """Upload one blob against an assign result, picking the raw-TCP
     fast path when the server advertises one — THE fast-path selection
-    logic, shared by every client (benchmark, upload CLI, tests)."""
-    if r.tcp_url:
-        return upload_data_tcp(r.tcp_url, fid, data, jwt=r.auth)
-    return upload_data(r.url, fid, data, jwt=r.auth)
+    logic, shared by every client (benchmark, upload CLI, filer chunk
+    writes, tests).  Falls back to HTTP when the frame cannot express
+    the request (ttl) or the TCP port is dead (negative-cached for
+    .TCP_DEAD_TTL so one unreachable port does not tax every upload
+    with a connect timeout)."""
+    if r.tcp_url and not ttl and \
+            _TCP_DEAD.get(r.tcp_url, 0) < time.time():
+        try:
+            return upload_data_tcp(r.tcp_url, fid, data, jwt=r.auth)
+        except (OSError, ConnectionError):
+            _TCP_DEAD[r.tcp_url] = time.time() + _TCP_DEAD_TTL
+    return upload_data(r.url, fid, data, jwt=r.auth, ttl=ttl)
 
 
 def assign_and_upload(master_grpc: str, data: bytes, **kw) -> str:
