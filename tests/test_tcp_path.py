@@ -102,3 +102,43 @@ def test_tcp_write_replicates(tmp_path):
             fid = FileId.parse(r.fid)
             n = vs.store.read_volume_needle(vid, fid.key, fid.cookie)
             assert bytes(n.data) == b"replicated"
+
+
+def test_upload_to_dead_tcp_port_negative_cache(cluster, monkeypatch):
+    """An advertised-but-dead TCP port must cost ONE connect failure,
+    then fall back to HTTP for .TCP_DEAD_TTL — not a connect timeout
+    per chunk (operation.upload_to's negative cache)."""
+    import socket
+    import time as _time
+
+    r = operation.assign(cluster.master_grpc)
+    # a bound-but-not-listening socket: connects get ECONNREFUSED and
+    # the port can't be rebound by anything else for the test's duration
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    dead_port = blocker.getsockname()[1]
+    r.tcp_url = f"127.0.0.1:{dead_port}"
+    operation._TCP_DEAD.clear()
+    attempts = []
+    real_tcp = operation.upload_data_tcp
+
+    def counting(*a, **kw):
+        attempts.append(1)
+        return real_tcp(*a, **kw)
+
+    monkeypatch.setattr(operation, "upload_data_tcp", counting)
+    out = operation.upload_to(r, r.fid, b"first")        # TCP fails -> HTTP
+    assert out.get("size") == len(b"first")
+    assert len(attempts) == 1
+    assert operation._TCP_DEAD[r.tcp_url] > _time.time()
+    r2 = operation.assign(cluster.master_grpc)
+    r2.tcp_url = r.tcp_url
+    operation.upload_to(r2, r2.fid, b"second")           # cached: no retry
+    assert len(attempts) == 1
+    # ttl'd uploads never try TCP (the frame cannot express ttl)
+    r3 = operation.assign(cluster.master_grpc, ttl="1m")
+    r3.tcp_url = r.tcp_url
+    operation._TCP_DEAD.clear()
+    operation.upload_to(r3, r3.fid, b"third", ttl="1m")
+    assert len(attempts) == 1     # TCP never tried for ttl'd uploads
+    blocker.close()
