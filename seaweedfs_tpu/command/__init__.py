@@ -868,6 +868,30 @@ def main(argv: list[str] | None = None) -> int:
             rpc_mod.set_tls(TlsConfig(str(sec["grpc.ca"]),
                                       str(sec.get("grpc.cert") or ""),
                                       str(sec.get("grpc.key") or "")))
+    # global EC backend pin on every verb: -ec.backend
+    # native|numpy|pallas|jax|auto.  Sets WEED_EC_BACKEND so the
+    # bandwidth-aware picker (ops.codec.device_link_ok) skips its probe —
+    # the operator's override for hosts where the probe would guess wrong
+    for i, a in enumerate(list(argv)):
+        if a == "-ec.backend" and i + 1 < len(argv):
+            value = argv[i + 1]
+            del argv[i:i + 2]
+            from ..ops.codec import reset_backend_probe, \
+                validate_ec_backend_pin
+            prior = os.environ.get("WEED_EC_BACKEND")
+            os.environ["WEED_EC_BACKEND"] = value
+            try:
+                # fail loudly pre-serve: bad name, then bad host
+                validate_ec_backend_pin()
+            except (ValueError, RuntimeError):
+                # don't leave a bad pin behind for in-process callers
+                if prior is None:
+                    del os.environ["WEED_EC_BACKEND"]
+                else:
+                    os.environ["WEED_EC_BACKEND"] = prior
+                raise
+            reset_backend_probe()
+            break
     # global profiling hooks on every verb (reference
     # util/grace/pprof.go:11-55): -cpuprofile FILE / -memprofile FILE
     prof_args = {}

@@ -161,8 +161,10 @@ def _layer_mds_matmul(k: int, m: int, u, k0: int):
     import jax.numpy as jnp
 
     from . import rs_jax, rs_pallas
-    from .codec import _tpu_available
-    on_tpu = _tpu_available()
+    from .codec import _tpu_available, ec_backend_override
+    # a 'jax' pin means the XLA path even on TPU (debugging a suspected
+    # pallas miscompile must reach the clay window path too)
+    on_tpu = _tpu_available() and ec_backend_override() != "jax"
     n = u.shape[-1]
     if not on_tpu:
         return rs_jax.gf_matmul_bits(jnp.asarray(_r_bits(k, m)), u,

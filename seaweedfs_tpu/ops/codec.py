@@ -23,6 +23,9 @@ stripped on return.
 from __future__ import annotations
 
 import functools
+import os
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +41,155 @@ def _tpu_available() -> bool:
         return False
 
 
+# -- backend selection ------------------------------------------------------
+#
+# The reference picks its SIMD encoder once per binary and is always right
+# for its host (ec_encoder.go:198).  A TPU host has a failure mode x86
+# doesn't: the device can be healthy but the HOST<->DEVICE LINK can be the
+# bottleneck (remote-tunneled devices, degraded PCIe).  On such a host the
+# pallas path computes parity at 30+ GB/s and then drains it through a
+# kilobyte-per-millisecond straw — orders of magnitude slower end to end
+# than the native CPU codec.  So the production picker is bandwidth-aware:
+# probe the round-trip once per process and use the device only when the
+# link actually wins.  `WEED_EC_BACKEND` overrides the probe both ways.
+
+_PROBE_BYTES = 4 * 1024 * 1024
+_DEVICE_BACKENDS = ("pallas", "jax")
+_CPU_BACKENDS = ("native", "numpy")
+_backend_probe_cache: dict[str, object] = {}
+
+
+def ec_backend_override() -> "str | None":
+    """The `WEED_EC_BACKEND` env knob (mirrored by the global -ec.backend
+    flag): pin the exact backend — 'native'/'numpy' (CPU) or
+    'pallas'/'jax' (device) — or 'auto'/unset to let the probe decide.
+    RSCodec/gf_apply 'auto' resolve to the pinned name verbatim; mesh
+    selection follows its CPU/device class (codec_for_devices)."""
+    v = os.environ.get("WEED_EC_BACKEND", "").strip().lower()
+    if v in ("", "auto"):
+        return None
+    if v not in _DEVICE_BACKENDS + _CPU_BACKENDS:
+        raise ValueError(
+            f"WEED_EC_BACKEND={v!r}: expected one of "
+            f"{', '.join(_DEVICE_BACKENDS + _CPU_BACKENDS)} or auto")
+    return v
+
+
+def _roundtrip_gbps(nbytes: int) -> float:
+    buf = np.random.randint(0, 256, size=nbytes, dtype=np.uint8)
+    dev = jax.devices()[0]
+    t0 = time.perf_counter()
+    darr = jax.device_put(buf, dev)
+    darr.block_until_ready()
+    jax.device_get(darr)
+    return nbytes / (time.perf_counter() - t0) / 1e9
+
+
+# below this rate the 256KB pre-probe already proves the link lost (every
+# CPU codec — even the numpy tables — beats it), so the full-size probe
+# would only stall the first encode for seconds on the very straw it
+# exists to detect
+_PREPROBE_BYTES = 256 * 1024
+_PREPROBE_FLOOR_GBPS = 0.02
+_probe_lock = threading.Lock()
+
+
+def _probe_device_roundtrip_gbps(nbytes: int = _PROBE_BYTES) -> float:
+    """Measured host->device->host round-trip rate, GB/s of payload moved
+    one way.  Fresh arrays each leg — jax.Array caches its first fetch, so
+    re-fetching one array would measure a memcpy, not the link.  Staged:
+    a 256KB pre-probe bails out early on pathological links (a 100 KB/s
+    tunnel would otherwise block the first encode for ~80 s moving 4 MB)."""
+    # warmup pays one-time dispatch/setup cost outside the timed window
+    jax.device_get(jax.device_put(np.zeros(1024, dtype=np.uint8), jax.devices()[0]))
+    small = _roundtrip_gbps(min(_PREPROBE_BYTES, nbytes))
+    if small < _PREPROBE_FLOOR_GBPS or nbytes <= _PREPROBE_BYTES:
+        return small
+    return _roundtrip_gbps(nbytes)
+
+
+def _probe_cpu_encode_gbps(nbytes: int = _PROBE_BYTES) -> float:
+    """Throughput of the CPU codec RSCodec would fall back to (native AVX2
+    .so when it builds, numpy tables otherwise) on a default-geometry
+    encode, GB/s of data bytes."""
+    k, m = rs_matrix.DEFAULT_DATA_SHARDS, rs_matrix.DEFAULT_PARITY_SHARDS
+    gen = rs_matrix.generator_matrix(k, m)[k:]
+    data = np.random.randint(0, 256, size=(k, nbytes // k), dtype=np.uint8)
+    from .. import native
+    use_native = native.lib() is not None and hasattr(native.lib(),
+                                                      "gf256_matmul")
+    run = (lambda: native.gf256_matmul(gen, data)) if use_native \
+        else (lambda: gf256.matmul(gen, data))
+    run()  # warmup (table setup, page faults)
+    t0 = time.perf_counter()
+    run()
+    dt = time.perf_counter() - t0
+    return data.size / dt / 1e9
+
+
+def device_link_ok() -> bool:
+    """Should EC work ride the accelerator on this host?
+
+    True on CPU-only hosts trivially (the 'device' IS the host — mesh
+    dryruns and tests rely on that).  On TPU hosts: honors
+    WEED_EC_BACKEND, else compares one cached probe of the transfer
+    round-trip against the CPU codec and says no when the LINK loses —
+    the case where a 30 GB/s kernel drains through a MB/s straw."""
+    override = ec_backend_override()
+    if override is not None:
+        return override in _DEVICE_BACKENDS
+    if not _tpu_available():
+        return True
+    # serialized: two first-encode threads probing concurrently would
+    # contend on the very link being measured and cache a falsely-low
+    # rate, permanently demoting a healthy TPU
+    with _probe_lock:
+        cached = _backend_probe_cache.get("device_ok")
+        if cached is None:
+            link = _probe_device_roundtrip_gbps()
+            cpu = _probe_cpu_encode_gbps()
+            cached = link >= cpu
+            _backend_probe_cache.update(
+                device_ok=cached, link_gbps=link, cpu_gbps=cpu)
+    return bool(cached)
+
+
+def device_compute_ok() -> bool:
+    """May single-device EC work ride the accelerator?  The one gate for
+    every 'TPU or CPU?' branch (RSCodec auto, clay window codec, pipeline
+    depth): a device exists AND its link wins (or is pinned on)."""
+    return _tpu_available() and device_link_ok()
+
+
+def mesh_compute_ok() -> bool:
+    """May EC work ride a multi-device mesh?  CPU virtual meshes (driver
+    dryruns) always — there the 'device' IS the host, even under a
+    'native' pin; TPU meshes only when the link wins."""
+    return not _tpu_available() or device_link_ok()
+
+
+def validate_ec_backend_pin() -> None:
+    """Raise if WEED_EC_BACKEND pins a backend this host cannot run —
+    called at CLI startup and at auto-resolution so a bad pin fails at
+    construction with a clear message, not mid-serve in the first encode."""
+    v = ec_backend_override()
+    if v == "native":
+        from .. import native
+        if native.lib() is None or not hasattr(native.lib(),
+                                               "gf256_matmul"):
+            raise RuntimeError(
+                "WEED_EC_BACKEND=native pinned but the native codec .so "
+                "is unavailable on this host (no compiler?)")
+    if v == "pallas" and not _tpu_available():
+        raise RuntimeError(
+            "WEED_EC_BACKEND=pallas pinned but this host has no TPU")
+
+
+def reset_backend_probe() -> None:
+    """Drop the cached link probe (tests; after env/topology changes)."""
+    _backend_probe_cache.clear()
+
+
 def gf_apply(M: np.ndarray, x: np.ndarray, *,
              backend: str = "auto") -> np.ndarray:
     """out[MO, B] = M ∘GF∘ x[KI, B] for an ARBITRARY GF(2^8) matrix —
@@ -49,7 +201,14 @@ def gf_apply(M: np.ndarray, x: np.ndarray, *,
     native AVX2 codec, numpy tables as last resort.  Bytes are identical
     on every path."""
     if backend == "auto":
-        backend = "jax" if _tpu_available() else "native"
+        override = ec_backend_override()
+        if override is not None:
+            validate_ec_backend_pin()
+            # gf_apply's device path is the bit-plane XLA matmul; a
+            # 'pallas' pin means "use the device", which here is 'jax'
+            backend = "jax" if override in _DEVICE_BACKENDS else override
+        else:
+            backend = "jax" if device_compute_ok() else "native"
     if backend == "native":
         from .. import native
         if native.lib() is not None and hasattr(native.lib(),
@@ -75,14 +234,24 @@ class RSCodec:
                  block_b: int = rs_pallas.SM_DEFAULT_BLOCK_B,
                  interpret: bool = False):
         if backend == "auto":
-            if _tpu_available():
+            override = ec_backend_override()
+            if override is not None:
+                validate_ec_backend_pin()
+                backend = override
+            elif device_compute_ok():
                 backend = "pallas"
             else:
-                # CPU: the native AVX2 codec beats the XLA bit-plane
-                # path; fall back to jax when the .so can't build
+                # CPU (or TPU behind a losing link): the native AVX2
+                # codec beats the XLA bit-plane path; when the .so can't
+                # build fall back to jax — except on a bad-link TPU host,
+                # where jax would dispatch to the same slow device and
+                # the numpy tables are the honest CPU path
                 from .. import native
-                backend = "native" if native.lib() is not None and \
-                    hasattr(native.lib(), "gf256_matmul") else "jax"
+                if native.lib() is not None and hasattr(native.lib(),
+                                                        "gf256_matmul"):
+                    backend = "native"
+                else:
+                    backend = "numpy" if _tpu_available() else "jax"
         if backend not in ("pallas", "jax", "numpy", "native"):
             raise ValueError(f"unknown backend {backend!r}")
         self.k = data_shards

@@ -316,8 +316,10 @@ def multi_device_host() -> bool:
 def codec_for_devices(k: int, m: int, *, kind: str = "vandermonde"):
     """The production codec picker: MeshCodec when this process sees more
     than one device (driver dryrun, multi-chip hosts), single-chip RSCodec
-    (pallas on TPU, XLA elsewhere) otherwise."""
-    if multi_device_host():
+    otherwise.  RSCodec's "auto" (and the mesh gate here) are
+    bandwidth-aware — a TPU behind a losing host<->device link falls back
+    to the native CPU codec (ops.codec.device_link_ok)."""
+    from ..ops.codec import RSCodec, mesh_compute_ok
+    if multi_device_host() and mesh_compute_ok():
         return MeshCodec(k, m, kind=kind)
-    from ..ops.codec import RSCodec
     return RSCodec(k, m, kind=kind)

@@ -51,8 +51,12 @@ def lrc_geometry(geo: EcGeometry) -> lrc.LrcGeometry:
 
 
 def _multi_device() -> bool:
+    """Ride the device mesh?  Same gate as codec_for_devices: a mesh of
+    TPUs behind a losing host<->device link (or a CPU-pinned
+    WEED_EC_BACKEND) must NOT ship windows through the slow transfer."""
+    from ...ops.codec import mesh_compute_ok
     from ...parallel.mesh_codec import multi_device_host
-    return multi_device_host()
+    return multi_device_host() and mesh_compute_ok()
 
 
 class LrcWindowCodec:
@@ -114,11 +118,11 @@ class ClayWindowCodec:
         assert W % small == 0, \
             f"window {W} not a multiple of small block {small}"
         from ...ops import clay_structured
-        from ...ops.codec import _tpu_available
+        from ...ops.codec import device_compute_ok
         if _multi_device():
             from ...parallel.mesh_codec import clay_mesh_encode_begin
             return clay_mesh_encode_begin(self.k, self.m, data, small)
-        if _tpu_available():
+        if device_compute_ok():
             import jax
             import jax.numpy as jnp
             fn = _clay_device_fn(self.k, self.m, small)

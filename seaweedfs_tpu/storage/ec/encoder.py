@@ -74,8 +74,8 @@ def _pipeline_depth(codec) -> int:
 
 
 def _codec_tpu_available() -> bool:
-    from ...ops.codec import _tpu_available
-    return _tpu_available()
+    from ...ops.codec import device_compute_ok
+    return device_compute_ok()
 
 
 def _begin_reconstruct(codec, shards):
