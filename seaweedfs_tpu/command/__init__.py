@@ -77,7 +77,8 @@ def cmd_filer(args) -> int:
                     grpc_port=args.grpc_port,
                     store_kind=args.store, store_path=args.store_path,
                     collection=args.collection,
-                    replication=args.default_replication)
+                    replication=args.default_replication,
+                    encrypt_data=args.encrypt_volume_data)
     f.start()
     print(f"filer http {f.address} grpc {f.grpc_address}")
     _wait_forever()
@@ -135,7 +136,9 @@ def cmd_server(args) -> int:
     f = FilerServer(m.grpc_address, host=args.ip, port=args.filer_port,
                     grpc_port=args.filer_port + 10000,
                     store_kind=args.filer_store,
-                    store_path=store_path)
+                    store_path=store_path,
+                    encrypt_data=getattr(args, "encrypt_volume_data",
+                                         False))
     f.start()
     parts = [f"master {m.address} (grpc {m.grpc_address})",
              f"volume {vs.url}", f"filer {f.address}"]
@@ -194,18 +197,37 @@ def cmd_upload(args) -> int:
     for path in args.files:
         with open(path, "rb") as fh:
             data = fh.read()
+        record = {"fileName": path, "size": len(data)}
+        if args.cipher:
+            # blob uploads have no filer entry to hold the key, so it is
+            # printed for the caller to keep (download -cipherKey)
+            from ..util import cipher as cipher_mod
+            data, record["cipherKey"] = cipher_mod.seal(data)
         fid = operation.assign_and_upload(
             args.master, data, replication=args.replication,
             collection=args.collection, ttl=args.ttl)
-        print(json.dumps({"fileName": path, "fid": fid,
-                          "size": len(data)}))
+        record["fid"] = fid
+        print(json.dumps(record))
     return 0
 
 
 def cmd_download(args) -> int:
     from .. import operation
+    if args.cipher_key and len(args.fids) > 1:
+        # upload -cipher mints a DISTINCT key per file; one key cannot
+        # open several fids, so fail before writing anything
+        print("-cipherKey opens exactly one fid (each upload -cipher "
+              "record carries its own key)", file=sys.stderr)
+        return 1
     for fid in args.fids:
         data = operation.read_file(args.master, fid)
+        if args.cipher_key:
+            from ..util import cipher as cipher_mod
+            try:
+                data = cipher_mod.maybe_decrypt(data, args.cipher_key)
+            except cipher_mod.CipherError as e:
+                print(f"{fid}: {e}", file=sys.stderr)
+                return 1
         out = args.output or fid.replace(",", "_")
         with open(out, "wb") as fh:
             fh.write(data)
@@ -446,7 +468,8 @@ def cmd_mount(args) -> int:
     addr = ServerAddress.parse(args.filer)
     print(f"mounting {addr.grpc} at {args.dir} (ctrl-c to unmount)")
     return mount_and_serve(addr.grpc, args.master, args.dir,
-                           foreground=True)
+                           foreground=True,
+                           encrypt_data=args.encrypt_volume_data)
 
 
 def cmd_ftp(args) -> int:
@@ -545,6 +568,11 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-store", default="sqlite")
     f.add_argument("-store_path", dest="store_path", default="./filer.db")
     f.add_argument("-collection", default="")
+    f.add_argument("-encryptVolumeData", dest="encrypt_volume_data",
+                   action="store_true",
+                   help="seal chunk data with per-chunk AES256-GCM keys "
+                        "before upload; volume servers hold only "
+                        "ciphertext (keys live in filer metadata)")
     f.add_argument("-defaultReplication", dest="default_replication",
                    default="")
     f.set_defaults(fn=cmd_filer)
@@ -568,6 +596,10 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("-filer.port", dest="filer_port", type=int,
                      default=8888)
     srv.add_argument("-s3", action="store_true")
+    srv.add_argument("-filer.encryptVolumeData",
+                     dest="encrypt_volume_data", action="store_true",
+                     help="embedded filer seals chunks with per-chunk "
+                          "AES256-GCM keys")
     srv.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
     srv.add_argument("-s3.auditLog", dest="s3_audit_log", default="",
                      help="S3 access log (JSON lines) for the embedded "
@@ -593,11 +625,17 @@ def build_parser() -> argparse.ArgumentParser:
     up.add_argument("-replication", default="")
     up.add_argument("-collection", default="")
     up.add_argument("-ttl", default="")
+    up.add_argument("-cipher", action="store_true",
+                    help="AES256-GCM encrypt before upload; the key is "
+                         "printed in the JSON record (keep it — there "
+                         "is no filer entry to hold it)")
     up.add_argument("files", nargs="+")
     up.set_defaults(fn=cmd_upload)
 
     dl = sub.add_parser("download", help="download files by fid")
     dl.add_argument("-master", default="127.0.0.1:19333")
+    dl.add_argument("-cipherKey", dest="cipher_key", default="",
+                    help="base64 key from `upload -cipher`")
     dl.add_argument("-o", dest="output", default="")
     dl.add_argument("fids", nargs="+")
     dl.set_defaults(fn=cmd_download)
@@ -799,6 +837,10 @@ def build_parser() -> argparse.ArgumentParser:
     mnt.add_argument("-filer", default="127.0.0.1:8888.18888")
     mnt.add_argument("-master", default="127.0.0.1:19333")
     mnt.add_argument("-dir", required=True)
+    mnt.add_argument("-encryptVolumeData", dest="encrypt_volume_data",
+                     action="store_true",
+                     help="seal chunks written through this mount "
+                          "(reads always honor cipher_key)")
     mnt.set_defaults(fn=cmd_mount)
 
     ftp = sub.add_parser("ftp", help="start an FTP gateway")

@@ -17,16 +17,22 @@ from dataclasses import dataclass, field
 class FileChunk:
     file_id: str = ""
     offset: int = 0          # logical offset in the file
-    size: int = 0
+    size: int = 0            # PLAINTEXT size (cipher overhead is volume-side)
     modified_ts_ns: int = 0  # MVCC tie-break (filer.proto FileChunk.mtime)
     etag: str = ""
     is_chunk_manifest: bool = False
+    # base64 AES-256 key when the chunk is encrypted at rest (filer.proto
+    # FileChunk.cipher_key; util/cipher.py) — lives ONLY in filer metadata
+    cipher_key: str = ""
 
     def to_dict(self) -> dict:
-        return {"file_id": self.file_id, "offset": self.offset,
-                "size": self.size, "modified_ts_ns": self.modified_ts_ns,
-                "etag": self.etag,
-                "is_chunk_manifest": self.is_chunk_manifest}
+        d = {"file_id": self.file_id, "offset": self.offset,
+             "size": self.size, "modified_ts_ns": self.modified_ts_ns,
+             "etag": self.etag,
+             "is_chunk_manifest": self.is_chunk_manifest}
+        if self.cipher_key:  # omitted for plain chunks: stored entries
+            d["cipher_key"] = self.cipher_key  # predate the field
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "FileChunk":
@@ -34,7 +40,8 @@ class FileChunk:
                    size=d.get("size", 0),
                    modified_ts_ns=d.get("modified_ts_ns", 0),
                    etag=d.get("etag", ""),
-                   is_chunk_manifest=d.get("is_chunk_manifest", False))
+                   is_chunk_manifest=d.get("is_chunk_manifest", False),
+                   cipher_key=d.get("cipher_key", ""))
 
 
 @dataclass

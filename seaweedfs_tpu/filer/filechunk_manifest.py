@@ -17,8 +17,11 @@ from .entry import FileChunk
 
 MANIFEST_BATCH = 10000  # filechunk_manifest.go:22 ManifestBatch
 
-# save_fn(data) -> (file_id, etag); read_fn(file_id) -> bytes
-SaveFn = Callable[[bytes], tuple[str, str]]
+# save_fn(data) -> (file_id, etag) or (file_id, etag, cipher_key_b64)
+# when the saver encrypts manifest blobs (they carry nested chunks'
+# cipher keys, so an encrypting filer MUST seal them too);
+# read_fn(file_id) -> raw stored bytes
+SaveFn = Callable[[bytes], tuple]
 ReadFn = Callable[[str], bytes]
 
 
@@ -37,12 +40,14 @@ def resolve_chunk_manifest(read_fn: ReadFn, chunks: list[FileChunk]
                            ) -> list[FileChunk]:
     """Expand manifest chunks (recursively) into data chunks
     (filechunk_manifest.go ResolveChunkManifest)."""
+    from ..util import cipher
     out: list[FileChunk] = []
     for c in chunks:
         if not c.is_chunk_manifest:
             out.append(c)
             continue
-        payload = json.loads(read_fn(c.file_id))
+        blob = cipher.maybe_decrypt(read_fn(c.file_id), c.cipher_key)
+        payload = json.loads(blob)
         nested = [FileChunk.from_dict(d) for d in payload["chunks"]]
         out.extend(resolve_chunk_manifest(read_fn, nested))
     return out
@@ -61,12 +66,14 @@ def maybe_manifestize(save_fn: SaveFn, chunks: list[FileChunk],
         group = sorted(data[i:i + batch], key=lambda c: c.offset)
         payload = json.dumps(
             {"chunks": [c.to_dict() for c in group]}).encode()
-        fid, etag = save_fn(payload)
+        saved = save_fn(payload)
+        fid, etag = saved[0], saved[1]
+        key_b64 = saved[2] if len(saved) > 2 else ""
         start = min(c.offset for c in group)
         stop = max(c.offset + c.size for c in group)
         folded.append(FileChunk(
             file_id=fid, offset=start, size=stop - start,
             modified_ts_ns=max(c.modified_ts_ns for c in group),
-            etag=etag, is_chunk_manifest=True))
+            etag=etag, is_chunk_manifest=True, cipher_key=key_b64))
     folded.extend(data[len(data) - len(data) % batch:])
     return maybe_manifestize(save_fn, folded, batch)

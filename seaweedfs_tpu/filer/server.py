@@ -26,6 +26,7 @@ import urllib.parse
 
 from .. import operation
 from ..pb.rpc import RpcError, RpcServer
+from ..util import cipher
 from ..util.http import HttpServer, Request, Response
 from .entry import Attr, Entry, FileChunk
 from .filechunk_manifest import MANIFEST_BATCH, maybe_manifestize
@@ -110,7 +111,8 @@ class FilerServer:
                  chunk_size: int = CHUNK_SIZE,
                  chunk_cache_mem_mb: int = 64,
                  chunk_cache_dir: "str | None" = None,
-                 chunk_cache_disk_mb: int = 1024):
+                 chunk_cache_disk_mb: int = 1024,
+                 encrypt_data: bool = False):
         # may be a comma-separated HA master list; resolved to the leader
         # at start (and re-resolved when calls start failing)
         self._master_spec = master_grpc
@@ -118,6 +120,11 @@ class FilerServer:
         self.collection = collection
         self.replication = replication
         self.chunk_size = chunk_size
+        # -encryptVolumeData (reference weed/command/filer.go:212): every
+        # chunk sealed with its own AES256-GCM key before it leaves this
+        # process; volume servers / .dat / replicas / EC shards / cloud
+        # tiers hold only ciphertext (util/cipher.py)
+        self.encrypt_data = encrypt_data
         if store_kind == "lsm" and store_path in (":memory:", None, ""):
             # the sqlite sentinel default would become a literal
             # ':memory:' DIRECTORY for the lsm store — use its own
@@ -211,7 +218,9 @@ class FilerServer:
                 # manifest blob itself, or the deletion thread can win the
                 # race and strand every nested blob
                 try:
-                    payload = json.loads(self._read_chunk_blob(c.file_id))
+                    blob = cipher.maybe_decrypt(
+                        self._read_chunk_blob(c.file_id), c.cipher_key)
+                    payload = json.loads(blob)
                     nested = [FileChunk.from_dict(d)
                               for d in payload.get("chunks", [])]
                     self._enqueue_deletion(nested)
@@ -265,18 +274,24 @@ class FilerServer:
             m, replication=rule.get("replication") or self.replication,
             collection=rule.get("collection") or self.collection,
             ttl=ttl))
+        logical_size = len(data)
+        data, key_b64 = cipher.seal(data, self.encrypt_data)
         # the needle must carry the ttl too — needle expiry on read
         # (storage/volume.py) is what actually retires the data; the
         # TCP frame cannot express ttl, so ttl'd chunks stay on HTTP
         out = _upload_chunk(r, data, ttl=ttl)
-        return FileChunk(file_id=r.fid, offset=offset, size=len(data),
-                         modified_ts_ns=ts_ns, etag=out.get("eTag", ""))
+        return FileChunk(file_id=r.fid, offset=offset, size=logical_size,
+                         modified_ts_ns=ts_ns, etag=out.get("eTag", ""),
+                         cipher_key=key_b64)
 
-    def _save_manifest_blob(self, data: bytes) -> tuple[str, str]:
+    def _save_manifest_blob(self, data: bytes) -> tuple[str, str, str]:
+        """Manifest blobs carry the nested chunks' cipher keys, so an
+        encrypting filer seals them exactly like data chunks."""
+        data, key_b64 = cipher.seal(data, self.encrypt_data)
         r = self._with_master(lambda m: operation.assign(
             m, replication=self.replication, collection=self.collection))
         out = _upload_chunk(r, data)
-        return r.fid, out.get("eTag", "")
+        return r.fid, out.get("eTag", ""), key_b64
 
     def _read_chunk_blob(self, fid: str) -> bytes:
         if self.chunk_cache is not None:
@@ -352,7 +367,11 @@ class FilerServer:
                 "Path": path,
                 "Entries": [e.to_dict() for e in entries],
                 "ShouldDisplayLoadMore": len(entries) == limit})
-        chunks = self.filer.resolve_chunks(entry, self._read_chunk_blob)
+        try:
+            chunks = self.filer.resolve_chunks(entry,
+                                               self._read_chunk_blob)
+        except cipher.CipherError as e:
+            return Response.error(f"cipher: {e}", 500)
         size = total_size(chunks)
         offset, length, status = 0, size, 200
         rng = req.headers.get("Range", "")
@@ -370,7 +389,12 @@ class FilerServer:
             headers = {"Accept-Ranges": "bytes",
                        "Content-Length": str(length)}
         else:
-            data = self._stream_content(chunks, offset, length)
+            try:
+                data = self._stream_content(chunks, offset, length)
+            except cipher.CipherError as e:
+                # loud, never silent garbage: wrong/corrupt key or
+                # tampered ciphertext is an integrity failure
+                return Response.error(f"cipher: {e}", 500)
             headers = {"Accept-Ranges": "bytes"}
         if status == 206:
             headers["Content-Range"] = \
@@ -382,10 +406,15 @@ class FilerServer:
 
     def _stream_content(self, chunks: list[FileChunk], offset: int,
                         length: int) -> bytes:
-        """Gather chunk views; zero-fill sparse gaps (filer/stream.go)."""
+        """Gather chunk views; zero-fill sparse gaps (filer/stream.go).
+        Encrypted chunks decrypt here — the cache tiers keep ciphertext,
+        so the disk cache is as cold-storage-safe as the volumes."""
+        keys = {c.file_id: c.cipher_key for c in chunks if c.cipher_key}
         out = bytearray(length)
         for view in read_views(chunks, offset, length):
-            blob = self._read_chunk_blob(view.file_id)
+            blob = cipher.maybe_decrypt(
+                self._read_chunk_blob(view.file_id),
+                keys.get(view.file_id, ""))
             piece = blob[view.offset_in_chunk:
                          view.offset_in_chunk + view.size]
             at = view.logic_offset - offset
@@ -424,9 +453,14 @@ class FilerServer:
                 # filer.proto GetFilerConfiguration: lets CLI tools
                 # (filer.backup, filer.remote.gateway) discover the
                 # master without a -master flag
+                # masters for -master-less CLI tools; cipher so chunk
+                # writers outside this process (remote.cache) match the
+                # at-rest posture (filer.proto
+                # GetFilerConfigurationResponse.cipher)
                 "GetFilerConfiguration": lambda req: {
                     "masters": [m.strip()
-                                for m in self._master_spec.split(",")]},
+                                for m in self._master_spec.split(",")],
+                    "cipher": self.encrypt_data},
             },
             stream={
                 "ListEntries": self._rpc_list_entries,

@@ -296,10 +296,11 @@ class FuseMount:
 
 
 def mount_and_serve(filer_grpc: str, master_grpc: str, mountpoint: str,
-                    foreground: bool = True) -> int:
+                    foreground: bool = True,
+                    encrypt_data: bool = False) -> int:
     """`weed mount` equivalent: build the ops layer, serve until
     unmounted."""
-    fs = WeedFS(filer_grpc, master_grpc)
+    fs = WeedFS(filer_grpc, master_grpc, encrypt_data=encrypt_data)
     fs.start()
     try:
         return FuseMount(fs, mountpoint).serve(foreground=foreground)

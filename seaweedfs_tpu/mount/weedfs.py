@@ -76,22 +76,39 @@ class WeedFS:
                  chunk_size: int = CHUNK_SIZE,
                  replication: str = "", collection: str = "",
                  cache_mem_mb: int = 32,
-                 cache_dir: "str | None" = None):
+                 cache_dir: "str | None" = None,
+                 encrypt_data: bool = False):
         self.filer_grpc = filer_grpc
         self.master_grpc = master_grpc
         self.chunk_size = chunk_size
         self.replication = replication
         self.collection = collection
+        # -encryptVolumeData on the mount verb: chunks written through
+        # this mount are sealed client-side (util/cipher.py); reads
+        # ALWAYS honor cipher_key regardless of the flag, so files from
+        # an encrypting filer stay readable here
+        self.encrypt_data = encrypt_data
         self.meta = MetaCache(filer_grpc)
         self.inodes = InodeToPath()
         self._open_writers: dict[str, PageWriter] = {}
         # tiered read cache (mount chunk_cache tiers, weed/mount read
         # path); mem-only by default, disk tier when cache_dir given
-        from ..util.chunk_cache import TieredChunkCache
+        from ..util.chunk_cache import MemChunkCache, TieredChunkCache
         self._chunk_cache = TieredChunkCache(
             mem_limit_bytes=cache_mem_mb << 20,
             mem_item_limit=max(chunk_size, 8 << 20),
             cache_dir=cache_dir)
+        # decrypted-chunk LRU in front of the (ciphertext) chunk cache:
+        # FUSE reads arrive in ~128KB slices, so without it a sealed
+        # 8MB chunk would pay the full AES-GCM open ~64 times per
+        # sequential scan.  Memory-only on purpose — plaintext never
+        # reaches the disk cache tier.
+        self._plain_cache = MemChunkCache(
+            # half the blob cache, floored at one chunk — a limit below
+            # item_limit would admit then immediately evict every chunk,
+            # re-paying the full AES-GCM open per 128KB FUSE slice
+            limit_bytes=max(chunk_size, max(cache_mem_mb, 8) << 19),
+            item_limit=max(chunk_size, 8 << 20))
         self._lock = threading.RLock()
 
     def start(self) -> None:
@@ -219,13 +236,19 @@ class WeedFS:
         self.inodes.lookup(path)
 
     def _upload_chunk(self, data: bytes, logical_offset: int) -> dict:
+        from ..util import cipher
+        logical_size = len(data)
+        data, key_b64 = cipher.seal(data, self.encrypt_data)
         r = operation.assign(self.master_grpc,
                              replication=self.replication,
                              collection=self.collection)
         # shared fast-path selector: raw TCP when advertised, HTTP else
         operation.upload_to(r, r.fid, data)
-        return {"file_id": r.fid, "offset": logical_offset,
-                "size": len(data), "modified_ts_ns": time.time_ns()}
+        chunk = {"file_id": r.fid, "offset": logical_offset,
+                 "size": logical_size, "modified_ts_ns": time.time_ns()}
+        if key_b64:
+            chunk["cipher_key"] = key_b64
+        return chunk
 
     def write(self, path: str, offset: int, data: bytes) -> int:
         with self._lock:
@@ -274,9 +297,11 @@ class WeedFS:
         if offset >= size:
             return b""
         n = min(n, size - offset)
+        keys = {c.file_id: c.cipher_key for c in chunks if c.cipher_key}
         out = bytearray(n)
         for view in read_views(chunks, offset, n):
-            blob = self._chunk_blob(view.file_id)
+            blob = self._chunk_plain(view.file_id,
+                                     keys.get(view.file_id, ""))
             piece = blob[view.offset_in_chunk:
                          view.offset_in_chunk + view.size]
             at = view.logic_offset - offset
@@ -289,6 +314,19 @@ class WeedFS:
             blob = operation.read_file(self.master_grpc, fid)
             self._chunk_cache.put(fid, blob)
         return blob
+
+    def _chunk_plain(self, fid: str, cipher_key_b64: str) -> bytes:
+        """Plaintext view of a chunk: decrypt-once LRU for sealed chunks,
+        straight blob-cache hit for plain ones."""
+        if not cipher_key_b64:
+            return self._chunk_blob(fid)
+        plain = self._plain_cache.get(fid)
+        if plain is None:
+            from ..util import cipher
+            plain = cipher.maybe_decrypt(self._chunk_blob(fid),
+                                         cipher_key_b64)
+            self._plain_cache.put(fid, plain)
+        return plain
 
     def truncate(self, path: str, size: int) -> None:
         """ftruncate(2): size 0 drops every chunk; a shorter size keeps

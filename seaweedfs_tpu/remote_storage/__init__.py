@@ -219,9 +219,24 @@ class RemoteMount:
         self.master_grpc = master_grpc
         self.remote = remote
         self.mount_dir = mount_dir.rstrip("/")
+        self._cipher: "bool | None" = None  # filer's posture, lazy
 
     def _filer(self):
         return POOL.client(self.filer_grpc, "SeaweedFiler")
+
+    def _filer_cipher(self) -> bool:
+        """Does the filer run -encryptVolumeData?  remote.cache writes
+        local chunks directly, so it must match the filer's at-rest
+        posture — advertised via GetFilerConfiguration exactly like the
+        reference's cipher field (pb/filer.proto
+        GetFilerConfigurationResponse.cipher)."""
+        if self._cipher is None:
+            try:
+                out = self._filer().call("GetFilerConfiguration", {})
+                self._cipher = bool(out.get("cipher", False))
+            except RpcError:
+                self._cipher = False
+        return self._cipher
 
     def _entry_path(self, key: str) -> str:
         return f"{self.mount_dir}/{key}"
@@ -258,15 +273,23 @@ class RemoteMount:
     def cache(self, key: str) -> None:
         """Pull remote content into local chunks (the FetchAndWriteNeedle
         flow, server/volume_grpc_remote.go — here via normal upload)."""
+        from ..util import cipher
         data = self.remote.read_object(key)
+        logical_size = len(data)
+        # honor the filer's -encryptVolumeData posture: cached copies
+        # land on the same volume servers the flag promises hold only
+        # ciphertext
+        data, key_b64 = cipher.seal(data, self._filer_cipher())
         fid = operation.assign_and_upload(self.master_grpc, data)
         path = self._entry_path(key)
         directory, _, name = path.rpartition("/")
         entry = self._filer().call("LookupDirectoryEntry", {
             "directory": directory, "name": name})["entry"]
-        entry["chunks"] = [{"file_id": fid, "offset": 0,
-                            "size": len(data),
-                            "modified_ts_ns": time.time_ns()}]
+        chunk = {"file_id": fid, "offset": 0, "size": logical_size,
+                 "modified_ts_ns": time.time_ns()}
+        if key_b64:
+            chunk["cipher_key"] = key_b64
+        entry["chunks"] = [chunk]
         self._filer().call("UpdateEntry", {"entry": entry})
 
     def uncache(self, key: str) -> None:
@@ -299,9 +322,12 @@ class RemoteMount:
             "directory": directory, "name": name})["entry"]
         chunks = entry.get("chunks", [])
         if chunks:
+            from ..util import cipher
             out = bytearray()
             for c in sorted(chunks, key=lambda c: c["offset"]):
-                out += operation.read_file(self.master_grpc, c["file_id"])
+                out += cipher.maybe_decrypt(
+                    operation.read_file(self.master_grpc, c["file_id"]),
+                    c.get("cipher_key", ""))
             return bytes(out)
         return self.remote.read_object(key)
 
@@ -319,11 +345,16 @@ class RemoteMount:
             if ext.get(REMOTE_SYNCED) == "1" \
                     and local_mtime <= remote_mtime:
                 continue
+            from ..util import cipher
             data = bytearray()
             for c in sorted(entry.get("chunks", []),
                             key=lambda c: c["offset"]):
-                data += operation.read_file(self.master_grpc,
-                                            c["file_id"])
+                # the remote tier has no filer entry to hold cipher_key,
+                # so sealed chunks MUST be opened here — pushing raw
+                # ciphertext would make the remote copy irrecoverable
+                data += cipher.maybe_decrypt(
+                    operation.read_file(self.master_grpc, c["file_id"]),
+                    c.get("cipher_key", ""))
             self.remote.write_object(key, bytes(data))
             st = self.remote.stat_object(key)
             ext.update({REMOTE_MTIME: str(st["mtime"]),

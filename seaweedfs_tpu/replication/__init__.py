@@ -17,6 +17,7 @@ from typing import Protocol
 
 from ..filer.entry import Entry
 from ..pb.rpc import POOL, RpcError
+from ..util import cipher
 
 REPLICATION_SOURCE_KEY = "replication.source"  # loop-prevention signature
 
@@ -57,7 +58,9 @@ class FilerSink:
 
     def _rewrite_chunks(self, entry: Entry) -> list[dict]:
         """Copy chunk data into the target cluster (the sink's cluster has
-        its own volume servers; fids don't transfer)."""
+        its own volume servers; fids don't transfer).  Sealed chunks copy
+        as-is — raw ciphertext travels, cipher_key rides in the entry, so
+        the target cluster is exactly as encrypted as the source."""
         out = []
         for c in entry.chunks:
             d = c.to_dict()
@@ -111,7 +114,10 @@ class LocalSink:
             for c in sorted(entry.chunks, key=lambda c: c.offset):
                 if self.read_chunk:
                     f.seek(c.offset)
-                    f.write(self.read_chunk(c.file_id))
+                    # a local mirror is plaintext by definition — the
+                    # target filesystem has nowhere to carry cipher_key
+                    f.write(cipher.maybe_decrypt(
+                        self.read_chunk(c.file_id), c.cipher_key))
 
     def update_entry(self, old: Entry, new: Entry, signature: str) -> None:
         self.create_entry(new, signature)
@@ -143,7 +149,8 @@ class _ChunkStream:
                 c = next(self._chunks, None)
                 if c is None:
                     break
-                data = self._read_chunk(c.file_id)
+                data = cipher.maybe_decrypt(self._read_chunk(c.file_id),
+                                            c.cipher_key)
                 pad = b"\0" * max(0, c.offset - self._pos)
                 self._pos = c.offset + len(data)
                 self._buf = memoryview(bytes(pad) + data)
@@ -167,7 +174,7 @@ def stitch_chunks(entry: Entry, read_chunk):
         return _ChunkStream(chunks, read_chunk), None
     data = bytearray()
     for c in chunks:
-        blob = read_chunk(c.file_id)
+        blob = cipher.maybe_decrypt(read_chunk(c.file_id), c.cipher_key)
         if len(data) < c.offset:      # sparse hole → zero fill
             data.extend(b"\0" * (c.offset - len(data)))
         data[c.offset:c.offset + len(blob)] = blob

@@ -575,7 +575,10 @@ class S3ApiServer:
                     "modified_ts_ns": ch.get("modified_ts_ns", 0),
                     "etag": ch.get("etag", ""),
                     "is_chunk_manifest": ch.get("is_chunk_manifest",
-                                                False)})
+                                                False),
+                    # sealed parts stay readable: losing the key here
+                    # would make the completed object irrecoverable
+                    "cipher_key": ch.get("cipher_key", "")})
             offset += _entry_size(e)
         self._filer().call("CreateEntry", {"entry": {
             "full_path": f"{BUCKETS_PATH}/{bucket}/{key}",

@@ -116,8 +116,12 @@ def cmd_volume_fsck(env: CommandEnv, args: list[str]) -> str:
                 out.append(c)
                 if c.get("is_chunk_manifest"):
                     try:
-                        payload = _json.loads(operation.read_file(
-                            env.master_grpc, c["file_id"]))
+                        from ..util import cipher
+                        blob = cipher.maybe_decrypt(
+                            operation.read_file(env.master_grpc,
+                                                c["file_id"]),
+                            c.get("cipher_key", ""))
+                        payload = _json.loads(blob)
                         out.extend(expand(payload.get("chunks", [])))
                     except Exception:
                         dangling.append({"file_id": c["file_id"],

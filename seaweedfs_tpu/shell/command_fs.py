@@ -116,9 +116,12 @@ def cmd_fs_cat(env: CommandEnv, args: list[str]) -> str:
     except RpcError:
         raise ShellError(f"{path} not found") from None
     from .. import operation
+    from ..util import cipher
     out = bytearray()
     for c in sorted(entry.get("chunks", []), key=lambda c: c["offset"]):
-        out += operation.read_file(env.master_grpc, c["file_id"])
+        out += cipher.maybe_decrypt(
+            operation.read_file(env.master_grpc, c["file_id"]),
+            c.get("cipher_key", ""))
     return out.decode(errors="replace")
 
 

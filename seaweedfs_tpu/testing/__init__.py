@@ -46,7 +46,9 @@ class SimCluster:
                  pulse_seconds: float = 0.4,
                  jwt_key: "str | None" = None,
                  tls: bool = False,
-                 base_dir: "str | None" = None, seed: int = 0):
+                 base_dir: "str | None" = None, seed: int = 0,
+                 encrypt_data: bool = False):
+        self.encrypt_data = encrypt_data
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="simcluster-")
         self.pulse = pulse_seconds
         # JWT ON by default: the default deployment posture must exercise
@@ -129,7 +131,8 @@ class SimCluster:
             self.volume_servers.append(vs)
         self.wait_for_nodes(len(self.volume_servers), timeout)
         for _ in range(self._n_filers):
-            f = FilerServer(self._master_list())
+            f = FilerServer(self._master_list(),
+                            encrypt_data=self.encrypt_data)
             f.start()
             self.filers.append(f)
         if self._want_s3:

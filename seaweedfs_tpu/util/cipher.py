@@ -1,0 +1,87 @@
+"""At-rest chunk encryption — AES256-GCM with a random per-chunk key.
+
+Capability-equivalent to weed/util/cipher.go:23-60 (util.Encrypt /
+util.Decrypt): every chunk gets its own random 256-bit key, the 12-byte
+GCM nonce is prepended to the sealed box, and the key never leaves the
+FILER's metadata (FileChunk.cipher_key) — volume servers, their .dat
+files, replicas, EC shards and cloud tiers all hold only ciphertext.
+Losing the filer entry means losing the data, exactly like the reference.
+
+The wire/disk format is `nonce(12) || ciphertext || tag(16)` — 28 bytes
+of overhead per chunk, carried by the volume layer; FileChunk.size stays
+the PLAINTEXT size so all offset math (visible intervals, range reads,
+sparse zero-fill) is unchanged.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+
+KEY_BYTES = 32    # AES-256
+NONCE_BYTES = 12  # GCM standard nonce
+TAG_BYTES = 16
+OVERHEAD = NONCE_BYTES + TAG_BYTES
+
+
+class CipherError(Exception):
+    """Decryption failed: wrong key, truncated box, or tampered bytes.
+    Always loud — a silent wrong-plaintext would be corruption."""
+
+
+def _aesgcm(key: bytes):
+    if len(key) != KEY_BYTES:
+        raise CipherError(f"cipher key must be {KEY_BYTES} bytes, "
+                          f"got {len(key)}")
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    return AESGCM(key)
+
+
+def gen_key() -> bytes:
+    return os.urandom(KEY_BYTES)
+
+
+def encrypt(data: bytes, key: bytes) -> bytes:
+    """nonce || AESGCM(key, nonce, data) — cipher.go Encrypt's layout."""
+    nonce = os.urandom(NONCE_BYTES)
+    return nonce + _aesgcm(key).encrypt(nonce, bytes(data), None)
+
+
+def decrypt(box: bytes, key: bytes) -> bytes:
+    if len(box) < OVERHEAD:
+        raise CipherError(
+            f"ciphertext too short: {len(box)} < {OVERHEAD} bytes")
+    try:
+        return _aesgcm(key).decrypt(bytes(box[:NONCE_BYTES]),
+                                    bytes(box[NONCE_BYTES:]), None)
+    except Exception as e:  # InvalidTag and friends
+        raise CipherError(f"chunk decryption failed: {e}") from None
+
+
+def key_to_b64(key: bytes) -> str:
+    return base64.b64encode(key).decode()
+
+
+def key_from_b64(s: str) -> bytes:
+    try:
+        return base64.b64decode(s, validate=True)
+    except Exception as e:
+        raise CipherError(f"bad cipher key encoding: {e}") from None
+
+
+def seal(data: bytes, enabled: bool = True) -> tuple[bytes, str]:
+    """The write-path helper every sealing site shares: fresh key,
+    sealed box, base64 key for the chunk record — or a pass-through
+    (data, "") when encryption is off."""
+    if not enabled:
+        return data, ""
+    key = gen_key()
+    return encrypt(data, key), key_to_b64(key)
+
+
+def maybe_decrypt(blob: bytes, cipher_key_b64: str) -> bytes:
+    """The read-path helper: pass-through for legacy/plain chunks, loud
+    CipherError for bad keys or tampered boxes."""
+    if not cipher_key_b64:
+        return blob
+    return decrypt(blob, key_from_b64(cipher_key_b64))

@@ -1,0 +1,335 @@
+"""Encrypted volume data (util/cipher.py; reference weed/util/cipher.go,
+upload_content.go:166, command/filer.go:212): per-chunk AES256-GCM keys
+live only in filer metadata; volume servers, .dat files and blob caches
+hold ciphertext.  Round-trips through filer HTTP, S3 and the mount ops
+layer; wrong keys fail loudly; plaintext provably absent from disk."""
+
+import glob
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu.testing import SimCluster
+from seaweedfs_tpu.util import cipher
+from seaweedfs_tpu.util.http import http_request
+
+MARKER = b"TOP-SECRET-PLAINTEXT-MARKER-0123456789"
+
+
+# -- unit: the box format ---------------------------------------------------
+
+def test_round_trip_and_overhead():
+    key = cipher.gen_key()
+    for plain in (b"", b"x", MARKER * 100, os.urandom(1 << 16)):
+        box = cipher.encrypt(plain, key)
+        assert len(box) == len(plain) + cipher.OVERHEAD
+        assert cipher.decrypt(box, key) == plain
+        if plain:
+            assert plain not in box
+
+
+def test_wrong_key_and_tamper_fail_loudly():
+    key = cipher.gen_key()
+    box = cipher.encrypt(MARKER, key)
+    with pytest.raises(cipher.CipherError):
+        cipher.decrypt(box, cipher.gen_key())
+    flipped = bytes(box[:-1]) + bytes([box[-1] ^ 1])
+    with pytest.raises(cipher.CipherError):
+        cipher.decrypt(flipped, key)
+    with pytest.raises(cipher.CipherError):
+        cipher.decrypt(box[:cipher.OVERHEAD - 1], key)
+    with pytest.raises(cipher.CipherError):
+        cipher.decrypt(box, b"short-key")
+    with pytest.raises(cipher.CipherError):
+        cipher.maybe_decrypt(box, "!!!not-base64!!!")
+
+
+def test_maybe_decrypt_passthrough_for_plain_chunks():
+    assert cipher.maybe_decrypt(MARKER, "") == MARKER
+
+
+def test_every_chunk_gets_its_own_key_and_nonce():
+    key = cipher.gen_key()
+    assert cipher.gen_key() != key
+    assert cipher.encrypt(MARKER, key)[:cipher.NONCE_BYTES] != \
+        cipher.encrypt(MARKER, key)[:cipher.NONCE_BYTES]
+
+
+# -- manifests carry nested keys, so they are sealed too --------------------
+
+def test_encrypted_manifest_fold_and_resolve():
+    from seaweedfs_tpu.filer import (FileChunk, maybe_manifestize,
+                                     resolve_chunk_manifest)
+    blobs: dict[str, bytes] = {}
+    n = [0]
+
+    def save(data: bytes):
+        key = cipher.gen_key()
+        fid = f"m{n[0]}"
+        n[0] += 1
+        blobs[fid] = cipher.encrypt(data, key)
+        return fid, "etag", cipher.key_to_b64(key)
+
+    chunks = [FileChunk(file_id=f"d{i}", offset=i * 10, size=10,
+                        cipher_key=cipher.key_to_b64(cipher.gen_key()))
+              for i in range(25)]
+    folded = maybe_manifestize(save, chunks, batch=10)
+    manifests = [c for c in folded if c.is_chunk_manifest]
+    assert manifests and all(c.cipher_key for c in manifests)
+    # the stored manifest blobs are sealed: no nested key material leaks
+    for c in chunks:
+        for blob in blobs.values():
+            assert c.cipher_key.encode() not in blob
+    resolved = resolve_chunk_manifest(lambda fid: blobs[fid], folded)
+    assert sorted(c.file_id for c in resolved) == \
+        sorted(c.file_id for c in chunks)
+    assert all(c.cipher_key for c in resolved)
+    # a tampered manifest key fails loudly, not with garbage chunks
+    manifests[0].cipher_key = cipher.key_to_b64(cipher.gen_key())
+    with pytest.raises(cipher.CipherError):
+        resolve_chunk_manifest(lambda fid: blobs[fid], folded)
+
+
+# -- cluster: filer HTTP + S3 + disk scan -----------------------------------
+
+@pytest.fixture(scope="module")
+def encrypted_cluster(tmp_path_factory):
+    base = str(tmp_path_factory.mktemp("cipher-cluster"))
+    with SimCluster(volume_servers=1, filers=1, s3=True,
+                    base_dir=base, encrypt_data=True) as c:
+        c.filers[0].chunk_size = 64 * 1024  # force multi-chunk files
+        yield c
+
+
+def _scan_dat_for(cluster, needle: bytes) -> list[str]:
+    hits = []
+    for pattern in ("**/*.dat", "**/*.idx"):
+        for path in glob.glob(os.path.join(cluster.base_dir, pattern),
+                              recursive=True):
+            with open(path, "rb") as f:
+                if needle in f.read():
+                    hits.append(path)
+    return hits
+
+
+def test_filer_http_round_trip_no_plaintext_on_disk(encrypted_cluster):
+    c = encrypted_cluster
+    filer = c.filers[0]
+    body = (MARKER + os.urandom(128)) * 1500  # ~250KB, several chunks
+    status, _, _ = http_request(f"http://{filer.address}/enc/a.bin",
+                                method="POST", body=body)
+    assert status == 201
+    status, got, _ = http_request(f"http://{filer.address}/enc/a.bin")
+    assert status == 200 and got == body
+    # range read decrypts only the covered chunks and still slices right
+    status, part, _ = http_request(
+        f"http://{filer.address}/enc/a.bin",
+        headers={"Range": "bytes=70000-70099"})
+    assert status == 206 and part == body[70000:70100]
+    # entry metadata carries a distinct key per chunk
+    entry = filer.filer.find_entry("/enc/a.bin")
+    keys = [ch.cipher_key for ch in entry.chunks]
+    assert len(keys) > 1 and all(keys) and len(set(keys)) == len(keys)
+    # ...and the volume layer never saw plaintext
+    assert _scan_dat_for(c, MARKER) == []
+
+
+def test_s3_round_trip_through_encrypting_filer(encrypted_cluster):
+    c = encrypted_cluster
+    s3 = c.s3_server.address
+    assert http_request(f"http://{s3}/cipher-bucket",
+                        method="PUT")[0] == 200
+    body = MARKER * 400
+    status, _, _ = http_request(f"http://{s3}/cipher-bucket/obj",
+                                method="PUT", body=body)
+    assert status == 200
+    status, got, _ = http_request(f"http://{s3}/cipher-bucket/obj")
+    assert status == 200 and got == body
+    assert _scan_dat_for(c, MARKER) == []
+
+
+def test_wrong_key_read_fails_loudly(encrypted_cluster):
+    c = encrypted_cluster
+    filer = c.filers[0]
+    body = MARKER * 10
+    assert http_request(f"http://{filer.address}/enc/poison.bin",
+                        method="POST", body=body)[0] == 201
+    entry = filer.filer.find_entry("/enc/poison.bin")
+    entry.chunks[0].cipher_key = cipher.key_to_b64(cipher.gen_key())
+    filer.filer.store.update_entry(entry)
+    status, got, _ = http_request(
+        f"http://{filer.address}/enc/poison.bin")
+    assert status == 500 and b"cipher" in got
+
+
+def test_mount_ops_layer_interops_with_encrypting_filer(encrypted_cluster):
+    """Both directions: mount-written sealed chunks read back through the
+    filer gateway, filer-written ones through the mount (reference weed
+    mount reads cipher_key chunks regardless of its own flag)."""
+    from seaweedfs_tpu.mount.weedfs import WeedFS
+    c = encrypted_cluster
+    filer = c.filers[0]
+    fs = WeedFS(filer.grpc_address, c.master_grpc, encrypt_data=True)
+    fs.start()
+    try:
+        body = MARKER * 999
+        fs.create("/enc/via-mount.bin")
+        fs.write("/enc/via-mount.bin", 0, body)
+        fs.flush("/enc/via-mount.bin")
+        assert fs.read("/enc/via-mount.bin", 0, len(body)) == body
+        entry = filer.filer.find_entry("/enc/via-mount.bin")
+        assert all(ch.cipher_key for ch in entry.chunks)
+        status, got, _ = http_request(
+            f"http://{filer.address}/enc/via-mount.bin")
+        assert status == 200 and got == body
+        # reverse direction: filer-encrypted file read through the mount
+        assert fs.read("/enc/a.bin", 65536, 1024) or True  # may be sparse
+        status, want, _ = http_request(
+            f"http://{filer.address}/enc/a.bin",
+            headers={"Range": "bytes=65536-66559"})
+        assert fs.read("/enc/a.bin", 65536, 1024) == want
+        assert _scan_dat_for(c, MARKER) == []
+    finally:
+        fs.stop()
+
+
+def test_s3_multipart_preserves_cipher_keys(encrypted_cluster):
+    """CompleteMultipartUpload stitches part chunks into the object entry;
+    dropping cipher_key there would make the object irrecoverable."""
+    import re
+    c = encrypted_cluster
+    s3 = c.s3_server.address
+    http_request(f"http://{s3}/mp-bucket", method="PUT")
+    status, body, _ = http_request(
+        f"http://{s3}/mp-bucket/big.bin?uploads", method="POST")
+    assert status == 200
+    upload_id = re.search(rb"<UploadId>([^<]+)</UploadId>", body).group(1) \
+        .decode()
+    parts = [MARKER * 300, os.urandom(9000), MARKER * 123]
+    etags = []
+    for i, part in enumerate(parts, start=1):
+        status, _, hdrs = http_request(
+            f"http://{s3}/mp-bucket/big.bin?partNumber={i}"
+            f"&uploadId={upload_id}", method="PUT", body=part)
+        assert status == 200
+        etags.append(hdrs.get("ETag", ""))
+    complete = "<CompleteMultipartUpload>" + "".join(
+        f"<Part><PartNumber>{i}</PartNumber><ETag>{e}</ETag></Part>"
+        for i, e in enumerate(etags, start=1)) \
+        + "</CompleteMultipartUpload>"
+    status, _, _ = http_request(
+        f"http://{s3}/mp-bucket/big.bin?uploadId={upload_id}",
+        method="POST", body=complete.encode())
+    assert status == 200
+    status, got, _ = http_request(f"http://{s3}/mp-bucket/big.bin")
+    assert status == 200 and got == b"".join(parts)
+    assert _scan_dat_for(c, MARKER) == []
+
+
+def test_shell_fs_cat_decrypts(encrypted_cluster):
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    c = encrypted_cluster
+    filer = c.filers[0]
+    text = b"cat me: " + MARKER
+    assert http_request(f"http://{filer.address}/enc/cat.txt",
+                        method="POST", body=text)[0] == 201
+    env = CommandEnv(c.master_grpc)
+    env.filer_grpc = filer.grpc_address
+    out = run_command(env, "fs.cat /enc/cat.txt")
+    assert MARKER.decode() in out
+
+
+def test_object_and_local_sinks_mirror_plaintext(encrypted_cluster,
+                                                 tmp_path):
+    """LocalSink files and stitched object-sink bodies are PLAINTEXT
+    mirrors (the target has nowhere to carry cipher_key); FilerSink
+    copies ciphertext + key so the target cluster stays sealed."""
+    from seaweedfs_tpu import operation
+    from seaweedfs_tpu.replication import LocalSink, stitch_chunks
+    c = encrypted_cluster
+    filer = c.filers[0]
+    body = MARKER * 77
+    assert http_request(f"http://{filer.address}/enc/mirror.bin",
+                        method="POST", body=body)[0] == 201
+    entry = filer.filer.find_entry("/enc/mirror.bin")
+    read_chunk = lambda fid: operation.read_file(c.master_grpc, fid)
+    # object-sink policy: stitch decrypts
+    stream, data = stitch_chunks(entry, read_chunk)
+    got = stream.read() if stream is not None else data
+    assert got == body
+    # local mirror decrypts
+    sink = LocalSink(str(tmp_path / "mirror"), read_chunk=read_chunk)
+    sink.create_entry(entry, signature="src")
+    assert (tmp_path / "mirror/enc/mirror.bin").read_bytes() == body
+
+
+def test_remote_sync_pushes_plaintext(encrypted_cluster, tmp_path):
+    from seaweedfs_tpu.remote_storage import (LocalDirRemoteStorage,
+                                              RemoteMount)
+    c = encrypted_cluster
+    filer = c.filers[0]
+    body = MARKER * 55
+    assert http_request(f"http://{filer.address}/cloudmnt/push.bin",
+                        method="POST", body=body)[0] == 201
+    cloud = LocalDirRemoteStorage(str(tmp_path / "cloud"))
+    mount = RemoteMount(filer.grpc_address, c.master_grpc, cloud,
+                        "/cloudmnt")
+    assert mount.sync_to_remote() >= 1
+    assert cloud.read_object("push.bin") == body
+    # ...and the mount's read-through fallback decrypts local chunks
+    assert mount.read("push.bin") == body
+
+
+def test_upload_download_cipher_cli(encrypted_cluster, tmp_path, capsys,
+                                    monkeypatch):
+    from seaweedfs_tpu import operation
+    from seaweedfs_tpu.command import main
+    c = encrypted_cluster
+    src = tmp_path / "secret.txt"
+    src.write_bytes(MARKER * 50)
+    assert main(["upload", "-master", c.master_grpc, "-cipher",
+                 str(src)]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["cipherKey"]
+    # the stored blob is ciphertext
+    raw = operation.read_file(c.master_grpc, rec["fid"])
+    assert MARKER not in raw
+    out = tmp_path / "plain.txt"
+    monkeypatch.chdir(tmp_path)
+    assert main(["download", "-master", c.master_grpc,
+                 "-cipherKey", rec["cipherKey"],
+                 "-o", str(out), rec["fid"]]) == 0
+    assert out.read_bytes() == MARKER * 50
+    # one key cannot open several fids — refuse before writing anything
+    assert main(["download", "-master", c.master_grpc,
+                 "-cipherKey", rec["cipherKey"],
+                 rec["fid"], rec["fid"]]) == 1
+    # ...and a wrong key fails with an error, not a traceback
+    assert main(["download", "-master", c.master_grpc,
+                 "-cipherKey", cipher.key_to_b64(cipher.gen_key()),
+                 "-o", str(tmp_path / "bad.bin"), rec["fid"]]) == 1
+
+
+def test_remote_cache_honors_filer_cipher_posture(encrypted_cluster,
+                                                  tmp_path):
+    """remote.cache writes local chunks from OUTSIDE the filer process —
+    it must seal them when the filer runs -encryptVolumeData (the filer
+    advertises its posture via GetFilerConfiguration.cipher)."""
+    from seaweedfs_tpu.remote_storage import (LocalDirRemoteStorage,
+                                              RemoteMount)
+    c = encrypted_cluster
+    filer = c.filers[0]
+    cloud = LocalDirRemoteStorage(str(tmp_path / "cloud2"))
+    cloud.write_object("cachette.bin", MARKER * 64)
+    mount = RemoteMount(filer.grpc_address, c.master_grpc, cloud,
+                        "/cloudcache")
+    mount.mount()
+    mount.cache("cachette.bin")
+    # the cached chunk is sealed on the volume layer...
+    assert _scan_dat_for(c, MARKER) == []
+    # ...and both read paths still serve plaintext
+    assert mount.read("cachette.bin") == MARKER * 64
+    status, got, _ = http_request(
+        f"http://{filer.address}/cloudcache/cachette.bin")
+    assert status == 200 and got == MARKER * 64
