@@ -198,14 +198,24 @@ def cmd_upload(args) -> int:
         with open(path, "rb") as fh:
             data = fh.read()
         record = {"fileName": path, "size": len(data)}
+        compressed = False
         if args.cipher:
             # blob uploads have no filer entry to hold the key, so it is
-            # printed for the caller to keep (download -cipherKey)
+            # printed for the caller to keep (download -cipherKey).
+            # No gzip under -cipher: the needle flag can't be set on an
+            # opaque sealed box, and download has no chunk record
             from ..util import cipher as cipher_mod
             data, record["cipherKey"] = cipher_mod.seal(data)
+        else:
+            # auto-gzip compressible files like the reference's upload
+            # path; the needle flag drives read-side negotiation
+            from ..util import compression
+            data, compressed = compression.maybe_gzip(
+                data, ext=os.path.splitext(path)[1])
         fid = operation.assign_and_upload(
             args.master, data, replication=args.replication,
-            collection=args.collection, ttl=args.ttl)
+            collection=args.collection, ttl=args.ttl,
+            compressed=compressed)
         record["fid"] = fid
         print(json.dumps(record))
     return 0
@@ -219,8 +229,14 @@ def cmd_download(args) -> int:
         print("-cipherKey opens exactly one fid (each upload -cipher "
               "record carries its own key)", file=sys.stderr)
         return 1
+    if args.output and len(args.fids) > 1:
+        print("-o names one output file; downloading several fids into "
+              "it would keep only the last", file=sys.stderr)
+        return 1
     for fid in args.fids:
-        data = operation.read_file(args.master, fid)
+        # stored=False: no chunk record here — the volume server decodes
+        # compressed needles by its own flag
+        data = operation.read_file(args.master, fid, stored=False)
         if args.cipher_key:
             from ..util import cipher as cipher_mod
             try:

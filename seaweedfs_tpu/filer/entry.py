@@ -24,6 +24,9 @@ class FileChunk:
     # base64 AES-256 key when the chunk is encrypted at rest (filer.proto
     # FileChunk.cipher_key; util/cipher.py) — lives ONLY in filer metadata
     cipher_key: str = ""
+    # stored bytes are gzip of the logical content (filer.proto
+    # FileChunk.is_compressed; util/compression.py) — size stays logical
+    is_compressed: bool = False
 
     def to_dict(self) -> dict:
         d = {"file_id": self.file_id, "offset": self.offset,
@@ -32,6 +35,8 @@ class FileChunk:
              "is_chunk_manifest": self.is_chunk_manifest}
         if self.cipher_key:  # omitted for plain chunks: stored entries
             d["cipher_key"] = self.cipher_key  # predate the field
+        if self.is_compressed:
+            d["is_compressed"] = True
         return d
 
     @classmethod
@@ -41,7 +46,8 @@ class FileChunk:
                    modified_ts_ns=d.get("modified_ts_ns", 0),
                    etag=d.get("etag", ""),
                    is_chunk_manifest=d.get("is_chunk_manifest", False),
-                   cipher_key=d.get("cipher_key", ""))
+                   cipher_key=d.get("cipher_key", ""),
+                   is_compressed=d.get("is_compressed", False))
 
 
 @dataclass

@@ -19,6 +19,7 @@ Capability-equivalent to weed/server/filer_server*.go:
 from __future__ import annotations
 
 import json
+import os
 import queue
 import threading
 import time
@@ -26,7 +27,7 @@ import urllib.parse
 
 from .. import operation
 from ..pb.rpc import RpcError, RpcServer
-from ..util import cipher
+from ..util import cipher, compression
 from ..util.http import HttpServer, Request, Response
 from .entry import Attr, Entry, FileChunk
 from .filechunk_manifest import MANIFEST_BATCH, maybe_manifestize
@@ -35,12 +36,14 @@ from .filer import Filer
 from .filerstore import NotFound, new_filer_store
 
 
-def _upload_chunk(r, data: bytes, ttl: str = "") -> dict:
+def _upload_chunk(r, data: bytes, ttl: str = "",
+                  compressed: bool = False) -> dict:
     """Chunk upload to the assigned volume server through the shared
     fast-path selector (operation.upload_to: raw TCP when advertised,
     HTTP when the frame can't express the request or the port is
     dead)."""
-    return operation.upload_to(r, r.fid, data, ttl=ttl)
+    return operation.upload_to(r, r.fid, data, ttl=ttl,
+                               compressed=compressed)
 
 
 CHUNK_SIZE = 8 * 1024 * 1024  # autochunk size (filer_server.go option)
@@ -267,7 +270,7 @@ class FilerServer:
 
     # -- chunk IO ----------------------------------------------------------
     def _save_chunk(self, data: bytes, ts_ns: int, offset: int,
-                    path: str = "") -> FileChunk:
+                    path: str = "", mime: str = "") -> FileChunk:
         rule = self.conf.match(path) if path else {}
         ttl = rule.get("ttl", "")
         r = self._with_master(lambda m: operation.assign(
@@ -275,14 +278,19 @@ class FilerServer:
             collection=rule.get("collection") or self.collection,
             ttl=ttl))
         logical_size = len(data)
-        data, key_b64 = cipher.seal(data, self.encrypt_data)
+        # each chunk encodes independently (util/compression.encode_chunk:
+        # compress-then-seal + the record/needle flags)
+        ext = os.path.splitext(path)[1] if path else ""
+        data, key_b64, compressed, needle_flag = compression.encode_chunk(
+            data, encrypt=self.encrypt_data, ext=ext, mime=mime)
         # the needle must carry the ttl too — needle expiry on read
         # (storage/volume.py) is what actually retires the data; the
-        # TCP frame cannot express ttl, so ttl'd chunks stay on HTTP
-        out = _upload_chunk(r, data, ttl=ttl)
+        # TCP frame cannot express ttl (or the compressed flag), so such
+        # chunks stay on HTTP
+        out = _upload_chunk(r, data, ttl=ttl, compressed=needle_flag)
         return FileChunk(file_id=r.fid, offset=offset, size=logical_size,
                          modified_ts_ns=ts_ns, etag=out.get("eTag", ""),
-                         cipher_key=key_b64)
+                         cipher_key=key_b64, is_compressed=compressed)
 
     def _save_manifest_blob(self, data: bytes) -> tuple[str, str, str]:
         """Manifest blobs carry the nested chunks' cipher keys, so an
@@ -328,11 +336,12 @@ class FilerServer:
         ts_ns = time.time_ns()
         chunks: list[FileChunk] = []
         body = req.body
+        mime = req.headers.get("Content-Type", "")
         for off in range(0, len(body), self.chunk_size) or [0]:
             piece = body[off:off + self.chunk_size]
             if piece or off == 0:
                 chunks.append(self._save_chunk(piece, ts_ns, off,
-                                               path=path))
+                                               path=path, mime=mime))
         chunks = maybe_manifestize(self._save_manifest_blob, chunks)
         now = time.time()
         import hashlib
@@ -395,6 +404,8 @@ class FilerServer:
                 # loud, never silent garbage: wrong/corrupt key or
                 # tampered ciphertext is an integrity failure
                 return Response.error(f"cipher: {e}", 500)
+            except compression.DecodeError as e:
+                return Response.error(f"decompress: {e}", 500)
             headers = {"Accept-Ranges": "bytes"}
         if status == 206:
             headers["Content-Range"] = \
@@ -407,14 +418,15 @@ class FilerServer:
     def _stream_content(self, chunks: list[FileChunk], offset: int,
                         length: int) -> bytes:
         """Gather chunk views; zero-fill sparse gaps (filer/stream.go).
-        Encrypted chunks decrypt here — the cache tiers keep ciphertext,
-        so the disk cache is as cold-storage-safe as the volumes."""
-        keys = {c.file_id: c.cipher_key for c in chunks if c.cipher_key}
+        Encrypted/compressed chunks decode here — the cache tiers keep
+        the stored bytes, so the disk cache is as cold-storage-safe (and
+        as small) as the volumes."""
+        by_fid = {c.file_id: c for c in chunks}
         out = bytearray(length)
         for view in read_views(chunks, offset, length):
-            blob = cipher.maybe_decrypt(
+            blob = compression.decode_chunk_record(
                 self._read_chunk_blob(view.file_id),
-                keys.get(view.file_id, ""))
+                by_fid[view.file_id])
             piece = blob[view.offset_in_chunk:
                          view.offset_in_chunk + view.size]
             at = view.logic_offset - offset
@@ -450,13 +462,11 @@ class FilerServer:
                 "KvGet": self._rpc_kv_get,
                 "KvPut": self._rpc_kv_put,
                 "Statistics": lambda req: {},
-                # filer.proto GetFilerConfiguration: lets CLI tools
-                # (filer.backup, filer.remote.gateway) discover the
-                # master without a -master flag
-                # masters for -master-less CLI tools; cipher so chunk
+                # filer.proto GetFilerConfiguration: masters let CLI
+                # tools (filer.backup, filer.remote.gateway) discover
+                # the master without a -master flag; cipher lets chunk
                 # writers outside this process (remote.cache) match the
-                # at-rest posture (filer.proto
-                # GetFilerConfigurationResponse.cipher)
+                # at-rest posture
                 "GetFilerConfiguration": lambda req: {
                     "masters": [m.strip()
                                 for m in self._master_spec.split(",")],

@@ -98,7 +98,7 @@ class WeedFS:
             mem_limit_bytes=cache_mem_mb << 20,
             mem_item_limit=max(chunk_size, 8 << 20),
             cache_dir=cache_dir)
-        # decrypted-chunk LRU in front of the (ciphertext) chunk cache:
+        # decoded-chunk LRU in front of the (stored-bytes) chunk cache:
         # FUSE reads arrive in ~128KB slices, so without it a sealed
         # 8MB chunk would pay the full AES-GCM open ~64 times per
         # sequential scan.  Memory-only on purpose — plaintext never
@@ -235,26 +235,37 @@ class WeedFS:
         self.meta.upsert(entry)
         self.inodes.lookup(path)
 
-    def _upload_chunk(self, data: bytes, logical_offset: int) -> dict:
-        from ..util import cipher
+    def _upload_chunk(self, data: bytes, logical_offset: int,
+                      ext: str = "") -> dict:
+        from ..util import compression
         logical_size = len(data)
-        data, key_b64 = cipher.seal(data, self.encrypt_data)
+        # same encode (compress-then-seal + flags) as the filer's
+        # _save_chunk, keyed by the file's extension
+        data, key_b64, compressed, needle_flag = compression.encode_chunk(
+            data, encrypt=self.encrypt_data, ext=ext)
         r = operation.assign(self.master_grpc,
                              replication=self.replication,
                              collection=self.collection)
         # shared fast-path selector: raw TCP when advertised, HTTP else
-        operation.upload_to(r, r.fid, data)
+        operation.upload_to(r, r.fid, data, compressed=needle_flag)
         chunk = {"file_id": r.fid, "offset": logical_offset,
                  "size": logical_size, "modified_ts_ns": time.time_ns()}
         if key_b64:
             chunk["cipher_key"] = key_b64
+        if compressed:
+            chunk["is_compressed"] = True
         return chunk
 
     def write(self, path: str, offset: int, data: bytes) -> int:
         with self._lock:
             pw = self._open_writers.get(path)
             if pw is None:
-                pw = PageWriter(self._upload_chunk, self.chunk_size)
+                import os as _os
+                ext = _os.path.splitext(path)[1]
+                pw = PageWriter(
+                    lambda data, off: self._upload_chunk(data, off,
+                                                         ext=ext),
+                    self.chunk_size)
                 self._open_writers[path] = pw
         return pw.write(offset, data)
 
@@ -297,11 +308,10 @@ class WeedFS:
         if offset >= size:
             return b""
         n = min(n, size - offset)
-        keys = {c.file_id: c.cipher_key for c in chunks if c.cipher_key}
+        by_fid = {c.file_id: c for c in chunks}
         out = bytearray(n)
         for view in read_views(chunks, offset, n):
-            blob = self._chunk_plain(view.file_id,
-                                     keys.get(view.file_id, ""))
+            blob = self._chunk_plain(by_fid[view.file_id])
             piece = blob[view.offset_in_chunk:
                          view.offset_in_chunk + view.size]
             at = view.logic_offset - offset
@@ -315,17 +325,17 @@ class WeedFS:
             self._chunk_cache.put(fid, blob)
         return blob
 
-    def _chunk_plain(self, fid: str, cipher_key_b64: str) -> bytes:
-        """Plaintext view of a chunk: decrypt-once LRU for sealed chunks,
-        straight blob-cache hit for plain ones."""
-        if not cipher_key_b64:
-            return self._chunk_blob(fid)
-        plain = self._plain_cache.get(fid)
+    def _chunk_plain(self, chunk: FileChunk) -> bytes:
+        """Plaintext view of a chunk: decode-once LRU for sealed or
+        compressed chunks, straight blob-cache hit for plain ones."""
+        if not chunk.cipher_key and not chunk.is_compressed:
+            return self._chunk_blob(chunk.file_id)
+        plain = self._plain_cache.get(chunk.file_id)
         if plain is None:
-            from ..util import cipher
-            plain = cipher.maybe_decrypt(self._chunk_blob(fid),
-                                         cipher_key_b64)
-            self._plain_cache.put(fid, plain)
+            from ..util.compression import decode_chunk_record
+            plain = decode_chunk_record(self._chunk_blob(chunk.file_id),
+                                        chunk)
+            self._plain_cache.put(chunk.file_id, plain)
         return plain
 
     def truncate(self, path: str, size: int) -> None:

@@ -51,14 +51,16 @@ def derive_fids(r: AssignResult) -> list[str]:
 
 def upload_data(url_or_server: str, fid: str, data: bytes,
                 name: str = "", mime: str = "", ttl: str = "",
-                jwt: str = "") -> dict:
+                jwt: str = "", compressed: bool = False) -> dict:
     import urllib.parse
     qs = urllib.parse.urlencode(
         [(k, v) for k, v in (("name", name), ("mime", mime), ("ttl", ttl),
                              ("jwt", jwt))
          if v])
     target = f"http://{url_or_server}/{fid}" + (f"?{qs}" if qs else "")
-    status, body, _ = http_request(target, method="POST", body=data)
+    headers = {"Content-Encoding": "gzip"} if compressed else None
+    status, body, _ = http_request(target, method="POST", body=data,
+                                   headers=headers)
     if status >= 300:
         raise RuntimeError(f"upload {fid} to {url_or_server}: HTTP {status} "
                            f"{body[:200]!r}")
@@ -214,27 +216,29 @@ _TCP_DEAD_TTL = 60.0
 
 
 def upload_to(r: AssignResult, fid: str, data: bytes,
-              ttl: str = "") -> dict:
+              ttl: str = "", compressed: bool = False) -> dict:
     """Upload one blob against an assign result, picking the raw-TCP
     fast path when the server advertises one — THE fast-path selection
     logic, shared by every client (benchmark, upload CLI, filer chunk
     writes, tests).  Falls back to HTTP when the frame cannot express
-    the request (ttl) or the TCP port is dead (negative-cached for
-    .TCP_DEAD_TTL so one unreachable port does not tax every upload
-    with a connect timeout)."""
-    if r.tcp_url and not ttl and \
+    the request (ttl; the compressed needle flag) or the TCP port is
+    dead (negative-cached for .TCP_DEAD_TTL so one unreachable port
+    does not tax every upload with a connect timeout)."""
+    if r.tcp_url and not ttl and not compressed and \
             _TCP_DEAD.get(r.tcp_url, 0) < time.time():
         try:
             return upload_data_tcp(r.tcp_url, fid, data, jwt=r.auth)
         except (OSError, ConnectionError):
             _TCP_DEAD[r.tcp_url] = time.time() + _TCP_DEAD_TTL
-    return upload_data(r.url, fid, data, jwt=r.auth, ttl=ttl)
+    return upload_data(r.url, fid, data, jwt=r.auth, ttl=ttl,
+                       compressed=compressed)
 
 
-def assign_and_upload(master_grpc: str, data: bytes, **kw) -> str:
+def assign_and_upload(master_grpc: str, data: bytes,
+                      compressed: bool = False, **kw) -> str:
     """-> fid (the one-call `weed upload` path)."""
     r = assign(master_grpc, **kw)
-    upload_to(r, r.fid, data)
+    upload_to(r, r.fid, data, compressed=compressed)
     return r.fid
 
 
@@ -260,7 +264,12 @@ def lookup_volume(master_grpc: str, vid: int,
     return locs
 
 
-def read_file(master_grpc: str, fid: str) -> bytes:
+def read_file(master_grpc: str, fid: str, stored: bool = True) -> bytes:
+    """stored=True (internal readers): the blob's STORED bytes — chunk
+    holders decode via their record's cipher/compression flags, and the
+    raw-TCP fast path applies.  stored=False (record-less readers like
+    `weed download`): HTTP only, no Accept-Encoding, so the volume
+    server decodes by the needle's own is_compressed flag."""
     vid = int(fid.split(",")[0])
     last_err = ""
     for fresh in (False, True):
@@ -273,7 +282,7 @@ def read_file(master_grpc: str, fid: str) -> bytes:
             raise RuntimeError(f"volume {vid} has no locations")
         import http.client
         for loc in locs:
-            if loc.get("tcp_url"):
+            if loc.get("tcp_url") and stored:
                 # transparent raw-TCP fast path; HTTP remains the
                 # fallback (wdclient/volume_tcp_client.go)
                 try:
@@ -284,8 +293,15 @@ def read_file(master_grpc: str, fid: str) -> bytes:
                     last_err = str(e)
                     continue    # server-side error (e.g. not found)
             try:
+                # Accept-Encoding: gzip = "give me the STORED bytes" —
+                # internal readers decode via the chunk record's flags
+                # (util/compression.decode_chunk), matching what the TCP
+                # path above returns; without it the server would burn
+                # CPU decompressing for readers that don't want it to
                 status, body, _ = http_request(
-                    f"http://{loc['url']}/{fid}")
+                    f"http://{loc['url']}/{fid}",
+                    headers={"Accept-Encoding":
+                             "gzip" if stored else "identity"})
             except (OSError, http.client.HTTPException) as e:
                 last_err = f"{loc['url']}: {e}"
                 continue

@@ -231,11 +231,12 @@ class RemoteMount:
         reference's cipher field (pb/filer.proto
         GetFilerConfigurationResponse.cipher)."""
         if self._cipher is None:
-            try:
-                out = self._filer().call("GetFilerConfiguration", {})
-                self._cipher = bool(out.get("cipher", False))
-            except RpcError:
-                self._cipher = False
+            # no fail-open: an unreachable filer must NOT be memoized as
+            # "unencrypted" — cache() would then silently write plaintext
+            # to a sealed cluster.  Let the RpcError surface; cache()
+            # needs the filer for its entry update anyway.
+            out = self._filer().call("GetFilerConfiguration", {})
+            self._cipher = bool(out.get("cipher", False))
         return self._cipher
 
     def _entry_path(self, key: str) -> str:
@@ -322,12 +323,12 @@ class RemoteMount:
             "directory": directory, "name": name})["entry"]
         chunks = entry.get("chunks", [])
         if chunks:
-            from ..util import cipher
+            from ..util.compression import decode_chunk_record
             out = bytearray()
             for c in sorted(chunks, key=lambda c: c["offset"]):
-                out += cipher.maybe_decrypt(
+                out += decode_chunk_record(
                     operation.read_file(self.master_grpc, c["file_id"]),
-                    c.get("cipher_key", ""))
+                    c)
             return bytes(out)
         return self.remote.read_object(key)
 
@@ -345,16 +346,17 @@ class RemoteMount:
             if ext.get(REMOTE_SYNCED) == "1" \
                     and local_mtime <= remote_mtime:
                 continue
-            from ..util import cipher
+            from ..util.compression import decode_chunk_record
             data = bytearray()
             for c in sorted(entry.get("chunks", []),
                             key=lambda c: c["offset"]):
-                # the remote tier has no filer entry to hold cipher_key,
-                # so sealed chunks MUST be opened here — pushing raw
-                # ciphertext would make the remote copy irrecoverable
-                data += cipher.maybe_decrypt(
+                # the remote tier has no filer entry to hold chunk
+                # flags, so sealed/compressed chunks MUST be opened
+                # here — pushing raw stored bytes would make the remote
+                # copy irrecoverable (or silently gzip-wrapped)
+                data += decode_chunk_record(
                     operation.read_file(self.master_grpc, c["file_id"]),
-                    c.get("cipher_key", ""))
+                    c)
             self.remote.write_object(key, bytes(data))
             st = self.remote.stat_object(key)
             ext.update({REMOTE_MTIME: str(st["mtime"]),

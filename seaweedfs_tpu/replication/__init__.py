@@ -17,7 +17,7 @@ from typing import Protocol
 
 from ..filer.entry import Entry
 from ..pb.rpc import POOL, RpcError
-from ..util import cipher
+from ..util.compression import decode_chunk_record
 
 REPLICATION_SOURCE_KEY = "replication.source"  # loop-prevention signature
 
@@ -115,9 +115,10 @@ class LocalSink:
                 if self.read_chunk:
                     f.seek(c.offset)
                     # a local mirror is plaintext by definition — the
-                    # target filesystem has nowhere to carry cipher_key
-                    f.write(cipher.maybe_decrypt(
-                        self.read_chunk(c.file_id), c.cipher_key))
+                    # target filesystem has nowhere to carry the chunk's
+                    # cipher_key / is_compressed flags
+                    f.write(decode_chunk_record(
+                        self.read_chunk(c.file_id), c))
 
     def update_entry(self, old: Entry, new: Entry, signature: str) -> None:
         self.create_entry(new, signature)
@@ -149,8 +150,8 @@ class _ChunkStream:
                 c = next(self._chunks, None)
                 if c is None:
                     break
-                data = cipher.maybe_decrypt(self._read_chunk(c.file_id),
-                                            c.cipher_key)
+                data = decode_chunk_record(self._read_chunk(c.file_id),
+                                           c)
                 pad = b"\0" * max(0, c.offset - self._pos)
                 self._pos = c.offset + len(data)
                 self._buf = memoryview(bytes(pad) + data)
@@ -174,7 +175,7 @@ def stitch_chunks(entry: Entry, read_chunk):
         return _ChunkStream(chunks, read_chunk), None
     data = bytearray()
     for c in chunks:
-        blob = cipher.maybe_decrypt(read_chunk(c.file_id), c.cipher_key)
+        blob = decode_chunk_record(read_chunk(c.file_id), c)
         if len(data) < c.offset:      # sparse hole → zero fill
             data.extend(b"\0" * (c.offset - len(data)))
         data[c.offset:c.offset + len(blob)] = blob

@@ -576,9 +576,10 @@ class S3ApiServer:
                     "etag": ch.get("etag", ""),
                     "is_chunk_manifest": ch.get("is_chunk_manifest",
                                                 False),
-                    # sealed parts stay readable: losing the key here
-                    # would make the completed object irrecoverable
-                    "cipher_key": ch.get("cipher_key", "")})
+                    # sealed/compressed parts stay readable: losing the
+                    # flags here would make the object irrecoverable
+                    "cipher_key": ch.get("cipher_key", ""),
+                    "is_compressed": ch.get("is_compressed", False)})
             offset += _entry_size(e)
         self._filer().call("CreateEntry", {"entry": {
             "full_path": f"{BUCKETS_PATH}/{bucket}/{key}",

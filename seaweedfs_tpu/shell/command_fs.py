@@ -116,12 +116,11 @@ def cmd_fs_cat(env: CommandEnv, args: list[str]) -> str:
     except RpcError:
         raise ShellError(f"{path} not found") from None
     from .. import operation
-    from ..util import cipher
+    from ..util.compression import decode_chunk_record
     out = bytearray()
     for c in sorted(entry.get("chunks", []), key=lambda c: c["offset"]):
-        out += cipher.maybe_decrypt(
-            operation.read_file(env.master_grpc, c["file_id"]),
-            c.get("cipher_key", ""))
+        out += decode_chunk_record(
+            operation.read_file(env.master_grpc, c["file_id"]), c)
     return out.decode(errors="replace")
 
 

@@ -1,0 +1,141 @@
+"""Transparent content compression — gzip on write, negotiated on read.
+
+Capability-equivalent to weed/util/compression.go:17-71 +
+weed/operation/upload_content.go:122-139: compressible content (by mime
+type / extension) is gzipped CLIENT-side before upload, the needle
+carries the `is_compressed` flag, and the volume read handler negotiates
+— serving stored gzip verbatim to `Accept-Encoding: gzip` clients and
+decompressing for everyone else
+(weed/server/volume_server_handlers_read.go:208-215).  Chunked files
+additionally record `is_compressed` per FileChunk (pb FileChunk), which
+is what the filer/mount/sink read paths decode by; zstd is accepted on
+the read side by magic sniffing (the reference's zstd hooks).
+
+Layering with encryption: compress THEN seal (ciphertext does not
+compress).  The stored bytes are then gzip(plain) under AES — the chunk
+record carries both flags and `decode_chunk` unwinds them in order.
+"""
+
+from __future__ import annotations
+
+import gzip as _gzip
+import io
+
+# mime prefixes / exact types / extensions the reference deems worth
+# compressing (util.IsCompressableFileType, weed/util/compression.go) —
+# text-ish content; already-packed formats are skipped
+_MIME_PREFIXES = ("text/",)
+_MIME_TYPES = {
+    "application/json", "application/javascript", "application/xml",
+    "application/xhtml+xml", "application/x-javascript",
+    "application/x-ndjson", "image/svg+xml", "application/x-tar",
+    "application/wasm",
+}
+_EXTS = {
+    ".txt", ".htm", ".html", ".css", ".js", ".json", ".xml", ".csv",
+    ".tsv", ".md", ".svg", ".yaml", ".yml", ".toml", ".conf", ".log",
+    ".sql", ".py", ".go", ".c", ".h", ".cpp", ".java", ".sh", ".rs",
+    ".pdf", ".wasm", ".tar",
+}
+
+GZIP_MAGIC = b"\x1f\x8b"
+ZSTD_MAGIC = b"\x28\xb5\x2f\xfd"
+
+
+class DecodeError(Exception):
+    """Stored-content decompression failed (corrupt bytes, missing
+    codec) — loud like CipherError; silent garbage would be corruption."""
+
+
+def is_compressable(ext: str = "", mime: str = "") -> bool:
+    mime = (mime or "").split(";")[0].strip().lower()
+    if mime.startswith(_MIME_PREFIXES) or mime in _MIME_TYPES:
+        return True
+    return (ext or "").lower() in _EXTS
+
+
+def gzip_data(data: bytes, level: int = 3) -> bytes:
+    """Level 3: the reference's flate.BestSpeed-class tradeoff — the win
+    for text is in the first levels; higher levels buy bytes with CPU the
+    write path can't spare."""
+    buf = io.BytesIO()
+    # mtime=0 keeps output deterministic (byte-identical replicas/etags)
+    with _gzip.GzipFile(fileobj=buf, mode="wb", compresslevel=level,
+                        mtime=0) as f:
+        f.write(data)
+    return buf.getvalue()
+
+
+def ungzip_data(data: bytes) -> bytes:
+    return _gzip.decompress(data)
+
+
+def decompress(data: bytes) -> bytes:
+    """Magic-sniffing decompress for stored content: gzip always, zstd
+    when the optional module exists (reference compression.go's zstd
+    read hooks behind a build tag)."""
+    if data[:2] == GZIP_MAGIC:
+        try:
+            return ungzip_data(data)
+        except (OSError, EOFError, ValueError) as e:
+            raise DecodeError(f"gzip decompress failed: {e}") from None
+    if data[:4] == ZSTD_MAGIC:
+        try:
+            import zstandard
+        except ImportError:
+            raise DecodeError(
+                "stored content is zstd but the zstandard module is "
+                "not available") from None
+        try:
+            return zstandard.ZstdDecompressor().decompress(data)
+        except Exception as e:
+            raise DecodeError(f"zstd decompress failed: {e}") from None
+    return data
+
+
+def maybe_gzip(data: bytes, ext: str = "", mime: str = "",
+               min_size: int = 128) -> tuple[bytes, bool]:
+    """Compress when the content type says it's worth trying AND the
+    result actually shrinks (util.MaybeGzipData keeps the original
+    otherwise).  Tiny payloads skip the attempt — the 18-byte gzip
+    envelope plus CPU can't win under ~128 bytes."""
+    if len(data) < min_size or not is_compressable(ext, mime):
+        return data, False
+    packed = gzip_data(data)
+    if len(packed) >= len(data):
+        return data, False
+    return packed, True
+
+
+def encode_chunk(data: bytes, encrypt: bool = False, ext: str = "",
+                 mime: str = "") -> tuple[bytes, str, bool, bool]:
+    """The one chunk-store helper every write path shares — compress
+    THEN seal (upload_content.go:122-139 order; ciphertext does not
+    compress).  -> (stored_bytes, cipher_key_b64, is_compressed,
+    needle_flag): the record flags for the FileChunk, plus whether the
+    NEEDLE may advertise gzip (never for sealed chunks — the stored
+    bytes are an opaque box no gzip client can use)."""
+    from . import cipher
+    data, compressed = maybe_gzip(data, ext=ext, mime=mime)
+    data, key_b64 = cipher.seal(data, encrypt)
+    return data, key_b64, compressed, compressed and not key_b64
+
+
+def decode_chunk(blob: bytes, cipher_key_b64: str = "",
+                 is_compressed: bool = False) -> bytes:
+    """The one chunk-open helper every read path shares: unseal
+    (util/cipher.py), then decompress — the reverse of the write-side
+    compress-then-seal order."""
+    from . import cipher
+    blob = cipher.maybe_decrypt(blob, cipher_key_b64)
+    if is_compressed:
+        blob = decompress(blob)
+    return blob
+
+
+def decode_chunk_record(blob: bytes, chunk) -> bytes:
+    """decode_chunk keyed off a FileChunk or its dict form."""
+    if isinstance(chunk, dict):
+        return decode_chunk(blob, chunk.get("cipher_key", ""),
+                            chunk.get("is_compressed", False))
+    return decode_chunk(blob, chunk.cipher_key, chunk.is_compressed)

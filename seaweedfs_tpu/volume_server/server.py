@@ -300,6 +300,16 @@ class VolumeServer:
         mime = (n.mime.decode(errors="replace")
                 if n.has_mime() else "application/octet-stream")
         data = bytes(n.data)
+        if n.is_compressed():
+            # negotiate like volume_server_handlers_read.go:208-215:
+            # gzip-accepting clients get the stored bytes verbatim (zero
+            # recompute), everyone else gets them decompressed
+            accept = req.headers.get("Accept-Encoding", "")
+            if "gzip" in accept.lower():
+                headers["Content-Encoding"] = "gzip"
+            else:
+                from ..util.compression import decompress
+                data = decompress(data)
         if req.qs("width") or req.qs("height"):
             data, mime = _maybe_resize_image(
                 data, mime, req.qs("width"), req.qs("height"),
@@ -336,6 +346,11 @@ class VolumeServer:
             n.set_mime(req.qs("mime").encode())
         if req.qs("ttl"):
             n.set_ttl(TTL.parse(req.qs("ttl")))
+        if req.headers.get("Content-Encoding", "").lower() == "gzip" \
+                or req.qs("compressed"):
+            # client uploaded pre-gzipped content (upload_content.go
+            # sets the header); the flag drives read-side negotiation
+            n.set_is_compressed()
         if req.qs("fsync"):
             # durable writes ride the group-commit worker: N concurrent
             # fsync writers share one fsync per batch (volume_write.go:233)
@@ -473,6 +488,9 @@ class VolumeServer:
         for arg in ("name", "mime", "ttl", "jwt"):
             if req.qs(arg):
                 qs += f"&{arg}={urllib.parse.quote(req.qs(arg), safe='')}"
+        if req.headers.get("Content-Encoding", "").lower() == "gzip" \
+                or req.qs("compressed"):
+            qs += "&compressed=1"  # replicas must keep the needle flag
         auth = req.headers.get("Authorization", "")
         if "jwt=" not in qs and auth[:7] in ("BEARER ", "Bearer "):
             qs += f"&jwt={urllib.parse.quote(auth[7:], safe='')}"

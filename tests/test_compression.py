@@ -1,0 +1,240 @@
+"""Compression semantics (util/compression.py; reference
+weed/util/compression.go, upload_content.go:122-139,
+volume_server_handlers_read.go:208-215): compressible content gzips
+client-side, the needle + FileChunk carry is_compressed, reads negotiate
+(stored gzip verbatim for Accept-Encoding: gzip, decompressed otherwise),
+and every chunk consumer decodes by the record's flags."""
+
+import glob
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.testing import SimCluster
+from seaweedfs_tpu.util import compression
+from seaweedfs_tpu.util.http import http_request
+
+TEXT = (b"the quick brown fox jumps over the lazy dog; " * 400)
+
+
+# -- unit -------------------------------------------------------------------
+
+def test_is_compressable_by_mime_and_ext():
+    assert compression.is_compressable(mime="text/plain")
+    assert compression.is_compressable(mime="application/json; charset=x")
+    assert compression.is_compressable(ext=".html")
+    assert compression.is_compressable(ext=".LOG")
+    assert not compression.is_compressable(mime="image/jpeg")
+    assert not compression.is_compressable(ext=".zip")
+    assert not compression.is_compressable()
+
+
+def test_maybe_gzip_only_when_it_wins():
+    packed, ok = compression.maybe_gzip(TEXT, mime="text/plain")
+    assert ok and len(packed) < len(TEXT) // 4
+    assert compression.decompress(packed) == TEXT
+    # wrong type: untouched
+    same, ok = compression.maybe_gzip(TEXT, mime="image/png")
+    assert not ok and same == TEXT
+    # tiny payload: not worth the envelope
+    _, ok = compression.maybe_gzip(b"hi", mime="text/plain")
+    assert not ok
+    # incompressible content under a compressable mime: kept original
+    rnd = os.urandom(4096)
+    same, ok = compression.maybe_gzip(rnd, mime="text/plain")
+    assert not ok and same == rnd
+
+
+def test_decompress_magic_and_errors():
+    assert compression.decompress(b"plain bytes") == b"plain bytes"
+    box = compression.gzip_data(TEXT)
+    assert compression.decompress(box) == TEXT
+    with pytest.raises(compression.DecodeError):
+        compression.decompress(compression.GZIP_MAGIC + b"\xff garbage")
+
+
+def test_decode_chunk_unwinds_compress_then_seal():
+    from seaweedfs_tpu.util import cipher
+    packed, ok = compression.maybe_gzip(TEXT, mime="text/plain")
+    assert ok
+    sealed, key_b64 = cipher.seal(packed)
+    assert compression.decode_chunk(sealed, key_b64, True) == TEXT
+    assert compression.decode_chunk(packed, "", True) == TEXT
+    assert compression.decode_chunk(TEXT, "", False) == TEXT
+
+
+# -- volume-level negotiation ----------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    base = str(tmp_path_factory.mktemp("gz-cluster"))
+    with SimCluster(volume_servers=1, filers=1, s3=True,
+                    base_dir=base) as c:
+        c.filers[0].chunk_size = 64 * 1024
+        yield c
+
+
+def test_volume_get_negotiates(cluster):
+    r = operation.assign(cluster.master_grpc)
+    packed = compression.gzip_data(TEXT)
+    operation.upload_data(r.url, r.fid, packed, jwt=r.auth,
+                          compressed=True)
+    # gzip-accepting client: stored bytes verbatim
+    status, body, hdrs = http_request(
+        f"http://{r.url}/{r.fid}",
+        headers={"Accept-Encoding": "gzip"})
+    assert status == 200 and body == packed
+    assert hdrs.get("Content-Encoding") == "gzip"
+    # plain client: server decompresses
+    status, body, hdrs = http_request(
+        f"http://{r.url}/{r.fid}",
+        headers={"Accept-Encoding": "identity"})
+    assert status == 200 and body == TEXT
+    assert "Content-Encoding" not in hdrs
+
+
+def test_volume_flag_survives_replication(tmp_path):
+    with SimCluster(volume_servers=2, base_dir=str(tmp_path)) as c:
+        # the SimCluster default puts its two servers in different racks
+        r = operation.assign(c.master_grpc, replication="010")
+        packed = compression.gzip_data(TEXT)
+        operation.upload_data(r.url, r.fid, packed, jwt=r.auth,
+                              compressed=True)
+        # read the REPLICA (the other server) without gzip acceptance:
+        # the forwarded compressed=1 flag must have set its needle flag
+        others = [vs for vs in c.volume_servers if vs.url != r.url]
+        assert others
+        status, body, _ = http_request(
+            f"http://{others[0].url}/{r.fid}",
+            headers={"Accept-Encoding": "identity"})
+        assert status == 200 and body == TEXT
+
+
+# -- filer / chunk-record flows --------------------------------------------
+
+def _dat_bytes(cluster) -> int:
+    return sum(os.path.getsize(p) for p in glob.glob(
+        os.path.join(cluster.base_dir, "**/*.dat"), recursive=True))
+
+
+def test_filer_autocompresses_text(cluster):
+    filer = cluster.filers[0]
+    before = _dat_bytes(cluster)
+    body = TEXT * 20  # ~360KB, several 64KB chunks
+    status, _, _ = http_request(
+        f"http://{filer.address}/gz/notes.txt", method="POST", body=body,
+        headers={"Content-Type": "text/plain"})
+    assert status == 201
+    entry = filer.filer.find_entry("/gz/notes.txt")
+    assert len(entry.chunks) > 1
+    assert all(c.is_compressed for c in entry.chunks)
+    assert all(c.size and not c.cipher_key for c in entry.chunks)
+    # bytes on disk grew far less than the logical size
+    assert _dat_bytes(cluster) - before < len(body) // 4
+    status, got, _ = http_request(f"http://{filer.address}/gz/notes.txt")
+    assert status == 200 and got == body
+    # range read slices the decompressed stream
+    status, part, _ = http_request(
+        f"http://{filer.address}/gz/notes.txt",
+        headers={"Range": "bytes=70000-70099"})
+    assert status == 206 and part == body[70000:70100]
+    # S3 read through the gateway sees plaintext too
+    s3 = cluster.s3_server.address
+    http_request(f"http://{s3}/gzb", method="PUT")
+    http_request(f"http://{s3}/gzb/o.txt", method="PUT", body=TEXT,
+                 headers={"Content-Type": "text/plain"})
+    status, got, _ = http_request(f"http://{s3}/gzb/o.txt")
+    assert status == 200 and got == TEXT
+
+
+def test_filer_leaves_incompressible_alone(cluster):
+    filer = cluster.filers[0]
+    body = os.urandom(100_000)
+    http_request(f"http://{filer.address}/gz/blob.bin", method="POST",
+                 body=body)
+    entry = filer.filer.find_entry("/gz/blob.bin")
+    assert not any(c.is_compressed for c in entry.chunks)
+    status, got, _ = http_request(f"http://{filer.address}/gz/blob.bin")
+    assert status == 200 and got == body
+
+
+def test_compression_layers_under_encryption(tmp_path):
+    """compress-then-seal: the volume holds AES(gzip(plain)) — smaller
+    than plaintext AND unreadable; both flags decode on read."""
+    with SimCluster(volume_servers=1, filers=1, base_dir=str(tmp_path),
+                    encrypt_data=True) as c:
+        filer = c.filers[0]
+        body = TEXT * 10
+        before = _dat_bytes(c)
+        status, _, _ = http_request(
+            f"http://{filer.address}/enc.txt", method="POST", body=body,
+            headers={"Content-Type": "text/plain"})
+        assert status == 201
+        entry = filer.filer.find_entry("/enc.txt")
+        assert all(c2.is_compressed and c2.cipher_key
+                   for c2 in entry.chunks)
+        grown = _dat_bytes(c) - before
+        assert grown < len(body) // 4  # compressed even while sealed
+        status, got, _ = http_request(f"http://{filer.address}/enc.txt")
+        assert status == 200 and got == body
+        # plaintext absent from disk
+        for p in glob.glob(os.path.join(c.base_dir, "**/*.dat"),
+                           recursive=True):
+            assert b"quick brown fox" not in open(p, "rb").read()
+
+
+def test_mount_compresses_by_extension(cluster):
+    from seaweedfs_tpu.mount.weedfs import WeedFS
+    filer = cluster.filers[0]
+    fs = WeedFS(filer.grpc_address, cluster.master_grpc)
+    fs.start()
+    try:
+        body = TEXT * 5
+        fs.create("/gz/mounted.txt")
+        fs.write("/gz/mounted.txt", 0, body)
+        fs.flush("/gz/mounted.txt")
+        entry = filer.filer.find_entry("/gz/mounted.txt")
+        assert all(c.is_compressed for c in entry.chunks)
+        assert fs.read("/gz/mounted.txt", 0, len(body)) == body
+        status, got, _ = http_request(
+            f"http://{filer.address}/gz/mounted.txt")
+        assert status == 200 and got == body
+        # mount reads filer-compressed files too
+        assert fs.read("/gz/notes.txt", 70000, 100) == \
+            (TEXT * 20)[70000:70100]
+    finally:
+        fs.stop()
+
+
+def test_sinks_decode_compressed_chunks(cluster, tmp_path):
+    from seaweedfs_tpu.replication import LocalSink, stitch_chunks
+    filer = cluster.filers[0]
+    entry = filer.filer.find_entry("/gz/notes.txt")
+    read_chunk = lambda fid: operation.read_file(cluster.master_grpc,
+                                                 fid)
+    stream, data = stitch_chunks(entry, read_chunk)
+    got = stream.read() if stream is not None else data
+    assert got == TEXT * 20
+    sink = LocalSink(str(tmp_path / "mirror"), read_chunk=read_chunk)
+    sink.create_entry(entry, signature="src")
+    assert (tmp_path / "mirror/gz/notes.txt").read_bytes() == TEXT * 20
+
+
+def test_upload_download_cli_compresses(cluster, tmp_path, capsys,
+                                        monkeypatch):
+    from seaweedfs_tpu.command import main
+    src = tmp_path / "readme.md"
+    src.write_bytes(TEXT)
+    assert main(["upload", "-master", cluster.master_grpc,
+                 str(src)]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # stored bytes are gzip (the internal stored=True read)
+    raw = operation.read_file(cluster.master_grpc, rec["fid"])
+    assert raw[:2] == compression.GZIP_MAGIC and len(raw) < len(TEXT)
+    out = tmp_path / "out.md"
+    monkeypatch.chdir(tmp_path)
+    assert main(["download", "-master", cluster.master_grpc,
+                 "-o", str(out), rec["fid"]]) == 0
+    assert out.read_bytes() == TEXT
