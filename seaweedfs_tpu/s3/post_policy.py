@@ -1,0 +1,231 @@
+"""S3 POST-policy browser uploads — form parsing, policy document
+evaluation, and policy-signature verification.
+
+Capability-equivalent to weed/s3api/s3api_object_handlers_postpolicy.go:1
++ weed/s3api/policy/postpolicyform.go:1 (AWS sigv4-HTTPPOSTConstructPolicy):
+a browser POSTs multipart/form-data to the bucket URL with a base64
+policy document; the gateway verifies the signature over the policy
+string, checks expiration, evaluates every condition (eq / starts-with /
+content-length-range), and stores the `file` part as the object.
+
+Divergences from the reference, on purpose:
+- a failed condition answers 403 AccessDenied with error XML (AWS's
+  documented behavior) instead of the reference's bare 307 redirect;
+- form-field matching is by lowercased name rather than Go's canonical
+  header keys — same equivalence classes, simpler in Python.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime as _dt
+import json
+import re
+from dataclasses import dataclass, field
+
+MAX_FIELD_BYTES = 1 << 20       # per-form-field cap (S3 spec)
+MAX_FORM_BYTES = 5 << 20        # non-file form budget (reference 5MiB)
+
+# which condition keys may use starts-with (postpolicyform.go:31-45);
+# False = eq-only, absent = only x-amz-* / x-amz-meta-* are allowed
+STARTS_WITH_ALLOWED = {
+    "$acl": True, "$bucket": False, "$cache-control": True,
+    "$content-type": True, "$content-disposition": True,
+    "$content-encoding": True, "$expires": True, "$key": True,
+    "$success_action_redirect": True, "$redirect": True,
+    "$success_action_status": False, "$x-amz-algorithm": False,
+    "$x-amz-credential": False, "$x-amz-date": False,
+}
+
+
+class PolicyError(Exception):
+    """Policy parse/evaluation failure -> 403/400 at the handler."""
+
+
+@dataclass
+class PostPolicy:
+    expiration: _dt.datetime
+    conditions: list = field(default_factory=list)  # (op, "$key", value)
+    length_range: "tuple[int, int] | None" = None
+
+
+def parse_multipart_form(body: bytes, content_type: str
+                         ) -> tuple[dict, bytes, str]:
+    """-> ({lowercased field: value}, file_bytes, file_name).
+
+    Minimal RFC 7578 parsing: split on the boundary, one header block
+    per part.  Per AWS, fields after `file` are ignored and `file` is
+    the object payload."""
+    m = re.search(r'boundary="?([^";]+)"?', content_type)
+    if not m:
+        raise PolicyError("multipart/form-data without a boundary")
+    delim = b"--" + m.group(1).encode()
+    fields: dict[str, str] = {}
+    file_bytes: "bytes | None" = None
+    file_name = ""
+    form_budget = MAX_FORM_BYTES
+    for part in body.split(delim)[1:]:
+        if part[:2] in (b"--", b""):  # closing delimiter
+            break
+        part = part.lstrip(b"\r\n")
+        head, sep, payload = part.partition(b"\r\n\r\n")
+        if not sep:
+            continue
+        payload = payload[:-2] if payload.endswith(b"\r\n") else payload
+        disp = ""
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-disposition:"):
+                disp = line.decode(errors="replace")
+        nm = re.search(r'name="([^"]*)"', disp)
+        if not nm:
+            continue
+        name = nm.group(1).lower()
+        if name == "file":
+            fn = re.search(r'filename="([^"]*)"', disp)
+            file_name = fn.group(1) if fn else ""
+            file_bytes = payload
+            break  # everything after `file` is ignored (AWS)
+        if len(payload) > MAX_FIELD_BYTES:
+            raise PolicyError(f"form field {name} exceeds "
+                              f"{MAX_FIELD_BYTES} bytes")
+        form_budget -= len(payload)
+        if form_budget < 0:
+            raise PolicyError("form exceeds the non-file size budget")
+        fields[name] = payload.decode(errors="replace")
+    if file_bytes is None:
+        raise PolicyError("POST requires a `file` form field")
+    return fields, file_bytes, file_name
+
+
+def parse_policy(policy_json: str) -> PostPolicy:
+    """Strictly-typed parse of the policy document
+    (postpolicyform.go ParsePostPolicyForm)."""
+    try:
+        raw = json.loads(policy_json)
+    except ValueError as e:
+        raise PolicyError(f"policy is not JSON: {e}") from None
+    if not isinstance(raw, dict):
+        raise PolicyError("policy must be a JSON object")
+    exp_s = raw.get("expiration")
+    if not isinstance(exp_s, str):
+        raise PolicyError("policy needs an expiration")
+    try:
+        exp = _dt.datetime.fromisoformat(exp_s.replace("Z", "+00:00"))
+    except ValueError as e:
+        raise PolicyError(f"bad expiration: {e}") from None
+    pol = PostPolicy(expiration=exp)
+    for cond in raw.get("conditions", []):
+        if isinstance(cond, dict):
+            # {"acl": "public-read"} is sugar for ["eq", "$acl", ...]
+            for k, v in cond.items():
+                if not isinstance(v, str):
+                    raise PolicyError(f"condition {k}: value must be "
+                                      "a string")
+                pol.conditions.append(("eq", "$" + k.lower(), v))
+        elif isinstance(cond, list) and len(cond) == 3:
+            op = str(cond[0]).lower()
+            if op in ("eq", "starts-with"):
+                if not all(isinstance(c, str) for c in cond):
+                    raise PolicyError(f"condition {cond}: all three "
+                                      "elements must be strings")
+                key = cond[1].lower()
+                if not key.startswith("$"):
+                    raise PolicyError(f"condition key {cond[1]} must "
+                                      "start with $")
+                pol.conditions.append((op, key, cond[2]))
+            elif op == "content-length-range":
+                try:
+                    lo, hi = int(cond[1]), int(cond[2])
+                except (TypeError, ValueError):
+                    raise PolicyError(
+                        "content-length-range needs two integers") \
+                        from None
+                pol.length_range = (lo, hi)
+            else:
+                raise PolicyError(f"unknown condition operator {op!r}")
+        else:
+            raise PolicyError(f"malformed condition {cond!r}")
+    return pol
+
+
+def _cond_holds(op: str, have: str, want: str) -> bool:
+    if op == "eq":
+        return have == want
+    if op == "starts-with":
+        return have.startswith(want)
+    return False
+
+
+def check_policy(fields: dict, pol: PostPolicy,
+                 now: "_dt.datetime | None" = None) -> None:
+    """Evaluate the policy against the (lowercased) form fields
+    (postpolicyform.go CheckPostPolicy).  Raises PolicyError with the
+    failing condition named."""
+    now = now or _dt.datetime.now(_dt.timezone.utc)
+    exp = pol.expiration
+    if exp.tzinfo is None:
+        exp = exp.replace(tzinfo=_dt.timezone.utc)
+    if exp <= now:
+        raise PolicyError("policy expired")
+    # any x-amz-meta-* form input must be named by a condition
+    allowed_meta = {c[1][1:] for c in pol.conditions
+                    if c[1].startswith("$x-amz-meta-")}
+    for name in fields:
+        if name.startswith("x-amz-meta-") and name not in allowed_meta:
+            raise PolicyError(f"extra input field: {name}")
+    for op, key, want in pol.conditions:
+        name = key[1:]
+        starts_ok = STARTS_WITH_ALLOWED.get(key)
+        if starts_ok is not None:
+            if op == "starts-with" and not starts_ok:
+                raise PolicyError(f"{key} does not allow starts-with")
+            if not _cond_holds(op, fields.get(name, ""), want):
+                raise PolicyError(
+                    f"condition failed: [{op}, {key}, {want}]")
+        elif key.startswith("$x-amz-"):
+            # covers x-amz-meta-* and other x-amz-* fields
+            if not _cond_holds(op, fields.get(name, ""), want):
+                raise PolicyError(
+                    f"condition failed: [{op}, {key}, {want}]")
+        # conditions on keys outside the known set and x-amz-*:
+        # ignored, like the reference
+
+
+def verify_policy_signature(iam, fields: dict):
+    """-> Identity.  V2 when a bare `signature` field exists, else V4
+    over the raw base64 policy string
+    (auth_signature_v4.go doesPolicySignatureV4Match:315)."""
+    import hashlib
+    import hmac as _hmac
+
+    from .auth import S3AuthError, _signing_key
+    policy_b64 = fields.get("policy", "")
+    if "signature" in fields:  # SigV2
+        ident = iam.lookup_by_access_key(fields.get("awsaccesskeyid", ""))
+        if ident is None:
+            raise S3AuthError("InvalidAccessKeyId",
+                              "access key does not exist")
+        want = base64.b64encode(_hmac.new(
+            ident.secret_key.encode(), policy_b64.encode(),
+            hashlib.sha1).digest()).decode()
+        if not _hmac.compare_digest(want, fields.get("signature", "")):
+            raise S3AuthError("SignatureDoesNotMatch",
+                              "policy signature mismatch")
+        return ident
+    cred = fields.get("x-amz-credential", "")
+    parts = cred.split("/")
+    if len(parts) != 5 or parts[4] != "aws4_request":
+        raise S3AuthError("AuthorizationHeaderMalformed",
+                          f"bad credential scope {cred!r}")
+    access_key, date, region, service, _ = parts
+    ident = iam.lookup_by_access_key(access_key)
+    if ident is None:
+        raise S3AuthError("InvalidAccessKeyId",
+                          "access key does not exist")
+    key = _signing_key(ident.secret_key, date, region, service)
+    want = _hmac.new(key, policy_b64.encode(), hashlib.sha256).hexdigest()
+    if not _hmac.compare_digest(want,
+                                fields.get("x-amz-signature", "")):
+        raise S3AuthError("SignatureDoesNotMatch",
+                          "policy signature mismatch")
+    return ident

@@ -15,6 +15,7 @@ copied, just re-offset (filer_multipart.go:87-160).
 
 from __future__ import annotations
 
+import base64
 import hashlib
 import json
 import threading
@@ -161,6 +162,20 @@ class S3ApiServer:
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
         req._audit_bucket, req._audit_key = bucket, key  # ONE parse
+        # browser POST-policy uploads authenticate via the signed policy
+        # INSIDE the form, not the Authorization header — route them
+        # before the header-based authenticate rejects them
+        # (s3api_object_handlers_postpolicy.go:21)
+        if req.method == "POST" and bucket and not key \
+                and "delete" not in req.query \
+                and "multipart/form-data" in req.headers.get(
+                    "Content-Type", ""):
+            try:
+                return self._post_policy_upload(bucket, req)
+            except S3AuthError as e:
+                return Response(e.status,
+                                _error_xml(e.code, str(e), path),
+                                content_type="application/xml")
         try:
             ident = self.iam.authenticate(req.method, req.path, req.query,
                                           req.headers, req.body)
@@ -325,22 +340,120 @@ class S3ApiServer:
         self._quota_cache[bucket] = (exceeded, now)
         return exceeded
 
-    def _put_object(self, bucket: str, key: str, req: Request) -> Response:
+    def _store_object(self, bucket: str, key: str, data: bytes,
+                      content_type: str = ""
+                      ) -> "tuple[str, Response | None]":
+        """Quota gate + filer upload + error mapping — the storage tail
+        shared by PUT object and POST-policy uploads.  -> (etag, None)
+        on success, ("", error Response) otherwise."""
         denied = self._quota_response(bucket)
         if denied:
-            return denied
-        headers = {}
-        if req.headers.get("Content-Type"):
-            headers["Content-Type"] = req.headers["Content-Type"]
+            return "", denied
+        headers = {"Content-Type": content_type} if content_type else {}
         status, body, _ = http_request(self._object_url(bucket, key),
-                                       method="POST", body=req.body,
+                                       method="POST", body=data,
                                        headers=headers)
         if status >= 300:
-            return Response(500, _error_xml("InternalError",
-                                            body.decode(errors="replace")),
-                            content_type="application/xml")
-        etag = hashlib.md5(req.body).hexdigest()
+            return "", Response(
+                500, _error_xml("InternalError",
+                                body.decode(errors="replace")),
+                content_type="application/xml")
+        return hashlib.md5(data).hexdigest(), None
+
+    def _put_object(self, bucket: str, key: str, req: Request) -> Response:
+        etag, err = self._store_object(
+            bucket, key, req.body, req.headers.get("Content-Type", ""))
+        if err is not None:
+            return err
         return Response(200, b"", headers={"ETag": f'"{etag}"'})
+
+    def _post_policy_upload(self, bucket: str, req: Request) -> Response:
+        """Browser form upload (POST policy) — parse the form, verify
+        the policy signature, evaluate conditions, store the `file` part
+        (s3api_object_handlers_postpolicy.go PostPolicyBucketHandler).
+        A failed condition answers 403 with error XML (AWS-documented;
+        the reference's bare 307 is a minio inheritance)."""
+        from . import post_policy as pp
+        try:
+            fields, file_bytes, file_name = pp.parse_multipart_form(
+                req.body, req.headers.get("Content-Type", ""))
+        except pp.PolicyError as e:
+            return Response(400, _error_xml("MalformedPOSTRequest",
+                                            str(e), bucket),
+                            content_type="application/xml")
+        key = fields.get("key", "").replace("${filename}", file_name)
+        if not key:
+            # checked AFTER substitution: key="${filename}" with a
+            # filename-less file part must not store at the bucket root
+            return Response(400, _error_xml(
+                "MalformedPOSTRequest", "form needs a non-empty key",
+                bucket), content_type="application/xml")
+        req._audit_key = key  # the URL had none; the audit log should
+        # policy-signature auth + condition checks (skipped entirely on
+        # an open gateway, matching header-auth behavior)
+        if self.iam.is_enabled():
+            ident = pp.verify_policy_signature(self.iam, fields)
+            req._audit_requester = ident.name
+            self._require(ident, ACTION_WRITE, bucket)
+            policy_b64 = fields.get("policy", "")
+            if policy_b64:
+                try:
+                    policy_json = base64.b64decode(
+                        policy_b64, validate=True).decode()
+                except Exception as e:  # binascii / UnicodeDecodeError
+                    return Response(400, _error_xml(
+                        "MalformedPOSTRequest",
+                        f"policy is not base64 JSON: {e}", bucket),
+                        content_type="application/xml")
+                try:
+                    pol = pp.parse_policy(policy_json)
+                    # conditions see the SUBSTITUTED key and the
+                    # implicit bucket, like the reference's formValues
+                    pol_fields = dict(fields, bucket=bucket, key=key)
+                    pp.check_policy(pol_fields, pol)
+                except pp.PolicyError as e:
+                    return Response(403, _error_xml(
+                        "AccessDenied", f"policy: {e}", bucket),
+                        content_type="application/xml")
+                if pol.length_range is not None:
+                    lo, hi = pol.length_range
+                    if len(file_bytes) < lo:
+                        return Response(400, _error_xml(
+                            "EntityTooSmall",
+                            f"{len(file_bytes)} < {lo}", bucket),
+                            content_type="application/xml")
+                    if len(file_bytes) > hi:
+                        return Response(400, _error_xml(
+                            "EntityTooLarge",
+                            f"{len(file_bytes)} > {hi}", bucket),
+                            content_type="application/xml")
+        etag, err = self._store_object(bucket, key, file_bytes,
+                                       fields.get("content-type", ""))
+        if err is not None:
+            return err
+        redirect = fields.get("success_action_redirect", "")
+        if redirect:
+            q = urllib.parse.urlencode(
+                {"bucket": bucket, "key": key, "etag": f'"{etag}"'})
+            sep = "&" if "?" in redirect else "?"
+            return Response(303, b"", headers={
+                "Location": f"{redirect}{sep}{q}",
+                "ETag": f'"{etag}"'})
+        want_status = fields.get("success_action_status", "")
+        if want_status == "201":
+            root = ET.Element("PostResponse")
+            _el(root, "Bucket", bucket)
+            _el(root, "Key", key)
+            _el(root, "ETag", f'"{etag}"')
+            _el(root, "Location",
+                f"http://{req.headers.get('Host', '')}/{bucket}/"
+                + urllib.parse.quote(key))
+            return Response(201, _xml(root),
+                            content_type="application/xml",
+                            headers={"ETag": f'"{etag}"'})
+        if want_status == "200":
+            return Response(200, b"", headers={"ETag": f'"{etag}"'})
+        return Response(204, b"", headers={"ETag": f'"{etag}"'})
 
     def _get_object(self, bucket: str, key: str, req: Request) -> Response:
         headers = {}
