@@ -392,7 +392,24 @@ class S3ApiServer:
         # policy-signature auth + condition checks (skipped entirely on
         # an open gateway, matching header-auth behavior)
         if self.iam.is_enabled():
-            ident = pp.verify_policy_signature(self.iam, fields)
+            if "x-amz-signature" not in fields \
+                    and "signature" not in fields:
+                # credential-less form: the anonymous identity, exactly
+                # like header auth's fallback (auth.py authenticate)
+                ident = self.iam.lookup_anonymous()
+                if ident is None:
+                    raise S3AuthError("AccessDenied",
+                                      "no policy signature provided")
+            else:
+                ident = pp.verify_policy_signature(self.iam, fields)
+                if not fields.get("policy"):
+                    # AWS requires the policy element on authenticated
+                    # POST — a signed empty policy would skip every
+                    # condition/expiration/size check
+                    return Response(400, _error_xml(
+                        "MalformedPOSTRequest",
+                        "authenticated POST requires a policy",
+                        bucket), content_type="application/xml")
             req._audit_requester = ident.name
             self._require(ident, ACTION_WRITE, bucket)
             policy_b64 = fields.get("policy", "")

@@ -273,6 +273,41 @@ def test_post_policy_requires_write_action(bucket):
     assert status == 403 and b"AccessDenied" in body
 
 
+def test_post_policy_anonymous_identity(s3stack):  # noqa: F811
+    """With an 'anonymous' identity holding Write, a credential-less
+    browser form works — like header auth's anonymous fallback; without
+    one it is refused."""
+    from seaweedfs_tpu.s3.auth import Identity
+    _, _, _, s3srv, client = s3stack
+    client.request("PUT", "/anonb")
+    status, body, _ = post_form(s3srv.address, "anonb",
+                                {"key": "nope.bin"}, b"x")
+    assert status == 403 and b"AccessDenied" in body
+    s3srv.iam.identities.append(
+        Identity(name="anonymous", actions=["Write"]))
+    try:
+        status, body, _ = post_form(s3srv.address, "anonb",
+                                    {"key": "anon.bin"}, b"anon data")
+        assert status == 204, body
+    finally:
+        s3srv.iam.identities.pop()
+    status, got, _ = client.request("GET", "/anonb/anon.bin")
+    assert status == 200 and got == b"anon data"
+
+
+def test_post_policy_signed_but_empty_policy_is_400(bucket):
+    """A signature over the empty string must not buy a condition-free
+    upload: AWS requires the policy element on authenticated POST."""
+    s3, _ = bucket
+    fields = dict(signed_fields(""), key="nopolicy.bin")
+    fields.pop("policy")
+    status, body, _ = post_form(s3, "forms", fields, b"x")
+    assert status == 400 and b"MalformedPOSTRequest" in body
+    fields = dict(signed_fields(""), key="nopolicy.bin")  # empty string
+    status, body, _ = post_form(s3, "forms", fields, b"x")
+    assert status == 400 and b"MalformedPOSTRequest" in body
+
+
 def test_post_policy_open_gateway(tmp_path):
     """No IAM configured: browser uploads work without a signature,
     matching header-auth behavior on an open gateway."""
