@@ -151,17 +151,22 @@ def split_path(full_path: str) -> tuple[str, str]:
     return d or "/", n
 
 
-def lex_increment(b: bytes) -> bytes:
+def lex_increment(b: bytes) -> "bytes | None":
     """Smallest key greater than every key prefixed by b — the range-end
     computation every seek-paginated store shares (etcd clientv3's
-    GetPrefixRangeEnd)."""
+    GetPrefixRangeEnd).  An all-0xFF prefix has NO such key: returns
+    None, meaning 'no upper bound' (etcd expresses the same with "\\x00";
+    a 0xFF-fill sentinel would sort BELOW longer 0xFF-prefixed keys and
+    silently exclude them).  Unreachable for current key shapes — every
+    store key starts with a printable prefix — but callers treat None as
+    an unbounded range so the contract holds at the edge."""
     out = bytearray(b)
     while out:
         if out[-1] < 0xFF:
             out[-1] += 1
             return bytes(out)
         out.pop()
-    return b"\xff" * 9
+    return None
 
 
 # sqlite/mysql/postgres all ride the shared abstract-SQL engine

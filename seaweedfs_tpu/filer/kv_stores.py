@@ -183,12 +183,16 @@ class EtcdStore(FilerStore):
         range_end = _lex_increment(base + prefix if prefix else base)
         out: list[Entry] = []
         get_range = getattr(self.client, "get_range", None)
-        if get_range is not None:
+        if get_range is not None and range_end is not None:
             it = get_range(range_start, range_end, limit=limit)
         else:
-            # degraded client: prefix scan, still range-filtered here
+            # degraded client (or unbounded range-end edge): prefix
+            # scan, still range-filtered here; the shared loop below
+            # caps output at `limit`
             it = (pair for pair in self.client.get_prefix(base)
-                  if range_start <= _meta_key(pair[1]) < range_end)
+                  if range_start <= _meta_key(pair[1])
+                  and (range_end is None
+                       or _meta_key(pair[1]) < range_end))
         for value, meta in it:
             name = _meta_key(meta).split("\x00", 1)[1]
             if prefix and not name.startswith(prefix):
@@ -219,6 +223,8 @@ def _meta_key(meta) -> str:
     return k.decode() if isinstance(k, bytes) else k
 
 
-def _lex_increment(s: str) -> str:
-    """filerstore.lex_increment over the etcd store's str keys."""
-    return lex_increment(s.encode()).decode(errors="surrogateescape")
+def _lex_increment(s: str) -> "str | None":
+    """filerstore.lex_increment over the etcd store's str keys (None =
+    unbounded, same contract)."""
+    end = lex_increment(s.encode())
+    return None if end is None else end.decode(errors="surrogateescape")

@@ -119,9 +119,10 @@ class CassandraStore(FilerStore):
             cql += " AND name >= %s"
             params.append(prefix)
         if prefix:
-            cql += " AND name < %s"
-            params.append(_inc_bytes(prefix.encode()).decode(
-                errors="surrogateescape"))
+            end = _inc_bytes(prefix.encode())
+            if end is not None:  # None = unbounded: the in-loop
+                cql += " AND name < %s"  # startswith filter suffices
+                params.append(end.decode(errors="surrogateescape"))
         cql += " LIMIT %s"
         params.append(limit)
         out = []
@@ -195,8 +196,11 @@ class HBaseStore(FilerStore):
         base = full_path.rstrip("/")
         for start in (f"{base or '/'}\x00".encode(),
                       f"{base}/".encode()):
+            stop = _inc_bytes(start)
             for key, _ in list(self.table.scan(
-                    row_start=start, row_stop=_inc_bytes(start))):
+                    row_start=start, row_stop=stop)):
+                if stop is None and not key.startswith(start):
+                    break  # unbounded edge: stay inside the prefix
                 self.table.delete(key)
 
     def list_directory_entries(self, dir_path: str, start_name: str = "",
@@ -214,6 +218,8 @@ class HBaseStore(FilerStore):
         out = []
         for key, data in self.table.scan(row_start=start, row_stop=stop,
                                          limit=limit):
+            if stop is None and not key.startswith(base):
+                break  # unbounded edge: don't walk into the next dir
             name = key.decode().split("\x00", 1)[1]
             if prefix and not name.startswith(prefix):
                 continue
@@ -410,7 +416,21 @@ class TikvStore(FilerStore):
         base = full_path.rstrip("/")
         for start in (b"m" + (base or "/").encode() + b"\x00",
                       b"m" + base.encode() + b"/"):
-            self.client.delete_range(start, _inc_bytes(start))
+            end = _inc_bytes(start)
+            if end is None:  # unbounded edge: delete by paged scans
+                # (a real RawClient.scan treats limit as a hard max —
+                # never 'unlimited' — so page explicitly)
+                cursor = start
+                while True:
+                    page = list(self.client.scan(cursor, None, 1024))
+                    hits = [k for k, _ in page if k.startswith(start)]
+                    for k in hits:
+                        self.client.delete(k)
+                    if len(page) < 1024 or not hits:
+                        break
+                    cursor = page[-1][0] + b"\x00"
+                continue
+            self.client.delete_range(start, end)
 
     def list_directory_entries(self, dir_path: str, start_name: str = "",
                                include_start: bool = False,
@@ -426,6 +446,8 @@ class TikvStore(FilerStore):
         end = _inc_bytes(base + prefix.encode() if prefix else base)
         out = []
         for key, value in self.client.scan(start, end, limit):
+            if end is None and not key.startswith(base):
+                break  # unbounded edge: stay inside the directory
             name = key.decode().split("\x00", 1)[1]
             if prefix and not name.startswith(prefix):
                 continue

@@ -251,6 +251,15 @@ static PyObject *py_write_reply(PyObject *self, PyObject *args)
         PyBuffer_Release(&payload);
         return NULL;
     }
+    if ((uint64_t)payload.len > 0xFFFFFFFFu) {
+        /* same fail-loud guard as the Python write_reply: a >=4GiB
+           payload would truncate in the u32 length header and desync
+           the stream */
+        PyBuffer_Release(&payload);
+        PyErr_SetString(PyExc_ValueError,
+                        "reply payload exceeds the u32 frame limit");
+        return NULL;
+    }
     unsigned char hdr[5];
     hdr[0] = (unsigned char)status;
     uint32_t len = (uint32_t)payload.len;

@@ -96,12 +96,16 @@ def _tcp_sock(addr: str):
         host, _, port = addr.rpartition(":")
         sock = _socket.create_connection((host, int(port)), timeout=30)
         sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-        fp = _fastpath()
+        import sys as _sys
+        fp = _fastpath() if _sys.platform == "linux" else None
         ctx = rf = None
         if fp is not None:
             # the C loop needs a BLOCKING fd (a Python-level timeout
             # flips the socket non-blocking and raw recv sees EAGAIN);
-            # keep the 30s guard at the OS level instead
+            # keep the 30s guard at the OS level instead.  The 'll'
+            # timeval packing assumes Linux LP64 — hence the platform
+            # gate above: anywhere else it would be garbage or zero
+            # (blocking forever), so those hosts take the Python path
             import struct as _struct
             sock.settimeout(None)
             tv = _struct.pack("ll", 30, 0)

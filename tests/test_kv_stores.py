@@ -236,3 +236,19 @@ def test_contract_update_overwrites(store):
     assert store.find_entry("/u/x").attr.mtime == 99
     # upsert path stays single-entry
     assert len([x for x in store.list_directory_entries("/u")]) == 1
+
+def test_lex_increment_contract():
+    """Range-end helper: ordinary prefixes increment; an all-0xFF prefix
+    has NO upper bound and returns None (ADVICE r4: a 0xFF-fill sentinel
+    would sort below longer 0xFF keys and silently exclude them)."""
+    from seaweedfs_tpu.filer.filerstore import lex_increment
+    assert lex_increment(b"abc") == b"abd"
+    assert lex_increment(b"a\xff") == b"b"
+    assert lex_increment(b"a\xff\xff") == b"b"
+    assert lex_increment(b"\xff") is None
+    assert lex_increment(b"\xff\xff\xff") is None
+    # the None (unbounded) verdict really covers longer 0xFF-keys that
+    # the old sentinel missed
+    sentinel = b"\xff" * 9
+    longer_key = b"\xff" * 12
+    assert longer_key > sentinel  # the bug the contract change fixes
