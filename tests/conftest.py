@@ -12,6 +12,14 @@ import os
 
 import jax
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "live: opt-in integration tests against REAL store/sink "
+        "endpoints (env-gated; see tests/test_live_drivers.py and "
+        "deploy/README.md)")
+
 jax.config.update("jax_platforms", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

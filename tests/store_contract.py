@@ -1,0 +1,99 @@
+"""The filer-store conformance contract — one set of behavioral checks
+every store backend must pass, whether backed by an in-process fake
+(tests/test_more_stores.py) or a REAL endpoint (tests/test_live_drivers.py,
+env-gated).  The reference exercises its drivers the same way through
+compose clusters (docker/seaweedfs-compose.yml); here the contract is the
+shared artifact so fakes and live endpoints can never drift apart."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer import Attr, Entry, Filer, NotFound
+
+# every root the contract touches — live runs purge these before each
+# check so leftovers from earlier runs can't poison assertions
+ROOTS = ("/dir", "/x", "/y", "/u", "/big")
+
+
+def purge(store) -> None:
+    for root in ROOTS:
+        try:
+            store.delete_folder_children(root)
+            store.delete_entry(root)
+        except Exception:
+            pass
+    try:
+        store.kv_delete(b"\x01k")
+    except Exception:
+        pass
+
+
+def crud_listing(store) -> None:
+    f = Filer(store)
+    now = time.time()
+    for name in ("b", "a", "c", "ab"):
+        f.create_entry(Entry(full_path=f"/dir/{name}",
+                             attr=Attr(mtime=now, crtime=now)))
+    assert [e.name for e in f.list_entries("/dir")] == ["a", "ab", "b", "c"]
+    assert [e.name for e in f.list_entries("/dir", start_name="a",
+                                           limit=2)] == ["ab", "b"]
+    assert [e.name for e in f.list_entries("/dir", prefix="a")] \
+        == ["a", "ab"]
+    assert f.find_entry("/dir").is_directory()
+    f.delete_entry("/dir/b")
+    with pytest.raises(NotFound):
+        store.find_entry("/dir/b")
+
+
+def recursive_delete(store) -> None:
+    f = Filer(store)
+    now = time.time()
+    for p in ("/x/a/f1", "/x/a/b/f2", "/x/f3", "/y/keep"):
+        f.create_entry(Entry(full_path=p, attr=Attr(mtime=now, crtime=now)))
+    store.delete_folder_children("/x")
+    for p in ("/x/a", "/x/a/f1", "/x/a/b/f2", "/x/f3"):
+        with pytest.raises(NotFound):
+            store.find_entry(p)
+    assert store.find_entry("/y/keep")
+
+
+def kv_roundtrip(store) -> None:
+    store.kv_put(b"\x01k", b"v\x00v")
+    assert store.kv_get(b"\x01k") == b"v\x00v"
+    store.kv_delete(b"\x01k")
+    with pytest.raises(NotFound):
+        store.kv_get(b"\x01k")
+
+
+def update_overwrites(store) -> None:
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/u/x", attr=Attr(mtime=1, crtime=1)))
+    e = store.find_entry("/u/x")
+    e.attr.mtime = 99
+    store.update_entry(e)
+    assert store.find_entry("/u/x").attr.mtime == 99
+    assert len(list(store.list_directory_entries("/u"))) == 1
+
+
+def paginated_walk(store, n: int = 300, page: int = 37) -> None:
+    """Page-by-page walk with start_name cursors — every store family
+    must paginate with server-side seeks (range/slice/scan)."""
+    f = Filer(store)
+    now = time.time()
+    for i in range(n):
+        f.create_entry(Entry(full_path=f"/big/e{i:04d}",
+                             attr=Attr(mtime=now, crtime=now)))
+    seen, cursor = [], ""
+    while True:
+        entries = store.list_directory_entries("/big", start_name=cursor,
+                                               limit=page)
+        if not entries:
+            break
+        seen += [e.name for e in entries]
+        cursor = entries[-1].name
+    assert seen == [f"e{i:04d}" for i in range(n)]
+
+
+ALL_CHECKS = (crud_listing, recursive_delete, kv_roundtrip,
+              update_overwrites, paginated_walk)
