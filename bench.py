@@ -394,18 +394,25 @@ def main():
                 small = 1 << 20          # production small block
                 # bench-scale calls: the tunnel charges ~60-100ms fixed
                 # per dispatched call, so small calls measure overhead,
-                # not the kernel (BENCH_NOTES.md round-3 finding)
-                wps = 128 << 20          # bytes per shard per call
+                # not the kernel (BENCH_NOTES.md round-3 finding).
+                # >=2GB per call: the round-4 bar is ">=15 GB/s at
+                # >=2GB calls"
+                wps = 205 << 20          # bytes per shard per call
+                # the relayout-free tiled path: data generated directly
+                # in the digit-tiled 5D layout (production builds it as
+                # a free host view; ClayWindowCodec wiring)
+                shape5 = clay_structured.tiled_shape(k, m, wps, small)
                 cfn = jax.jit(_ft.partial(
-                    clay_structured.encode_device, k, m, small=small))
+                    clay_structured.encode_device_tiled, k, m,
+                    small=small))
                 cd = jax.jit(lambda key: jax.random.randint(
-                    key, (k, wps), 0, 256,
+                    key, shape5, 0, 256,
                     dtype=jnp.uint8))(jax.random.PRNGKey(9))
 
                 @jax.jit
                 def cprobe(x):
                     p = cfn(x)
-                    return jnp.sum(p[0, :1024].astype(jnp.int32))
+                    return jnp.sum(p[0, 0, :4].astype(jnp.int32))
 
                 float(cprobe(cd))
                 t0 = time.perf_counter()

@@ -161,10 +161,7 @@ def _layer_mds_matmul(k: int, m: int, u, k0: int):
     import jax.numpy as jnp
 
     from . import rs_jax, rs_pallas
-    from .codec import _tpu_available, ec_backend_override
-    # a 'jax' pin means the XLA path even on TPU (debugging a suspected
-    # pallas miscompile must reach the clay window path too)
-    on_tpu = _tpu_available() and ec_backend_override() != "jax"
+    on_tpu = _use_pallas_engine()
     n = u.shape[-1]
     if not on_tpu:
         return rs_jax.gf_matmul_bits(jnp.asarray(_r_bits(k, m)), u,
@@ -178,6 +175,43 @@ def _layer_mds_matmul(k: int, m: int, u, k0: int):
         jnp.asarray(_r_bits_plane_major(k, m), dtype=jnp.int8), sm)
     out = out.reshape(m, -1)
     return out[:, :n] if pad else out
+
+
+def _use_pallas_engine() -> bool:
+    """ONE gate for 'run the layer-MDS matmul on the Pallas kernel':
+    a TPU exists and the operator has not pinned the XLA engine (a
+    'jax' pin must reach the clay window paths too, for debugging a
+    suspected pallas miscompile) — shared by both matmul entries so
+    the override contract cannot drift between them."""
+    from .codec import _tpu_available, ec_backend_override
+    return _tpu_available() and ec_backend_override() != "jax"
+
+
+def _layer_mds_matmul_cols(k: int, m: int, u, k0: int):
+    """u [k0, X, 128] -> [m, X, 128] — the column-tiled engine for the
+    relayout-free path (rs_pallas.gf_matmul_bits_pallas_cols consumes
+    the operand's native tiling directly).  X pads up to the kernel's
+    32-sublane block (zero columns encode to zero parity, exactly like
+    the sm path's lane padding).  CPU (tests, shard_map dryrun)
+    flattens for the XLA bit-plane path."""
+    import jax.numpy as jnp
+
+    from . import rs_jax, rs_pallas
+    if _use_pallas_engine():
+        x = u.shape[1]
+        vblock = rs_pallas.COLS_DEFAULT_VBLOCK
+        pad = (-x) % vblock
+        if pad:
+            u = jnp.pad(u, ((0, 0), (0, pad), (0, 0)))
+        out = rs_pallas.gf_matmul_bits_pallas_cols(
+            jnp.asarray(_r_bits_plane_major(k, m), dtype=jnp.int8), u,
+            vblock=vblock)
+        return out[:, :x] if pad else out
+    k0_, x, lane = u.shape
+    out = rs_jax.gf_matmul_bits(jnp.asarray(_r_bits(k, m)),
+                                u.reshape(k0_, x * lane),
+                                dot_dtype=jnp.int8)
+    return out.reshape(m, x, lane)
 
 
 def _pair_swap(arr, q: int, t: int, y: int, off: int = 0):
@@ -204,32 +238,104 @@ def _diag_mask(q: int, t: int, y: int, off: int = 0):
     return x == zy
 
 
+def tiled_shape(k: int, m: int, w: int, small: int) -> "tuple | None":
+    """The digit-tiled 5D view [k, n_win, alpha, w_i, 128] of a [k, w]
+    volume slab — a FREE reshape for contiguous host arrays.  None when
+    the window is too narrow for the 128-lane tile (tests' tiny blocks);
+    such calls take the legacy 2D path."""
+    c = code(k, m)
+    w_a = small // c.alpha
+    if w_a % 128 != 0 or w % small != 0:
+        return None
+    return (k, w // small, c.alpha, w_a // 128, 128)
+
+
+def encode_device_tiled(k: int, m: int, data5, *, small: int):
+    """Jittable structured encode over the digit-tiled layout — the
+    RELAYOUT-FREE device path.
+
+    data5 [k, n_win, alpha, w_i, 128] uint8 (tiled_shape's view of the
+    natural [k, W] slab; producers reshape HOST-side where it is free);
+    returns parity [m, n_win, alpha, w_i, 128] (viewable as [m, W]
+    host-side, same argument).
+
+    Round 4's path took [k, W] and paid three hidden HBM round-trips in
+    device reshapes: input [k, W] -> digit axes, the stacked u
+    [k0, ...] -> [k0, W], and the matmul's [k0, W] -> [k0, 8, W/8]
+    retile (each a full copy of its operand — together more traffic
+    than the real work).  Here every reshape either splits/merges axes
+    ABOVE the dense (w_i, 128) minor tile (free) or merges w_i into the
+    sublane axis at its native 32-row tile boundary (also free), so HBM
+    sees only: read data, write+read u, write parity, plus the couple's
+    elementwise pass.  The companion permutation stays an axis swap
+    over the window's q-ary digit axes — never a row gather — and the
+    virtual zero nodes (k..k0) are synthesized per GRID ROW, so only
+    the one partial row pays a concat instead of the whole [k0] slab.
+    Byte-axis parallel throughout — safe under shard_map when the
+    window axis splits on window boundaries."""
+    import jax.numpy as jnp
+
+    c = code(k, m)
+    alpha, k0, q, t = c.alpha, c.k0, c.q, c.t
+    kk, n_win, a, w_i, inner = data5.shape
+    assert (kk, a, inner) == (k, alpha, 128), data5.shape
+    x_cols = n_win * alpha * w_i
+    u_rows = []
+    for y in range(t - 1):
+        lo, hi = y * q, (y + 1) * q
+        if hi <= k:
+            row = data5[lo:hi]
+        elif lo < k:   # the one partial grid row: real nodes + zeros
+            row = jnp.concatenate(
+                [data5[lo:k],
+                 jnp.zeros((hi - k, n_win, alpha, w_i, inner),
+                           jnp.uint8)])
+        else:          # fully virtual row (k0 - k >= q geometries)
+            row = jnp.zeros((q, n_win, alpha, w_i, inner), jnp.uint8)
+        # [x, n_win, z_{t-1}, .., z_0, w_i, inner] — digit z_{t-1} owns
+        # the largest stride of the layer index
+        s = row.reshape(q, n_win, *((q,) * t), w_i, inner)
+        comp = _pair_swap(s, q, t, y, off=1)
+        mask = _diag_mask(q, t, y, off=1)
+        u_rows.append(jnp.where(mask, s,
+                                s ^ _gf_const_mul(GAMMA, comp)))
+    # [k0, n_win, q^t, w_i, 128] -> [k0, X, 128]: merges land exactly on
+    # the u8 (32, 128) tile (alpha and w_i are powers of two with
+    # alpha*w_i >= 32), so the matmul reads it with zero relayout
+    u = jnp.stack(u_rows).reshape(k0, x_cols, inner)
+    u_par = _layer_mds_matmul_cols(k, m, u, k0)
+    # parity row y = t-1: companions pair within the row, axis swap again
+    p = u_par.reshape(q, n_win, *((q,) * t), w_i, inner)
+    comp = _pair_swap(p, q, t, t - 1, off=1)
+    mask = _diag_mask(q, t, t - 1, off=1)
+    c_par = jnp.where(mask, p, _gf_const_mul(
+        int(c._det_inv), p ^ _gf_const_mul(GAMMA, comp)))
+    return c_par.reshape(m, n_win, alpha, w_i, inner)
+
+
 def encode_device(k: int, m: int, data, *, small: int):
     """Jittable structured encode over raw window bytes.
 
     data [k, W] uint8 (W a multiple of the small block) laid out as
     write_ec_files streams it; returns parity [m, W] in the same layout.
 
-    Everything runs in the volume's NATURAL layout — no layer-gather
-    transpose at either end, which measured ~100 ms per 160MB on its own
-    (the whole throughput budget): the per-layer MDS matmul applies the
-    same matrix to every column, so column ORDER is irrelevant to it,
-    and the uncouple/couple steps address the layer structure in place
-    by splitting each window's alpha axis into its q-ary digits
-    ([k0, n_win, q, .., q, w_a1, 128]).  Two more layout rules hold the
-    throughput: the trailing two dims stay a dense (w_a1, 128) u8 tile
-    (digit-sized trailing dims pad 8x in HBM), and the companion
-    permutation is an axis swap, not a gather.  Byte-axis parallel
-    throughout — safe under shard_map when W splits on window
-    boundaries."""
+    Wide windows route through the relayout-free tiled path
+    (encode_device_tiled) — note the in-jit [k, W] <-> 5D reshapes are
+    real device copies; hot callers (ClayWindowCodec, bench) pass the
+    5D view directly, built host-side for free.  Narrow windows (tests'
+    tiny blocks) keep the legacy digit layout with inner=1."""
     import jax.numpy as jnp
 
     c = code(k, m)
     alpha, k0, q, t = c.alpha, c.k0, c.q, c.t
     w = data.shape[-1]
     n_win, w_a = w // small, small // alpha
-    inner = 128 if w_a % 128 == 0 else 1
-    w_i = w_a // inner
+    shape5 = tiled_shape(k, m, w, small)
+    if shape5 is not None:
+        return encode_device_tiled(
+            k, m, data.reshape(shape5), small=small).reshape(m, w)
+    inner = 1
+    w_i = w_a
     flat_c = jnp.concatenate(
         [data.reshape(k, n_win, alpha, w_i, inner),
          jnp.zeros((k0 - k, n_win, alpha, w_i, inner), jnp.uint8)])

@@ -186,6 +186,46 @@ def gf_matmul_bits_pallas_sm(mbits_pm: jax.Array, data: jax.Array, *,
     )(mbits_pm, data)
 
 
+COLS_DEFAULT_VBLOCK = 32  # one full u8 sublane tile per block row
+
+
+@functools.partial(jax.jit, static_argnames=("vblock", "interpret"))
+def gf_matmul_bits_pallas_cols(mbits_pm: jax.Array, data: jax.Array, *,
+                               vblock: int = COLS_DEFAULT_VBLOCK,
+                               interpret: bool = False) -> jax.Array:
+    """Column-tiled layout: data [KI, X, 128] -> parity [MO, X, 128].
+
+    The operand keeps whatever (…, 128)-lane tiling the producer already
+    has — the clay structured path's digit-tiled tensors merge to
+    [k0, X, 128] as a FREE view (X is a multiple of the 32-sublane u8
+    tile), so the matmul consumes them with zero relayout where the
+    2D SM form cost two full HBM round-trips ([k0, W] -> [k0, 8, W/8]
+    is a retile copy on device).  Same kernel math as the shard-major
+    variant; block = (KI, vblock, 128) = 4096 columns at vblock 32."""
+    ki, x, lane = data.shape
+    mo = mbits_pm.shape[0] // 8
+    assert lane == LANE, f"last axis must be {LANE}, got {lane}"
+    assert mbits_pm.shape == (8 * mo, 8 * ki)
+    assert x % vblock == 0, f"X={x} must be a multiple of {vblock}"
+    grid = (x // vblock,)
+    return pl.pallas_call(
+        functools.partial(_gf2_matmul_kernel_sm, ki=ki, mo=mo),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((8 * mo, 8 * ki), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ki, vblock, LANE), lambda i: (0, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((mo, vblock, LANE), lambda i: (0, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((mo, x, LANE), jnp.uint8),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(mbits_pm, data)
+
+
 def to_sm_layout(arr: np.ndarray) -> np.ndarray:
     """HOST-side relayout [.., S, B] -> shard-major [S, 8*prod(lead), B/8].
 

@@ -125,6 +125,20 @@ class ClayWindowCodec:
         if device_compute_ok():
             import jax
             import jax.numpy as jnp
+            shape5 = clay_structured.tiled_shape(self.k, self.m, W,
+                                                 small)
+            if shape5 is not None:
+                # relayout-free fast path: the 5D digit-tiled view is a
+                # FREE host reshape both ways; the device never pays a
+                # retile copy (clay_structured.encode_device_tiled)
+                fn = _clay_device_fn_tiled(self.k, self.m, small)
+                dev = fn(jnp.asarray(
+                    np.ascontiguousarray(data).reshape(shape5)))
+
+                def fetch():
+                    return np.asarray(jax.device_get(dev)) \
+                        .reshape(self.m, W)
+                return fetch
             fn = _clay_device_fn(self.k, self.m, small)
             dev = fn(jnp.asarray(data))
 
@@ -151,6 +165,15 @@ def _clay_device_fn(k: int, m: int, small: int):
     from ...ops import clay_structured
     return jax.jit(functools.partial(
         clay_structured.encode_device, k, m, small=small))
+
+
+@functools.lru_cache(maxsize=8)
+def _clay_device_fn_tiled(k: int, m: int, small: int):
+    import jax
+
+    from ...ops import clay_structured
+    return jax.jit(functools.partial(
+        clay_structured.encode_device_tiled, k, m, small=small))
 
 
 # -- rebuild ---------------------------------------------------------------
