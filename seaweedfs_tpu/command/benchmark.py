@@ -27,6 +27,11 @@ class _Stats:
             self.latencies.append(latency)
             self.bytes += nbytes
 
+    def add_many(self, latencies: list[float], nbytes: int) -> None:
+        with self._lock:
+            self.latencies.extend(latencies)
+            self.bytes += nbytes
+
     def fail(self) -> None:
         with self._lock:
             self.failed += 1
@@ -174,11 +179,25 @@ def run_benchmark(master_grpc: str, n_files: int = 10000,
     batch = 100     # amortize the master round-trip (count=N assigns)
 
     def writer(w: int) -> None:
+        # thread-local accounting, merged once per batch: a lock + list
+        # append per op is measurable when client and servers share one
+        # core (the op itself is ~70us)
+        lats: list[float] = []
+        my_fids: list[str] = []
+
+        def flush():
+            with fid_lock:
+                fids.extend(my_fids)
+            stats.add_many(lats, file_size * len(lats))
+            lats.clear()
+            my_fids.clear()
+
         while True:
             with counter_lock:
                 take = min(batch, remaining[0])
                 remaining[0] -= take
             if take <= 0:
+                flush()
                 return
             try:
                 r = operation.assign(master_grpc, count=take,
@@ -194,11 +213,11 @@ def run_benchmark(master_grpc: str, n_files: int = 10000,
                 t0 = time.time()
                 try:
                     operation.upload_to(r, fid, payload)
-                    stats.add(time.time() - t0, file_size)
-                    with fid_lock:
-                        fids.append(fid)
+                    lats.append(time.time() - t0)
+                    my_fids.append(fid)
                 except Exception:
                     stats.fail()
+            flush()
 
     t0 = time.time()
     _run_workers(concurrency, writer)
@@ -213,11 +232,14 @@ def run_benchmark(master_grpc: str, n_files: int = 10000,
 
         def reader(w: int) -> None:
             r = random.Random(w)
+            lats: list[float] = []
+            nbytes = [0]
             while True:
                 with read_lock:
                     take = min(batch, reads_left[0])
                     reads_left[0] -= take
                 if take <= 0:
+                    stats.add_many(lats, nbytes[0])
                     return
                 # read_file rides the raw-TCP fast path transparently
                 # (operation.read_file tcp_url preference); per-op timing
@@ -227,7 +249,8 @@ def run_benchmark(master_grpc: str, n_files: int = 10000,
                     t0 = time.time()
                     try:
                         data = operation.read_file(master_grpc, fid)
-                        stats.add(time.time() - t0, len(data))
+                        lats.append(time.time() - t0)
+                        nbytes[0] += len(data)
                     except Exception:
                         stats.fail()
 

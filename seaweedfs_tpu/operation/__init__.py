@@ -148,8 +148,20 @@ def _tcp_call(addr: str, op: str, fid: str, jwt: str = "",
 
 def upload_data_tcp(tcp_addr: str, fid: str, data: bytes,
                     jwt: str = "") -> dict:
+    reply = _tcp_call(tcp_addr, "W", fid, jwt, data)
+    # the write reply has ONE producer shape
+    # ('{"name":"","size":N,"eTag":"H"}', volume_server/tcp.py _handle);
+    # parse it with two finds instead of the JSON decoder — measurable
+    # on the 1KB hot path where client and server share one core
+    if reply.startswith(b'{"name":"","size":'):
+        try:
+            num, _, rest = reply[18:].partition(b',"eTag":"')
+            return {"name": "", "size": int(num),
+                    "eTag": rest[:-2].decode()}
+        except ValueError:
+            pass
     import json
-    return json.loads(_tcp_call(tcp_addr, "W", fid, jwt, data))
+    return json.loads(reply)
 
 
 def upload_batch_tcp(tcp_addr: str, items: "list[tuple[str, bytes]]",

@@ -110,12 +110,14 @@ def _decode_jwt_cached(signing_key: str, token: str) -> dict:
     return claims
 
 
-def verify_fid_jwt(signing_key: str, token: str, fid: str) -> None:
+def verify_fid_jwt(signing_key: str, token: str, fid: str,
+                   key: "int | None" = None) -> None:
     """The volume-server write gate: token must be valid AND scoped to
     this fid — exact match, or a vid token whose KeyBase/KeyCount claims
     (batch assigns) cover the fid's needle key.  A bare vid token with no
     key range is accepted for backward compatibility (the reference's
-    vid-wide tokens)."""
+    vid-wide tokens).  Callers that already parsed the fid (the TCP hot
+    path) pass `key` to skip the re-parse."""
     claims = _decode_jwt_cached(signing_key, token)
     claimed = claims.get("Fid", "")
     if not claimed or claimed == fid:
@@ -124,11 +126,12 @@ def verify_fid_jwt(signing_key: str, token: str, fid: str) -> None:
         raise JwtError(f"token is for {claimed}, not {fid}")
     count = int(claims.get("KeyCount") or 0)
     if count > 0:
-        from ..storage.types import parse_needle_id_cookie
-        try:
-            key, _ = parse_needle_id_cookie(fid.split(",", 1)[1])
-        except Exception:
-            raise JwtError(f"unparseable fid {fid}") from None
+        if key is None:
+            from ..storage.types import parse_needle_id_cookie
+            try:
+                key, _ = parse_needle_id_cookie(fid.split(",", 1)[1])
+            except Exception:
+                raise JwtError(f"unparseable fid {fid}") from None
         base = int(claims.get("KeyBase") or 0)
         if not base <= key < base + count:
             raise JwtError(

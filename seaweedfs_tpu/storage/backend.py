@@ -29,6 +29,11 @@ class BackendStorageFile(ABC):
     def get_stat(self) -> tuple[int, float]:
         """(size, mtime)."""
 
+    def size(self) -> int:
+        """Current file size; subclasses with a cached EOF override
+        this to spare the append path a stat per record."""
+        return self.get_stat()[0]
+
     @abstractmethod
     def sync(self) -> None: ...
 
@@ -51,6 +56,10 @@ class DiskFile(BackendStorageFile):
         self.fd = os.open(path, flags, 0o644)
         self.read_only = read_only
         self._closed = False
+        # cached EOF: every mutation goes through this object (write_at /
+        # truncate under the volume lock), so appends need no fstat —
+        # one syscall per needle on the 1KB hot path
+        self._size = os.fstat(self.fd).st_size
 
     def read_at(self, size: int, offset: int) -> bytes:
         chunks = []
@@ -70,20 +79,29 @@ class DiskFile(BackendStorageFile):
         while written < len(data):
             n = os.pwrite(self.fd, view[written:], offset + written)
             written += n
+        if offset + written > self._size:
+            self._size = offset + written
         return written
 
     def append(self, data: bytes) -> int:
         """Write at current EOF; returns the offset written at."""
-        end = self.get_stat()[0]
+        end = self._size
         self.write_at(data, end)
         return end
 
     def truncate(self, size: int) -> None:
         os.ftruncate(self.fd, size)
+        self._size = size
 
     def get_stat(self) -> tuple[int, float]:
         st = os.fstat(self.fd)
+        self._size = st.st_size
         return st.st_size, st.st_mtime
+
+    def size(self) -> int:
+        """Cached EOF — the append hot path's replacement for get_stat
+        (valid because all writes ride this object)."""
+        return self._size
 
     def sync(self) -> None:
         os.fsync(self.fd)
@@ -137,6 +155,9 @@ class MemoryMappedFile(BackendStorageFile):
 
     def get_stat(self) -> tuple[int, float]:
         return self.disk.get_stat()
+
+    def size(self) -> int:
+        return self.disk.size()
 
     def sync(self) -> None:
         self.disk.sync()

@@ -264,7 +264,7 @@ class Needle:
         """Append at EOF (or given offset); returns (offset, size, actual_size)
         (Append, needle_read_write.go:136-166)."""
         if offset is None:
-            offset = w.get_stat()[0]
+            offset = w.size()  # cached EOF on disk backends: no fstat
         if offset >= t.MAX_POSSIBLE_VOLUME_SIZE and t.size_is_valid(self.size):
             raise ValueError(f"volume size {offset} exceeds maximum")
         if version == t.VERSION3 and self.append_at_ns == 0:

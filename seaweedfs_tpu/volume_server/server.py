@@ -396,36 +396,52 @@ class VolumeServer:
         return Response.json({"size": size}, status=202)
 
     # -- raw-TCP data fast path (volume_server/tcp.py frames) --------------
-    def tcp_write(self, fid_str: str, body: bytes, jwt: str) -> dict:
+    def tcp_write(self, fid_str: str, body: bytes,
+                  jwt: str) -> tuple[int, str]:
         """The HTTP write handler's semantics — jwt gate, replication
         fan-out — minus what a TCP frame cannot express (name/mime/ttl/
         fsync params; durable group-commit writes stay HTTP-only).
         Skipping the Request/Response wrapping and its twelve per-op
         query-string parses halved the server-side cost on 1KB writes
-        (BENCH_NOTES.md)."""
+        (BENCH_NOTES.md).  -> (size, etag); every avoidable per-op
+        allocation matters here: the jwt check reuses the parsed needle
+        key, and the replication query string is built only when
+        replicas actually exist."""
         t0 = time.time()
         fid = FileId.parse(fid_str)
         if self.jwt_signing_key:
             from ..security import JwtError, verify_fid_jwt
             try:
-                verify_fid_jwt(self.jwt_signing_key, jwt, str(fid))
-            except JwtError as e:
-                raise ValueError(f"jwt: {e}") from None
+                # hot path: the wire fid verbatim (clients echo the
+                # master's canonical form, so no re-format needed)
+                verify_fid_jwt(self.jwt_signing_key, jwt, fid_str,
+                               key=fid.key)
+            except JwtError:
+                try:
+                    # cold path: a NON-canonical wire fid (upper-case
+                    # hex, zero-padded vid) must still match a token
+                    # minted for the canonical form, like the HTTP gate
+                    verify_fid_jwt(self.jwt_signing_key, jwt, str(fid),
+                                   key=fid.key)
+                except JwtError as e:
+                    raise ValueError(f"jwt: {e}") from None
         n = Needle(id=fid.key, cookie=fid.cookie, data=body)
         try:
             size = self.store.write_volume_needle(fid.volume_id, n)
         except NotFoundError:
             raise ValueError(f"volume {fid.volume_id} not local") from None
-        qs = "type=replicate"
-        if jwt:
-            qs += f"&jwt={urllib.parse.quote(jwt, safe='')}"
-        err = self._fan_out(fid, qs, "POST", body)
+        err = self._fan_out(
+            fid,
+            lambda: "type=replicate"
+            + (f"&jwt={urllib.parse.quote(jwt, safe='')}" if jwt
+               else ""),
+            "POST", body)
         if err:
             raise ValueError(f"replication failed: {err}")
         self.metrics.volume_requests.inc("write")
         self.metrics.volume_latency.observe("write",
                                             value=time.time() - t0)
-        return {"name": "", "size": size, "eTag": n.etag()}
+        return size, n.etag()
 
     def tcp_read(self, fid_str: str) -> bytes:
         fid = FileId.parse(fid_str)
@@ -496,15 +512,19 @@ class VolumeServer:
             qs += f"&jwt={urllib.parse.quote(auth[7:], safe='')}"
         return self._fan_out(fid, qs, method, body)
 
-    def _fan_out(self, fid: FileId, qs: str, method: str,
+    def _fan_out(self, fid: FileId, qs, method: str,
                  body: bytes | None) -> str:
         """The shared replica fan-out (HTTP and TCP write paths).
         Transport errors count as replication failures — a DOWN replica
-        must fail the write loudly, never silently skip it."""
+        must fail the write loudly, never silently skip it.  `qs` may be
+        a zero-arg callable so hot callers defer the query-string build
+        to the (rare) replicated case."""
         locs = [l for l in self._replica_locations(fid.volume_id)
                 if l["url"] != self.url]
         if not locs:
             return ""
+        if callable(qs):
+            qs = qs()
         errors: list[str] = []
         threads = []
 

@@ -239,8 +239,13 @@ class TcpDataServer:
 
     def _handle(self, op: str, fid: str, jwt: str, body: bytes) -> bytes:
         if op == "W":
-            out = self.vs.tcp_write(fid, body, jwt)
-            return json.dumps(out, separators=(",", ":")).encode()
+            size, etag = self.vs.tcp_write(fid, body, jwt)
+            # hand-built reply: same bytes json.dumps would emit for
+            # this fixed shape (size is an int, etag is hex — nothing
+            # needs escaping), at a third of the encoder's cost on the
+            # 1KB-write hot path
+            return b'{"name":"","size":%d,"eTag":"%s"}' \
+                % (size, etag.encode())
         if op == "R":
             return self.vs.tcp_read(fid)
         if op == "D":

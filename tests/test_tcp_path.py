@@ -142,3 +142,19 @@ def test_upload_to_dead_tcp_port_negative_cache(cluster, monkeypatch):
     operation.upload_to(r3, r3.fid, b"third", ttl="1m")
     assert len(attempts) == 1     # TCP never tried for ttl'd uploads
     blocker.close()
+
+
+def test_tcp_write_accepts_noncanonical_fid_with_canonical_token(tmp_path):
+    """A token minted for the canonical fid must authorize the same
+    write sent with a non-canonical wire form (upper-case hex), exactly
+    like the HTTP gate — the TCP fast path's verbatim-string fast check
+    falls back to the canonical form."""
+    from seaweedfs_tpu import operation
+    from seaweedfs_tpu.testing import SimCluster
+    with SimCluster(volume_servers=1, base_dir=str(tmp_path)) as c:
+        r = operation.assign(c.master_grpc)
+        vid, rest = r.fid.split(",", 1)
+        weird = f"{vid},{rest.upper()}"
+        out = operation.upload_data_tcp(r.tcp_url, weird, b"payload",
+                                        jwt=r.auth)
+        assert out["size"] > 0
