@@ -1,0 +1,27 @@
+"""Shared clay-encode oracle for tests — ONE construction of the
+expected parity in the volume's natural byte layout, used by both the
+CPU suite (test_clay_structured.py) and the opt-in real-chip gate
+(test_real_tpu.py) so the layout convention can never drift between
+them."""
+
+import numpy as np
+
+from seaweedfs_tpu.ops import clay_structured
+from seaweedfs_tpu.ops.clay_matrix import code
+
+
+def natural_layout_parity(k: int, m: int, data: np.ndarray,
+                          small: int) -> np.ndarray:
+    """data [k, W] (natural window layout) -> expected parity [m, W]
+    via the numpy oracle (encode_np over layer-major symbols)."""
+    c = code(k, m)
+    W = data.shape[1]
+    win_a = small // c.alpha
+    n_win = W // small
+    sym = np.ascontiguousarray(
+        data.reshape(k, n_win, c.alpha, win_a).transpose(0, 2, 1, 3)
+    ).reshape(k, c.alpha, -1)
+    par = clay_structured.encode_np(k, m, sym)
+    return np.ascontiguousarray(
+        par.reshape(m, c.alpha, n_win, win_a).transpose(0, 2, 1, 3)
+    ).reshape(m, W)
