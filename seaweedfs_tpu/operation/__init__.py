@@ -82,17 +82,17 @@ def _fastpath():
 
 
 def _tcp_sock(addr: str):
-    """-> (socket, buffered reader, C conn ctx | None).  Reply parsing
-    happens in the native C frame loop when available (one C call per
-    round trip, native/fastpath.c), else inside CPython's C
-    BufferedReader — the Python recv loops were a measurable slice of
-    the per-read overhead."""
+    """-> (socket, buffered reader, C conn ctx | None, fastpath module
+    | None).  Reply parsing happens in the native C frame loop when
+    available (one C call per round trip, native/fastpath.c), else
+    inside CPython's C BufferedReader — the Python recv loops were a
+    measurable slice of the per-read overhead."""
     import socket as _socket
     socks = getattr(_TCP_LOCAL, "socks", None)
     if socks is None:
         socks = _TCP_LOCAL.socks = {}
-    trio = socks.get(addr)
-    if trio is None:
+    cached = socks.get(addr)
+    if cached is None:
         host, _, port = addr.rpartition(":")
         sock = _socket.create_connection((host, int(port)), timeout=30)
         sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
@@ -116,15 +116,17 @@ def _tcp_sock(addr: str):
             # only built when the C ctx is absent: two readers on one
             # socket would steal bytes from each other
             rf = sock.makefile("rb")
-        trio = socks[addr] = (sock, rf, ctx)
-    return trio
+        # the resolved C module rides in the tuple so the per-call path
+        # skips the module-attribute chase (~3us/op on this box)
+        cached = socks[addr] = (sock, rf, ctx, fp)
+    return cached
 
 
 def _tcp_call_once(addr: str, op: str, fid: str, jwt: str,
                    body: bytes) -> tuple[int, bytes]:
-    sock, rf, ctx = _tcp_sock(addr)
+    sock, rf, ctx, fp = _tcp_sock(addr)
     if ctx is not None:
-        return _fastpath().request(
+        return fp.request(
             ctx, ord(op), fid.encode(), jwt.encode(), body)
     from ..volume_server.tcp import read_reply_buf, write_frame
     write_frame(sock, op, fid, jwt, body)
@@ -172,13 +174,13 @@ def upload_batch_tcp(tcp_addr: str, items: "list[tuple[str, bytes]]",
     the dominant cost for 1KB blobs.  Returns error strings ('' = ok)
     per item."""
     from ..volume_server.tcp import read_reply_buf, write_frame
-    sock, rf, ctx = _tcp_sock(tcp_addr)
+    sock, rf, ctx, _fp = _tcp_sock(tcp_addr)
     try:
         for fid, data in items:
             write_frame(sock, "W", fid, jwt, data)
         out = []
         for _ in items:
-            status, payload = _read_reply_any(rf, ctx)
+            status, payload = _read_reply_any(rf, ctx, _fp)
             out.append("" if status == 0
                        else payload.decode(errors="replace"))
         return out
@@ -187,12 +189,12 @@ def upload_batch_tcp(tcp_addr: str, items: "list[tuple[str, bytes]]",
         raise
 
 
-def _read_reply_any(rf, ctx):
+def _read_reply_any(rf, ctx, fp=None):
     """One reply via the C conn when it exists (its userspace buffer and
     the Python BufferedReader must never both read the same socket), the
     buffered reader otherwise."""
     if ctx is not None:
-        return _fastpath().read_reply(ctx)
+        return (fp or _fastpath()).read_reply(ctx)
     from ..volume_server.tcp import read_reply_buf
     return read_reply_buf(rf)
 
@@ -201,13 +203,13 @@ def read_batch_tcp(tcp_addr: str, fids: list[str]
                    ) -> "list[bytes | None]":
     """Pipelined reads; None for per-fid errors."""
     from ..volume_server.tcp import write_frame
-    sock, rf, ctx = _tcp_sock(tcp_addr)
+    sock, rf, ctx, _fp = _tcp_sock(tcp_addr)
     try:
         for fid in fids:
             write_frame(sock, "R", fid)
         out: "list[bytes | None]" = []
         for _ in fids:
-            status, payload = _read_reply_any(rf, ctx)
+            status, payload = _read_reply_any(rf, ctx, _fp)
             out.append(payload if status == 0 else None)
         return out
     except (OSError, ConnectionError):
@@ -280,13 +282,42 @@ def lookup_volume(master_grpc: str, vid: int,
     return locs
 
 
+# (master, vid) -> (expires, tcp_url): the one-dict-get fast route for
+# repeat reads of the same volume — skips the location-list walk and
+# its per-call plumbing entirely.  Invalidated on any failure; the slow
+# path below re-resolves and repopulates.
+_TCP_ROUTE: dict = {}
+
+
 def read_file(master_grpc: str, fid: str, stored: bool = True) -> bytes:
     """stored=True (internal readers): the blob's STORED bytes — chunk
     holders decode via their record's cipher/compression flags, and the
     raw-TCP fast path applies.  stored=False (record-less readers like
     `weed download`): HTTP only, no Accept-Encoding, so the volume
     server decodes by the needle's own is_compressed flag."""
-    vid = int(fid.split(",")[0])
+    vid = int(fid.split(",", 1)[0])
+    if stored:
+        route = _TCP_ROUTE.get((master_grpc, vid))
+        now = time.time()
+        if route is not None and route[0] > now \
+                and _TCP_DEAD.get(route[1], 0) < now:
+            try:
+                return read_file_tcp(route[1], fid)
+            except (OSError, ConnectionError):
+                # dead port: negative-cache it so neither this nor the
+                # resolve walk below re-pays the connect timeout
+                _TCP_DEAD[route[1]] = now + _TCP_DEAD_TTL
+                _TCP_ROUTE.pop((master_grpc, vid), None)
+            except RuntimeError:
+                # moved volume / not-found: full resolution below
+                # (it re-raises with context)
+                _TCP_ROUTE.pop((master_grpc, vid), None)
+    return _read_file_resolve(master_grpc, fid, vid, stored)
+
+
+def _read_file_resolve(master_grpc: str, fid: str, vid: int,
+                       stored: bool) -> bytes:
+    import http.client
     last_err = ""
     for fresh in (False, True):
         if fresh:
@@ -296,15 +327,20 @@ def read_file(master_grpc: str, fid: str, stored: bool = True) -> bytes:
         locs = lookup_volume(master_grpc, vid)
         if not locs:
             raise RuntimeError(f"volume {vid} has no locations")
-        import http.client
         for loc in locs:
-            if loc.get("tcp_url") and stored:
+            if loc.get("tcp_url") and stored \
+                    and _TCP_DEAD.get(loc["tcp_url"], 0) < time.time():
                 # transparent raw-TCP fast path; HTTP remains the
                 # fallback (wdclient/volume_tcp_client.go)
                 try:
-                    return read_file_tcp(loc["tcp_url"], fid)
+                    data = read_file_tcp(loc["tcp_url"], fid)
+                    _TCP_ROUTE[(master_grpc, vid)] = (
+                        time.time() + _LOOKUP_TTL, loc["tcp_url"])
+                    return data
                 except (OSError, ConnectionError):
-                    pass        # fall through to HTTP
+                    # shared negative cache with the upload path
+                    _TCP_DEAD[loc["tcp_url"]] = \
+                        time.time() + _TCP_DEAD_TTL
                 except RuntimeError as e:
                     last_err = str(e)
                     continue    # server-side error (e.g. not found)
