@@ -85,6 +85,29 @@ class FilerConf:
         return {}
 
 
+def _accepts_gzip(header: str) -> bool:
+    """RFC 9110 Accept-Encoding: gzip is acceptable when listed (or
+    covered by *) with a non-zero q — a bare substring match would
+    serve gzip to a client that explicitly refused it with gzip;q=0."""
+    best = None
+    for part in header.lower().split(","):
+        token, _, params = part.partition(";")
+        token = token.strip()
+        if token not in ("gzip", "x-gzip", "*"):
+            continue
+        q = 1.0
+        params = params.strip()
+        if params.startswith("q="):
+            try:
+                q = float(params[2:])
+            except ValueError:
+                q = 0.0
+        if token in ("gzip", "x-gzip"):
+            return q > 0
+        best = q  # '*' applies only if gzip itself is not named
+    return bool(best)
+
+
 def _parse_range(spec: str, size: int) -> "tuple[int, int] | None":
     """One RFC 7233 byte-range -> [start, stop) clamped to size, or None if
     unsatisfiable.  Multi-range requests fall back to the full body."""
@@ -392,6 +415,25 @@ class FilerServer:
             if parsed != (0, size):
                 offset, end = parsed
                 length, status = end - offset, 206
+        # whole-file reads of fully-compressed files serve the STORED
+        # gzip verbatim to accepting clients — zero decompress CPU and
+        # compressed wire bytes, like the volume handler's negotiation
+        # (volume_server_handlers_read.go:208-215 at the filer level).
+        # RFC 1952 makes concatenated members legal, so multi-chunk
+        # files stream as one multi-member gzip.
+        if req.method == "GET" and status == 200 \
+                and _accepts_gzip(req.headers.get("Accept-Encoding",
+                                                  "")):
+            ordered = self._gzip_passthrough_chunks(chunks, size)
+            if ordered is not None:
+                body = b"".join(self._read_chunk_blob(c.file_id)
+                                for c in ordered)
+                return Response(200, body,
+                                content_type=entry.attr.mime
+                                or "application/octet-stream",
+                                headers={"Accept-Ranges": "bytes",
+                                         "Content-Encoding": "gzip",
+                                         "Vary": "Accept-Encoding"})
         # HEAD needs only the size/headers, not a full cluster read
         if req.method == "HEAD":
             data = b""
@@ -414,6 +456,26 @@ class FilerServer:
                         content_type=entry.attr.mime
                         or "application/octet-stream",
                         headers=headers)
+
+    @staticmethod
+    def _gzip_passthrough_chunks(chunks: list[FileChunk], size: int
+                                 ) -> "list[FileChunk] | None":
+        """Chunks in serving order when the stored bytes may serve
+        verbatim as one gzip stream, else None.  Every chunk must be
+        gzip (not sealed — ciphertext is opaque), and the chunks must
+        tile [0, size) exactly: any MVCC shadowing, sparse gap, or
+        partial visibility forces the decode path."""
+        if size == 0 or not chunks:
+            return None
+        if any(not c.is_compressed or c.cipher_key for c in chunks):
+            return None
+        ordered = sorted(chunks, key=lambda c: c.offset)
+        pos = 0
+        for c in ordered:
+            if c.offset != pos:
+                return None
+            pos += c.size
+        return ordered if pos == size else None
 
     def _stream_content(self, chunks: list[FileChunk], offset: int,
                         length: int) -> bytes:

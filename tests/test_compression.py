@@ -238,3 +238,74 @@ def test_upload_download_cli_compresses(cluster, tmp_path, capsys,
     assert main(["download", "-master", cluster.master_grpc,
                  "-o", str(out), rec["fid"]]) == 0
     assert out.read_bytes() == TEXT
+
+
+def test_filer_serves_stored_gzip_to_accepting_clients(cluster):
+    """Whole-file GET + Accept-Encoding: gzip = the stored bytes
+    verbatim (multi-member gzip across chunks, RFC 1952) with
+    Content-Encoding; ranges and non-accepting clients still decode."""
+    import gzip as _gzip
+    filer = cluster.filers[0]
+    body = TEXT * 25  # several 64KB chunks
+    http_request(f"http://{filer.address}/gz/served.txt", method="POST",
+                 body=body, headers={"Content-Type": "text/plain"})
+    status, raw, hdrs = http_request(
+        f"http://{filer.address}/gz/served.txt",
+        headers={"Accept-Encoding": "gzip"})
+    assert status == 200 and hdrs.get("Content-Encoding") == "gzip"
+    assert len(raw) < len(body) // 4
+    assert _gzip.decompress(raw) == body  # multi-member decompress
+    # identity client: decoded
+    status, got, hdrs = http_request(
+        f"http://{filer.address}/gz/served.txt",
+        headers={"Accept-Encoding": "identity"})
+    assert status == 200 and got == body \
+        and "Content-Encoding" not in hdrs
+    # range: decoded slice, never gzip
+    status, part, hdrs = http_request(
+        f"http://{filer.address}/gz/served.txt",
+        headers={"Accept-Encoding": "gzip",
+                 "Range": "bytes=100-199"})
+    assert status == 206 and part == body[100:200] \
+        and "Content-Encoding" not in hdrs
+
+
+def test_no_gzip_passthrough_for_sealed_chunks(tmp_path):
+    with SimCluster(volume_servers=1, filers=1, base_dir=str(tmp_path),
+                    encrypt_data=True) as c:
+        filer = c.filers[0]
+        body = TEXT * 5
+        http_request(f"http://{filer.address}/s.txt", method="POST",
+                     body=body, headers={"Content-Type": "text/plain"})
+        status, got, hdrs = http_request(
+            f"http://{filer.address}/s.txt",
+            headers={"Accept-Encoding": "gzip"})
+        # sealed chunks are opaque: the filer decodes, never passes
+        # ciphertext through
+        assert status == 200 and got == body \
+            and "Content-Encoding" not in hdrs
+
+
+def test_no_gzip_passthrough_for_shadowed_or_partial(cluster):
+    """MVCC-shadowed chunk lists must take the decode path — serving
+    stored members verbatim would replay overwritten bytes."""
+    from seaweedfs_tpu.filer import FileChunk
+    from seaweedfs_tpu.filer.server import FilerServer, _accepts_gzip
+    ok = FilerServer._gzip_passthrough_chunks
+    c1 = FileChunk(file_id="1,a", offset=0, size=10, is_compressed=True)
+    c2 = FileChunk(file_id="1,b", offset=10, size=5, is_compressed=True)
+    assert ok([c2, c1], 15) == [c1, c2]       # serving order
+    assert ok([c1, c2], 20) is None           # sparse tail
+    assert ok([c1, FileChunk(file_id="1,c", offset=5, size=10,
+                             is_compressed=True)], 15) is None  # overlap
+    assert ok([c1], 10) == [c1]               # single chunk fine
+    assert ok([FileChunk(file_id="1,d", offset=0, size=10)], 10) is None
+    assert ok([], 0) is None
+    # Accept-Encoding parsing: an explicit refusal must not get gzip
+    assert _accepts_gzip("gzip")
+    assert _accepts_gzip("br, gzip;q=0.5")
+    assert _accepts_gzip("*")
+    assert not _accepts_gzip("gzip;q=0, identity")
+    assert not _accepts_gzip("identity")
+    assert not _accepts_gzip("")
+    assert not _accepts_gzip("*;q=0")
