@@ -102,9 +102,10 @@ def encrypted_cluster(tmp_path_factory):
         yield c
 
 
-def _scan_dat_for(cluster, needle: bytes) -> list[str]:
+def _scan_dat_for(cluster, needle: bytes,
+                  patterns=("**/*.dat", "**/*.idx")) -> list[str]:
     hits = []
-    for pattern in ("**/*.dat", "**/*.idx"):
+    for pattern in patterns:
         for path in glob.glob(os.path.join(cluster.base_dir, pattern),
                               recursive=True):
             with open(path, "rb") as f:
@@ -333,3 +334,49 @@ def test_remote_cache_honors_filer_cipher_posture(encrypted_cluster,
     status, got, _ = http_request(
         f"http://{filer.address}/cloudcache/cachette.bin")
     assert status == 200 and got == MARKER * 64
+
+
+def test_sealed_compressed_data_survives_ec_conversion(tmp_path):
+    """End-to-end interplay: a compressible file written through an
+    encrypting filer lands as AES(gzip(plain)) needles; converting its
+    volume to EC shards and deleting the original .dat must keep the
+    file readable through the filer (EC reads + decode), with plaintext
+    absent from the shard files too."""
+    from seaweedfs_tpu.pb.rpc import POOL
+    from seaweedfs_tpu.storage.ec import TOTAL_SHARDS_COUNT
+    from seaweedfs_tpu.util import compression
+    with SimCluster(volume_servers=1, filers=1, base_dir=str(tmp_path),
+                    encrypt_data=True) as c:
+        filer = c.filers[0]
+        filer.chunk_size = 64 * 1024   # force several sealed chunks
+        body = (MARKER + b" compressible! ") * 3000
+        status, _, _ = http_request(
+            f"http://{filer.address}/sec/report.txt", method="POST",
+            body=body, headers={"Content-Type": "text/plain"})
+        assert status == 201
+        entry = filer.filer.find_entry("/sec/report.txt")
+        assert len(entry.chunks) > 1
+        assert all(ch.cipher_key and ch.is_compressed
+                   for ch in entry.chunks)
+        vids = {int(ch.file_id.split(",")[0]) for ch in entry.chunks}
+        vs = c.volume_servers[0]
+        client = POOL.client(vs.grpc_address, "VolumeServer")
+        for vid in vids:
+            client.call("VolumeMarkReadonly", {"volume_id": vid})
+            client.call("VolumeEcShardsGenerate", {"volume_id": vid})
+            client.call("VolumeEcShardsMount",
+                        {"volume_id": vid, "collection": "",
+                         "shard_ids": list(range(TOTAL_SHARDS_COUNT))})
+            client.call("VolumeDelete", {"volume_id": vid})
+        # reads now resolve through EC shards; the filer still decodes
+        status, got, _ = http_request(
+            f"http://{filer.address}/sec/report.txt")
+        assert status == 200 and got == body
+        # neither .dat remnants nor .ec shards hold plaintext — and
+        # since gzip alone would already hide MARKER, also assert the
+        # DETERMINISTIC gzip of the first chunk is absent: a silently
+        # disabled cipher (bare gzip on disk) must fail here
+        gz_probe = compression.gzip_data(body[:64 * 1024])[:64]
+        patterns = ("**/*.dat", "**/*.idx", "**/*.ec[0-9][0-9]")
+        assert _scan_dat_for(c, MARKER, patterns) == []
+        assert _scan_dat_for(c, gz_probe, patterns) == []
