@@ -28,6 +28,7 @@ import urllib.parse
 from .. import operation
 from ..pb.rpc import RpcError, RpcServer
 from ..util import cipher, compression
+from ..util.compression import accepts_gzip as _accepts_gzip
 from ..util.http import HttpServer, Request, Response
 from .entry import Attr, Entry, FileChunk
 from .filechunk_manifest import MANIFEST_BATCH, maybe_manifestize
@@ -83,29 +84,6 @@ class FilerConf:
             if path.startswith(rule.get("location_prefix", "")):
                 return rule
         return {}
-
-
-def _accepts_gzip(header: str) -> bool:
-    """RFC 9110 Accept-Encoding: gzip is acceptable when listed (or
-    covered by *) with a non-zero q — a bare substring match would
-    serve gzip to a client that explicitly refused it with gzip;q=0."""
-    best = None
-    for part in header.lower().split(","):
-        token, _, params = part.partition(";")
-        token = token.strip()
-        if token not in ("gzip", "x-gzip", "*"):
-            continue
-        q = 1.0
-        params = params.strip()
-        if params.startswith("q="):
-            try:
-                q = float(params[2:])
-            except ValueError:
-                q = 0.0
-        if token in ("gzip", "x-gzip"):
-            return q > 0
-        best = q  # '*' applies only if gzip itself is not named
-    return bool(best)
 
 
 def _parse_range(spec: str, size: int) -> "tuple[int, int] | None":

@@ -121,6 +121,30 @@ def encode_chunk(data: bytes, encrypt: bool = False, ext: str = "",
     return data, key_b64, compressed, compressed and not key_b64
 
 
+def accepts_gzip(header: str) -> bool:
+    """RFC 9110 Accept-Encoding negotiation, shared by the filer and
+    volume read handlers: gzip is acceptable when listed (or covered by
+    *) with a non-zero q — a bare substring match would serve gzip to a
+    client that explicitly refused it with gzip;q=0."""
+    best = None
+    for part in header.lower().split(","):
+        token, _, params = part.partition(";")
+        token = token.strip()
+        if token not in ("gzip", "x-gzip", "*"):
+            continue
+        q = 1.0
+        params = params.strip()
+        if params.startswith("q="):
+            try:
+                q = float(params[2:])
+            except ValueError:
+                q = 0.0
+        if token in ("gzip", "x-gzip"):
+            return q > 0
+        best = q  # '*' applies only if gzip itself is not named
+    return bool(best)
+
+
 def decode_chunk(blob: bytes, cipher_key_b64: str = "",
                  is_compressed: bool = False) -> bytes:
     """The one chunk-open helper every read path shares: unseal

@@ -303,14 +303,20 @@ class VolumeServer:
         if n.is_compressed():
             # negotiate like volume_server_handlers_read.go:208-215:
             # gzip-accepting clients get the stored bytes verbatim (zero
-            # recompute), everyone else gets them decompressed
-            accept = req.headers.get("Accept-Encoding", "")
-            if "gzip" in accept.lower():
+            # recompute), everyone else gets them decompressed.  Resize
+            # requests always decode — the image transform must see the
+            # content, never the gzip envelope
+            from ..util.compression import accepts_gzip, decompress
+            resizing = bool(req.qs("width") or req.qs("height"))
+            headers["Vary"] = "Accept-Encoding"  # caches key on encoding
+            if accepts_gzip(req.headers.get("Accept-Encoding", "")) \
+                    and not resizing:
                 headers["Content-Encoding"] = "gzip"
             else:
-                from ..util.compression import decompress
                 data = decompress(data)
-        if req.qs("width") or req.qs("height"):
+        else:
+            resizing = bool(req.qs("width") or req.qs("height"))
+        if resizing:
             data, mime = _maybe_resize_image(
                 data, mime, req.qs("width"), req.qs("height"),
                 req.qs("mode"))
@@ -699,9 +705,18 @@ class VolumeServer:
                 try:
                     fid = FileId.parse(fid_s)
                     n = self._read_needle_any(fid)
+                    raw = bytes(n.data)
+                    if n.is_compressed():
+                        # JSON/CSV are compressable types, so scanned
+                        # needles are often stored gzipped — the parser
+                        # must see the content, not the envelope
+                        from ..util.compression import decompress
+                        raw = decompress(raw)
                 except Exception:
-                    continue  # malformed fid / missing needle: skip it
-                text = bytes(n.data).decode(errors="replace")
+                    # malformed fid / missing needle / corrupt stored
+                    # bytes: skip this one, keep scanning the rest
+                    continue
+                text = raw.decode(errors="replace")
                 rows: list = []
                 if fmt == "json":
                     for line in text.splitlines():

@@ -309,3 +309,67 @@ def test_no_gzip_passthrough_for_shadowed_or_partial(cluster):
     assert not _accepts_gzip("identity")
     assert not _accepts_gzip("")
     assert not _accepts_gzip("*;q=0")
+
+
+def test_query_scans_compressed_needles(cluster):
+    """The Query RPC must parse the CONTENT of gzip-stored JSON needles
+    (JSON is a compressable type, so scanned blobs are often stored
+    compressed)."""
+    from seaweedfs_tpu.pb.rpc import POOL
+    rows = (b'{"name": "alice", "city": "sf"}\n'
+            b'{"name": "bob", "city": "nyc"}\n') * 50
+    packed = compression.gzip_data(rows)
+    r = operation.assign(cluster.master_grpc)
+    operation.upload_data(r.url, r.fid, packed, jwt=r.auth,
+                          compressed=True)
+    vs = cluster.volume_servers[0]
+    c = POOL.client(vs.grpc_address, "VolumeServer")
+    out = list(c.stream("Query", iter([{
+        "from": {"file_ids": [r.fid]},
+        "selections": ["name"],
+        "where": {"field": "city", "op": "=", "value": "sf"}}])))
+    assert len(out) == 50
+    assert all(rec["record"] == {"name": "alice"} for rec in out)
+
+
+def test_export_extracts_content_not_gzip(tmp_path):
+    """`weed export` members carry the content, not the stored gzip
+    envelope (command/export.go decompresses the same way)."""
+    import tarfile
+
+    from seaweedfs_tpu.command.volume_tools import export_volume
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+    v = Volume(str(tmp_path), "", 9)
+    try:
+        plain = TEXT * 3
+        packed = compression.gzip_data(plain)
+        n = Needle(id=5, cookie=7, data=packed)
+        n.set_name(b"story.txt")
+        n.set_is_compressed()
+        v.write_needle(n)
+        v.write_needle(Needle(id=6, cookie=7, data=b"raw bytes"))
+    finally:
+        v.close()
+    tar_path = str(tmp_path / "out.tar")
+    out = export_volume(str(tmp_path), "", 9, tar_path)
+    assert out["exported"] == 2
+    with tarfile.open(tar_path) as tar:
+        members = {m.name: m for m in tar.getmembers()}
+        assert tar.extractfile(members["story.txt"]).read() == plain
+        assert tar.extractfile(members["9_6"]).read() == b"raw bytes"
+
+
+def test_resize_params_never_get_gzip(cluster):
+    """width/height requests decode even for gzip-accepting clients —
+    the image transform must see content, not the envelope."""
+    r = operation.assign(cluster.master_grpc)
+    packed = compression.gzip_data(TEXT)
+    operation.upload_data(r.url, r.fid, packed, jwt=r.auth,
+                          compressed=True)
+    status, body, hdrs = http_request(
+        f"http://{r.url}/{r.fid}?width=10",
+        headers={"Accept-Encoding": "gzip"})
+    # not an image: resize is a no-op, but the body is the CONTENT
+    assert status == 200 and body == TEXT
+    assert "Content-Encoding" not in hdrs
