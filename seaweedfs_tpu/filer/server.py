@@ -27,9 +27,12 @@ import urllib.parse
 
 from .. import operation
 from ..pb.rpc import RpcError, RpcServer
+from ..stats import ServerMetrics
 from ..util import cipher, compression
 from ..util.compression import accepts_gzip as _accepts_gzip
 from ..util.http import HttpServer, Request, Response
+from ..util import tracing
+from ..util.tracing import Tracer
 from .entry import Attr, Entry, FileChunk
 from .filechunk_manifest import MANIFEST_BATCH, maybe_manifestize
 from .filechunks import read_views, total_size
@@ -159,6 +162,13 @@ class FilerServer:
             if chunk_cache_mem_mb > 0 or chunk_cache_dir else None
         self.http = HttpServer(host, port)
         self.rpc = RpcServer(host, grpc_port)
+        # request counters/latency (the filer_requests/filer_latency
+        # families in stats/__init__.py, served at GET /metrics) and the
+        # span ring behind GET /debug/traces
+        self.metrics = ServerMetrics()
+        self.tracer = Tracer("filer")
+        self.http.tracer = self.tracer
+        self.rpc.tracer = self.tracer
         self._del_queue: "queue.Queue[str]" = queue.Queue()
         self._stop = threading.Event()
         # aggregate feed = local events + peer filers' events
@@ -315,17 +325,49 @@ class FilerServer:
 
     # -- HTTP --------------------------------------------------------------
     def _register_http(self) -> None:
+        # observability endpoints match the volume server's; exact routes
+        # keep user files like /metricsfoo readable (a prefix route would
+        # shadow them)
+        self.http.route("GET", "/metrics", self._http_metrics,
+                        exact=True)
+        self.http.route("GET", "/status", self._http_status, exact=True)
+        self.http.route("GET", "/debug/traces",
+                        tracing.traces_http_handler(self.tracer),
+                        exact=True)
         self.http.route("*", "/", self._http_dispatch)
 
+    def _http_metrics(self, req: Request) -> Response:
+        return Response(200, self.metrics.render().encode(),
+                        content_type="text/plain; version=0.0.4")
+
+    def _http_status(self, req: Request) -> Response:
+        return Response.json({
+            "Version": "seaweedfs-tpu",
+            "Masters": [m.strip()
+                        for m in self._master_spec.split(",")],
+            "Store": type(self.filer.store).__name__,
+            "EncryptData": self.encrypt_data,
+            "DeletionQueueDepth": self._del_queue.qsize()})
+
+    _KINDS = {"POST": "write", "PUT": "write", "GET": "read",
+              "HEAD": "read", "DELETE": "delete"}
+
     def _http_dispatch(self, req: Request) -> Response:
+        t0 = time.time()
         path = urllib.parse.unquote(req.path) or "/"
-        if req.method in ("POST", "PUT"):
-            return self._http_write(path, req)
-        if req.method in ("GET", "HEAD"):
-            return self._http_read(path, req)
-        if req.method == "DELETE":
-            return self._http_delete(path, req)
-        return Response.error("method not allowed", 405)
+        kind = self._KINDS.get(req.method, "other")
+        try:  # finally: handler exceptions (-> 500 upstream) must count
+            if kind == "write":
+                return self._http_write(path, req)
+            if kind == "read":
+                return self._http_read(path, req)
+            if kind == "delete":
+                return self._http_delete(path, req)
+            return Response.error("method not allowed", 405)
+        finally:
+            self.metrics.filer_requests.inc(kind)
+            self.metrics.filer_latency.observe(kind,
+                                               value=time.time() - t0)
 
     def _http_write(self, path: str, req: Request) -> Response:
         """Auto-chunked upload (doPostAutoChunk)."""
@@ -393,12 +435,14 @@ class FilerServer:
             if parsed != (0, size):
                 offset, end = parsed
                 length, status = end - offset, 206
-        # whole-file reads of fully-compressed files serve the STORED
-        # gzip verbatim to accepting clients — zero decompress CPU and
-        # compressed wire bytes, like the volume handler's negotiation
-        # (volume_server_handlers_read.go:208-215 at the filer level).
-        # RFC 1952 makes concatenated members legal, so multi-chunk
-        # files stream as one multi-member gzip.
+        # whole-file reads of fully-compressed SINGLE-CHUNK files serve
+        # the STORED gzip verbatim to accepting clients — zero decompress
+        # CPU and compressed wire bytes, like the volume handler's
+        # negotiation (volume_server_handlers_read.go:208-215 at the
+        # filer level).  Multi-chunk files would concatenate members —
+        # legal per RFC 1952 but common client stacks (Java
+        # GZIPInputStream, some proxies) decode only the first member
+        # and silently truncate, so they take the decode path (ADVICE).
         if req.method == "GET" and status == 200 \
                 and _accepts_gzip(req.headers.get("Accept-Encoding",
                                                   "")):
@@ -439,21 +483,18 @@ class FilerServer:
     def _gzip_passthrough_chunks(chunks: list[FileChunk], size: int
                                  ) -> "list[FileChunk] | None":
         """Chunks in serving order when the stored bytes may serve
-        verbatim as one gzip stream, else None.  Every chunk must be
-        gzip (not sealed — ciphertext is opaque), and the chunks must
-        tile [0, size) exactly: any MVCC shadowing, sparse gap, or
-        partial visibility forces the decode path."""
-        if size == 0 or not chunks:
+        verbatim as one gzip stream, else None.  The file must be a
+        SINGLE gzip chunk (not sealed — ciphertext is opaque; multiple
+        chunks would make a multi-member stream many clients truncate
+        at the first member) covering [0, size) exactly: any MVCC
+        shadowing, sparse gap, or partial visibility forces the decode
+        path."""
+        if size == 0 or len(chunks) != 1:
             return None
-        if any(not c.is_compressed or c.cipher_key for c in chunks):
+        c = chunks[0]
+        if not c.is_compressed or c.cipher_key:
             return None
-        ordered = sorted(chunks, key=lambda c: c.offset)
-        pos = 0
-        for c in ordered:
-            if c.offset != pos:
-                return None
-            pos += c.size
-        return ordered if pos == size else None
+        return [c] if c.offset == 0 and c.size == size else None
 
     def _stream_content(self, chunks: list[FileChunk], offset: int,
                         length: int) -> bytes:
@@ -511,6 +552,12 @@ class FilerServer:
                     "masters": [m.strip()
                                 for m in self._master_spec.split(",")],
                     "cipher": self.encrypt_data},
+                # observability over gRPC: the shell discovers filers by
+                # their grpc address (master cluster registry), so
+                # cluster.trace / metrics.dump fetch through these
+                # instead of guessing the HTTP port
+                "DebugTraces": tracing.traces_rpc_handler(self.tracer),
+                "Metrics": lambda req: {"text": self.metrics.render()},
             },
             stream={
                 "ListEntries": self._rpc_list_entries,

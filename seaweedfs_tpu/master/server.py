@@ -31,6 +31,7 @@ from ..storage.ec.shard_bits import ShardBits
 from ..topology import (Topology, VolumeGrowOption, grow_volumes,
                         targets_for_replication)
 from ..topology.node import DataNode
+from ..util import tracing
 from ..util.http import HttpServer, Request, Response
 from ..util.weedlog import logger
 from .sequencer import MemorySequencer
@@ -75,6 +76,7 @@ class MasterServer:
         self.jwt_expires_seconds = jwt_expires_seconds
         from ..stats import ServerMetrics
         self.metrics = ServerMetrics()
+        self.tracer = tracing.Tracer("master")
         # `follow` makes this a read-only follower of an EXISTING cluster
         # (weed master.follower, command/master_follower.go): it serves
         # lookups from a KeepConnected-fed vid cache and proxies writes —
@@ -110,6 +112,8 @@ class MasterServer:
 
         self.http = HttpServer(host, port)
         self.rpc = RpcServer(host, grpc_port)
+        self.http.tracer = self.tracer
+        self.rpc.tracer = self.tracer
         self._register_http()
         self._register_rpc()
 
@@ -468,6 +472,11 @@ class MasterServer:
                 "VolumeList": lambda req: {"topology": self.topo.to_dict()},
                 "ListClusterNodes": self._rpc_list_cluster_nodes,
                 "Vacuum": self._rpc_vacuum,
+                # observability over gRPC (shell cluster.trace /
+                # metrics.dump reach the master through its grpc
+                # address; HTTP /debug/traces serves the same spans)
+                "DebugTraces": tracing.traces_rpc_handler(self.tracer),
+                "Metrics": lambda req: {"text": self.metrics.render()},
             },
             stream={
                 "SendHeartbeat": self._handle_heartbeat_stream,
@@ -551,6 +560,8 @@ class MasterServer:
         self.http.route("GET", "/vol/status", self._http_vol_status)
         self.http.route("*", "/vol/vacuum", self._http_vol_vacuum)
         self.http.route("GET", "/metrics", self._http_metrics)
+        self.http.route("GET", "/debug/traces",
+                        tracing.traces_http_handler(self.tracer))
         self.http.route("GET", "/ui", self._http_ui)
 
     def _http_assign(self, req: Request) -> Response:

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from .mesh import shard_map
 
 from ..ops import rs_jax, rs_matrix, rs_pallas
 from . import sharded_codec

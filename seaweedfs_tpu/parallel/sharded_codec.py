@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from .mesh import shard_map
 
 from ..ops import rs_jax, rs_matrix, rs_pallas
 
@@ -69,7 +69,8 @@ def xor_psum(x: jax.Array, axis_name: str) -> jax.Array:
     ring rotation with local XOR gives an exact all-reduce on *packed uint8*
     at (n-1)/n link efficiency — each hop rides one ICI neighbor link.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = (jax.lax.axis_size(axis_name) if hasattr(jax.lax, "axis_size")
+         else jax.lax.psum(1, axis_name))  # jax 0.4.x spelling
     if n == 1:
         return x
     perm = [(j, (j + 1) % n) for j in range(n)]

@@ -10,6 +10,12 @@ copy streams, metadata subscribe streams).
 Error convention: a handler raising RpcError(msg) (or any Exception) aborts
 the call with the message in the gRPC status details; clients re-raise it
 as RpcError.
+
+Tracing: every outgoing call attaches the ambient trace id as
+`x-trace-id` metadata (util/tracing.py); the server wrappers adopt it
+for the handler's duration, so a filer request's master Assign carries
+the same trace id as the originating HTTP hop.  Attaching a Tracer to
+`RpcServer.tracer` records one span per handled method.
 """
 
 from __future__ import annotations
@@ -17,10 +23,13 @@ from __future__ import annotations
 import base64
 import json
 import threading
+import time
 from concurrent import futures
 from typing import Callable, Iterator
 
 import grpc
+
+from ..util import tracing
 
 
 class RpcError(Exception):
@@ -74,6 +83,21 @@ def _de(b: bytes) -> dict:
     return json.loads(b) if b else {}
 
 
+def _trace_metadata() -> "list[tuple[str, str]] | None":
+    tid = tracing.current_trace_id()
+    return [(tracing.TRACE_METADATA_KEY, tid)] if tid else None
+
+
+def _incoming_trace_id(context) -> str:
+    try:
+        for key, value in context.invocation_metadata() or ():
+            if key == tracing.TRACE_METADATA_KEY:
+                return value
+    except Exception:
+        pass
+    return ""
+
+
 class RpcServer:
     """One grpc.Server hosting one or more named services."""
 
@@ -86,6 +110,7 @@ class RpcServer:
         self.host = host
         self._requested_port = port
         self.port = 0
+        self.tracer: "tracing.Tracer | None" = None
 
     def add_service(self, service: str,
                     unary: dict[str, Callable[[dict], dict]] | None = None,
@@ -95,37 +120,62 @@ class RpcServer:
         handlers = {}
         for name, fn in (unary or {}).items():
             handlers[name] = grpc.unary_unary_rpc_method_handler(
-                self._wrap_unary(fn),
+                self._wrap_unary(fn, f"{service}/{name}"),
                 request_deserializer=_de, response_serializer=_ser)
         for name, fn in (stream or {}).items():
             handlers[name] = grpc.stream_stream_rpc_method_handler(
-                self._wrap_stream(fn),
+                self._wrap_stream(fn, f"{service}/{name}"),
                 request_deserializer=_de, response_serializer=_ser)
         self._server.add_generic_rpc_handlers(
             [grpc.method_handlers_generic_handler(service, handlers)])
 
-    @staticmethod
-    def _wrap_unary(fn):
+    def _record(self, label: str, tid: str, t0: float, status: str,
+                slow_log: bool = True) -> None:
+        tracer = self.tracer  # attached after construction; read late
+        if tracer is not None:
+            tracer.record(label, tid, t0, time.time() - t0,
+                          status=status, slow_log=slow_log)
+
+    def _wrap_unary(self, fn, label: str):
         def h(request: dict, context) -> dict:
+            tid = _incoming_trace_id(context) or tracing.new_trace_id()
+            t0 = time.time()
+            status = "ok"
             try:
-                return fn(request) or {}
+                with tracing.trace_scope(tid):
+                    return fn(request) or {}
             except RpcError as e:
+                status = "error"
                 context.abort(grpc.StatusCode.UNKNOWN, str(e))
             except Exception as e:  # surface the message to the caller
+                status = "error"
                 context.abort(grpc.StatusCode.INTERNAL,
                               f"{type(e).__name__}: {e}")
+            finally:
+                self._record(label, tid, t0, status)
         return h
 
-    @staticmethod
-    def _wrap_stream(fn):
+    def _wrap_stream(self, fn, label: str):
         def h(request_iterator, context):
+            tid = _incoming_trace_id(context) or tracing.new_trace_id()
+            t0 = time.time()
+            status = "ok"
             try:
-                yield from fn(request_iterator)
+                with tracing.trace_scope(tid):
+                    yield from fn(request_iterator)
             except RpcError as e:
+                status = "error"
                 context.abort(grpc.StatusCode.UNKNOWN, str(e))
             except Exception as e:
+                status = "error"
                 context.abort(grpc.StatusCode.INTERNAL,
                               f"{type(e).__name__}: {e}")
+            finally:
+                # a stream's span lasts the connection (heartbeats and
+                # metadata subscriptions live for hours) — its duration
+                # is lifetime, not latency, so keep it out of the slow
+                # log
+                self._record(label, tid, t0, status, slow_log=False)
         return h
 
     def start(self) -> int:
@@ -168,7 +218,8 @@ class RpcClient:
             f"/{self.service}/{method}",
             request_serializer=_ser, response_deserializer=_de)
         try:
-            return fn(payload or {}, timeout=timeout)
+            return fn(payload or {}, timeout=timeout,
+                      metadata=_trace_metadata())
         except grpc.RpcError as e:
             raise RpcError(e.details() or str(e.code())) from None
 
@@ -178,7 +229,8 @@ class RpcClient:
             f"/{self.service}/{method}",
             request_serializer=_ser, response_deserializer=_de)
         try:
-            yield from fn(requests, timeout=timeout)
+            yield from fn(requests, timeout=timeout,
+                          metadata=_trace_metadata())
         except grpc.RpcError as e:
             raise RpcError(e.details() or str(e.code())) from None
 

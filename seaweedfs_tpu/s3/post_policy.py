@@ -218,6 +218,19 @@ def verify_policy_signature(iam, fields: dict):
         raise S3AuthError("AuthorizationHeaderMalformed",
                           f"bad credential scope {cred!r}")
     access_key, date, region, service, _ = parts
+    # AWS rejects malformed scopes before any key derivation: the
+    # service must be s3 and the scope date must be the yyyymmdd prefix
+    # of x-amz-date (the HMAC would catch a forgery anyway, but
+    # accepting what AWS rejects invites interop drift)
+    if service != "s3":
+        raise S3AuthError("AuthorizationHeaderMalformed",
+                          f"credential scope service {service!r} "
+                          "must be s3")
+    amz_date = fields.get("x-amz-date", "")
+    if amz_date and not amz_date.startswith(date):
+        raise S3AuthError("AuthorizationHeaderMalformed",
+                          f"credential scope date {date} does not "
+                          f"match x-amz-date {amz_date}")
     ident = iam.lookup_by_access_key(access_key)
     if ident is None:
         raise S3AuthError("InvalidAccessKeyId",

@@ -1,0 +1,102 @@
+"""Cluster-wide observability verbs: `cluster.trace` gathers span ring
+buffers and `metrics.dump` gathers prometheus snapshots from every node.
+
+Discovery matches each plane's own surface: volume servers come from the
+master topology and answer over their HTTP data port (/debug/traces,
+/metrics — the endpoints an operator would curl); filers come from the
+master's cluster registry, which records their gRPC addresses, so they
+answer over the SeaweedFiler DebugTraces/Metrics RPCs; the master itself
+answers over its Seaweed service.  A node that fails to answer reports
+an error entry instead of sinking the whole sweep — half a cluster view
+beats none during an incident."""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+from concurrent.futures import ThreadPoolExecutor
+
+from ..pb.rpc import POOL, RpcError
+from ..util.http import http_request
+from .commands import (CommandEnv, ShellError, command, iter_data_nodes,
+                       parse_flags)
+
+
+def _filer_grpc_addresses(env: CommandEnv) -> list[str]:
+    try:
+        out = env.master().call("ListClusterNodes", {})
+    except RpcError:
+        return []
+    return list(out.get("nodes", {}).get("filer", []))
+
+
+def _fetch_http_json(url: str) -> dict:
+    status, body, _ = http_request(url, timeout=5)
+    if status != 200:
+        raise RuntimeError(f"HTTP {status}")
+    return json.loads(body)
+
+
+def _sweep(env: CommandEnv, master_call, filer_call, volume_fetch) -> dict:
+    """One entry per node ('master' / 'filer:<grpc>' / 'volume:<url>'),
+    errors inline.  Nodes are polled concurrently: with sequential 5s
+    timeouts a sweep would stall longest exactly when nodes are down —
+    the incident an operator runs it for."""
+    jobs: dict = {"master": lambda: master_call(env.master())}
+    for addr in _filer_grpc_addresses(env):
+        jobs[f"filer:{addr}"] = \
+            lambda a=addr: filer_call(POOL.client(a, "SeaweedFiler"))
+    try:
+        topo = env.topology()
+    except RpcError:
+        topo = None
+    if topo is not None:
+        for _, _, dn in iter_data_nodes(topo):
+            url = (dn.get("ip", "") and f"{dn['ip']}:{dn['port']}"
+                   or dn["id"])
+            jobs[f"volume:{url}"] = lambda u=url: volume_fetch(u)
+    out: dict = {}
+    with ThreadPoolExecutor(max_workers=min(16, len(jobs))) as pool:
+        futures = {name: pool.submit(fn) for name, fn in jobs.items()}
+        for name, future in futures.items():
+            try:
+                out[name] = future.result()
+            except Exception as e:
+                out[name] = {"error": str(e)}
+    return out
+
+
+@command("cluster.trace",
+         "fetch /debug/traces spans from every node: "
+         "[-traceId X] [-limit N]")
+def cmd_cluster_trace(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    tid = flags.get("traceId", "")
+    try:
+        limit = int(flags.get("limit", "100"))
+    except ValueError:
+        raise ShellError(f"-limit must be an integer, "
+                         f"got {flags['limit']!r}")
+    req = {"trace_id": tid, "limit": limit}
+    qs = "?" + urllib.parse.urlencode({"trace_id": tid, "limit": limit})
+    return json.dumps(_sweep(
+        env,
+        lambda m: m.call("DebugTraces", req),
+        lambda f: f.call("DebugTraces", req),
+        lambda url: _fetch_http_json(f"http://{url}/debug/traces{qs}")))
+
+
+@command("metrics.dump",
+         "snapshot every node's prometheus /metrics text")
+def cmd_metrics_dump(env: CommandEnv, args: list[str]) -> str:
+    def volume_metrics(url: str) -> dict:
+        status, body, _ = http_request(f"http://{url}/metrics", timeout=5)
+        if status != 200:
+            raise RuntimeError(f"HTTP {status}")
+        return {"text": body.decode(errors="replace")}
+
+    return json.dumps(_sweep(
+        env,
+        lambda m: m.call("Metrics", {}),
+        lambda f: f.call("Metrics", {}),
+        volume_metrics))

@@ -15,6 +15,19 @@ from collections import defaultdict
 _BUCKETS = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0]
 
 
+def escape_label_value(value) -> str:
+    """Prometheus text-format label escaping: backslash, double-quote and
+    newline must be escaped inside label values or the sample line is
+    unparseable (exposition format spec §label values)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_labels(label_names: list, labels: tuple) -> str:
+    return ",".join(f'{n}="{escape_label_value(l)}"'
+                    for n, l in zip(label_names, labels))
+
+
 class Counter:
     kind = "counter"
 
@@ -37,8 +50,7 @@ class Counter:
         with self._lock:
             items = sorted(self._values.items())
         for labels, v in items:
-            sel = ",".join(f'{n}="{l}"'
-                           for n, l in zip(label_names, labels))
+            sel = _fmt_labels(label_names, labels)
             out.append(f"{self.name}{{{sel}}} {v}" if sel
                        else f"{self.name} {v}")
         return "\n".join(out)
@@ -81,8 +93,7 @@ class Histogram:
                       self._totals[labels])
                      for labels, counts in sorted(self._counts.items())]
         for labels, counts, label_sum, label_total in items:
-            base = ",".join(f'{n}="{l}"'
-                            for n, l in zip(label_names, labels))
+            base = _fmt_labels(label_names, labels)
             for b, c in zip(self.buckets, counts):
                 sel = (base + "," if base else "") + f'le="{b}"'
                 out.append(f"{self.name}_bucket{{{sel}}} {c}")
@@ -114,8 +125,9 @@ class Registry:
         return g
 
     def histogram(self, name: str, help_text: str,
-                  label_names: list[str] | None = None) -> Histogram:
-        h = Histogram(name, help_text)
+                  label_names: list[str] | None = None,
+                  buckets: list[float] | None = None) -> Histogram:
+        h = Histogram(name, help_text, buckets=buckets)
         with self._lock:
             self._metrics.append((h, label_names or []))
         return h

@@ -24,11 +24,12 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 
 import numpy as np
 
 from ...ops import clay_matrix, lrc
-from ...ops.codec import gf_apply
+from ...ops.codec import codec_metrics, gf_apply, metered_fetch
 from .layout import EcGeometry, to_ext
 
 
@@ -76,15 +77,18 @@ class LrcWindowCodec:
         return self.encode_begin(data)()
 
     def encode_begin(self, data: np.ndarray):
+        t0 = time.perf_counter()
         data = np.asarray(data, dtype=np.uint8)
         assert data.shape[0] == self.k
         G = lrc.generator_matrix(self.lgeo)
         parity_rows = np.ascontiguousarray(G[self.k:])
         if _multi_device():
             from ...parallel.mesh_codec import gf_mesh_encode_begin
-            return gf_mesh_encode_begin(parity_rows, data)
-        parity = gf_apply(parity_rows, data)
-        return lambda: parity
+            fetch = gf_mesh_encode_begin(parity_rows, data)
+        else:
+            parity = gf_apply(parity_rows, data)
+            fetch = lambda: parity  # noqa: E731
+        return metered_fetch(fetch, "lrc", "encode", data.nbytes, t0)
 
 
 class ClayWindowCodec:
@@ -111,7 +115,12 @@ class ClayWindowCodec:
         return self.encode_begin(data)()
 
     def encode_begin(self, data: np.ndarray):
+        t0 = time.perf_counter()
         data = np.asarray(data, dtype=np.uint8)
+        return metered_fetch(self._encode_begin_raw(data), "clay",
+                             "encode", data.nbytes, t0)
+
+    def _encode_begin_raw(self, data: np.ndarray):
         k, W = data.shape
         small = self.geo.small_block_size
         assert k == self.k, f"expected {self.k} data shards"
@@ -184,6 +193,7 @@ def rebuild_lrc(base_path: str, geo: EcGeometry, missing: list[int],
     """LRC rebuild: the planner picks the cheapest read set — one local
     group for a single loss (k/l reads instead of k), globals otherwise
     (ops/lrc.py plan_repair; Huang et al.'s LRC pyramid argument)."""
+    t0 = time.perf_counter()
     lgeo = lrc_geometry(geo)
     n = geo.total_shards
     have = [os.path.exists(base_path + to_ext(i)) for i in range(n)]
@@ -206,6 +216,8 @@ def rebuild_lrc(base_path: str, geo: EcGeometry, missing: list[int],
     finally:
         for f in outputs.values():
             f.close()
+    codec_metrics().observe("lrc", "reconstruct", bytes_read,
+                            time.perf_counter() - t0)
     if stats is not None:
         stats["bytes_read"] = bytes_read
         stats["read_shards"] = list(plan.read_shards)
@@ -220,6 +232,7 @@ def rebuild_clay(base_path: str, geo: EcGeometry, missing: list[int],
     the beta plane layers of every helper window (partial-range reads —
     beta/alpha = 1/q of each helper's bytes).  Multi-loss: flat decode
     from k full survivors, same engine."""
+    t0 = time.perf_counter()
     code = clay_matrix.code(geo.data_shards, geo.parity_shards)
     n = geo.total_shards
     small = geo.small_block_size
@@ -257,6 +270,8 @@ def rebuild_clay(base_path: str, geo: EcGeometry, missing: list[int],
                 rec = np.ascontiguousarray(
                     rec.reshape(alpha, wn, win_a).transpose(1, 0, 2))
                 out.write(rec.tobytes())
+        codec_metrics().observe("clay", "reconstruct", bytes_read,
+                                time.perf_counter() - t0)
         if stats is not None:
             stats["bytes_read"] = bytes_read
             stats["plan_kind"] = "clay-plane"
@@ -294,6 +309,8 @@ def rebuild_clay(base_path: str, geo: EcGeometry, missing: list[int],
     finally:
         for f in outputs.values():
             f.close()
+    codec_metrics().observe("clay", "reconstruct", bytes_read,
+                            time.perf_counter() - t0)
     if stats is not None:
         stats["bytes_read"] = bytes_read
         stats["plan_kind"] = "clay-decode"

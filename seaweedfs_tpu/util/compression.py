@@ -133,12 +133,17 @@ def accepts_gzip(header: str) -> bool:
         if token not in ("gzip", "x-gzip", "*"):
             continue
         q = 1.0
-        params = params.strip()
-        if params.startswith("q="):
-            try:
-                q = float(params[2:])
-            except ValueError:
-                q = 0.0
+        # scan ALL ';'-separated parameters for the weight — a header
+        # like 'gzip;foo=1;q=0' refuses gzip even though q= is not the
+        # first parameter (first q= wins once found)
+        for param in params.split(";"):
+            param = param.strip()
+            if param.startswith("q="):
+                try:
+                    q = float(param[2:])
+                except ValueError:
+                    q = 0.0
+                break
         if token in ("gzip", "x-gzip"):
             return q > 0
         best = q  # '*' applies only if gzip itself is not named

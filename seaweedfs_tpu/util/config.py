@@ -14,7 +14,11 @@ Capability-equivalent to weed/util/config.go + command/scaffold.go:18-27:
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:  # stdlib on python >= 3.11
+    import tomllib
+except ImportError:  # 3.10: same API under the backport name
+    import tomli as tomllib
 
 SEARCH_DIRS = [".", os.path.expanduser("~/.seaweedfs"), "/etc/seaweedfs"]
 ENV_PREFIX = "WEED_"

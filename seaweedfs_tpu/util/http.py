@@ -10,12 +10,15 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
+
+from . import tracing
 
 
 class CIDict(dict):
@@ -74,12 +77,29 @@ class Response:
 Handler = Callable[[Request], Response]
 
 
+def _trace_skip(path: str) -> bool:
+    """Request paths whose spans would drown real traffic in the ring
+    buffer (scrapers poll these): context still propagates, recording is
+    skipped.  Exact match for the scrape endpoints — a filer user file
+    like /metrics-archive/day.csv must still trace."""
+    return path in ("/metrics", "/status") or path.startswith("/debug/")
+
+
 class HttpServer:
-    """Routes are (method, path_prefix) -> handler; longest prefix wins.
-    A fallback handler (prefix "") catches file-id style paths."""
+    """Routes are (method, path_prefix) -> handler; longest prefix wins,
+    and `exact=True` routes match only the full path (they sort ahead of
+    an equal-length prefix).  A fallback handler (prefix "") catches
+    file-id style paths.
+
+    Every request runs inside a trace scope: the incoming `X-Trace-Id`
+    header is adopted (minted when absent), echoed on the response, and
+    propagated by the outgoing client helpers below.  Attaching a
+    `tracing.Tracer` to `.tracer` additionally records one span per
+    request into that server's /debug/traces ring."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.routes: list[tuple[str, str, Handler]] = []
+        self.tracer: "tracing.Tracer | None" = None
         outer = self
 
         class _H(BaseHTTPRequestHandler):
@@ -103,13 +123,25 @@ class HttpServer:
                     body=body,
                     remote_addr=self.client_address[0])
                 handler = outer._match(self.command, parsed.path)
-                if handler is None:
-                    resp = Response.error("not found", 404)
-                else:
-                    try:
-                        resp = handler(req)
-                    except Exception as e:
-                        resp = Response.error(f"{type(e).__name__}: {e}")
+                t0 = time.time()
+                tid = req.headers.get(tracing.TRACE_HEADER, "") \
+                    or tracing.new_trace_id()
+                with tracing.trace_scope(tid):
+                    if handler is None:
+                        resp = Response.error("not found", 404)
+                    else:
+                        try:
+                            resp = handler(req)
+                        except Exception as e:
+                            resp = Response.error(
+                                f"{type(e).__name__}: {e}")
+                resp.headers.setdefault(tracing.TRACE_HEADER, tid)
+                tracer = outer.tracer
+                if tracer is not None and not _trace_skip(parsed.path):
+                    tracer.record(f"{self.command} {parsed.path}", tid,
+                                  t0, time.time() - t0,
+                                  status=("ok" if resp.status < 400
+                                          else f"http {resp.status}"))
                 try:
                     self.send_response(resp.status)
                     self.send_header("Content-Type", resp.content_type)
@@ -143,13 +175,16 @@ class HttpServer:
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
-    def route(self, method: str, prefix: str, handler: Handler) -> None:
-        self.routes.append((method, prefix, handler))
-        self.routes.sort(key=lambda r: len(r[1]), reverse=True)
+    def route(self, method: str, prefix: str, handler: Handler,
+              exact: bool = False) -> None:
+        self.routes.append((method, prefix, handler, exact))
+        self.routes.sort(key=lambda r: (len(r[1]), r[3]), reverse=True)
 
     def _match(self, method: str, path: str) -> Optional[Handler]:
-        for m, prefix, h in self.routes:
-            if m in (method, "*") and path.startswith(prefix):
+        for m, prefix, h, exact in self.routes:
+            if m not in (method, "*"):
+                continue
+            if path == prefix if exact else path.startswith(prefix):
                 return h
         return None
 
@@ -252,10 +287,15 @@ def http_request(url: str, method: str = "GET", body: bytes | None = None,
                  headers: dict | None = None, timeout: float = 30.0
                  ) -> tuple[int, bytes, dict]:
     """-> (status, body, headers); non-2xx does NOT raise.  Keep-alive
-    pooled per thread."""
+    pooled per thread.  Propagates the ambient trace id (X-Trace-Id) so
+    multi-hop requests correlate across servers."""
     if not url.startswith("http"):
         url = "http://" + url
-    return _POOL.request(url, method, body, dict(headers or {}), timeout)
+    headers = dict(headers or {})
+    tid = tracing.current_trace_id()
+    if tid:
+        headers.setdefault(tracing.TRACE_HEADER, tid)
+    return _POOL.request(url, method, body, headers, timeout)
 
 
 def http_get_json(url: str, timeout: float = 30.0) -> dict:

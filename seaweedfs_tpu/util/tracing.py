@@ -1,0 +1,171 @@
+"""Cross-plane request tracing — trace ids, spans, per-server ring buffers.
+
+The reference has no distributed tracing; its operational story is
+per-store request stats (Haystack) and per-layer latency accounting
+(Tectonic).  This module gives the three planes (filer -> volume ->
+master) one correlating primitive:
+
+- A request entering any HTTP surface adopts the `X-Trace-Id` header or
+  mints a fresh id; the id rides a thread-local so every downstream hop
+  made while serving that request — chunk uploads, master Assigns,
+  replica fan-outs — carries it automatically (util/http.py injects the
+  header on outgoing requests, pb/rpc.py attaches `x-trace-id` gRPC
+  metadata).
+- Each server owns a `Tracer`: a bounded in-memory span ring buffer
+  (newest wins, O(1) memory) served as JSON at `GET /debug/traces`, plus
+  a slow-request log through util/weedlog.py for spans over a
+  configurable threshold (`WEED_TRACE_SLOW_MS`, default 1000).
+
+Deliberate gap: the raw-TCP data fast path (volume_server/tcp.py) has a
+fixed frame with no header slot, so hops that ride it appear only as the
+caller's span — the same trade the frame already makes for ttl and the
+compressed flag.  Compressed/TTL'd chunk uploads stay on HTTP and trace
+end to end.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+from .weedlog import logger
+
+LOG = logger(__name__)
+
+TRACE_HEADER = "X-Trace-Id"
+TRACE_METADATA_KEY = "x-trace-id"  # grpc metadata keys must be lowercase
+DEFAULT_CAPACITY = 1024
+
+
+def slow_threshold_seconds() -> float:
+    """The slow-request log knob: spans at least this long are logged
+    (WEED_TRACE_SLOW_MS env; 0 disables the log entirely)."""
+    try:
+        return float(os.environ.get("WEED_TRACE_SLOW_MS", "1000")) / 1000.0
+    except ValueError:
+        return 1.0
+
+
+def new_trace_id() -> str:
+    return os.urandom(8).hex()
+
+
+_ctx = threading.local()
+
+
+def current_trace_id() -> str:
+    """The ambient trace id for this thread ('' outside any request)."""
+    return getattr(_ctx, "trace_id", "")
+
+
+@contextmanager
+def trace_scope(trace_id: str):
+    """Install `trace_id` as the thread's ambient trace for the block —
+    outgoing HTTP/gRPC calls inside it propagate the id.  Nests: the
+    previous id is restored on exit, so a handler serving request B on a
+    thread that still owns request A's suspended stream is labeled B
+    only for its own duration."""
+    prev = getattr(_ctx, "trace_id", "")
+    _ctx.trace_id = trace_id
+    try:
+        yield trace_id
+    finally:
+        _ctx.trace_id = prev
+
+
+class Tracer:
+    """Per-server span sink: bounded ring buffer + slow log.
+
+    A span is a plain dict (JSON-ready for /debug/traces):
+      {trace_id, name, service, start, duration_ms, status, ...tags}.
+    Recording is lock-cheap (deque append is atomic; the lock only
+    guards snapshot iteration vs rotation)."""
+
+    def __init__(self, service: str, capacity: int = DEFAULT_CAPACITY,
+                 slow_seconds: "float | None" = None):
+        self.service = service
+        self.capacity = capacity
+        self.slow_seconds = (slow_threshold_seconds()
+                             if slow_seconds is None else slow_seconds)
+        self.slow_count = 0
+        self._spans: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, name: str, trace_id: str, start: float,
+               duration: float, status: str = "ok",
+               slow_log: bool = True, **tags) -> None:
+        """`slow_log=False` keeps the span out of the slow-request log —
+        for long-lived streams (heartbeats, metadata subscriptions) whose
+        duration is connection lifetime, not request latency."""
+        span = {"trace_id": trace_id, "name": name,
+                "service": self.service, "start": start,
+                "duration_ms": round(duration * 1000.0, 3),
+                "status": status}
+        if tags:
+            span.update(tags)
+        with self._lock:
+            self._spans.append(span)
+        if slow_log and self.slow_seconds > 0 \
+                and duration >= self.slow_seconds:
+            self.slow_count += 1
+            LOG.warning("slow request trace=%s %s %s took %.1fms "
+                        "(threshold %.0fms)", trace_id or "-",
+                        self.service, name, duration * 1000.0,
+                        self.slow_seconds * 1000.0)
+
+    @contextmanager
+    def span(self, name: str, trace_id: str = ""):
+        """Record one span around the block; adopts the ambient trace id
+        when none is given.  Exceptions mark the span `error` and
+        propagate."""
+        tid = trace_id or current_trace_id() or new_trace_id()
+        t0 = time.time()
+        with trace_scope(tid):
+            try:
+                yield tid
+            except BaseException:
+                self.record(name, tid, t0, time.time() - t0,
+                            status="error")
+                raise
+        self.record(name, tid, t0, time.time() - t0)
+
+    def snapshot(self, trace_id: str = "", limit: int = 0) -> list[dict]:
+        """Newest-last span dicts, optionally filtered to one trace and
+        trimmed to the most recent `limit`."""
+        with self._lock:
+            spans = list(self._spans)
+        if trace_id:
+            spans = [s for s in spans if s["trace_id"] == trace_id]
+        if limit > 0:
+            spans = spans[-limit:]
+        return spans
+
+    def to_dict(self, trace_id: str = "", limit: int = 0) -> dict:
+        """The GET /debug/traces reply body."""
+        spans = self.snapshot(trace_id=trace_id, limit=limit)
+        return {"service": self.service, "capacity": self.capacity,
+                "slow_threshold_ms": round(self.slow_seconds * 1000.0),
+                "span_count": len(spans), "spans": spans}
+
+
+def traces_http_handler(tracer: Tracer):
+    """The GET /debug/traces handler, shared by all three planes."""
+    from .http import Response  # local import: http.py imports tracing
+
+    def handler(req):
+        return Response.json(tracer.to_dict(
+            trace_id=req.qs("trace_id"),
+            limit=int(req.qs("limit", "0") or 0)))
+    return handler
+
+
+def traces_rpc_handler(tracer: Tracer):
+    """The DebugTraces unary RPC handler (shell cluster.trace reaches
+    filers/masters through their gRPC address)."""
+    def handler(req: dict) -> dict:
+        return tracer.to_dict(trace_id=req.get("trace_id", ""),
+                              limit=int(req.get("limit", 0) or 0))
+    return handler

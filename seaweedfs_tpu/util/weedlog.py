@@ -23,13 +23,26 @@ class _GlogFormatter(logging.Formatter):
                 f"{record.name}] {record.getMessage()}")
 
 
+class _GlogHandler(logging.StreamHandler):
+    def handleError(self, record: logging.LogRecord) -> None:
+        # Server daemon threads (heartbeat streams, deletion queues) may
+        # emit after the process — or a test harness's capture stream —
+        # starts tearing down; a failed emit must never dump a handler
+        # traceback into whatever stdio remains (glog drops, never
+        # raises).
+        pass
+
+
 def setup(verbosity: int = 0, stream=None) -> None:
-    """Install the glog-style handler on the package root logger."""
+    """Install the glog-style handler on the package root logger.
+    Called by the server entrypoints — embedding applications that skip
+    it keep stock logging behavior, including emit-error reporting."""
     global _VERBOSITY, _CONFIGURED
     _VERBOSITY = verbosity
+    logging.raiseExceptions = False  # see _GlogHandler.handleError
     root = logging.getLogger("seaweedfs_tpu")
     if not _CONFIGURED:
-        h = logging.StreamHandler(stream or sys.stderr)
+        h = _GlogHandler(stream or sys.stderr)
         h.setFormatter(_GlogFormatter())
         root.addHandler(h)
         root.propagate = False

@@ -35,6 +35,7 @@ from ..storage.store import Store
 from ..storage.ttl import TTL
 from ..storage.types import FileId
 from ..storage.volume import NotFoundError, volume_file_name
+from ..util import tracing
 from ..util.http import HttpServer, Request, Response, http_request
 
 from ..util.weedlog import logger
@@ -90,6 +91,7 @@ class VolumeServer:
         self.jwt_signing_key = jwt_signing_key
         from ..stats import ServerMetrics
         self.metrics = ServerMetrics()
+        self.tracer = tracing.Tracer("volume")
         self.pulse_seconds = pulse_seconds
         self.store = Store(directories, max_volume_counts)
         self.http = HttpServer(host, port)
@@ -108,6 +110,8 @@ class VolumeServer:
         self._ec_locations: dict[int, tuple[float, dict[int, list[str]]]] = {}
         # vid -> (ts, [location dicts]) — replica urls for write fan-out
         self._vol_locations: dict[int, tuple[float, list[dict]]] = {}
+        self.http.tracer = self.tracer
+        self.rpc.tracer = self.tracer
         self._register_http()
         self._register_rpc()
         self._public_url = public_url
@@ -225,12 +229,18 @@ class VolumeServer:
     def _register_http(self) -> None:
         self.http.route("GET", "/status", self._http_status)
         self.http.route("GET", "/metrics", self._http_metrics)
+        self.http.route("GET", "/debug/traces",
+                        tracing.traces_http_handler(self.tracer))
         self.http.route("*", "/", self._http_data)
 
     def _http_metrics(self, req: Request) -> Response:
         total = sum(len(loc.volumes) for loc in self.store.locations)
         self.metrics.volume_count.set(value=total)
-        return Response(200, self.metrics.render().encode(),
+        # the process-global codec families ride along: per-backend EC
+        # encode/decode latency + bytes (ops/codec.py codec_metrics)
+        from ..ops.codec import codec_metrics
+        text = self.metrics.render() + codec_metrics().registry.render()
+        return Response(200, text.encode(),
                         content_type="text/plain; version=0.0.4")
 
     def _check_jwt(self, req: Request, fid: FileId) -> "Response | None":
@@ -312,6 +322,10 @@ class VolumeServer:
             if accepts_gzip(req.headers.get("Accept-Encoding", "")) \
                     and not resizing:
                 headers["Content-Encoding"] = "gzip"
+                # RFC 9110: distinct representations need distinct
+                # validators — If-None-Match does not key on encoding,
+                # so the gzip body must not share the identity ETag
+                headers["Etag"] = f'"{n.etag()}-gzip"'
             else:
                 data = decompress(data)
         else:

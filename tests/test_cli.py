@@ -61,8 +61,9 @@ def test_shell_oneshot_cli(cluster, capsys):
 
 
 def test_scaffold_and_version(capsys):
-    # default output is now TOML templates (util/config.py layering)
-    import tomllib
+    # default output is now TOML templates (util/config.py layering);
+    # parse with the same tomllib/tomli module the product code resolved
+    from seaweedfs_tpu.util.config import tomllib
     assert main(["scaffold", "-config", "security"]) == 0
     toml_out = capsys.readouterr().out
     assert "jwt.signing" in toml_out

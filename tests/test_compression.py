@@ -241,12 +241,14 @@ def test_upload_download_cli_compresses(cluster, tmp_path, capsys,
 
 
 def test_filer_serves_stored_gzip_to_accepting_clients(cluster):
-    """Whole-file GET + Accept-Encoding: gzip = the stored bytes
-    verbatim (multi-member gzip across chunks, RFC 1952) with
-    Content-Encoding; ranges and non-accepting clients still decode."""
+    """Whole-file GET + Accept-Encoding: gzip on a SINGLE-chunk file =
+    the stored bytes verbatim with Content-Encoding; multi-chunk files
+    would concatenate gzip members (legal per RFC 1952 but truncated by
+    common clients), so they decode server-side, as do ranges and
+    non-accepting clients."""
     import gzip as _gzip
     filer = cluster.filers[0]
-    body = TEXT * 25  # several 64KB chunks
+    body = TEXT  # one 64KB chunk
     http_request(f"http://{filer.address}/gz/served.txt", method="POST",
                  body=body, headers={"Content-Type": "text/plain"})
     status, raw, hdrs = http_request(
@@ -254,7 +256,7 @@ def test_filer_serves_stored_gzip_to_accepting_clients(cluster):
         headers={"Accept-Encoding": "gzip"})
     assert status == 200 and hdrs.get("Content-Encoding") == "gzip"
     assert len(raw) < len(body) // 4
-    assert _gzip.decompress(raw) == body  # multi-member decompress
+    assert _gzip.decompress(raw) == body
     # identity client: decoded
     status, got, hdrs = http_request(
         f"http://{filer.address}/gz/served.txt",
@@ -267,6 +269,15 @@ def test_filer_serves_stored_gzip_to_accepting_clients(cluster):
         headers={"Accept-Encoding": "gzip",
                  "Range": "bytes=100-199"})
     assert status == 206 and part == body[100:200] \
+        and "Content-Encoding" not in hdrs
+    # multi-chunk: decoded whole even for accepting clients
+    many = TEXT * 25  # several 64KB chunks
+    http_request(f"http://{filer.address}/gz/many.txt", method="POST",
+                 body=many, headers={"Content-Type": "text/plain"})
+    status, got, hdrs = http_request(
+        f"http://{filer.address}/gz/many.txt",
+        headers={"Accept-Encoding": "gzip"})
+    assert status == 200 and got == many \
         and "Content-Encoding" not in hdrs
 
 
@@ -294,10 +305,11 @@ def test_no_gzip_passthrough_for_shadowed_or_partial(cluster):
     ok = FilerServer._gzip_passthrough_chunks
     c1 = FileChunk(file_id="1,a", offset=0, size=10, is_compressed=True)
     c2 = FileChunk(file_id="1,b", offset=10, size=5, is_compressed=True)
-    assert ok([c2, c1], 15) == [c1, c2]       # serving order
+    # multi-chunk would serve a multi-member gzip many clients truncate
+    assert ok([c2, c1], 15) is None
     assert ok([c1, c2], 20) is None           # sparse tail
-    assert ok([c1, FileChunk(file_id="1,c", offset=5, size=10,
-                             is_compressed=True)], 15) is None  # overlap
+    assert ok([c1], 20) is None               # partial coverage
+    assert ok([c2], 15) is None               # offset head
     assert ok([c1], 10) == [c1]               # single chunk fine
     assert ok([FileChunk(file_id="1,d", offset=0, size=10)], 10) is None
     assert ok([], 0) is None

@@ -47,7 +47,7 @@ def test_env_overrides_and_typed_coercion(tmp_path):
 
 
 def test_scaffold_templates_parse():
-    import tomllib
+    from seaweedfs_tpu.util.config import tomllib
     for kind in ("security", "filer", "master"):
         tomllib.loads(scaffold(kind))
     assert "[jwt.signing]" in scaffold("security")

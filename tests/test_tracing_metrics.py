@@ -1,0 +1,352 @@
+"""Observability plane tests: trace-id propagation across a filer ->
+volume write, span ring buffers at /debug/traces, filer /metrics,
+codec hot-path metrics, the prometheus text exposition format, and the
+cluster.trace / metrics.dump shell verbs."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu import operation, shell
+from seaweedfs_tpu.stats import Registry, escape_label_value
+from seaweedfs_tpu.testing import SimCluster
+from seaweedfs_tpu.util import tracing
+from seaweedfs_tpu.util.compression import accepts_gzip
+from seaweedfs_tpu.util.http import http_request
+
+
+# -- tracing unit ----------------------------------------------------------
+
+def test_trace_scope_nests_and_restores():
+    assert tracing.current_trace_id() == ""
+    with tracing.trace_scope("aaa"):
+        assert tracing.current_trace_id() == "aaa"
+        with tracing.trace_scope("bbb"):
+            assert tracing.current_trace_id() == "bbb"
+        assert tracing.current_trace_id() == "aaa"
+    assert tracing.current_trace_id() == ""
+
+
+def test_tracer_ring_buffer_bounded():
+    t = tracing.Tracer("test", capacity=8, slow_seconds=0)
+    for i in range(20):
+        t.record(f"op{i}", f"tid{i}", time.time(), 0.001)
+    spans = t.snapshot()
+    assert len(spans) == 8                      # oldest rotated out
+    assert spans[-1]["name"] == "op19"
+    assert t.snapshot(trace_id="tid15")[0]["name"] == "op15"
+    assert len(t.snapshot(limit=3)) == 3
+    body = t.to_dict(limit=3)
+    assert body["service"] == "test" and body["span_count"] == 3
+
+
+def test_tracer_slow_log_threshold():
+    t = tracing.Tracer("test", slow_seconds=0.05)
+    t.record("fast", "t1", time.time(), 0.01)
+    t.record("slow", "t2", time.time(), 0.5)
+    assert t.slow_count == 1
+    # 0 disables the slow log entirely
+    t0 = tracing.Tracer("test", slow_seconds=0)
+    t0.record("slow", "t3", time.time(), 99.0)
+    assert t0.slow_count == 0
+
+
+def test_tracer_span_contextmanager_marks_errors():
+    t = tracing.Tracer("test", slow_seconds=0)
+    with t.span("ok-op") as tid:
+        assert tracing.current_trace_id() == tid
+    with pytest.raises(ValueError):
+        with t.span("bad-op"):
+            raise ValueError("boom")
+    spans = t.snapshot()
+    assert spans[0]["name"] == "ok-op" and spans[0]["status"] == "ok"
+    assert spans[1]["name"] == "bad-op" and spans[1]["status"] == "error"
+
+
+# -- prometheus exposition format ------------------------------------------
+
+def test_exposition_help_type_and_inf_bucket():
+    reg = Registry()
+    h = reg.histogram("t_seconds", "latency", ["op"])
+    h.observe("read", value=0.002)
+    h.observe("read", value=123.0)  # beyond the last finite bucket
+    text = reg.render()
+    assert "# HELP t_seconds latency" in text
+    assert "# TYPE t_seconds histogram" in text
+    # +Inf bucket counts EVERY observation, including out-of-range ones
+    assert 't_seconds_bucket{op="read",le="+Inf"} 2' in text
+    assert 't_seconds_count{op="read"} 2' in text
+    assert 't_seconds_sum{op="read"} 123.002' in text
+
+
+def test_exposition_label_escaping():
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+    reg = Registry()
+    c = reg.counter("esc_total", "t", ["path"])
+    c.inc('we"ird\\pa\nth')
+    line = [l for l in reg.render().splitlines()
+            if l.startswith("esc_total{")][0]
+    assert line == 'esc_total{path="we\\"ird\\\\pa\\nth"} 1.0'
+    # histograms escape identically
+    h = reg.histogram("esc_seconds", "t", ["path"])
+    h.observe('q"v', value=0.1)
+    assert 'le="+Inf"' in reg.render()
+    assert '{path="q\\"v",le=' in reg.render()
+
+
+def test_accepts_gzip_scans_all_params():
+    # satellite: q= must be found among ALL ';' parameters
+    assert not accepts_gzip("gzip;foo=1;q=0")
+    assert not accepts_gzip("gzip ; q=0")
+    assert accepts_gzip("gzip;foo=1")
+    assert accepts_gzip("gzip;foo=1;q=0.5")
+    assert not accepts_gzip("*;x=y;q=0")
+    assert accepts_gzip("br;q=1, gzip;a=b;q=0.1")
+
+
+# -- codec hot-path metrics ------------------------------------------------
+
+def test_codec_metrics_record_encode_and_reconstruct():
+    from seaweedfs_tpu.ops.codec import RSCodec, codec_metrics
+    m = codec_metrics()
+    label = ("rs_numpy", "encode")
+    before = m.bytes.value(*label)
+    before_n = m.seconds._totals.get(label, 0)
+    codec = RSCodec(4, 2, backend="numpy")
+    data = np.random.randint(0, 256, size=(4, 512), dtype=np.uint8)
+    parity = codec.encode(data)
+    assert m.bytes.value(*label) == before + data.nbytes
+    assert m.seconds._totals[label] == before_n + 1
+    # reconstruct records under its own op label
+    shards = [data[i] for i in range(4)] + [parity[0], None]
+    rb = ("rs_numpy", "reconstruct")
+    before_r = m.seconds._totals.get(rb, 0)
+    out = codec.reconstruct(shards)
+    assert np.array_equal(out[5], parity[1])
+    assert m.seconds._totals[rb] == before_r + 1
+    text = m.registry.render()
+    assert 'seaweedfs_codec_bytes_total{backend="rs_numpy",op="encode"}' \
+        in text
+    assert "# TYPE seaweedfs_codec_op_seconds histogram" in text
+
+
+def test_lrc_window_codec_metered():
+    from seaweedfs_tpu.ops.codec import codec_metrics
+    from seaweedfs_tpu.storage.ec.codes import LrcWindowCodec
+    from seaweedfs_tpu.storage.ec.layout import EcGeometry
+    geo = EcGeometry(data_shards=4, parity_shards=4, code_kind="lrc",
+                     lrc_locals=2)
+    m = codec_metrics()
+    before = m.bytes.value("lrc", "encode")
+    data = np.random.randint(0, 256, size=(4, 256), dtype=np.uint8)
+    LrcWindowCodec(geo).encode(data)
+    assert m.bytes.value("lrc", "encode") == before + data.nbytes
+
+
+# -- cluster integration ---------------------------------------------------
+
+@pytest.fixture()
+def cluster(tmp_path):
+    with SimCluster(volume_servers=2, filers=1,
+                    base_dir=str(tmp_path)) as c:
+        # wait for the filer to appear in the master cluster registry so
+        # the shell sweeps can discover it
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            nodes = c.masters[0].cluster_nodes.get("filer", {})
+            if nodes:
+                break
+            time.sleep(0.05)
+        yield c
+
+
+def _filer_write(c, path, body, trace_id):
+    f = c.filers[0]
+    status, _, headers = http_request(
+        f"http://{f.address}{path}", method="POST", body=body,
+        headers={"Content-Type": "text/plain",
+                 "X-Trace-Id": trace_id})
+    assert status == 201
+    return headers
+
+
+def test_trace_propagates_filer_to_volume(cluster):
+    c = cluster
+    tid = tracing.new_trace_id()
+    # compressible text/plain > 128B: the chunk upload carries the
+    # compressed needle flag and therefore rides HTTP, which carries the
+    # X-Trace-Id header to the volume server
+    body = b"propagate me! " * 64
+    headers = _filer_write(c, "/obs/traced.txt", body, tid)
+    assert headers.get("X-Trace-Id") == tid  # echoed back
+    f = c.filers[0]
+    out = json.loads(http_request(
+        f"http://{f.address}/debug/traces?trace_id={tid}")[1])
+    assert out["service"] == "filer"
+    assert any(s["name"].startswith("POST /obs/")
+               for s in out["spans"])
+    # the SAME trace id shows up on whichever volume server took the
+    # chunk ...
+    vs_spans = []
+    for vs in c.volume_servers:
+        vout = json.loads(http_request(
+            f"http://{vs.url}/debug/traces?trace_id={tid}")[1])
+        vs_spans.extend(vout["spans"])
+    assert vs_spans, "no volume-server span carried the trace id"
+    assert all(s["trace_id"] == tid for s in vs_spans)
+    # ... and on the master's gRPC plane (Assign rode the rpc metadata)
+    mspans = c.masters[0].tracer.snapshot(trace_id=tid)
+    assert any(s["name"] == "Seaweed/Assign" for s in mspans)
+
+
+def test_filer_metrics_and_status_endpoints(cluster):
+    c = cluster
+    f = c.filers[0]
+    _filer_write(c, "/obs/counted.txt", b"count me " * 32,
+                 tracing.new_trace_id())
+    http_request(f"http://{f.address}/obs/counted.txt")
+    status, body, _ = http_request(f"http://{f.address}/metrics")
+    assert status == 200
+    text = body.decode()
+    assert 'seaweedfs_filer_request_total{type="write"}' in text
+    assert 'seaweedfs_filer_request_total{type="read"}' in text
+    assert "# TYPE seaweedfs_filer_request_seconds histogram" in text
+    status, body, _ = http_request(f"http://{f.address}/status")
+    st = json.loads(body)
+    assert status == 200 and st["Version"] == "seaweedfs-tpu"
+    assert st["Store"]
+    # user files whose names extend the endpoint prefixes stay readable
+    _filer_write(c, "/metricsfoo", b"not a scrape " * 16,
+                 tracing.new_trace_id())
+    status, body, _ = http_request(f"http://{f.address}/metricsfoo")
+    assert status == 200 and body == b"not a scrape " * 16
+
+
+def test_volume_metrics_include_codec_families(cluster):
+    from seaweedfs_tpu.ops.codec import RSCodec
+    RSCodec(4, 2, backend="numpy").encode(
+        np.zeros((4, 128), dtype=np.uint8))
+    vs = cluster.volume_servers[0]
+    text = http_request(f"http://{vs.url}/metrics")[1].decode()
+    assert "# TYPE seaweedfs_codec_op_seconds histogram" in text
+    assert 'seaweedfs_codec_bytes_total{backend="rs_numpy"' in text
+
+
+def test_shell_cluster_trace_and_metrics_dump(cluster):
+    c = cluster
+    tid = tracing.new_trace_id()
+    _filer_write(c, "/obs/shellseen.txt", b"shell sees this " * 16, tid)
+    env = shell.CommandEnv(c.master_grpc)
+    out = json.loads(shell.run_command(env,
+                                       f"cluster.trace -traceId {tid}"))
+    assert any(k.startswith("filer:") and v.get("spans")
+               for k, v in out.items()), out.keys()
+    assert any(k.startswith("volume:") and v.get("spans")
+               for k, v in out.items())
+    assert out["master"]["service"] == "master"
+    dump = json.loads(shell.run_command(env, "metrics.dump"))
+    assert "seaweedfs_master_assign_total" in dump["master"]["text"]
+    filer_texts = [v["text"] for k, v in dump.items()
+                   if k.startswith("filer:") and "text" in v]
+    assert any("seaweedfs_filer_request_total" in t
+               for t in filer_texts)
+    volume_texts = [v["text"] for k, v in dump.items()
+                    if k.startswith("volume:") and "text" in v]
+    assert any("seaweedfs_volume_request_total" in t
+               for t in volume_texts)
+
+
+def test_gzip_representation_gets_distinct_etag(cluster):
+    # satellite: the gzip and identity representations of a compressed
+    # needle must carry distinct validators (RFC 9110)
+    from seaweedfs_tpu.util.compression import gzip_data
+    c = cluster
+    r = operation.assign(c.master_grpc)
+    payload = b"etag me properly " * 64
+    operation.upload_data(r.url, r.fid, gzip_data(payload), jwt=r.auth,
+                          compressed=True)
+    status, body, headers = http_request(
+        f"http://{r.url}/{r.fid}",
+        headers={"Accept-Encoding": "gzip"})
+    assert status == 200
+    gz_etag = headers["Etag"]
+    assert gz_etag.endswith('-gzip"')
+    status, body, headers = http_request(
+        f"http://{r.url}/{r.fid}",
+        headers={"Accept-Encoding": "identity"})
+    assert status == 200 and body == payload
+    assert headers["Etag"] == gz_etag.replace('-gzip"', '"')
+
+
+def test_filer_gzip_passthrough_single_chunk_only(cluster):
+    # satellite: multi-chunk files must NOT serve a multi-member gzip
+    c = cluster
+    f = c.filers[0]
+    f.chunk_size = 64 * 1024  # force multiple chunks cheaply
+    try:
+        small = b"tiny compressible body " * 32          # one chunk
+        big = b"large compressible body " * 8192         # several chunks
+        _filer_write(c, "/gz/one.txt", small, tracing.new_trace_id())
+        _filer_write(c, "/gz/many.txt", big, tracing.new_trace_id())
+        status, body, headers = http_request(
+            f"http://{f.address}/gz/one.txt",
+            headers={"Accept-Encoding": "gzip"})
+        assert status == 200
+        assert headers.get("Content-Encoding") == "gzip"
+        import gzip as _gzip
+        assert _gzip.decompress(body) == small
+        status, body, headers = http_request(
+            f"http://{f.address}/gz/many.txt",
+            headers={"Accept-Encoding": "gzip"})
+        assert status == 200
+        assert "Content-Encoding" not in headers  # decoded server-side
+        assert body == big
+    finally:
+        f.chunk_size = 8 * 1024 * 1024
+
+
+# -- s3 post-policy scope validation (satellite) ---------------------------
+
+def test_post_policy_rejects_bad_credential_scope():
+    import base64
+    import hashlib
+    import hmac
+
+    from seaweedfs_tpu.s3.auth import S3AuthError, _signing_key
+    from seaweedfs_tpu.s3.post_policy import verify_policy_signature
+
+    class _Ident:
+        secret_key = "sekrit"
+
+    class _Iam:
+        def lookup_by_access_key(self, ak):
+            return _Ident() if ak == "AK" else None
+
+    policy_b64 = base64.b64encode(b'{"expiration": "2099-01-01"}'
+                                  ).decode()
+
+    def fields(cred, amz_date="20260801T000000Z"):
+        date = cred.split("/")[1]
+        key = _signing_key(_Ident.secret_key, date, "r", cred.split("/")[3])
+        sig = hmac.new(key, policy_b64.encode(),
+                       hashlib.sha256).hexdigest()
+        return {"policy": policy_b64, "x-amz-credential": cred,
+                "x-amz-date": amz_date, "x-amz-signature": sig}
+
+    # valid scope verifies
+    ident = verify_policy_signature(
+        _Iam(), fields("AK/20260801/r/s3/aws4_request"))
+    assert ident.secret_key == "sekrit"
+    # wrong service rejected before key derivation
+    with pytest.raises(S3AuthError):
+        verify_policy_signature(
+            _Iam(), fields("AK/20260801/r/sts/aws4_request"))
+    # scope date must prefix x-amz-date
+    with pytest.raises(S3AuthError):
+        verify_policy_signature(
+            _Iam(), fields("AK/20260731/r/s3/aws4_request",
+                           amz_date="20260801T000000Z"))
