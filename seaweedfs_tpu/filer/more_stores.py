@@ -32,9 +32,12 @@ from __future__ import annotations
 import base64
 import json
 
+from ..util.weedlog import logger
 from .entry import Entry
 from .filerstore import (FilerStore, NotFound, lex_increment as _inc_bytes,
                          split_path as _split)
+
+LOG = logger(__name__)
 
 
 def _child(base: str, name: str) -> str:
@@ -280,9 +283,9 @@ class Elastic7Store(FilerStore):
             create(index=self.KV_INDEX, body={"mappings": {
                 "properties": {"v": {"type": "keyword",
                                      "index": False}}}}, ignore=400)
-        except Exception:
+        except Exception as e:
             # index may pre-exist on a cluster rejecting `ignore`
-            pass
+            LOG.debug("es index bootstrap skipped: %s", e)
 
     @staticmethod
     def _id(full_path: str) -> str:

@@ -33,11 +33,14 @@ from ..util.compression import accepts_gzip as _accepts_gzip
 from ..util.http import HttpServer, Request, Response
 from ..util import tracing
 from ..util.tracing import Tracer
+from ..util.weedlog import logger
 from .entry import Attr, Entry, FileChunk
 from .filechunk_manifest import MANIFEST_BATCH, maybe_manifestize
 from .filechunks import read_views, total_size
 from .filer import Filer
 from .filerstore import NotFound, new_filer_store
+
+LOG = logger(__name__)
 
 
 def _upload_chunk(r, data: bytes, ttl: str = "",
@@ -238,8 +241,11 @@ class FilerServer:
                     nested = [FileChunk.from_dict(d)
                               for d in payload.get("chunks", [])]
                     self._enqueue_deletion(nested)
-                except Exception:
-                    pass
+                except Exception as e:
+                    # best-effort: the manifest blob itself still gets
+                    # deleted below; nested blobs may strand
+                    LOG.debug("manifest resolve failed for %s: %s",
+                              c.file_id, e)
             self._del_queue.put(c.file_id)
 
     def _deletion_loop(self) -> None:
@@ -251,8 +257,8 @@ class FilerServer:
             try:
                 self._with_master(
                     lambda m: operation.delete_file(m, fid))
-            except Exception:
-                pass
+            except Exception as e:
+                LOG.debug("async delete of %s failed: %s", fid, e)
 
     def drain_deletions(self, timeout: float = 5.0) -> None:
         """Block until the deletion queue empties (tests)."""
@@ -585,8 +591,8 @@ class FilerServer:
                 self.filer.store.insert_entry(Entry.from_dict(new))
             elif old is not None:
                 self.filer.store.delete_entry(old["full_path"])
-        except Exception:
-            pass
+        except Exception as e:
+            LOG.debug("peer event apply failed: %s", e)
         with self._agg_lock:
             for q in self._agg_subs.values():
                 q.put(event)

@@ -373,8 +373,8 @@ class RaftNode:
                 term = self.term
             try:
                 self._replicate_to(peer, term)
-            except Exception:       # never kill the loop
-                pass
+            except Exception as e:  # never kill the loop
+                LOG.debug("replicate to %s failed: %s", peer, e)
 
     def _replicate_to(self, peer: str, term: int) -> None:
         with self._lock:

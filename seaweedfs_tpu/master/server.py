@@ -149,8 +149,8 @@ class MasterServer:
                         try:
                             vacuum_mod.vacuum(self.topo,
                                               self.garbage_threshold)
-                        except Exception:
-                            pass
+                        except Exception as e:
+                            LOG.debug("auto-vacuum pass failed: %s", e)
             threading.Thread(target=vacuum_loop, daemon=True).start()
 
     def stop(self) -> None:

@@ -30,6 +30,9 @@ from typing import Callable, Iterator
 import grpc
 
 from ..util import tracing
+from ..util.weedlog import logger
+
+LOG = logger(__name__)
 
 
 class RpcError(Exception):
@@ -93,8 +96,10 @@ def _incoming_trace_id(context) -> str:
         for key, value in context.invocation_metadata() or ():
             if key == tracing.TRACE_METADATA_KEY:
                 return value
-    except Exception:
-        pass
+    except Exception as e:
+        # fakes/in-process contexts may not implement metadata at all;
+        # a request without a trace id is fine, a crashed handler is not
+        LOG.debug("invocation metadata unreadable: %s", e)
     return ""
 
 

@@ -25,9 +25,9 @@ class AuditLog:
             self._sink = sink
             self._close = getattr(sink, "close", lambda: None)
         elif path:
-            f = open(path, "a", buffering=1)  # line-buffered
-            self._sink = f
-            self._close = f.close
+            # handle lives as long as the AuditLog; released in close()
+            self._sink = open(path, "a", buffering=1)  # line-buffered
+            self._close = self._sink.close
         else:
             raise ValueError("AuditLog needs a path or a sink")
 

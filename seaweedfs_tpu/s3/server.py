@@ -28,12 +28,15 @@ from ..filer.entry import Entry, FileChunk
 from ..filer.filechunks import total_size
 from ..pb.rpc import POOL, RpcError
 from ..util.http import HttpServer, Request, Response, http_request
+from ..util.weedlog import logger
 from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_TAGGING,
                    ACTION_WRITE, Identity, IdentityAccessManagement,
                    S3AuthError)
 
 BUCKETS_PATH = "/buckets"
 UPLOADS_DIR = ".uploads"
+
+LOG = logger(__name__)
 
 
 def _xml(root: ET.Element) -> bytes:
@@ -107,11 +110,13 @@ class S3ApiServer:
                         cfg = json.loads(payload)
                         self.iam.identities = IdentityAccessManagement \
                             .from_config(cfg).identities
-                    except Exception:
+                    except Exception as e:
                         # one malformed payload must not kill the
                         # subscription — later rotations still apply
+                        LOG.debug("bad iam config payload: %s", e)
                         continue
-            except Exception:   # stream broke — reconnect, never die
+            except Exception as e:  # stream broke — reconnect, never die
+                LOG.debug("iam config stream broke, reconnecting: %s", e)
                 if self._iam_stop.wait(0.5):
                     return
 

@@ -13,12 +13,15 @@ import threading
 from dataclasses import dataclass, field
 
 from . import types as t
+from ..util.weedlog import logger
 from .needle import Needle
 from .needle_map import KIND_MEMORY
 from .super_block import ReplicaPlacement
 from .ttl import TTL, EMPTY_TTL
 from .volume import (NotFoundError, Volume, VolumeInfo, VolumeError,
                      parse_volume_base_name, volume_file_name)
+
+LOG = logger(__name__)
 
 
 class DiskLocation:
@@ -34,6 +37,9 @@ class DiskLocation:
         self.volumes: dict[int, Volume] = {}
         self.ec_volumes: dict[int, object] = {}  # vid -> EcVolume (storage.ec)
         self._lock = threading.RLock()
+        # vids being created: reserved under _lock, volume files opened
+        # outside it (opening .dat/.idx can block on a slow disk)
+        self._pending: set[int] = set()
         os.makedirs(self.directory, exist_ok=True)
         self.load_existing_volumes()
 
@@ -59,7 +65,11 @@ class DiskLocation:
                 self.volumes[vid] = Volume(
                     self.directory, collection, vid,
                     needle_map_kind=self.needle_map_kind)
-            except Exception:
+            except Exception as e:
+                # one corrupt volume must not keep the server down, but
+                # an operator has to be able to find out it was skipped
+                LOG.debug("skipping unloadable volume %s in %s: %s",
+                          vid, self.directory, e)
                 continue
         self.load_ec_shards()
 
@@ -87,33 +97,57 @@ class DiskLocation:
                 for _, shard_id in pairs:
                     vol.load_shard(shard_id)
                 self.ec_volumes[vid] = vol
-            except Exception:
+            except Exception as e:
+                LOG.debug("skipping unloadable ec volume %s in %s: %s",
+                          vid, self.directory, e)
                 continue
 
     def add_volume(self, collection: str, vid: int,
                    replica_placement: ReplicaPlacement | None = None,
                    ttl: TTL = EMPTY_TTL,
                    needle_map_kind: str | None = None) -> Volume:
+        # reserve the vid under the lock, open the volume files outside it
+        # (disk I/O must not convoy every other volume op on this disk)
         with self._lock:
-            if vid in self.volumes:
+            if vid in self.volumes or vid in self._pending:
                 raise VolumeError(f"volume {vid} already exists")
+            self._pending.add(vid)
+        try:
             v = Volume(self.directory, collection, vid,
                        needle_map_kind=needle_map_kind or self.needle_map_kind,
                        replica_placement=replica_placement, ttl=ttl)
-            self.volumes[vid] = v
+            with self._lock:
+                self.volumes[vid] = v
             return v
+        finally:
+            with self._lock:
+                self._pending.discard(vid)
 
     def delete_volume(self, vid: int) -> None:
+        # keep the vid reserved while destroy() unlinks files, or a
+        # concurrent add_volume could recreate it mid-teardown
         with self._lock:
             v = self.volumes.pop(vid, None)
             if v is not None:
+                self._pending.add(vid)
+        if v is not None:
+            try:
                 v.destroy()
+            finally:
+                with self._lock:
+                    self._pending.discard(vid)
 
     def unload_volume(self, vid: int) -> None:
         with self._lock:
             v = self.volumes.pop(vid, None)
             if v is not None:
+                self._pending.add(vid)
+        if v is not None:
+            try:
                 v.close()
+            finally:
+                with self._lock:
+                    self._pending.discard(vid)
 
     def has_free_space(self) -> bool:
         st = os.statvfs(self.directory)

@@ -25,12 +25,15 @@ import time
 from dataclasses import dataclass
 
 from . import types as t
+from ..util.weedlog import logger
 from .backend import BackendStorageFile, open_backend
 from .idx import idx_entry_bytes, parse_index_bytes
 from .needle import Needle, read_needle_header
 from .needle_map import KIND_MEMORY, NeedleMapper, new_needle_map
 from .super_block import ReplicaPlacement, SuperBlock
 from .ttl import TTL, EMPTY_TTL
+
+LOG = logger(__name__)
 
 
 class VolumeError(Exception):
@@ -170,8 +173,12 @@ class Volume:
                         if old.cookie == n.cookie and old.data == n.data:
                             n.size = existing.size
                             return len(n.data)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        # unreadable prior record: fall through and
+                        # append the new copy, but leave a trace — this
+                        # is the first sign of a corrupt tail
+                        LOG.debug("dedup read of needle %s failed: %s",
+                                  n.id, e)
             offset, size, _ = n.append_to(self.data_backend, self.version)
             # the map records the *body* size written in the header (n.size),
             # which is what ReadBytes validates against (volume_write.go nm.Put)
@@ -377,7 +384,10 @@ class Volume:
                 ttl=self.super_block.ttl,
                 compaction_revision=self.super_block.compaction_revision,
             ).inc_compaction_revision()
-            with open(cpd, "wb") as dat, open(cpx, "wb") as idxf:
+            # vacuum swaps the live .dat/.idx under every reader; holding
+            # the volume lock for the whole compact IS the design — this
+            # is the per-volume serialization point, not a container lock
+            with open(cpd, "wb") as dat, open(cpx, "wb") as idxf:  # weedlint: disable=WL001
                 dat.write(new_sb.to_bytes())
                 offset = len(new_sb.to_bytes())
                 for nv in sorted(self.nm.items(), key=lambda v: v.offset):

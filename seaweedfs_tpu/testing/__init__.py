@@ -26,7 +26,10 @@ from .. import operation
 from ..filer import FilerServer
 from ..master import MasterServer
 from ..s3 import S3ApiServer
+from ..util.weedlog import logger
 from ..volume_server import VolumeServer
+
+LOG = logger(__name__)
 
 
 def free_port() -> int:
@@ -143,28 +146,31 @@ class SimCluster:
         return self
 
     def stop(self) -> None:
+        # best-effort teardown: every server gets its stop() even if an
+        # earlier one died mid-shutdown, but failures are logged — a
+        # silently half-stopped cluster leaks ports into the next test
         if self.s3_server:
             try:
                 self.s3_server.stop()
-            except Exception:
-                pass
+            except Exception as e:
+                LOG.debug("s3 server stop failed: %s", e)
         for f in self.filers:
             try:
                 f.stop()
-            except Exception:
-                pass
+            except Exception as e:
+                LOG.debug("filer stop failed: %s", e)
         for vs in self.volume_servers:
             if vs is not None:
                 try:
                     vs.stop()
-                except Exception:
-                    pass
+                except Exception as e:
+                    LOG.debug("volume server stop failed: %s", e)
         for m in self.masters:
             if m is not None:
                 try:
                     m.stop()
-                except Exception:
-                    pass
+                except Exception as e:
+                    LOG.debug("master stop failed: %s", e)
         if self.tls:
             from ..pb import rpc as rpc_mod
             rpc_mod.clear_tls()

@@ -666,7 +666,11 @@ class VolumeServer:
                 try:
                     full = Needle.read_from(
                         v.data_backend, offset, n.size, v.version)
-                except Exception:
+                except Exception as e:
+                    # tail keeps streaming past one bad record, but the
+                    # corruption itself must be visible to an operator
+                    LOG.debug("tail skipping needle at offset %s in "
+                              "volume %s: %s", offset, vid, e)
                     continue
                 # append_at_ns lives in the record TRAILER (v3), so the
                 # filter runs after the full read, not on the header scan
@@ -726,9 +730,10 @@ class VolumeServer:
                         # must see the content, not the envelope
                         from ..util.compression import decompress
                         raw = decompress(raw)
-                except Exception:
+                except Exception as e:
                     # malformed fid / missing needle / corrupt stored
                     # bytes: skip this one, keep scanning the rest
+                    LOG.debug("query skipping %s: %s", fid_s, e)
                     continue
                 text = raw.decode(errors="replace")
                 rows: list = []

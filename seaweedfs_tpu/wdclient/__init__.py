@@ -12,6 +12,9 @@ from __future__ import annotations
 import threading
 
 from ..pb.rpc import POOL, RpcError
+from ..util.weedlog import logger
+
+LOG = logger(__name__)
 
 
 def resolve_leader(masters: str, timeout: float = 2.0) -> str:
@@ -100,8 +103,9 @@ class MasterClient:
                 # the homed master may be dead; chase the current leader
                 try:
                     self.master_grpc = resolve_leader(self.masters)
-                except Exception:
-                    pass
+                except Exception as e:
+                    LOG.debug("leader resolve failed, keeping %s: %s",
+                              self.master_grpc, e)
 
     def lookup(self, vid: int) -> list[dict]:
         with self._lock:

@@ -1,0 +1,155 @@
+"""Tier-1 gate for tools/weedlint: the shipped tree must be clean
+(modulo the checked-in baseline), every checker must catch its fixture's
+known-bad patterns at exact lines, and the baseline must never be used
+to hide lock-discipline or swallowed-exception findings."""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:  # plain `pytest` doesn't put the repo root here
+    sys.path.insert(0, ROOT)
+
+from tools.weedlint import (DEFAULT_BASELINE, analyze_paths, filter_new,  # noqa: E402
+                            load_baseline, write_baseline)
+FIXTURES = os.path.join(ROOT, "tests", "weedlint_fixtures")
+PACKAGE = os.path.join(ROOT, "seaweedfs_tpu")
+
+
+def _findings(path):
+    return analyze_paths([path])
+
+
+def _ids_lines(findings):
+    return sorted((f.checker, f.line) for f in findings)
+
+
+# -- each checker against its fixture corpus -------------------------------
+
+def test_bad_locks_fixture():
+    got = _ids_lines(_findings(os.path.join(FIXTURES, "bad_locks.py")))
+    assert got == [("WL001", 14), ("WL001", 19), ("WL002", 23)]
+
+
+def test_bad_jax_fixture():
+    got = _ids_lines(_findings(os.path.join(FIXTURES, "bad_jax.py")))
+    assert got == [("WL010", 15), ("WL010", 21), ("WL010", 28),
+                   ("WL011", 34), ("WL011", 35), ("WL011", 36),
+                   ("WL012", 41), ("WL012", 42)]
+
+
+def test_bad_wire_fixture():
+    got = _ids_lines(_findings(os.path.join(FIXTURES, "bad_wire.py")))
+    assert got == [("WL020", 10), ("WL021", 16), ("WL022", 5)]
+
+
+def test_bad_except_fixture():
+    got = _ids_lines(_findings(os.path.join(FIXTURES, "bad_except.py")))
+    assert got == [("WL030", 7), ("WL030", 14), ("WL030", 23)]
+
+
+def test_bad_resource_fixture():
+    got = _ids_lines(_findings(os.path.join(FIXTURES, "bad_resource.py")))
+    assert got == [("WL040", 8), ("WL040", 13), ("WL040", 17)]
+
+
+def test_good_fixture_is_clean():
+    assert _findings(os.path.join(FIXTURES, "good.py")) == []
+
+
+def test_findings_carry_location_and_hint():
+    f = _findings(os.path.join(FIXTURES, "bad_locks.py"))[0]
+    assert f.file.endswith("bad_locks.py") and f.line == 14
+    assert f.checker == "WL001" and f.hint
+    rendered = f.render()
+    assert "bad_locks.py:14" in rendered and "WL001" in rendered
+
+
+# -- the tier-1 gate --------------------------------------------------------
+
+def test_package_is_clean_under_baseline():
+    findings = analyze_paths([PACKAGE])
+    new = filter_new(findings, load_baseline(DEFAULT_BASELINE))
+    assert new == [], "new weedlint findings:\n" + \
+        "\n".join(f.render() for f in new)
+
+
+def test_baseline_never_hides_lock_or_exception_findings():
+    with open(DEFAULT_BASELINE) as f:
+        data = json.load(f)
+    banned = {"WL001", "WL002", "WL030"}
+    hidden = [e for e in data.get("entries", [])
+              if e["checker"] in banned]
+    assert hidden == [], \
+        "lock-discipline/swallowed-exception findings must be FIXED, " \
+        f"not baselined: {hidden}"
+
+
+# -- baseline round trip ----------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    bad = os.path.join(FIXTURES, "bad_except.py")
+    findings = _findings(bad)
+    assert findings
+    bl_path = str(tmp_path / "baseline.json")
+    write_baseline(findings, bl_path)
+    assert filter_new(_findings(bad), load_baseline(bl_path)) == []
+    # a NEW finding (different line) still fires through the baseline
+    moved = [type(f)(f.checker, f.name, f.file, f.line + 1000,
+                     f.message, f.hint) for f in findings]
+    assert len(filter_new(moved, load_baseline(bl_path))) == len(moved)
+
+
+def test_pragma_suppresses_single_checker(tmp_path):
+    src = ("import threading, time\n"
+           "_lock = threading.Lock()\n"
+           "def f():\n"
+           "    with _lock:\n"
+           "        time.sleep(1)  # weedlint: disable=WL001\n")
+    p = tmp_path / "pragma_case.py"
+    p.write_text(src)
+    assert analyze_paths([str(p)]) == []
+    p.write_text(src.replace("  # weedlint: disable=WL001", ""))
+    assert [f.checker for f in analyze_paths([str(p)])] == ["WL001"]
+
+
+# -- CLI contract (the command CI runs) -------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.weedlint", *args],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+
+
+def test_cli_clean_tree_exits_zero():
+    r = _run_cli("seaweedfs_tpu")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_injected_bad_pattern_exits_nonzero(tmp_path):
+    # inject a fixture's known-bad pattern into a copy of a real
+    # package module: the gate must go red with file:line + checker id
+    with open(os.path.join(PACKAGE, "storage", "super_block.py")) as f:
+        src = f.read()
+    injected = src + ("\n\ndef _injected(fn):\n"
+                      "    try:\n"
+                      "        return fn()\n"
+                      "    except Exception:\n"
+                      "        pass\n")
+    target = tmp_path / "super_block_injected.py"
+    target.write_text(injected)
+    r = _run_cli(str(target))
+    assert r.returncode == 1
+    line_no = injected.count("\n") - 1  # the `except Exception:` line
+    assert f"super_block_injected.py:{line_no}" in r.stdout
+    assert "WL030" in r.stdout
+
+
+def test_cli_list_checkers():
+    r = _run_cli("--list-checkers")
+    assert r.returncode == 0
+    for cid in ("WL001", "WL002", "WL010", "WL011", "WL012",
+                "WL020", "WL021", "WL022", "WL030", "WL040"):
+        assert cid in r.stdout

@@ -1,0 +1,48 @@
+"""Known-bad JAX trace purity. Line numbers are asserted exactly."""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_COUNT = 0
+
+
+@jax.jit
+def printing(x):
+    print("tracing", x)          # line 15: WL010
+    return x + 1
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def timing(x, k):
+    t0 = time.time()             # line 21: WL010
+    return x * k, t0
+
+
+@jax.jit
+def mutates_global(x):
+    global _COUNT
+    _COUNT = _COUNT + 1          # line 28: WL010
+    return x
+
+
+@jax.jit
+def host_sync(x):
+    y = np.asarray(x)            # line 34: WL011
+    x.block_until_ready()        # line 35: WL011
+    return float(y)              # line 36: WL011
+
+
+@jax.jit
+def u8_overflow(a, b):
+    s = a.astype(jnp.uint8) + b.astype(jnp.uint8)   # line 41: WL012
+    return jnp.sum(s.astype(jnp.uint8))             # line 42: WL012
+
+
+@jax.jit
+def pure_ok(a, b):
+    acc = jnp.sum(a.astype(jnp.int32) * b.astype(jnp.int32))
+    return (acc % 256).astype(jnp.uint8)

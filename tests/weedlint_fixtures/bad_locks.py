@@ -1,0 +1,39 @@
+"""Known-bad lock discipline. Expected findings (checker, line) are
+asserted exactly in tests/test_weedlint.py — keep line numbers stable."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+_state = {}
+
+
+def sleep_under_lock():
+    with _lock:
+        _state["x"] = 1
+        time.sleep(0.5)          # line 14: WL001
+
+
+def http_under_lock(sock):
+    with _lock:
+        sock.connect(("h", 80))  # line 19: WL001
+
+
+def unbalanced(flag):
+    _lock.acquire()              # line 23: WL002
+    if flag:
+        return _state
+    return None
+
+
+def balanced_ok():
+    _lock.acquire()
+    try:
+        return dict(_state)
+    finally:
+        _lock.release()
+
+
+def with_ok():
+    with _lock:
+        return dict(_state)

@@ -1,0 +1,41 @@
+"""Known-bad resource hygiene. Line numbers are asserted exactly."""
+
+import json
+import socket
+
+
+def leak_assigned(path):
+    f = open(path, "rb")         # line 8: WL040
+    return f.read()
+
+
+def leak_inline(path):
+    return json.load(open(path))     # line 13: WL040
+
+
+def leak_socket():
+    s = socket.socket()          # line 17: WL040
+    s.send(b"x")
+
+
+def with_ok(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def finally_ok(path):
+    f = open(path, "rb")
+    try:
+        return f.read()
+    finally:
+        f.close()
+
+
+def fanout_ok(paths):
+    outs = {i: open(p, "wb") for i, p in enumerate(paths)}
+    try:
+        for f in outs.values():
+            f.write(b"")
+    finally:
+        for f in outs.values():
+            f.close()

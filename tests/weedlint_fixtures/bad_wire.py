@@ -1,0 +1,23 @@
+"""Known-bad wire-format usage. Line numbers are asserted exactly."""
+
+import struct
+
+NEEDLE_HEADER_SIZE = 17          # line 5: WL022 (format fixes it at 16)
+SUPER_BLOCK_SIZE = 8
+
+
+def bad_format(value):
+    return struct.pack(">Z", value)              # line 10: WL020
+
+
+def overflow_pack(rev):
+    header = bytearray(SUPER_BLOCK_SIZE)
+    struct.pack_into(">H", header, 4, rev)
+    struct.pack_into(">Q", header, 4, rev)       # line 16: WL021 (4+8 > 8)
+    return bytes(header)
+
+
+def ok_pack(rev):
+    header = bytearray(SUPER_BLOCK_SIZE)
+    struct.pack_into(">H", header, 6, rev)
+    return bytes(header)

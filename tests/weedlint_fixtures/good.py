@@ -1,0 +1,53 @@
+"""Known-good code exercising every checker's happy path — weedlint
+must report zero findings here."""
+
+import json
+import struct
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+
+NEEDLE_HEADER_SIZE = 16
+SUPER_BLOCK_SIZE = 8
+
+_lock = threading.Lock()
+_cache = {}
+
+
+def snapshot_then_sleep():
+    with _lock:
+        snap = dict(_cache)
+    time.sleep(0.01)
+    return snap
+
+
+def paired_acquire():
+    _lock.acquire()
+    try:
+        _cache["k"] = 1
+    finally:
+        _lock.release()
+
+
+@jax.jit
+def gf_accumulate(a, b):
+    acc = jnp.sum(a.astype(jnp.int32) * b.astype(jnp.int32))
+    return (acc % 255).astype(jnp.int32)
+
+
+def pack_header(rev):
+    header = bytearray(SUPER_BLOCK_SIZE)
+    struct.pack_into(">H", header, 4, rev)
+    struct.pack_into(">H", header, 6, 0)
+    return bytes(header)
+
+
+def read_config(path, log):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except Exception as e:
+        log.debug("config read failed: %s", e)
+        return {}

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# One-command static gate: weedlint + bytecode compile (+ ruff when
+# installed).  Run from the repo root:  bash tools/check.sh
+set -u
+
+cd "$(dirname "$0")/.."
+rc=0
+
+echo "== weedlint =="
+python -m tools.weedlint seaweedfs_tpu || rc=1
+
+echo "== compileall =="
+python -m compileall -q seaweedfs_tpu tools || rc=1
+
+echo "== ruff =="
+if python -m ruff --version >/dev/null 2>&1; then
+    python -m ruff check seaweedfs_tpu tests tools || rc=1
+elif command -v ruff >/dev/null 2>&1; then
+    ruff check seaweedfs_tpu tests tools || rc=1
+else
+    echo "ruff not installed; skipping (config lives in pyproject.toml)"
+fi
+
+if [ "$rc" -eq 0 ]; then
+    echo "check.sh: all gates green"
+else
+    echo "check.sh: FAILED" >&2
+fi
+exit "$rc"

@@ -1,0 +1,235 @@
+"""weedlint — repo-native static analysis for seaweedfs_tpu.
+
+Generic linters can't see this codebase's load-bearing invariants: locks
+that must not be held across blocking I/O, `jax.jit`-traced functions
+that must stay pure, and `struct` format strings that must match the
+Haystack on-disk layout byte for byte.  weedlint is a small AST-walking
+framework with pluggable checkers for exactly those classes of defect.
+
+Usage:
+    python -m tools.weedlint seaweedfs_tpu
+    python -m tools.weedlint --list-checkers
+    python -m tools.weedlint --write-baseline seaweedfs_tpu
+
+Checkers register themselves with the @register decorator; each receives
+a ModuleContext (path + parsed AST) and yields Findings.  A checked-in
+baseline (tools/weedlint/baseline.json) suppresses accepted legacy
+findings so the tier-1 gate test fails only on NEW violations.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Iterator
+
+__all__ = [
+    "Finding", "ModuleContext", "register", "all_checkers",
+    "analyze_file", "analyze_paths", "load_baseline", "baseline_key",
+    "filter_new", "write_baseline", "DEFAULT_BASELINE",
+]
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: where, which checker, what, and how to fix it."""
+    checker: str        # stable id, e.g. "WL001"
+    name: str           # human slug, e.g. "lock-blocking-call"
+    file: str           # path as given on the command line (posix slashes)
+    line: int
+    message: str
+    hint: str = ""
+
+    def render(self) -> str:
+        s = f"{self.file}:{self.line}: {self.checker} [{self.name}] {self.message}"
+        if self.hint:
+            s += f"  (fix: {self.hint})"
+        return s
+
+
+@dataclass
+class ModuleContext:
+    """What every checker gets: one parsed module plus its location."""
+    path: str           # display path (as passed / found)
+    tree: ast.Module
+    source: str
+    # module-level integer constants resolvable by literal/arith folding —
+    # shared across checkers that need declared sizes (wire format)
+    constants: dict[str, int] = field(default_factory=dict)
+
+
+_PRAGMA = "# weedlint: disable"
+
+
+def _pragmas(source: str) -> dict[int, set[str] | None]:
+    """line -> suppressed checker ids (None = all) for
+    ``# weedlint: disable=WL001,WL002`` / ``# weedlint: disable``."""
+    out: dict[int, set[str] | None] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        idx = line.find(_PRAGMA)
+        if idx < 0:
+            continue
+        rest = line[idx + len(_PRAGMA):].strip()
+        if rest.startswith("="):
+            out[i] = {c.strip() for c in rest[1:].split(",") if c.strip()}
+        else:
+            out[i] = None
+    return out
+
+
+def _suppressed(f: Finding, pragmas: dict[int, set[str] | None]) -> bool:
+    ids = pragmas.get(f.line, ())
+    return ids is None or f.checker in ids
+
+
+CheckerFn = Callable[[ModuleContext], Iterator[Finding]]
+_CHECKERS: list[tuple[str, str, CheckerFn]] = []
+
+
+def register(checker_id: str, name: str) -> Callable[[CheckerFn], CheckerFn]:
+    def deco(fn: CheckerFn) -> CheckerFn:
+        _CHECKERS.append((checker_id, name, fn))
+        return fn
+    return deco
+
+
+def all_checkers() -> list[tuple[str, str, CheckerFn]]:
+    _ensure_loaded()
+    return sorted(_CHECKERS)
+
+
+_LOADED = False
+
+
+def _ensure_loaded() -> None:
+    global _LOADED
+    if not _LOADED:
+        from . import checkers  # noqa: F401  (registers on import)
+        _LOADED = True
+
+
+# -- constant folding -------------------------------------------------------
+
+def _fold_constants(tree: ast.Module) -> dict[str, int]:
+    """Module-level NAME = <int expr over literals and earlier NAMEs>."""
+    consts: dict[str, int] = {}
+
+    def ev(node: ast.AST) -> int | None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and not isinstance(node.value, bool):
+            return node.value
+        if isinstance(node, ast.Name):
+            return consts.get(node.id)
+        if isinstance(node, ast.BinOp):
+            left, right = ev(node.left), ev(node.right)
+            if left is None or right is None:
+                return None
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+            if isinstance(node.op, ast.Mult):
+                return left * right
+            if isinstance(node.op, ast.FloorDiv) and right:
+                return left // right
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            v = ev(node.operand)
+            return -v if v is not None else None
+        return None
+
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            v = ev(stmt.value)
+            if v is not None:
+                consts[stmt.targets[0].id] = v
+    return consts
+
+
+# -- running ----------------------------------------------------------------
+
+def analyze_file(path: str, select: set[str] | None = None) -> list[Finding]:
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("WL000", "syntax-error", path.replace(os.sep, "/"),
+                        e.lineno or 1, f"syntax error: {e.msg}",
+                        "file must parse before weedlint can check it")]
+    ctx = ModuleContext(path=path.replace(os.sep, "/"), tree=tree,
+                        source=source, constants=_fold_constants(tree))
+    pragmas = _pragmas(source)
+    out: list[Finding] = []
+    for checker_id, _name, fn in all_checkers():
+        if select and checker_id not in select:
+            continue
+        out.extend(f for f in fn(ctx) if not _suppressed(f, pragmas))
+    return out
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def analyze_paths(paths: Iterable[str],
+                  select: set[str] | None = None) -> list[Finding]:
+    out: list[Finding] = []
+    for f in iter_python_files(paths):
+        out.extend(analyze_file(f, select=select))
+    out.sort(key=lambda fi: (fi.file, fi.line, fi.checker))
+    return out
+
+
+# -- baseline ---------------------------------------------------------------
+
+def baseline_key(f: Finding) -> tuple[str, str, int]:
+    # keyed on basename-relative path so the baseline survives being run
+    # from the repo root or with absolute paths
+    return (f.checker, _norm_path(f.file), f.line)
+
+
+def _norm_path(p: str) -> str:
+    p = p.replace(os.sep, "/")
+    if "seaweedfs_tpu/" in p:
+        return "seaweedfs_tpu/" + p.split("seaweedfs_tpu/", 1)[1]
+    return p.lstrip("./")
+
+
+def load_baseline(path: str = DEFAULT_BASELINE) -> set[tuple[str, str, int]]:
+    if not os.path.exists(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {(e["checker"], e["file"], int(e["line"]))
+            for e in data.get("entries", [])}
+
+
+def write_baseline(findings: list[Finding],
+                   path: str = DEFAULT_BASELINE) -> None:
+    entries = [{"checker": f.checker, "file": _norm_path(f.file),
+                "line": f.line, "message": f.message}
+               for f in findings]
+    entries.sort(key=lambda e: (e["file"], e["line"], e["checker"]))
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=1)
+        f.write("\n")
+
+
+def filter_new(findings: list[Finding],
+               baseline: set[tuple[str, str, int]]) -> list[Finding]:
+    return [f for f in findings if baseline_key(f) not in baseline]

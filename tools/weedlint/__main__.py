@@ -1,0 +1,74 @@
+"""CLI: python -m tools.weedlint [paths...]
+
+Exit codes: 0 = clean (after baseline suppression), 1 = new findings,
+2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import (DEFAULT_BASELINE, all_checkers, analyze_paths, filter_new,
+               load_baseline, write_baseline)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.weedlint",
+        description="repo-native static analysis for seaweedfs_tpu")
+    ap.add_argument("paths", nargs="*", default=["seaweedfs_tpu"],
+                    help="files or directories to analyze "
+                         "(default: seaweedfs_tpu)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON of accepted legacy findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write all current findings to the baseline "
+                         "file and exit 0")
+    ap.add_argument("--select", default="",
+                    help="comma-separated checker ids to run "
+                         "(e.g. WL001,WL030)")
+    ap.add_argument("--list-checkers", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_checkers:
+        for checker_id, name, fn in all_checkers():
+            print(f"{checker_id}  {name}")
+        return 0
+
+    select = {s.strip() for s in args.select.split(",") if s.strip()} or None
+    if args.write_baseline and select:
+        # a partial run must never overwrite the full baseline — it would
+        # drop every other checker's accepted entries
+        print("--write-baseline cannot be combined with --select",
+              file=sys.stderr)
+        return 2
+    paths = args.paths or ["seaweedfs_tpu"]
+    findings = analyze_paths(paths, select=select)
+
+    if args.write_baseline:
+        write_baseline(findings, args.baseline)
+        print(f"wrote {len(findings)} entries to {args.baseline}")
+        return 0
+
+    baseline = set() if args.no_baseline else load_baseline(args.baseline)
+    new = filter_new(findings, baseline)
+    for f in new:
+        print(f.render())
+    suppressed = len(findings) - len(new)
+    if new:
+        print(f"\nweedlint: {len(new)} new finding(s)"
+              + (f" ({suppressed} baselined)" if suppressed else ""),
+              file=sys.stderr)
+        return 1
+    if suppressed:
+        print(f"weedlint: clean ({suppressed} baselined legacy findings)")
+    else:
+        print("weedlint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

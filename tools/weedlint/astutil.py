@@ -1,0 +1,55 @@
+"""Shared AST helpers for weedlint checkers."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'time.sleep' for Attribute chains, 'open' for Names, '' otherwise.
+    Call receivers that aren't name chains (e.g. ``get_lock().acquire``)
+    fold to '<expr>.attr'."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else f"<expr>.{node.attr}"
+    return ""
+
+
+def terminal_name(node: ast.AST) -> str:
+    """Last component of a dotted name ('sleep' for time.sleep)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def walk_shallow(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does NOT descend into nested function/class bodies —
+    statements in a nested def run at call time, not while the enclosing
+    block (e.g. a ``with lock:``) is active."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def functions(tree: ast.AST) -> Iterator[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def is_lock_expr(node: ast.AST) -> bool:
+    """Heuristic: an expression naming a lock — terminal identifier
+    contains 'lock' or 'mutex' (``self._lock``, ``WRITE_LOCK``,
+    ``fid_lock``).  Condition objects are excluded: waiting on a
+    condition *inside* its ``with`` is the correct idiom."""
+    name = terminal_name(node).lower()
+    return ("lock" in name or "mutex" in name) and "cond" not in name

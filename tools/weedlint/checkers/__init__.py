@@ -1,0 +1,7 @@
+"""Checker modules register themselves on import."""
+
+from . import locks        # noqa: F401
+from . import jaxpurity    # noqa: F401
+from . import wire         # noqa: F401
+from . import exceptions   # noqa: F401
+from . import resources    # noqa: F401

@@ -1,0 +1,56 @@
+"""WL030 swallowed-exception — ``except:`` / ``except Exception:`` whose
+body only passes/continues, with no logging and no re-raise.
+
+A storage or serving stack that eats exceptions silently turns disk
+corruption, failed RPCs and torn shutdowns into un-debuggable mystery
+states.  Best-effort semantics are fine — but they must leave a trace:
+log at debug via util/weedlog.py and keep going.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .. import Finding, ModuleContext, register
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except:
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Attribute):
+        return t.attr in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(_is_broad(ast.ExceptHandler(type=e, name=None, body=[]))
+                   for e in t.elts)
+    return False
+
+
+def _only_swallows(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+@register("WL030", "swallowed-exception")
+def check_swallowed(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if _is_broad(node) and _only_swallows(node.body):
+            what = "bare except" if node.type is None else "except Exception"
+            yield Finding(
+                "WL030", "swallowed-exception", ctx.path, node.lineno,
+                f"{what} swallows the error with no log",
+                "keep the best-effort semantics but record it: "
+                "`_log.debug(\"...: %s\", e)` via util/weedlog.py, or "
+                "narrow the exception type")

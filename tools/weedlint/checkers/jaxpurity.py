@@ -1,0 +1,148 @@
+"""JAX trace-purity checkers for jit/shard_map-decorated functions.
+
+Traced functions run ONCE at trace time; Python side effects silently
+happen never again (or at every retrace), and host syncs
+(np.asarray / block_until_ready / float()) break async dispatch and
+stall the device pipeline mid-graph.
+
+WL010 jit-side-effect — print/open/input, time.*, random.*, or mutation
+of a ``global`` inside a traced function.
+WL011 jit-host-sync — np.asarray/np.array/jax.device_get/
+``.block_until_ready()``/``float(x)``/``int(x)`` on a bare name inside a
+traced function.
+WL012 jit-uint8-arith — add/mult/matmul/sum over operands explicitly
+cast to uint8: GF(2^8) byte math must go through the table/bit-plane
+helpers; raw uint8 arithmetic wraps mod 256 on TPU.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .. import Finding, ModuleContext, register
+from ..astutil import dotted_name, terminal_name
+
+_TRACE_DECOS = {"jit", "shard_map", "pmap", "vmap", "pjit"}
+_SIDE_EFFECT_CALLS = {
+    "print", "input", "open",
+    "time.time", "time.time_ns", "time.perf_counter", "time.monotonic",
+    "time.sleep",
+}
+_SIDE_EFFECT_PREFIX = ("random.", "np.random.", "numpy.random.")
+_HOST_SYNC_CALLS = {
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "jax.device_get", "np.save", "numpy.save",
+}
+
+
+def _decorated_traced(fn: ast.FunctionDef) -> bool:
+    """@jax.jit, @jit, @partial(jax.jit, ...), @shard_map(...) etc."""
+    for deco in fn.decorator_list:
+        target = deco.func if isinstance(deco, ast.Call) else deco
+        if terminal_name(target) in _TRACE_DECOS:
+            return True
+        # functools.partial(jax.jit, static_argnames=...)
+        if isinstance(deco, ast.Call) and terminal_name(deco.func) == "partial":
+            for arg in deco.args:
+                if terminal_name(arg) in _TRACE_DECOS:
+                    return True
+    return False
+
+
+def _mentions_uint8(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "uint8":
+            return True
+        if isinstance(sub, ast.Constant) and sub.value == "uint8":
+            return True
+    return False
+
+
+def _traced_functions(tree: ast.Module) -> Iterator[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and _decorated_traced(node):
+            yield node
+
+
+@register("WL010", "jit-side-effect")
+def check_jit_side_effects(ctx: ModuleContext) -> Iterator[Finding]:
+    for fn in _traced_functions(ctx.tree):
+        mutated_globals: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                mutated_globals.update(node.names)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name in _SIDE_EFFECT_CALLS \
+                        or name.startswith(_SIDE_EFFECT_PREFIX):
+                    yield Finding(
+                        "WL010", "jit-side-effect", ctx.path, node.lineno,
+                        f"side effect `{name}` inside traced `{fn.name}`",
+                        "runs at trace time only; hoist out of the jitted "
+                        "function (use jax.debug.print for debugging)")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id in mutated_globals:
+                        yield Finding(
+                            "WL010", "jit-side-effect", ctx.path,
+                            node.lineno,
+                            f"global `{t.id}` mutated inside traced "
+                            f"`{fn.name}`",
+                            "thread state through arguments/returns; "
+                            "trace-time mutation is invisible on replay")
+
+
+@register("WL011", "jit-host-sync")
+def check_jit_host_sync(ctx: ModuleContext) -> Iterator[Finding]:
+    for fn in _traced_functions(ctx.tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in _HOST_SYNC_CALLS \
+                    or terminal_name(node.func) == "block_until_ready":
+                yield Finding(
+                    "WL011", "jit-host-sync", ctx.path, node.lineno,
+                    f"host sync `{name or 'block_until_ready'}` inside "
+                    f"traced `{fn.name}`",
+                    "materializes the traced value on host (ConcretizationError "
+                    "or pipeline stall); use jnp.* and keep data on device")
+            elif name in ("float", "int") and len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.Name):
+                yield Finding(
+                    "WL011", "jit-host-sync", ctx.path, node.lineno,
+                    f"`{name}()` on traced value `{node.args[0].id}` "
+                    f"inside `{fn.name}`",
+                    "forces device->host transfer; keep it an array or "
+                    "pass as a static argument")
+
+
+@register("WL012", "jit-uint8-arith")
+def check_jit_uint8_arith(ctx: ModuleContext) -> Iterator[Finding]:
+    _REDUCERS = {"sum", "dot", "matmul", "prod", "cumsum", "einsum"}
+    for fn in _traced_functions(ctx.tree):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, (ast.Add, ast.Mult, ast.Pow)) \
+                    and (_mentions_uint8(node.left)
+                         or _mentions_uint8(node.right)):
+                yield Finding(
+                    "WL012", "jit-uint8-arith", ctx.path, node.lineno,
+                    f"uint8 arithmetic inside traced `{fn.name}` wraps "
+                    "mod 256",
+                    "accumulate in int32/f32 (gf_matmul_bits pattern) and "
+                    "cast back to uint8 at the end")
+            elif isinstance(node, ast.Call) \
+                    and terminal_name(node.func) in _REDUCERS \
+                    and any(_mentions_uint8(a) for a in node.args):
+                yield Finding(
+                    "WL012", "jit-uint8-arith", ctx.path, node.lineno,
+                    f"uint8 reduction `{dotted_name(node.func)}` inside "
+                    f"traced `{fn.name}` wraps mod 256",
+                    "reduce with preferred_element_type=jnp.int32 (or "
+                    "astype(int32) first), cast back after")

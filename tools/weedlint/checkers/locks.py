@@ -1,0 +1,99 @@
+"""Lock-discipline checkers.
+
+WL001 lock-blocking-call — a call known to block (sleep, subprocess,
+socket/HTTP, file open) lexically inside a ``with <lock>:`` body.  A
+container lock held across blocking I/O turns every reader into a
+convoy behind one slow disk/network op; snapshot under the lock and do
+the I/O outside.
+
+WL002 lock-unbalanced-acquire — ``x.acquire()`` in a function with no
+matching ``x.release()`` anywhere in that function.  An exception
+between them deadlocks every later taker; use ``with x:`` or
+``try/finally``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .. import Finding, ModuleContext, register
+from ..astutil import dotted_name, is_lock_expr, terminal_name, walk_shallow
+
+# dotted-name prefixes/exacts that block the calling thread
+_BLOCKING_EXACT = {
+    "time.sleep", "sleep",
+    "socket.socket", "socket.create_connection", "socket.getaddrinfo",
+    "urllib.request.urlopen", "urlopen",
+    "os.system", "open", "io.open",
+    "http_get", "http_post", "http_delete", "http_put",
+}
+_BLOCKING_PREFIX = ("subprocess.", "requests.")
+# attribute tails that block regardless of receiver (socket/conn objects)
+_BLOCKING_ATTRS = {"recv", "sendall", "connect", "accept",
+                   "urlopen", "getresponse"}
+
+
+def _is_blocking_call(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if name in _BLOCKING_EXACT:
+        return True
+    if name.startswith(_BLOCKING_PREFIX):
+        return True
+    return terminal_name(call.func) in _BLOCKING_ATTRS
+
+
+@register("WL001", "lock-blocking-call")
+def check_lock_blocking(ctx: ModuleContext) -> Iterator[Finding]:
+    seen: set[int] = set()  # call nodes already reported: nested lock
+    # withs both reach the same call, which is ONE defect site
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        lock_items = [it for it in node.items
+                      if is_lock_expr(it.context_expr)
+                      or (isinstance(it.context_expr, ast.Call)
+                          and is_lock_expr(it.context_expr.func))]
+        if not lock_items:
+            continue
+        lock_txt = dotted_name(lock_items[0].context_expr) or "lock"
+        for stmt in node.body:
+            for sub in [stmt, *walk_shallow(stmt)]:
+                if isinstance(sub, ast.Call) and _is_blocking_call(sub) \
+                        and id(sub) not in seen:
+                    seen.add(id(sub))
+                    yield Finding(
+                        "WL001", "lock-blocking-call", ctx.path, sub.lineno,
+                        f"blocking call `{dotted_name(sub.func)}` while "
+                        f"holding `{lock_txt}`",
+                        "snapshot state under the lock, do the blocking "
+                        "I/O outside the critical section")
+
+
+@register("WL002", "lock-unbalanced-acquire")
+def check_unbalanced_acquire(ctx: ModuleContext) -> Iterator[Finding]:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name in ("__enter__", "acquire"):
+            continue  # lock-wrapper protocol: release lives in __exit__
+        acquires: dict[str, list[int]] = {}
+        releases: set[str] = set()
+        for node in walk_shallow(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                recv = dotted_name(node.func.value)
+                if not is_lock_expr(node.func.value):
+                    continue
+                if node.func.attr == "acquire":
+                    acquires.setdefault(recv, []).append(node.lineno)
+                elif node.func.attr == "release":
+                    releases.add(recv)
+        for recv, lines in acquires.items():
+            if recv not in releases:
+                yield Finding(
+                    "WL002", "lock-unbalanced-acquire", ctx.path, lines[0],
+                    f"`{recv}.acquire()` with no `{recv}.release()` in "
+                    f"`{fn.name}`",
+                    "use `with` or pair acquire with release in "
+                    "try/finally")

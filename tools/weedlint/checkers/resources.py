@@ -1,0 +1,120 @@
+"""WL040 resource-leak — ``open()`` / ``socket.socket()`` outside a
+``with`` and without a reachable ``.close()``.
+
+A volume server holds thousands of file handles; every leaked one is a
+step toward EMFILE under real traffic.  Recognized ownership patterns:
+``with`` items, ``ExitStack.enter_context``/``contextlib.closing``,
+returning the handle, storing it on ``self``, and the repo's
+shard-fan-out idiom — a dict/list comprehension of handles assigned to
+a name that is close-looped in a ``finally`` (transitively, so nested
+``for d in outs.values(): for f in d.values(): f.close()`` counts).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .. import Finding, ModuleContext, register
+from ..astutil import dotted_name
+
+_OPENERS = {"open", "io.open", "socket.socket", "socket.create_connection",
+            "gzip.open", "lzma.open", "bz2.open"}
+_CLOSERS = {"close", "shutdown", "detach", "terminate"}
+_MANAGER_WRAPPERS = {"enter_context", "closing", "push"}
+
+
+def _opener_name(call: ast.Call) -> str | None:
+    name = dotted_name(call.func)
+    return name if name in _OPENERS else None
+
+
+def _closed_names(fn: ast.AST) -> set[str]:
+    """Names with a reachable `.close()`, propagated backwards through
+    for-loops: `for f in outputs.values(): f.close()` closes `outputs`."""
+    closed: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _CLOSERS:
+            closed.add(dotted_name(node.func.value))
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.For):
+                continue
+            targets = {n.id for n in ast.walk(node.target)
+                       if isinstance(n, ast.Name)}
+            if targets & closed:
+                for sub in ast.walk(node.iter):
+                    if isinstance(sub, ast.Name) and sub.id not in closed:
+                        closed.add(sub.id)
+                        changed = True
+    return closed
+
+
+@register("WL040", "resource-leak")
+def check_resources(ctx: ModuleContext) -> Iterator[Finding]:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        closed = _closed_names(fn)
+        returned: set[str] = set()
+        managed: set[int] = set()   # id() of opener Call nodes accounted for
+
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        managed.add(id(sub))
+            elif isinstance(node, ast.Return) and node.value is not None:
+                returned.add(dotted_name(node.value))
+                # `return open(p)` transfers ownership to the caller;
+                # `return json.load(open(p))` does NOT — only the
+                # directly-returned expression is managed
+                managed.add(id(node.value))
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MANAGER_WRAPPERS:
+                for arg in node.args:
+                    for sub in ast.walk(arg):
+                        managed.add(id(sub))
+            elif isinstance(node, ast.Call) \
+                    and dotted_name(node.func) == "contextlib.closing":
+                for arg in node.args:
+                    for sub in ast.walk(arg):
+                        managed.add(id(sub))
+
+        leaks: list[tuple[ast.Call, str, str]] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            openers = [sub for sub in ast.walk(node.value)
+                       if isinstance(sub, ast.Call) and _opener_name(sub)]
+            if not openers:
+                continue
+            names = {dotted_name(t) for t in node.targets}
+            attr_target = any(isinstance(t, ast.Attribute)
+                              for t in node.targets)
+            ok = attr_target or (names & closed) or (names & returned)
+            for call in openers:
+                already = id(call) in managed
+                managed.add(id(call))
+                if not ok and not already:
+                    leaks.append((call, next(iter(names), "?")))
+        for call, name in leaks:
+            yield Finding(
+                "WL040", "resource-leak", ctx.path, call.lineno,
+                f"`{_opener_name(call)}()` assigned to `{name}` is "
+                f"never closed in `{fn.name}`",
+                "use `with` (or ExitStack), or close it in a finally")
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and _opener_name(node) \
+                    and id(node) not in managed:
+                yield Finding(
+                    "WL040", "resource-leak", ctx.path, node.lineno,
+                    f"`{_opener_name(node)}()` result used without "
+                    f"`with` in `{fn.name}`",
+                    "bind it in a `with` block so the handle closes on "
+                    "every path")

@@ -1,0 +1,161 @@
+"""Wire-format consistency checkers.
+
+The Haystack on-disk layout (needle header/body, superblock, .idx
+entries) is fixed: every volume ever written depends on these exact
+byte counts.  These checkers cross-check `struct` usage against the
+declared size constants so a drive-by edit can't silently change the
+format.
+
+WL020 struct-bad-format — a literal struct format string that
+`struct.calcsize` rejects (typo'd endianness/type chars crash at
+runtime, on the first read of real data).
+WL021 struct-offset-overflow — `pack_into`/`unpack_from` with a literal
+offset into a buffer whose size is statically known (``bytearray(N)`` or
+``bytearray(CONST)``) where offset + calcsize(fmt) exceeds the buffer.
+WL022 wire-constant-drift — a module redefines one of the known on-disk
+size constants to a value that no longer matches the format.
+"""
+
+from __future__ import annotations
+
+import ast
+import struct as _struct
+from typing import Iterator
+
+from .. import Finding, ModuleContext, register
+from ..astutil import dotted_name, terminal_name, walk_shallow
+
+
+def _scope_walk(node: ast.AST):
+    yield node
+    yield from walk_shallow(node)
+
+_STRUCT_FNS = {"pack", "unpack", "pack_into", "unpack_from",
+               "calcsize", "Struct", "iter_unpack"}
+
+# the Haystack format, as shipped; see storage/types.py and
+# storage/super_block.py for provenance
+EXPECTED_WIRE_CONSTANTS = {
+    "NEEDLE_ID_SIZE": 8,
+    "COOKIE_SIZE": 4,
+    "SIZE_SIZE": 4,
+    "NEEDLE_HEADER_SIZE": 16,
+    "NEEDLE_CHECKSUM_SIZE": 4,
+    "TIMESTAMP_SIZE": 8,
+    "NEEDLE_PADDING_SIZE": 8,
+    "NEEDLE_MAP_ENTRY_SIZE": 16,
+    "SUPER_BLOCK_SIZE": 8,
+    "LAST_MODIFIED_BYTES_LENGTH": 5,
+    "TTL_BYTES_LENGTH": 2,
+}
+
+
+def _struct_calls(tree: ast.AST, walk=ast.walk
+                  ) -> Iterator[tuple[ast.Call, str, str]]:
+    """Yield (call, function-name, literal-format) for struct.* calls
+    whose first argument is a string literal."""
+    for node in walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fname = terminal_name(node.func)
+        if fname not in _STRUCT_FNS:
+            continue
+        dotted = dotted_name(node.func)
+        if not (dotted.startswith("struct.") or dotted in _STRUCT_FNS):
+            continue
+        fmt = node.args[0]
+        if isinstance(fmt, ast.Constant) and isinstance(fmt.value, str):
+            yield node, fname, fmt.value
+
+
+@register("WL020", "struct-bad-format")
+def check_struct_format(ctx: ModuleContext) -> Iterator[Finding]:
+    for call, fname, fmt in _struct_calls(ctx.tree):
+        try:
+            _struct.calcsize(fmt)
+        except _struct.error as e:
+            yield Finding(
+                "WL020", "struct-bad-format", ctx.path, call.lineno,
+                f"struct.{fname} format {fmt!r} is invalid: {e}",
+                "fix the format string; it would raise struct.error at "
+                "runtime")
+
+
+def _buffer_sizes(fn: ast.AST, constants: dict[str, int],
+                  walk=ast.walk) -> dict[str, int]:
+    """Local names bound to bytearray(N)/bytes(N) with resolvable N."""
+    sizes: dict[str, int] = {}
+    for node in walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and terminal_name(node.value.func) in ("bytearray", "bytes") \
+                and len(node.value.args) == 1:
+            arg = node.value.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, int):
+                sizes[node.targets[0].id] = arg.value
+            elif isinstance(arg, ast.Name) and arg.id in constants:
+                sizes[node.targets[0].id] = constants[arg.id]
+    return sizes
+
+
+@register("WL021", "struct-offset-overflow")
+def check_struct_offsets(ctx: ModuleContext) -> Iterator[Finding]:
+    # each scope (module body, each function) is scanned shallowly so a
+    # call is attributed to exactly one scope — no double reports
+    scopes: list[ast.AST] = [ctx.tree]
+    scopes += [n for n in ast.walk(ctx.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in scopes:
+        sizes = _buffer_sizes(fn, ctx.constants, walk=_scope_walk)
+        if not sizes:
+            continue
+        for call, fname, fmt in _struct_calls(fn, walk=_scope_walk):
+            if fname not in ("pack_into", "unpack_from") \
+                    or len(call.args) < 3:
+                continue
+            buf, off = call.args[1], call.args[2]
+            if not (isinstance(buf, ast.Name) and buf.id in sizes):
+                continue
+            offset = None
+            if isinstance(off, ast.Constant) and isinstance(off.value, int):
+                offset = off.value
+            elif isinstance(off, ast.Name) and off.id in ctx.constants:
+                offset = ctx.constants[off.id]
+            if offset is None:
+                continue
+            try:
+                need = offset + _struct.calcsize(fmt)
+            except _struct.error:
+                continue  # WL020's finding
+            if need > sizes[buf.id]:
+                yield Finding(
+                    "WL021", "struct-offset-overflow", ctx.path,
+                    call.lineno,
+                    f"struct.{fname}({fmt!r}, {buf.id}, {offset}) needs "
+                    f"{need} bytes but `{buf.id}` holds {sizes[buf.id]}",
+                    "offset + calcsize(format) must fit the declared "
+                    "buffer; check the layout constants")
+
+
+@register("WL022", "wire-constant-drift")
+def check_wire_constants(ctx: ModuleContext) -> Iterator[Finding]:
+    for name, expected in EXPECTED_WIRE_CONSTANTS.items():
+        actual = ctx.constants.get(name)
+        if actual is not None and actual != expected:
+            yield Finding(
+                "WL022", "wire-constant-drift", ctx.path,
+                _const_line(ctx.tree, name),
+                f"{name} = {actual}, but the on-disk format fixes it at "
+                f"{expected}",
+                "the Haystack layout is frozen — changing this breaks "
+                "every existing volume; revert or write a migration")
+
+
+def _const_line(tree: ast.Module, name: str) -> int:
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) \
+                and any(isinstance(t, ast.Name) and t.id == name
+                        for t in stmt.targets):
+            return stmt.lineno
+    return 1
