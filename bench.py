@@ -198,6 +198,75 @@ def bench_disk_path(on_tpu: bool, quick: bool) -> dict:
     return out
 
 
+def bench_hotset_reread(concurrency: int, quick: bool = False,
+                        n_hot: int = 2000, passes: int = 3) -> dict:
+    """Hot-set re-read throughput + needle-cache hit rate (ISSUE 4):
+    a working set small enough to live entirely in the volume servers'
+    hot-needle LRU is read repeatedly — pass 1 warms the cache, the
+    timed passes measure cache-resident serving.  The hit rate is
+    sampled per timed pass from the servers' own counters, so both
+    extras carry {value, n, min, max} spreads like every other volatile
+    metric here."""
+    import threading
+
+    from seaweedfs_tpu import operation
+    from seaweedfs_tpu.testing import SimCluster
+
+    if quick:
+        n_hot, passes = 400, 2
+    payload = b"h" * 1024
+    with SimCluster(volume_servers=2, max_volumes=60) as cluster:
+        fids: list[str] = []
+        for _ in range(0, n_hot, 100):
+            r = operation.assign(cluster.master_grpc, count=100)
+            for fid in operation.derive_fids(r):
+                operation.upload_to(r, fid, payload)
+                fids.append(fid)
+
+        def read_slice(sub):
+            for fid in sub:
+                operation.read_file(cluster.master_grpc, fid)
+
+        def one_pass() -> float:
+            per = max(1, len(fids) // concurrency)
+            slices = [fids[i * per:(i + 1) * per]
+                      for i in range(concurrency)]
+            slices = [s for s in slices if s]
+            threads = [threading.Thread(target=read_slice, args=(s,))
+                       for s in slices]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return len(fids) / (time.perf_counter() - t0)
+
+        def cache_counts() -> tuple[int, int]:
+            hits = misses = 0
+            for vs in cluster.volume_servers:
+                if vs is not None:
+                    hits += vs.needle_cache.hits
+                    misses += vs.needle_cache.misses
+            return hits, misses
+
+        one_pass()   # warm: populates the hot-needle LRU
+        rates, hit_rates = [], []
+        for _ in range(passes):
+            h0, m0 = cache_counts()
+            rates.append(one_pass())
+            h1, m1 = cache_counts()
+            looked = (h1 - h0) + (m1 - m0)
+            hit_rates.append((h1 - h0) / looked if looked else 0.0)
+        out: dict = {}
+        out["smallfile_hotset_reread_rps"], \
+            out["smallfile_hotset_reread_rps_spread"] = spread(rates,
+                                                               digits=1)
+        out["needle_cache_hit_rate"], \
+            out["needle_cache_hit_rate_spread"] = spread(hit_rates,
+                                                         digits=4)
+        return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -523,20 +592,31 @@ def main():
                 [r["write"]["req_per_sec"] for r in runs], digits=1)
             r_med, r_spread = spread(
                 [r["read"]["req_per_sec"] for r in runs], digits=1)
-            # p99 from the median-write run (the run the headline
-            # number describes)
-            mid = sorted(runs, key=lambda r:
-                         r["write"]["req_per_sec"])[len(runs) // 2]
+            # p99 with spread across ALL runs (ISSUE 4: latency tails
+            # are as volatile as throughput on this shared box)
+            wp99_med, wp99_spread = spread(
+                [r["write"].get("p99_ms") or 0.0 for r in runs])
+            rp99_med, rp99_spread = spread(
+                [r["read"].get("p99_ms") or 0.0 for r in runs])
             smallfile = {
                 "smallfile_write_rps": w_med,
                 "smallfile_write_rps_spread": w_spread,
-                "smallfile_write_p99_ms": mid["write"].get("p99_ms"),
+                "smallfile_write_p99_ms": wp99_med,
+                "smallfile_write_p99_ms_spread": wp99_spread,
                 "smallfile_read_rps": r_med,
                 "smallfile_read_rps_spread": r_spread,
-                "smallfile_read_p99_ms": mid["read"].get("p99_ms"),
+                "smallfile_read_p99_ms": rp99_med,
+                "smallfile_read_p99_ms_spread": rp99_spread,
                 "smallfile_ref_write_rps": 15708,
                 "smallfile_ref_read_rps": 47019,
             }
+            try:
+                # a flaked hotset extra must not discard the headline
+                # smallfile numbers measured above
+                smallfile.update(bench_hotset_reread(
+                    conc, quick=args.quick))
+            except Exception as e:
+                smallfile["smallfile_hotset_error"] = str(e)[:200]
         except Exception as e:   # never fail the headline metric
             smallfile = {"smallfile_error": str(e)[:200]}
     # end-to-end disk path (VERDICT r3 missing #1)

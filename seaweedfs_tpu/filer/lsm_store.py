@@ -87,13 +87,21 @@ class _Segment:
                     f.seek(vlen, 1)
                     off += 8 + klen + vlen
         self._f = open(path, "rb")
-        self._read_lock = threading.Lock()
 
     def _value_at(self, idx: int) -> bytes:
+        # positioned read: concurrent readers share no seek offset, so
+        # value fetches need no lock at all (same idiom as the volume
+        # read path, storage/backend.py)
         off, vlen = self._pos[idx]
-        with self._read_lock:
-            self._f.seek(off)
-            return self._f.read(vlen)
+        chunks = []
+        while vlen > 0:
+            b = os.pread(self._f.fileno(), vlen, off)
+            if not b:
+                break
+            chunks.append(b)
+            vlen -= len(b)
+            off += len(b)
+        return b"".join(chunks) if len(chunks) != 1 else chunks[0]
 
     def get(self, key: bytes) -> "bytes | None":
         i = bisect_left(self.keys, key)

@@ -157,12 +157,16 @@ class FilerServer:
         # read-path chunk cache tiers (util/chunk_cache + reader_at.go);
         # fids are immutable so entries only ever age out by capacity
         from ..util.chunk_cache import TieredChunkCache
+        from ..wdclient import CachedFileReader
         self.chunk_cache = TieredChunkCache(
             mem_limit_bytes=chunk_cache_mem_mb << 20,
             mem_item_limit=max(chunk_size, 8 << 20),
             cache_dir=chunk_cache_dir,
             disk_limit_bytes=chunk_cache_disk_mb << 20) \
             if chunk_cache_mem_mb > 0 or chunk_cache_dir else None
+        # chunk reads ride the shared wdclient reader: cache tiers +
+        # TTL'd volume-location cache + raw-TCP fast path
+        self._chunk_reader = CachedFileReader(cache=self.chunk_cache)
         self.http = HttpServer(host, port)
         self.rpc = RpcServer(host, grpc_port)
         # request counters/latency (the filer_requests/filer_latency
@@ -319,15 +323,8 @@ class FilerServer:
         return r.fid, out.get("eTag", ""), key_b64
 
     def _read_chunk_blob(self, fid: str) -> bytes:
-        if self.chunk_cache is not None:
-            blob = self.chunk_cache.get(fid)
-            if blob is not None:
-                return blob
-        blob = self._with_master(
-            lambda m: operation.read_file(m, fid))
-        if self.chunk_cache is not None:
-            self.chunk_cache.put(fid, blob)
-        return blob
+        return self._with_master(
+            lambda m: self._chunk_reader.read(m, fid))
 
     # -- HTTP --------------------------------------------------------------
     def _register_http(self) -> None:

@@ -94,10 +94,14 @@ class WeedFS:
         # tiered read cache (mount chunk_cache tiers, weed/mount read
         # path); mem-only by default, disk tier when cache_dir given
         from ..util.chunk_cache import MemChunkCache, TieredChunkCache
+        from ..wdclient import CachedFileReader
         self._chunk_cache = TieredChunkCache(
             mem_limit_bytes=cache_mem_mb << 20,
             mem_item_limit=max(chunk_size, 8 << 20),
             cache_dir=cache_dir)
+        # chunk fetches ride the shared wdclient reader (cache tiers +
+        # TTL'd location cache + raw-TCP fast path)
+        self._chunk_reader = CachedFileReader(cache=self._chunk_cache)
         # decoded-chunk LRU in front of the (stored-bytes) chunk cache:
         # FUSE reads arrive in ~128KB slices, so without it a sealed
         # 8MB chunk would pay the full AES-GCM open ~64 times per
@@ -319,11 +323,7 @@ class WeedFS:
         return bytes(out)
 
     def _chunk_blob(self, fid: str) -> bytes:
-        blob = self._chunk_cache.get(fid)
-        if blob is None:
-            blob = operation.read_file(self.master_grpc, fid)
-            self._chunk_cache.put(fid, blob)
-        return blob
+        return self._chunk_reader.read(self.master_grpc, fid)
 
     def _chunk_plain(self, chunk: FileChunk) -> bytes:
         """Plaintext view of a chunk: decode-once LRU for sealed or

@@ -119,12 +119,27 @@ def _crc32c(data: bytes, crc: int = 0) -> int:
     return lib.sw_crc32c(crc, data, len(data))
 
 
+def _crc32c_region(buf: bytes, offset: int, length: int,
+                   crc: int = 0) -> int:
+    """CRC of buf[offset:offset+length] WITHOUT materializing the slice —
+    the zero-copy needle read path checksums its data region in place
+    (c_char_p accepts a raw address; the caller keeps `buf` alive)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    if offset < 0 or length < 0 or offset + length > len(buf):
+        raise ValueError("crc region out of bounds")
+    base = ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p).value
+    return lib.sw_crc32c(crc, ctypes.c_char_p(base + offset), length)
+
+
 def _crc_available() -> bool:
     return _load() is not None
 
 
 # public handles (None when unavailable -> callers fall back to Python)
 crc32c = _crc32c if _crc_available() else None
+crc32c_region = _crc32c_region if _crc_available() else None
 
 
 def lib():

@@ -165,6 +165,15 @@ class ServerMetrics:
             "seaweedfs_s3_request_total", "s3 requests", ["action"])
         self.volume_count = r.gauge(
             "seaweedfs_volume_server_volumes", "volumes on this server")
+        # hot-needle LRU effectiveness (volume_server/needle_cache.py):
+        # result is "hit" / "miss"; the bench derives its cache-hit-rate
+        # extra from these
+        self.needle_cache_ops = r.counter(
+            "seaweedfs_volume_needle_cache_total",
+            "hot-needle cache lookups", ["result"])
+        self.needle_cache_bytes = r.gauge(
+            "seaweedfs_volume_needle_cache_bytes",
+            "bytes held by the hot-needle cache")
         # repair-IO accounting per rebuild plan (rs-full / clay-plane /
         # clay-decode / lrc-local / lrc-global): makes the clay/LRC
         # reduced-read advantage observable in production, not just in

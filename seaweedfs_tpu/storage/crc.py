@@ -68,6 +68,18 @@ def crc32c(data: bytes, crc: int = 0) -> int:
     return _crc32c_py(data, crc)
 
 
+def crc32c_region(buf: bytes, offset: int, length: int,
+                  crc: int = 0) -> int:
+    """CRC of buf[offset:offset+length] without copying the slice — the
+    zero-copy read path verifies a needle's data region inside the raw
+    record buffer it already holds."""
+    if _get_native():
+        from seaweedfs_tpu import native
+        if native.crc32c_region is not None and isinstance(buf, bytes):
+            return native.crc32c_region(buf, offset, length, crc)
+    return _crc32c_py(memoryview(buf)[offset:offset + length], crc)
+
+
 def masked_value(crc: int) -> int:
     """The stored checksum: rot17-left + magic (needle/crc.go:24-26)."""
     return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF

@@ -207,12 +207,13 @@ class EcVolume:
             if i < 0:
                 return
             self._sizes[i] = t.TOMBSTONE_FILE_SIZE
-            # write-through: size field lives at entry+8+OFFSET_SIZE
+            # write-through: size field lives at entry+8+OFFSET_SIZE.
+            # Positioned write — no shared seek offset, nothing buffered
+            # to flush (the handle is used only for these tombstones)
             pos = (i * t.NEEDLE_MAP_ENTRY_SIZE
                    + t.NEEDLE_ID_SIZE + t.OFFSET_SIZE)
-            self._ecx_rw.seek(pos)
-            self._ecx_rw.write(t.size_to_bytes(t.TOMBSTONE_FILE_SIZE))
-            self._ecx_rw.flush()
+            os.pwrite(self._ecx_rw.fileno(), t.size_to_bytes(
+                t.TOMBSTONE_FILE_SIZE), pos)
             # the .ecj tombstone journal append must be ordered with the
             # in-memory tombstone it mirrors; this is the volume's own
             # fine-grained lock, and the append is tiny

@@ -21,7 +21,7 @@ import time
 from dataclasses import dataclass, field
 
 from . import types as t
-from .crc import crc32c, masked_value
+from .crc import crc32c, crc32c_region, masked_value
 from .backend import BackendStorageFile
 from .ttl import TTL
 
@@ -202,8 +202,12 @@ class Needle:
         self.id = t.bytes_to_needle_id(raw[4:12])
         self.size = t.bytes_to_size(raw[12:16])
 
-    def _parse_body_v2(self, body: bytes) -> None:
-        """readNeedleDataVersion2 (needle_read_write.go:270-344)."""
+    def _parse_body_v2(self, body) -> None:
+        """readNeedleDataVersion2 (needle_read_write.go:270-344).  `body`
+        may be a memoryview (zero-copy read path): `data` then stays a
+        view over the caller's buffer, while the small metadata fields
+        (name/mime/pairs) are always materialized as bytes — consumers
+        call .decode() on them."""
         i, n = 0, len(body)
         if i < n:
             data_size = struct.unpack_from(">I", body, i)[0]
@@ -217,40 +221,56 @@ class Needle:
         if i < n and self.has_name():
             name_size = body[i]
             i += 1
-            self.name = body[i:i + name_size]
+            self.name = bytes(body[i:i + name_size])
             i += name_size
         if i < n and self.has_mime():
             mime_size = body[i]
             i += 1
-            self.mime = body[i:i + mime_size]
+            self.mime = bytes(body[i:i + mime_size])
             i += mime_size
         if i < n and self.has_last_modified_date():
             self.last_modified = int.from_bytes(
                 body[i:i + LAST_MODIFIED_BYTES_LENGTH], "big")
             i += LAST_MODIFIED_BYTES_LENGTH
         if i < n and self.has_ttl():
-            self.ttl = TTL.from_bytes(body[i:i + TTL_BYTES_LENGTH])
+            self.ttl = TTL.from_bytes(bytes(body[i:i + TTL_BYTES_LENGTH]))
             i += TTL_BYTES_LENGTH
         if i < n and self.has_pairs():
             pairs_size = struct.unpack_from(">H", body, i)[0]
             i += 2
-            self.pairs = body[i:i + pairs_size]
+            self.pairs = bytes(body[i:i + pairs_size])
             i += pairs_size
 
-    def read_bytes(self, raw: bytes, offset: int, size: int, version: int) -> None:
+    def read_bytes(self, raw: bytes, offset: int, size: int, version: int,
+                   zero_copy: bool = False) -> None:
         """Hydrate from a full record buffer; verifies size + CRC
-        (ReadBytes, needle_read_write.go:216-252)."""
+        (ReadBytes, needle_read_write.go:216-252).
+
+        zero_copy=True leaves `data` a memoryview over `raw` (which the
+        view keeps alive) and checksums the data region in place —
+        the serving path threads that view through Response to the
+        socket without ever materializing a bytes copy."""
         self.parse_header(raw)
         if self.size != size:
             raise SizeMismatchError(
                 f"offset {offset}: found size {self.size}, expected {size}")
+        body = memoryview(raw) if zero_copy else raw
         if version == t.VERSION1:
-            self.data = raw[t.NEEDLE_HEADER_SIZE:t.NEEDLE_HEADER_SIZE + size]
+            self.data = body[t.NEEDLE_HEADER_SIZE:t.NEEDLE_HEADER_SIZE + size]
         else:
-            self._parse_body_v2(raw[t.NEEDLE_HEADER_SIZE:t.NEEDLE_HEADER_SIZE + size])
+            self._parse_body_v2(
+                body[t.NEEDLE_HEADER_SIZE:t.NEEDLE_HEADER_SIZE + size])
         if size > 0:
             stored = struct.unpack_from(">I", raw, t.NEEDLE_HEADER_SIZE + size)[0]
-            actual = masked_value(crc32c(self.data))
+            if isinstance(self.data, memoryview):
+                # data is always the FIRST body field, so its region
+                # inside raw is header (+4B dataSize for v2+) onward
+                data_off = t.NEEDLE_HEADER_SIZE \
+                    + (0 if version == t.VERSION1 else 4)
+                actual = masked_value(
+                    crc32c_region(raw, data_off, len(self.data)))
+            else:
+                actual = masked_value(crc32c(self.data))
             if stored != actual:
                 raise CrcError("CRC error! data on disk corrupted")
             self.checksum = actual
@@ -280,11 +300,11 @@ class Needle:
 
     @classmethod
     def read_from(cls, r: BackendStorageFile, offset: int, size: int,
-                  version: int) -> "Needle":
+                  version: int, zero_copy: bool = False) -> "Needle":
         """ReadData (needle_read_write.go:255-261)."""
         raw = r.read_at(t.get_actual_size(size, version), offset)
         n = cls()
-        n.read_bytes(raw, offset, size, version)
+        n.read_bytes(raw, offset, size, version, zero_copy=zero_copy)
         return n
 
 

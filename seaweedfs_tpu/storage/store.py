@@ -238,20 +238,22 @@ class Store:
         return v.write_needle(n, fsync=fsync)
 
     def read_volume_needle(self, vid: int, n_id: int,
-                           cookie: int | None = None) -> Needle:
+                           cookie: int | None = None,
+                           zero_copy: bool = False) -> Needle:
         v = self.find_volume(vid)
         if v is None:
             raise NotFoundError(f"volume {vid} not found")
-        return v.read_needle(n_id, cookie)
+        return v.read_needle(n_id, cookie, zero_copy=zero_copy)
 
     def read_volume_needle_data(self, vid: int, n_id: int,
-                                cookie: int | None = None) -> bytes:
+                                cookie: int | None = None,
+                                meta: dict | None = None) -> bytes:
         """Blob bytes via the native fast parse (volume.read_needle_data)
         — the TCP read handler's path."""
         v = self.find_volume(vid)
         if v is None:
             raise NotFoundError(f"volume {vid} not found")
-        return v.read_needle_data(n_id, cookie)
+        return v.read_needle_data(n_id, cookie, meta=meta)
 
     def delete_volume_needle(self, vid: int, n_id: int,
                              cookie: int | None = None) -> int:

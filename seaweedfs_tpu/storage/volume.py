@@ -13,6 +13,16 @@ volume_write.go, volume_read.go, volume_checking.go):
 - vacuum() = Compact2 + commit: copy live needles to .cpd/.cpx then rename
   (volume_vacuum.go:67-91)
 
+Read-path concurrency: readers take NO lock.  The needle map's get is a
+plain dict read (atomic under the GIL; the sqlite kind has its own
+internal lock), data reads are positioned `os.pread`-style IO
+(storage/backend.py) so concurrent readers never contend on a shared
+seek offset, and the (needle map, data backend) pair rides one
+`_read_ref` tuple swapped atomically by vacuum — a reader either sees
+the old pair or the new pair, never a torn mix.  If vacuum closes the
+old backend under a reader mid-pread, the reader retries once under the
+volume lock against the fresh pair.
+
 File layout: <dir>/<collection>_<vid>.dat / .idx (or <vid>.dat when the
 collection is empty), matching the reference's FileName convention.
 """
@@ -122,6 +132,10 @@ class Volume:
         self.version = self.super_block.version
         self._check_and_fix(base)
         self.nm: NeedleMapper = new_needle_map(needle_map_kind, base)
+        # the read snapshot: (needle map, data backend) swapped as ONE
+        # tuple so lock-free readers never pair an old map with a new
+        # backend (or vice versa) across a vacuum swap
+        self._read_ref = (self.nm, self.data_backend)
 
     # -- consistency (volume_checking.go) ---------------------------------
     def _check_and_fix(self, base: str) -> None:
@@ -259,14 +273,56 @@ class Volume:
             # worker was stopped between ensure and put; recreate + retry
 
     # -- read path (volume_read.go:16-80) ---------------------------------
-    def read_needle(self, n_id: int, cookie: int | None = None) -> Needle:
+    # Lock-free: `_read_ref` gives a coherent (map, backend) pair, the
+    # dict read is GIL-atomic, and the pread-style backend read needs no
+    # shared seek offset.  A vacuum swapping the pair mid-read surfaces
+    # as a read error (closed fd / stale offsets -> size or CRC
+    # mismatch); `_locked_retry` re-runs the read under the volume lock,
+    # where the pair cannot change, and re-raises the real error if the
+    # failure wasn't the swap race.
+    def _locked_retry(self, fn):
         with self._lock:
-            nv = self.nm.get(n_id)
-        if nv is None or nv.offset == 0 or t.size_is_deleted(nv.size):
-            raise NotFoundError(f"needle {n_id:x} not found in volume {self.id}")
-        n = Needle.read_from(self.data_backend, nv.offset, nv.size, self.version)
+            return fn(self.nm, self.data_backend)
+
+    def read_needle(self, n_id: int, cookie: int | None = None,
+                    zero_copy: bool = False) -> Needle:
+        def attempt(nm: NeedleMapper, backend: BackendStorageFile) -> Needle:
+            nv = nm.get(n_id)
+            if nv is None or nv.offset == 0 or t.size_is_deleted(nv.size):
+                raise NotFoundError(
+                    f"needle {n_id:x} not found in volume {self.id}")
+            n = Needle.read_from(backend, nv.offset, nv.size, self.version,
+                                 zero_copy=zero_copy)
+            if n.id != n_id:
+                # lock-free reads can race a vacuum's backend close with
+                # the OS reusing the fd: the pread then lands in a
+                # different file, and a same-size record there must not
+                # be served as this needle (the locked retry re-reads
+                # coherently)
+                raise VolumeError(
+                    f"needle id mismatch at offset {nv.offset}: "
+                    f"read {n.id:x}, wanted {n_id:x}")
+            n.volume_offset = nv.offset
+            return n
+        try:
+            n = attempt(*self._read_ref)
+        except NotFoundError:
+            raise
+        except Exception:
+            n = self._locked_retry(attempt)
         self._check_read_needle(n, n_id, cookie)
         return n
+
+    def needle_offset(self, n_id: int) -> "int | None":
+        """Current .dat offset of a live needle (None when absent or
+        deleted) — the volume server's cache-population guard: an entry
+        is only admitted while the offset it was read at is still the
+        live one."""
+        nm, _ = self._read_ref
+        nv = nm.get(n_id)
+        if nv is None or nv.offset == 0 or t.size_is_deleted(nv.size):
+            return None
+        return nv.offset
 
     def _check_read_needle(self, n: Needle, n_id: int,
                            cookie: "int | None") -> None:
@@ -279,8 +335,8 @@ class Volume:
             if n.ttl.minutes() and time.time() > expire:
                 raise NotFoundError(f"needle {n_id:x} expired")
 
-    def read_needle_data(self, n_id: int,
-                         cookie: "int | None" = None) -> bytes:
+    def read_needle_data(self, n_id: int, cookie: "int | None" = None,
+                         meta: "dict | None" = None) -> bytes:
         """Fast-path blob read: just the data bytes.
 
         The plain-blob common case (no name/mime/ttl/pairs flags) parses
@@ -288,32 +344,62 @@ class Volume:
         (native/fastpath.c needle_data); rich needles, v1 volumes and
         every error path fall back to read_needle, which re-raises the
         precise error types.  The TCP data server's read handler rides
-        this — the frame protocol can only return bytes anyway."""
+        this — the frame protocol can only return bytes anyway.
+
+        `meta`, when given, receives {"ttl": bool} so the caller's cache
+        can refuse TTL'd needles (expiry is enforced on the disk path,
+        so a cache must never serve them)."""
         from .. import native
         fp = native.fastpath()
         if fp is None:
-            return bytes(self.read_needle(n_id, cookie).data)
-        with self._lock:
-            nv = self.nm.get(n_id)
-        if nv is None or nv.offset == 0 or t.size_is_deleted(nv.size):
-            raise NotFoundError(
-                f"needle {n_id:x} not found in volume {self.id}")
-        raw = self.data_backend.read_at(
-            t.get_actual_size(nv.size, self.version), nv.offset)
-        try:
-            return fp.needle_data(raw, nv.size, self.version,
-                                  -1 if cookie is None else cookie)
-        except ValueError:
-            # rich needle (flags set) or a mismatch: hydrate from the
-            # buffer ALREADY read — no second disk read — and let the
-            # Python parser/checks raise the precise error types
-            n = Needle()
-            n.read_bytes(raw, nv.offset, nv.size, self.version)
-            self._check_read_needle(n, n_id, cookie)
+            n = self.read_needle(n_id, cookie)
+            if meta is not None:
+                meta["ttl"] = n.has_ttl()
             return bytes(n.data)
 
+        def attempt(nm: NeedleMapper,
+                    backend: BackendStorageFile) -> bytes:
+            nv = nm.get(n_id)
+            if nv is None or nv.offset == 0 or t.size_is_deleted(nv.size):
+                raise NotFoundError(
+                    f"needle {n_id:x} not found in volume {self.id}")
+            raw = backend.read_at(
+                t.get_actual_size(nv.size, self.version), nv.offset)
+            try:
+                data = fp.needle_data(raw, nv.size, self.version,
+                                      -1 if cookie is None else cookie)
+                if meta is not None:
+                    meta["ttl"] = False  # fast parse == flags are 0
+                return data
+            except ValueError:
+                # rich needle (flags set) or a mismatch: hydrate from
+                # the buffer ALREADY read — no second disk read — and
+                # let the Python parser/checks raise the precise error
+                # types
+                n = Needle()
+                n.read_bytes(raw, nv.offset, nv.size, self.version)
+                if n.id != n_id:
+                    # fd-reuse race (see read_needle): locked retry
+                    raise VolumeError(
+                        f"needle id mismatch: read {n.id:x}, "
+                        f"wanted {n_id:x}")
+                self._check_read_needle(n, n_id, cookie)
+                if meta is not None:
+                    meta["ttl"] = n.has_ttl()
+                return bytes(n.data)
+
+        try:
+            return attempt(*self._read_ref)
+        except (NotFoundError, CookieMismatchError):
+            raise
+        except Exception:
+            # closed/swapped backend mid-read (vacuum): one coherent
+            # locked retry; real corruption re-raises the same error
+            return self._locked_retry(attempt)
+
     def has_needle(self, n_id: int) -> bool:
-        nv = self.nm.get(n_id)
+        nm, _ = self._read_ref
+        nv = nm.get(n_id)
         return nv is not None and not t.size_is_deleted(nv.size)
 
     # -- delete path (volume_write.go doDeleteRequest) --------------------
@@ -408,6 +494,9 @@ class Volume:
             self.data_backend = open_backend(self.backend_kind, base + ".dat")
             self.super_block = new_sb
             self.nm = new_needle_map(self.needle_map_kind, base)
+            # ONE atomic swap: lock-free readers pick up the fresh pair
+            # together (never old map + new backend)
+            self._read_ref = (self.nm, self.data_backend)
             return before - self.content_size()
 
     # -- lifecycle ---------------------------------------------------------

@@ -26,7 +26,11 @@ from collections import OrderedDict
 
 
 class MemChunkCache:
-    """Byte-bounded LRU of fid -> chunk bytes."""
+    """Byte-bounded LRU of fid -> chunk bytes.
+
+    Values only need `len()` for the byte accounting, so the machinery
+    is reused beyond raw chunks (the volume server's hot-needle cache
+    stores sized entry objects, volume_server/needle_cache.py)."""
 
     def __init__(self, limit_bytes: int = 64 << 20,
                  item_limit: int = 2 << 20):
@@ -60,6 +64,30 @@ class MemChunkCache:
             while self._size > self.limit and self._data:
                 _, evicted = self._data.popitem(last=False)
                 self._size -= len(evicted)
+
+    def remove(self, fid: str):
+        """Drop one entry (returns it, or None) — write-side
+        invalidation for caches whose keys CAN be rewritten (the
+        volume server's hot-needle tier)."""
+        with self._lock:
+            old = self._data.pop(fid, None)
+            if old is not None:
+                self._size -= len(old)
+            return old
+
+    def contains_value(self, fid: str, value) -> bool:
+        """Identity check without touching LRU order or hit/miss
+        accounting (admission re-validation)."""
+        with self._lock:
+            return self._data.get(fid) is value
+
+    def reclassify_miss(self) -> None:
+        """Turn the most recent hit into a miss — for callers whose
+        entry validation (cookie/metadata checks) rejects a found
+        entry after get() already counted it."""
+        with self._lock:
+            self.hits -= 1
+            self.misses += 1
 
     def clear(self) -> None:
         with self._lock:

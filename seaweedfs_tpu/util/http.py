@@ -77,6 +77,65 @@ class Response:
 Handler = Callable[[Request], Response]
 
 
+# -- fast response emit -----------------------------------------------------
+# BaseHTTPRequestHandler's send_response/send_header pipeline costs a
+# Python call + %-format per header and a strftime per request (Date).
+# The data path instead prebuilds status lines and common header bytes,
+# caches the Date header per second, and hands the socket ONE
+# writev-style gather of status+headers+body (sendmsg), so a small read
+# is a single syscall and a single packet.
+
+_STATUS_LINES: dict[int, bytes] = {}
+_SERVER_HDR = b"Server: seaweedfs-tpu\r\n"
+_DATE_CACHE: tuple[int, bytes] = (0, b"")
+
+
+def _status_line(code: int) -> bytes:
+    line = _STATUS_LINES.get(code)
+    if line is None:
+        import http as _http
+        try:
+            phrase = _http.HTTPStatus(code).phrase
+        except ValueError:
+            phrase = ""
+        line = _STATUS_LINES[code] = \
+            f"HTTP/1.1 {code} {phrase}\r\n".encode("latin-1")
+    return line
+
+
+def _date_header() -> bytes:
+    global _DATE_CACHE
+    now = int(time.time())
+    cached_at, hdr = _DATE_CACHE
+    if cached_at != now:
+        from email.utils import formatdate
+        hdr = f"Date: {formatdate(now, usegmt=True)}\r\n".encode("latin-1")
+        _DATE_CACHE = (now, hdr)
+    return hdr
+
+
+def _sendmsg_all(sock, parts: list) -> None:
+    """Gather-write every buffer in `parts` (writev under the hood);
+    falls back to sendall per part on partial sends or where sendmsg is
+    unavailable."""
+    total = sum(len(p) for p in parts)
+    try:
+        sent = sock.sendmsg(parts)
+    except AttributeError:      # platform without sendmsg
+        for p in parts:
+            sock.sendall(p)
+        return
+    if sent >= total:
+        return
+    # rare partial gather: resume with sendall of each remainder
+    for p in parts:
+        if sent >= len(p):
+            sent -= len(p)
+            continue
+        sock.sendall(memoryview(p)[sent:] if sent else p)
+        sent = 0
+
+
 def _trace_skip(path: str) -> bool:
     """Request paths whose spans would drown real traffic in the ring
     buffer (scrapers poll these): context still propagates, recording is
@@ -143,18 +202,31 @@ class HttpServer:
                                   status=("ok" if resp.status < 400
                                           else f"http {resp.status}"))
                 try:
-                    self.send_response(resp.status)
-                    self.send_header("Content-Type", resp.content_type)
+                    # fast emit: prebuilt status line + cached Date +
+                    # one gather-write of head and body (see
+                    # _sendmsg_all) instead of the send_response/
+                    # send_header call-per-line pipeline
+                    head = bytearray(_status_line(resp.status))
+                    head += _SERVER_HDR
+                    head += _date_header()
+                    head += b"Content-Type: "
+                    head += resp.content_type.encode("latin-1")
+                    head += b"\r\n"
                     # a handler may override Content-Length (HEAD replies
                     # advertise the real size with an empty body)
                     explicit_cl = resp.headers.pop("Content-Length", None)
-                    self.send_header("Content-Length",
-                                     explicit_cl or str(len(resp.body)))
+                    head += b"Content-Length: "
+                    head += (explicit_cl or str(len(resp.body))).encode(
+                        "latin-1")
+                    head += b"\r\n"
                     for k, v in resp.headers.items():
-                        self.send_header(k, v)
-                    self.end_headers()
-                    if self.command != "HEAD":
-                        self.wfile.write(resp.body)
+                        head += f"{k}: {v}\r\n".encode("latin-1")
+                    head += b"\r\n"
+                    if self.command != "HEAD" and resp.body:
+                        _sendmsg_all(self.connection,
+                                     [bytes(head), resp.body])
+                    else:
+                        self.wfile.write(bytes(head))
                 except (BrokenPipeError, ConnectionResetError):
                     pass
 

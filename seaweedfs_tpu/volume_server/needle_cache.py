@@ -1,0 +1,147 @@
+"""Hot-needle cache: a byte-bounded LRU of recently served needles in
+front of the volume read path.
+
+Reuses the `MemChunkCache` machinery (util/chunk_cache.py) — its LRU,
+byte accounting and locking work on any value with a `len()` — with
+needle-shaped entries keyed by `<vid>,<key-hex>`.  Unlike filer chunks,
+a (vid, key) CAN be rewritten in place (new cookie, new bytes), so
+entries carry the cookie for read-side validation and the .dat offset
+they were read at for write-side coherence:
+
+- every write/delete of a needle evicts its entry (the server calls
+  `invalidate` after the store mutation lands);
+- a populate is admitted only while the offset the bytes were read at
+  is still the needle's live offset, and is re-checked after insertion
+  (`put_guarded`) — this closes the read-miss/overwrite/populate race
+  where a slow reader could install pre-overwrite bytes after the
+  writer's eviction already ran.
+
+TTL'd needles are never cached (expiry is checked on the disk path).
+
+Env knobs: WEED_NEEDLE_CACHE_MB (total budget, default 64; 0 disables),
+WEED_NEEDLE_CACHE_ITEM_KB (per-entry cap, default 1024).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from ..util.chunk_cache import MemChunkCache
+
+# LRU bookkeeping outside the payload bytes (key string, OrderedDict
+# node, entry object) — charged per entry so a million 10-byte needles
+# cannot blow past the byte budget
+_ENTRY_OVERHEAD = 160
+
+
+@dataclass
+class CachedNeedle:
+    """One served needle: payload + the header fields the HTTP path
+    needs to rebuild its response.  `data_only` entries (populated by
+    the TCP frame path, which never sees name/mime) satisfy TCP reads
+    but are treated as misses by the HTTP path, which repopulates with
+    the full metadata."""
+    cookie: int
+    data: bytes
+    offset: int                 # .dat offset the bytes were read at
+    etag: str = ""
+    mime: bytes = b""
+    name: bytes = b""
+    is_compressed: bool = False
+    data_only: bool = True
+
+    def __len__(self) -> int:   # MemChunkCache byte accounting
+        return len(self.data) + len(self.mime) + len(self.name) \
+            + _ENTRY_OVERHEAD
+
+
+class HotNeedleCache:
+    """MemChunkCache of fid -> CachedNeedle with needle-coherent
+    admission (see module docstring)."""
+
+    def __init__(self, limit_bytes: int | None = None,
+                 item_limit: int | None = None):
+        if limit_bytes is None:
+            limit_bytes = int(os.environ.get("WEED_NEEDLE_CACHE_MB",
+                                             "64")) << 20
+        if item_limit is None:
+            item_limit = int(os.environ.get("WEED_NEEDLE_CACHE_ITEM_KB",
+                                            "1024")) << 10
+        self.enabled = limit_bytes > 0
+        self._mem = MemChunkCache(limit_bytes=limit_bytes,
+                                  item_limit=item_limit)
+
+    @staticmethod
+    def _key(vid: int, n_id: int) -> str:
+        return f"{vid},{n_id:x}"
+
+    # -- read side ---------------------------------------------------------
+    def get(self, vid: int, n_id: int, cookie: "int | None",
+            need_metadata: bool = False) -> "CachedNeedle | None":
+        """Entry for (vid, key) when the cookie matches; None counts as
+        a miss.  A cookie MISMATCH also returns None (the disk path owns
+        the precise error).  need_metadata skips data_only entries."""
+        if not self.enabled:
+            return None
+        e = self._mem.get(self._key(vid, n_id))
+        if e is None:
+            return None
+        if (cookie is not None and e.cookie != cookie) \
+                or (need_metadata and e.data_only):
+            # found-but-unusable counts as a miss, not a hit
+            self._mem.reclassify_miss()
+            return None
+        return e
+
+    def admissible(self, size: int) -> bool:
+        """Whether a payload of `size` bytes could be cached at all —
+        callers skip building (and copying into) an entry that put
+        would refuse anyway."""
+        return self.enabled \
+            and size + _ENTRY_OVERHEAD <= self._mem.item_limit
+
+    # -- populate side -----------------------------------------------------
+    def put_guarded(self, vid: int, n_id: int, entry: CachedNeedle,
+                    live_offset_fn) -> bool:
+        """Admit `entry` only while `live_offset_fn()` still reports the
+        offset the bytes were read at; re-check AFTER insertion so a
+        concurrent overwrite's eviction can never be outrun."""
+        if not self.enabled:
+            return False
+        if live_offset_fn() != entry.offset:
+            return False
+        key = self._key(vid, n_id)
+        self._mem.put(key, entry)
+        if not self._mem.contains_value(key, entry):
+            return False          # over item_limit / instantly evicted
+        if live_offset_fn() != entry.offset:
+            self.invalidate(vid, n_id)
+            return False
+        return True
+
+    # -- write side --------------------------------------------------------
+    def invalidate(self, vid: int, n_id: int) -> None:
+        if not self.enabled:
+            return
+        self._mem.remove(self._key(vid, n_id))
+
+    def clear(self) -> None:
+        self._mem.clear()
+
+    # -- observability -----------------------------------------------------
+    @property
+    def hits(self) -> int:
+        return self._mem.hits
+
+    @property
+    def misses(self) -> int:
+        return self._mem.misses
+
+    @property
+    def stats(self) -> dict:
+        total = self._mem.hits + self._mem.misses
+        return {"hits": self._mem.hits, "misses": self._mem.misses,
+                "bytes": self._mem._size,
+                "entries": len(self._mem._data),
+                "hit_rate": (self._mem.hits / total) if total else 0.0}

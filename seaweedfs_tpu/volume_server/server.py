@@ -44,6 +44,10 @@ LOG = logger(__name__)
 
 PULSE_SECONDS = 5
 EC_LOCATION_STALENESS = 11.0  # the freshest staleness tier (store_ec.go:227)
+# cached "volume is nowhere" answers: long enough to absorb a miss
+# burst, short enough that a just-heartbeated volume becomes reachable
+# within one pulse
+NEGATIVE_LOOKUP_TTL = 1.0
 
 
 def _maybe_resize_image(data: bytes, mime: str, width: str, height: str,
@@ -92,6 +96,11 @@ class VolumeServer:
         from ..stats import ServerMetrics
         self.metrics = ServerMetrics()
         self.tracer = tracing.Tracer("volume")
+        # hot-needle LRU in front of the read paths (HTTP + TCP frames);
+        # writes/deletes of a needle evict its entry, populates are
+        # offset-guarded (volume_server/needle_cache.py)
+        from .needle_cache import HotNeedleCache
+        self.needle_cache = HotNeedleCache()
         self.pulse_seconds = pulse_seconds
         self.store = Store(directories, max_volume_counts)
         self.http = HttpServer(host, port)
@@ -236,6 +245,8 @@ class VolumeServer:
     def _http_metrics(self, req: Request) -> Response:
         total = sum(len(loc.volumes) for loc in self.store.locations)
         self.metrics.volume_count.set(value=total)
+        self.metrics.needle_cache_bytes.set(
+            value=float(self.needle_cache.stats["bytes"]))
         # the process-global codec families ride along: per-backend EC
         # encode/decode latency + bytes (ops/codec.py codec_metrics)
         from ..ops.codec import codec_metrics
@@ -264,7 +275,8 @@ class VolumeServer:
     def _http_status(self, req: Request) -> Response:
         hb = self.store.collect_heartbeat()
         return Response.json({"Version": "seaweedfs-tpu",
-                              "Volumes": [vars(v) for v in hb.volumes]})
+                              "Volumes": [vars(v) for v in hb.volumes],
+                              "NeedleCache": self.needle_cache.stats})
 
     def _parse_fid_path(self, path: str) -> FileId:
         # /3,01637037d6 (volume_server_handlers_read.go:43 parsing)
@@ -290,10 +302,23 @@ class VolumeServer:
     def _read_needle(self, fid: FileId, req: Request) -> Response:
         t0 = time.time()
         self.metrics.volume_requests.inc("read")
+        v = self.store.find_volume(fid.volume_id)
+        if v is not None:
+            # hot-needle LRU first (HTTP needs the full metadata, so
+            # data_only entries populated by the TCP path don't count)
+            ce = self.needle_cache.get(fid.volume_id, fid.key, fid.cookie,
+                                       need_metadata=True)
+            if ce is not None:
+                self.metrics.needle_cache_ops.inc("hit")
+                return self._serve_needle(
+                    req, ce.data, ce.etag, ce.name, ce.mime,
+                    ce.is_compressed, t0)
+            self.metrics.needle_cache_ops.inc("miss")
         try:
-            if self.store.has_volume(fid.volume_id):
-                n = self.store.read_volume_needle(fid.volume_id, fid.key,
-                                                  fid.cookie)
+            if v is not None:
+                # zero-copy: n.data stays a memoryview over the pread
+                # buffer all the way to the socket
+                n = v.read_needle(fid.key, fid.cookie, zero_copy=True)
             elif self.store.find_ec_volume(fid.volume_id) is not None:
                 self._ensure_ec_remote_reader(fid.volume_id)
                 n = self.store.read_ec_needle(fid.volume_id, fid.key,
@@ -304,13 +329,34 @@ class VolumeServer:
             return Response.error("not found", 404)
         except ec_pkg.EcNotFoundError:
             return Response.error("not found", 404)
-        headers = {"Etag": f'"{n.etag()}"'}
-        if n.has_name():
-            headers["X-File-Name"] = n.name.decode(errors="replace")
-        mime = (n.mime.decode(errors="replace")
-                if n.has_mime() else "application/octet-stream")
-        data = bytes(n.data)
-        if n.is_compressed():
+        if v is not None and not n.has_ttl() \
+                and self.needle_cache.admissible(len(n.data)) \
+                and getattr(n, "volume_offset", None) is not None:
+            from .needle_cache import CachedNeedle
+            self.needle_cache.put_guarded(
+                fid.volume_id, fid.key,
+                CachedNeedle(cookie=n.cookie, data=bytes(n.data),
+                             offset=n.volume_offset, etag=n.etag(),
+                             mime=bytes(n.mime), name=bytes(n.name),
+                             is_compressed=n.is_compressed(),
+                             data_only=False),
+                lambda: v.needle_offset(fid.key))
+        return self._serve_needle(req, n.data, n.etag(), n.name, n.mime,
+                                  n.is_compressed(), t0)
+
+    def _serve_needle(self, req: Request, data, etag: str, name: bytes,
+                      mime_b: bytes, compressed: bool, t0: float
+                      ) -> Response:
+        """Response assembly shared by the cache-hit and disk paths.
+        `data` may be bytes or a memoryview (zero-copy serving); the
+        negotiation/resize branches materialize bytes only when they
+        must transform the payload."""
+        headers = {"Etag": f'"{etag}"'}
+        if name:
+            headers["X-File-Name"] = bytes(name).decode(errors="replace")
+        mime = (bytes(mime_b).decode(errors="replace")
+                if mime_b else "application/octet-stream")
+        if compressed:
             # negotiate like volume_server_handlers_read.go:208-215:
             # gzip-accepting clients get the stored bytes verbatim (zero
             # recompute), everyone else gets them decompressed.  Resize
@@ -325,9 +371,9 @@ class VolumeServer:
                 # RFC 9110: distinct representations need distinct
                 # validators — If-None-Match does not key on encoding,
                 # so the gzip body must not share the identity ETag
-                headers["Etag"] = f'"{n.etag()}-gzip"'
+                headers["Etag"] = f'"{etag}-gzip"'
             else:
-                data = decompress(data)
+                data = decompress(bytes(data))
         else:
             resizing = bool(req.qs("width") or req.qs("height"))
         if resizing:
@@ -338,13 +384,14 @@ class VolumeServer:
         return Response(200, data, content_type=mime, headers=headers)
 
     def _redirect_or_404(self, fid: FileId) -> Response:
-        try:
-            client = POOL.client(self.master_grpc, "Seaweed")
-            out = client.call("LookupVolume",
-                              {"volume_or_file_ids": [str(fid.volume_id)]})
-            locs = out["volume_id_locations"][str(fid.volume_id)]["locations"]
-        except (RpcError, KeyError):
-            locs = []
+        # short TTL, positive AND negative: a burst of misses costs one
+        # master gRPC call per second instead of one per request, while
+        # a volume mid-move (vacuum swap, EC conversion) still gets a
+        # fresh answer within a second — an 11s-stale redirect target
+        # would bounce readers between dead locations for longer than
+        # any client retry window
+        locs = self._lookup_locations(fid.volume_id, negative_ok=True,
+                                      max_age=NEGATIVE_LOOKUP_TTL)
         locs = [l for l in locs if l["url"] != self.url]
         if not locs:
             return Response.error("volume not found", 404)
@@ -377,6 +424,8 @@ class VolumeServer:
             size = v.write_needle_durable(n).result(timeout=30)
         else:
             size = self.store.write_volume_needle(fid.volume_id, n)
+        # evict AFTER the store mutation landed (needle_cache coherence)
+        self.needle_cache.invalidate(fid.volume_id, fid.key)
         if req.qs("type") != "replicate":
             err = self._replicate(fid, req, "POST", req.body)
             if err:
@@ -394,6 +443,7 @@ class VolumeServer:
         if self.store.has_volume(fid.volume_id):
             size = self.store.delete_volume_needle(fid.volume_id, fid.key,
                                                    fid.cookie)
+            self.needle_cache.invalidate(fid.volume_id, fid.key)
         elif self.store.find_ec_volume(fid.volume_id) is not None:
             vol = self.store.find_ec_volume(fid.volume_id)
             # same cookie gate as the normal-volume path: read the needle
@@ -450,6 +500,7 @@ class VolumeServer:
             size = self.store.write_volume_needle(fid.volume_id, n)
         except NotFoundError:
             raise ValueError(f"volume {fid.volume_id} not local") from None
+        self.needle_cache.invalidate(fid.volume_id, fid.key)
         err = self._fan_out(
             fid,
             lambda: "type=replicate"
@@ -468,14 +519,35 @@ class VolumeServer:
         # hot path: plain volume read with no Request/Response wrapping —
         # 1KB reads are dispatch-bound, and the TCP frame protocol has no
         # use for headers/mime/resize anyway
-        if self.store.has_volume(fid.volume_id):
+        v = self.store.find_volume(fid.volume_id)
+        if v is not None:
             t0 = time.time()
             self.metrics.volume_requests.inc("read")
+            ce = self.needle_cache.get(fid.volume_id, fid.key, fid.cookie)
+            if ce is not None:
+                self.metrics.needle_cache_ops.inc("hit")
+                self.metrics.volume_latency.observe(
+                    "read", value=time.time() - t0)
+                return ce.data
+            self.metrics.needle_cache_ops.inc("miss")
+            offset = v.needle_offset(fid.key)
+            meta: dict = {}
             try:
-                data = self.store.read_volume_needle_data(
-                    fid.volume_id, fid.key, fid.cookie)
+                data = v.read_needle_data(fid.key, fid.cookie, meta=meta)
             except NotFoundError:
                 raise ValueError("not found") from None
+            if offset is not None and not meta.get("ttl") \
+                    and self.needle_cache.admissible(len(data)):
+                # data_only entry: the frame path never parses metadata;
+                # an HTTP read of the same needle repopulates with it.
+                # The offset guard keeps a populate racing an overwrite
+                # from installing stale bytes (needle_cache.py).
+                from .needle_cache import CachedNeedle
+                self.needle_cache.put_guarded(
+                    fid.volume_id, fid.key,
+                    CachedNeedle(cookie=fid.cookie, data=data,
+                                 offset=offset),
+                    lambda: v.needle_offset(fid.key))
             self.metrics.volume_latency.observe("read",
                                                 value=time.time() - t0)
             return data
@@ -484,8 +556,11 @@ class VolumeServer:
                       headers=CIDict(), body=b"")
         resp = self._read_needle(fid, req)  # EC / redirect cases
         if resp.status >= 300:
-            raise ValueError(resp.body.decode(errors="replace"))
-        return resp.body
+            raise ValueError(bytes(resp.body).decode(errors="replace"))
+        # the frame writers concat the payload into the reply: a
+        # zero-copy memoryview body (volume mounted mid-request) must
+        # materialize here
+        return bytes(resp.body)
 
     def tcp_delete(self, fid_str: str, jwt: str) -> dict:
         from ..util.http import CIDict
@@ -498,23 +573,37 @@ class VolumeServer:
             raise ValueError(resp.body.decode(errors="replace"))
         return json.loads(resp.body)
 
-    def _replica_locations(self, vid: int) -> list[dict]:
-        """Master lookup with the same staleness window as EC locations —
-        the write hot path must not pay a master round-trip per request
-        (the reference consults the cached vid map)."""
+    def _lookup_locations(self, vid: int, negative_ok: bool = False,
+                          max_age: float = EC_LOCATION_STALENESS
+                          ) -> list[dict]:
+        """Master LookupVolume behind a TTL cache.  `max_age` bounds how
+        stale a served entry may be (the redirect path passes the short
+        window); empty results are additionally capped at
+        NEGATIVE_LOOKUP_TTL and served ONLY to callers that opt in — the
+        write fan-out must re-ask rather than skip a replica because of
+        a momentarily stale miss."""
         now = time.time()
         cached = self._vol_locations.get(vid)
-        if cached and now - cached[0] < EC_LOCATION_STALENESS:
-            return cached[1]
+        if cached is not None:
+            ts, locs = cached
+            ttl = min(max_age,
+                      max_age if locs else NEGATIVE_LOOKUP_TTL)
+            if now - ts < ttl and (locs or negative_ok):
+                return locs
         try:
             client = POOL.client(self.master_grpc, "Seaweed")
             out = client.call("LookupVolume",
                               {"volume_or_file_ids": [str(vid)]})
             locs = out["volume_id_locations"][str(vid)]["locations"]
         except (RpcError, KeyError):
-            return []  # not registered yet (e.g. pre-heartbeat tests)
+            locs = []  # not registered yet (e.g. pre-heartbeat tests)
         self._vol_locations[vid] = (now, locs)
         return locs
+
+    def _replica_locations(self, vid: int) -> list[dict]:
+        """Write-path lookup: never trusts a cached negative — see
+        _lookup_locations."""
+        return self._lookup_locations(vid, negative_ok=False)
 
     def _replicate(self, fid: FileId, req: Request, method: str,
                    body: bytes | None) -> str:
@@ -767,6 +856,9 @@ class VolumeServer:
 
     def _rpc_volume_delete(self, req: dict) -> dict:
         self.store.delete_volume(int(req["volume_id"]))
+        # coarse but rare: a recreated vid must never serve the old
+        # volume's cached needles
+        self.needle_cache.clear()
         return {}
 
     def _find_volume(self, req: dict):
@@ -814,6 +906,8 @@ class VolumeServer:
     def _rpc_volume_unmount(self, req: dict) -> dict:
         for loc in self.store.locations:
             loc.unload_volume(int(req["volume_id"]))
+        # the .dat may be replaced while unmounted (volume copy/move)
+        self.needle_cache.clear()
         return {}
 
     def _rpc_server_leave(self, req: dict) -> dict:
@@ -877,6 +971,7 @@ class VolumeServer:
                 size = self.store.delete_volume_needle(
                     fid.volume_id, fid.key,
                     None if req.get("skip_cookie_check") else fid.cookie)
+                self.needle_cache.invalidate(fid.volume_id, fid.key)
                 results.append({"file_id": fid_s, "status": 202,
                                 "size": size})
             except Exception as e:

@@ -1,20 +1,29 @@
 """MasterClient — long-lived client with an in-process vid->locations cache
-fed by the master's KeepConnected stream.
+fed by the master's KeepConnected stream — and CachedFileReader, the
+shared client-side chunk read path (tiered chunk cache + TTL'd
+volume-location cache + raw-TCP fast path).
 
 Capability-equivalent to weed/wdclient/masterclient.go:84-182 + vid_map.go:
 a background thread holds the stream open, applies location deltas to the
 cache, and reconnects on error; lookups hit the cache first and fall back
-to a LookupVolume RPC.
+to a LookupVolume RPC.  Stream-fed entries are authoritative (deltas
+retire them); RPC-fallback entries carry a TTL so a moved volume cannot
+serve a stale location forever.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 
 from ..pb.rpc import POOL, RpcError
 from ..util.weedlog import logger
 
 LOG = logger(__name__)
+
+# RPC-fallback location entries expire after the freshest staleness tier
+# the volume servers use for their own lookups (store_ec.go:227)
+LOOKUP_TTL = 11.0
 
 
 def resolve_leader(masters: str, timeout: float = 2.0) -> str:
@@ -53,6 +62,9 @@ class MasterClient:
         self.client_name = client_name
         self.client_type = client_type
         self._vid_map: dict[int, list[dict]] = {}
+        # vid -> (expires, locations) for RPC-sourced fallbacks; kept
+        # apart from the stream-fed map, whose entries deltas retire
+        self._vid_rpc: dict[int, tuple[float, list[dict]]] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -108,8 +120,13 @@ class MasterClient:
                               self.master_grpc, e)
 
     def lookup(self, vid: int) -> list[dict]:
+        now = time.time()
         with self._lock:
             cached = self._vid_map.get(vid)
+            if not cached:
+                rpc = self._vid_rpc.get(vid)
+                if rpc and rpc[0] > now:
+                    cached = rpc[1]
         if cached:
             return list(cached)
         try:
@@ -121,9 +138,41 @@ class MasterClient:
             return []
         with self._lock:
             if locs:
-                self._vid_map[vid] = locs
+                # TTL'd, NOT permanent: the stream owns long-lived
+                # entries; a fallback answer must age out or a volume
+                # move strands every reader on the dead location
+                self._vid_rpc[vid] = (now + LOOKUP_TTL, locs)
         return locs
 
     def lookup_file_id(self, fid: str) -> list[str]:
         vid = int(fid.split(",")[0])
         return [f"http://{l['url']}/{fid}" for l in self.lookup(vid)]
+
+
+class CachedFileReader:
+    """The shared client-side chunk read path: a tiered chunk cache in
+    front of `operation.read_file` (which rides the TTL'd
+    volume-location cache and the raw-TCP fast path, so repeated reads
+    of a volume skip the master entirely).
+
+    Used by the filer read path and the FUSE mount.  fids are immutable
+    at this level — the filer never rewrites a chunk fid (rewrites mint
+    a fresh fid with a fresh cookie) — so entries age out by capacity
+    only, exactly like the reference's reader_at + chunk_cache pairing.
+    """
+
+    def __init__(self, cache=None):
+        """cache: a TieredChunkCache/MemChunkCache-shaped object (get/
+        put); None disables caching (reads pass straight through)."""
+        self.cache = cache
+
+    def read(self, master_grpc: str, fid: str) -> bytes:
+        if self.cache is not None:
+            blob = self.cache.get(fid)
+            if blob is not None:
+                return blob
+        from .. import operation
+        blob = operation.read_file(master_grpc, fid)
+        if self.cache is not None:
+            self.cache.put(fid, blob)
+        return blob

@@ -1,0 +1,237 @@
+"""Read-path coherence + hot-needle cache (volume_server/needle_cache.py,
+the lock-free storage read snapshot in storage/volume.py).
+
+The invariant under test: concurrent readers vs. overwrite / delete /
+compaction must NEVER observe stale cached bytes — a read returns some
+payload that was live during the read, the final read after a mutation
+settles returns the final payload, and a cookie rewrite makes the old
+fid unreadable (which is what makes staleness assertable)."""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import NotFoundError, Volume
+from seaweedfs_tpu.testing import SimCluster
+from seaweedfs_tpu.util.http import http_request
+from seaweedfs_tpu.volume_server.needle_cache import (CachedNeedle,
+                                                      HotNeedleCache)
+
+
+# -- unit: LRU / eviction / guarded admission -------------------------------
+
+def _entry(cookie, data, offset, **kw):
+    return CachedNeedle(cookie=cookie, data=data, offset=offset, **kw)
+
+
+def test_cache_hit_miss_and_cookie_gate():
+    c = HotNeedleCache(limit_bytes=1 << 20, item_limit=1 << 16)
+    assert c.get(1, 7, 0xAA) is None                    # cold miss
+    assert c.put_guarded(1, 7, _entry(0xAA, b"x" * 100, 64), lambda: 64)
+    got = c.get(1, 7, 0xAA)
+    assert got is not None and got.data == b"x" * 100
+    # wrong cookie is a miss (the disk path owns the precise error)
+    assert c.get(1, 7, 0xBB) is None
+    s = c.stats
+    assert s["hits"] == 1 and s["misses"] == 2
+
+
+def test_cache_byte_bound_eviction():
+    c = HotNeedleCache(limit_bytes=1000, item_limit=600)
+    c.put_guarded(1, 1, _entry(0, b"a" * 300, 8), lambda: 8)
+    c.put_guarded(1, 2, _entry(0, b"b" * 300, 16), lambda: 16)
+    assert c.get(1, 1, 0) is not None                   # 1 is now MRU
+    c.put_guarded(1, 3, _entry(0, b"c" * 300, 24), lambda: 24)
+    # inserting 3 must evict the LRU entry (2), never the budget
+    assert c.get(1, 2, 0) is None
+    assert c.get(1, 1, 0) is not None
+    assert c.get(1, 3, 0) is not None
+    # oversized entries are refused outright
+    assert not c.put_guarded(1, 4, _entry(0, b"d" * 700, 32), lambda: 32)
+    assert c.get(1, 4, 0) is None
+
+
+def test_cache_guarded_put_rejects_moved_needle():
+    c = HotNeedleCache(limit_bytes=1 << 20)
+    # live offset changed between read and populate -> refused
+    assert not c.put_guarded(1, 7, _entry(0, b"old", 64), lambda: 128)
+    assert c.get(1, 7, 0) is None
+    # offset changes right AFTER insertion -> self-evicts
+    offsets = iter([64, 128])
+    assert not c.put_guarded(1, 7, _entry(0, b"old", 64),
+                             lambda: next(offsets))
+    assert c.get(1, 7, 0) is None
+
+
+def test_cache_invalidate_and_data_only():
+    c = HotNeedleCache(limit_bytes=1 << 20)
+    c.put_guarded(1, 7, _entry(0xAA, b"blob", 64), lambda: 64)
+    c.invalidate(1, 7)
+    assert c.get(1, 7, 0xAA) is None
+    # data_only entries satisfy the TCP path but not the HTTP path
+    c.put_guarded(1, 8, _entry(0xAA, b"blob", 64, data_only=True),
+                  lambda: 64)
+    assert c.get(1, 8, 0xAA) is not None
+    assert c.get(1, 8, 0xAA, need_metadata=True) is None
+    full = _entry(0xAA, b"blob", 64, data_only=False, etag="ff",
+                  mime=b"text/plain")
+    c.put_guarded(1, 8, full, lambda: 64)
+    assert c.get(1, 8, 0xAA, need_metadata=True) is full
+
+
+def test_cache_disabled_by_zero_budget():
+    c = HotNeedleCache(limit_bytes=0)
+    assert not c.put_guarded(1, 1, _entry(0, b"x", 8), lambda: 8)
+    assert c.get(1, 1, 0) is None
+
+
+# -- cluster: coherence through the serving paths ---------------------------
+
+def _holding_server(cluster, vid):
+    for vs in cluster.volume_servers:
+        if vs is not None and vs.store.has_volume(vid):
+            return vs
+    raise AssertionError(f"no server holds volume {vid}")
+
+
+def test_reread_hits_cache_and_overwrite_invalidates(tmp_path):
+    with SimCluster(volume_servers=1, jwt_key="",
+                    base_dir=str(tmp_path)) as c:
+        r = operation.assign(c.master_grpc)
+        vid = int(r.fid.split(",")[0])
+        vs = _holding_server(c, vid)
+        operation.upload_data(r.url, r.fid, b"first-payload")
+        url = f"http://{r.url}/{r.fid}"
+        # first HTTP read populates, second must hit
+        assert http_request(url)[1] == b"first-payload"
+        hits0 = vs.needle_cache.hits
+        assert http_request(url)[1] == b"first-payload"
+        assert vs.needle_cache.hits > hits0
+        # TCP re-read also rides the cache
+        hits1 = vs.needle_cache.hits
+        assert operation.read_file(c.master_grpc, r.fid) \
+            == b"first-payload"
+        assert vs.needle_cache.hits > hits1
+        # overwrite the SAME fid: no reader may ever see the old bytes
+        # again, on either path
+        operation.upload_data(r.url, r.fid, b"second-payload!")
+        assert http_request(url)[1] == b"second-payload!"
+        assert operation.read_file(c.master_grpc, r.fid) \
+            == b"second-payload!"
+
+
+def test_delete_purges_cache(tmp_path):
+    with SimCluster(volume_servers=1, jwt_key="",
+                    base_dir=str(tmp_path)) as c:
+        r = operation.assign(c.master_grpc)
+        operation.upload_data(r.url, r.fid, b"soon-gone")
+        url = f"http://{r.url}/{r.fid}"
+        assert http_request(url)[1] == b"soon-gone"     # populate
+        assert http_request(url)[1] == b"soon-gone"     # hit
+        status, _, _ = http_request(url, method="DELETE")
+        assert status == 202
+        status, body, _ = http_request(url)
+        assert status == 404, body
+
+
+def test_cookie_rewrite_rejects_stale_fid(tmp_path):
+    """The assertable form of coherence: rewriting a key under a new
+    cookie must make the OLD fid unreadable — a cache serving the old
+    entry would answer it instead."""
+    with SimCluster(volume_servers=1, jwt_key="",
+                    base_dir=str(tmp_path)) as c:
+        r = operation.assign(c.master_grpc)
+        operation.upload_data(r.url, r.fid, b"cookie-one")
+        url = f"http://{r.url}/{r.fid}"
+        assert http_request(url)[1] == b"cookie-one"    # populate
+        assert http_request(url)[1] == b"cookie-one"    # hit
+        vid_key, cookie = r.fid[:-8], r.fid[-8:]
+        new_cookie = format((int(cookie, 16) + 1) & 0xFFFFFFFF, "08x")
+        new_fid = vid_key + new_cookie
+        operation.upload_data(r.url, new_fid, b"cookie-two")
+        # old fid: cookie mismatch, NOT the cached old payload
+        status, body, _ = http_request(url)
+        assert status != 200 and b"cookie-one" not in body
+        assert http_request(f"http://{r.url}/{new_fid}")[1] \
+            == b"cookie-two"
+
+
+def test_concurrent_readers_never_see_stale_bytes(tmp_path):
+    with SimCluster(volume_servers=1, jwt_key="",
+                    base_dir=str(tmp_path)) as c:
+        r = operation.assign(c.master_grpc)
+        payloads = [f"generation-{i:04d}".encode() * 8 for i in range(12)]
+        operation.upload_data(r.url, r.fid, payloads[0])
+        url = f"http://{r.url}/{r.fid}"
+        valid = set(payloads)
+        errors: list = []
+        stop = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                status, body, _ = http_request(url)
+                if status != 200 or body not in valid:
+                    errors.append((status, bytes(body[:40])))
+                    return
+
+        threads = [threading.Thread(target=reader) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for p in payloads[1:]:
+            operation.upload_data(r.url, r.fid, p)
+        time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        # after the dust settles every path serves the LAST generation
+        assert http_request(url)[1] == payloads[-1]
+        assert operation.read_file(c.master_grpc, r.fid) == payloads[-1]
+
+
+# -- storage engine: lock-free reads vs. vacuum -----------------------------
+
+def test_lockfree_reads_survive_concurrent_vacuum(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    for i in range(1, 201):
+        v.write_needle(Needle(cookie=0x11, id=i,
+                              data=f"needle-{i:03d}".encode() * 20))
+    for i in range(1, 201, 2):
+        v.delete_needle(i)
+    errors: list = []
+    stop = threading.Event()
+
+    def reader():
+        i = 2
+        while not stop.is_set():
+            want = f"needle-{i:03d}".encode() * 20
+            try:
+                got = bytes(v.read_needle(i).data)
+            except Exception as e:    # no error is acceptable mid-vacuum
+                errors.append((i, repr(e)))
+                return
+            if got != want:
+                errors.append((i, got[:30]))
+                return
+            i += 2
+            if i > 200:
+                i = 2
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    reclaimed = v.vacuum()
+    time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert reclaimed > 0
+    assert not errors, errors[:3]
+    # deleted needles stay deleted, survivors stay readable, post-vacuum
+    assert v.read_needle(2).data == b"needle-002" * 20
+    with pytest.raises(NotFoundError):
+        v.read_needle(3)
+    v.close()

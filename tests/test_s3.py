@@ -136,6 +136,50 @@ def test_object_put_get_delete(s3stack):
     assert xml_root(body).find("Code").text == "NoSuchKey"
 
 
+def test_unknown_subresources_return_501(s3stack):
+    """VERDICT r5 gap #1 hazard: `PUT /bucket/key?acl` used to fall
+    through to the plain object handler and OVERWRITE the object's data
+    with the ACL XML.  Unimplemented sub-resources must 501."""
+    *_, client = s3stack
+    client.request("PUT", "/sb")
+    data = b"precious object bytes"
+    status, _, _ = client.request("PUT", "/sb/key.bin", data)
+    assert status == 200
+    # object-level: PUT ?acl must NOT touch the data
+    status, body, _ = client.request(
+        "PUT", "/sb/key.bin", b"<AccessControlPolicy/>",
+        query={"acl": ""})
+    assert status == 501
+    assert xml_root(body).find("Code").text == "NotImplemented"
+    status, got, _ = client.request("GET", "/sb/key.bin")
+    assert status == 200 and got == data      # data survived
+    for sub in ("acl", "torrent", "restore", "versioning"):
+        status, body, _ = client.request("GET", "/sb/key.bin",
+                                         query={sub: ""})
+        assert status == 501, sub
+        assert xml_root(body).find("Code").text == "NotImplemented"
+    # bucket-level too
+    status, _, _ = client.request("PUT", "/sb", b"<Policy/>",
+                                  query={"policy": ""})
+    assert status == 501
+    # routing params are NOT sub-resources and still work
+    status, _, _ = client.request("GET", "/sb", query={"list-type": "2"})
+    assert status == 200
+
+
+def test_get_bucket_location(s3stack):
+    *_, client = s3stack
+    client.request("PUT", "/locb")
+    status, body, _ = client.request("GET", "/locb", query={"location": ""})
+    assert status == 200
+    assert xml_root(body).tag == "LocationConstraint"
+    # existence probe semantics: missing bucket -> 404 NoSuchBucket
+    status, body, _ = client.request("GET", "/nope",
+                                     query={"location": ""})
+    assert status == 404
+    assert xml_root(body).find("Code").text == "NoSuchBucket"
+
+
 def test_list_objects_v1_v2_delimiter(s3stack):
     *_, client = s3stack
     client.request("PUT", "/lb")

@@ -30,7 +30,8 @@ def _ids_lines(findings):
 
 def test_bad_locks_fixture():
     got = _ids_lines(_findings(os.path.join(FIXTURES, "bad_locks.py")))
-    assert got == [("WL001", 14), ("WL001", 19), ("WL002", 23)]
+    assert got == [("WL001", 14), ("WL001", 19), ("WL001", 44),
+                   ("WL002", 23)]
 
 
 def test_bad_jax_fixture():

@@ -37,3 +37,9 @@ def balanced_ok():
 def with_ok():
     with _lock:
         return dict(_state)
+
+
+def seek_under_lock(f):
+    with _lock:
+        f.seek(128)              # line 44: WL001 (shared-offset IO)
+        return f.read(16)

@@ -2,6 +2,7 @@
 must report zero findings here."""
 
 import json
+import os
 import struct
 import threading
 import time
@@ -21,6 +22,16 @@ def snapshot_then_sleep():
         snap = dict(_cache)
     time.sleep(0.01)
     return snap
+
+
+def snapshot_then_pread(volume):
+    # the storage engine's read idiom: grab a coherent (map, backend)
+    # ref, then do positioned IO — os.pread carries its own offset, so
+    # it is NOT seek-convoy blocking even inside a critical section
+    nm, fd = volume.read_ref
+    offset = nm.get(7)
+    with _lock:
+        return os.pread(fd, 16, offset)
 
 
 def paired_acquire():

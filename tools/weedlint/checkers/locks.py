@@ -10,6 +10,14 @@ WL002 lock-unbalanced-acquire — ``x.acquire()`` in a function with no
 matching ``x.release()`` anywhere in that function.  An exception
 between them deadlocks every later taker; use ``with x:`` or
 ``try/finally``.
+
+Positioned IO is NOT blocking-by-convoy: ``os.pread``/``os.pwrite``
+carry their own offset, never touch a shared file position, and return
+straight from the page cache on the hot path — the read-mostly
+snapshot idiom (grab a (map, backend) ref, pread outside any seek)
+depends on the checker knowing this.  ``seek`` on the other hand IS
+flagged: a shared-offset seek inside a lock is exactly the
+seek-convoy WL001 exists to catch.
 """
 
 from __future__ import annotations
@@ -29,13 +37,20 @@ _BLOCKING_EXACT = {
     "http_get", "http_post", "http_delete", "http_put",
 }
 _BLOCKING_PREFIX = ("subprocess.", "requests.")
-# attribute tails that block regardless of receiver (socket/conn objects)
+# attribute tails that block regardless of receiver (socket/conn objects;
+# `seek` = shared-file-position IO, the convoy/race WL001 exists for)
 _BLOCKING_ATTRS = {"recv", "sendall", "connect", "accept",
-                   "urlopen", "getresponse"}
+                   "urlopen", "getresponse", "seek"}
+# positioned (non-seeking) IO: per-call offset, no shared file position,
+# page-cache-speed on the hot path — explicitly NOT blocking, so the
+# storage engine's snapshot-read idiom stays green
+_POSITIONED_EXACT = {"os.pread", "os.pwrite", "os.preadv", "os.pwritev"}
 
 
 def _is_blocking_call(call: ast.Call) -> bool:
     name = dotted_name(call.func)
+    if name in _POSITIONED_EXACT:
+        return False
     if name in _BLOCKING_EXACT:
         return True
     if name.startswith(_BLOCKING_PREFIX):
