@@ -267,6 +267,111 @@ def bench_hotset_reread(concurrency: int, quick: bool = False,
         return out
 
 
+def bench_replicated_write(concurrency: int, quick: bool = False,
+                           n_files: int = 1000, runs: int = 3) -> dict:
+    """Replicated small-write throughput (ISSUE 5): replication 001
+    (same-rack copy) and 010 (cross-rack copy) through the leased-fid +
+    frame-fan-out write path, with the fan-out latency breakdown and the
+    assign-RPC-per-write ratio that the overhaul is supposed to move.
+
+    Also asserts the no-socket-churn property in numbers: the pooled
+    HTTP client's created-connection count and the per-replica fan-out
+    transport counts ride along, so a regression to
+    connection-per-request shows up as created ~ O(writes)."""
+    import threading
+
+    from seaweedfs_tpu import operation
+    from seaweedfs_tpu.testing import SimCluster
+    from seaweedfs_tpu.util.http import connection_pool
+
+    if quick:
+        n_files, runs = 200, 1
+    payload = b"r" * 1024
+    out: dict = {}
+    # 3 servers over 2 racks places BOTH policies: 001 needs two servers
+    # in one rack, 010 needs two racks (test_cluster fixture geometry)
+    with SimCluster(volume_servers=3, racks=2, max_volumes=60) as cluster:
+        master = next(m for m in cluster.masters
+                      if m is not None and m.is_leader)
+
+        def one_run(replication: str) -> tuple[float, dict]:
+            leaser = operation.FidLeaser(lease_size=50)
+            remaining = [n_files]
+            lock = threading.Lock()
+            failed = [0]
+
+            def writer():
+                while True:
+                    with lock:
+                        if remaining[0] <= 0:
+                            return
+                        remaining[0] -= 1
+                    try:
+                        r = leaser.assign(cluster.master_grpc,
+                                          replication=replication)
+                        operation.upload_to(r, r.fid, payload)
+                    except Exception:
+                        with lock:
+                            failed[0] += 1
+            assigns0 = master.metrics.master_assign.value()
+            threads = [threading.Thread(target=writer)
+                       for _ in range(concurrency)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            assigns = master.metrics.master_assign.value() - assigns0
+            ok = n_files - failed[0]
+            return ok / wall if wall else 0.0, {
+                "assign_rpcs": assigns,
+                "assign_rpcs_per_write": round(assigns / max(1, ok), 4),
+                "failed": failed[0]}
+
+        pool0 = dict(connection_pool().stats)
+        for replication, tag in (("001", "001"), ("010", "010")):
+            rates, assigns, ok_writes, failures = [], 0.0, 0, 0
+            for _ in range(runs):
+                rps, extras = one_run(replication)
+                rates.append(rps)
+                # accumulate over ALL runs: a lease anomaly or failure
+                # burst in run 1 must not be hidden by run N's numbers
+                assigns += extras["assign_rpcs"]
+                failures += extras["failed"]
+                ok_writes += n_files - extras["failed"]
+            out[f"replicated_write_{tag}_rps"], \
+                out[f"replicated_write_{tag}_rps_spread"] = spread(
+                    rates, digits=1)
+            out[f"replicated_write_{tag}_assign_rpcs_per_write"] = \
+                round(assigns / max(1, ok_writes), 4)
+            if failures:
+                out[f"replicated_write_{tag}_failed"] = failures
+        # fan-out breakdown across all volume servers: per-transport
+        # send counts and average per-replica latency
+        for transport in ("tcp", "http"):
+            n = sum(vs.metrics.replica_fanout_latency._totals.get(
+                        (transport,), 0)
+                    for vs in cluster.volume_servers if vs is not None)
+            s = sum(vs.metrics.replica_fanout_latency._sums.get(
+                        (transport,), 0.0)
+                    for vs in cluster.volume_servers if vs is not None)
+            ok_n = sum(vs.metrics.replica_fanout_ops.value(transport,
+                                                           "ok")
+                       for vs in cluster.volume_servers
+                       if vs is not None)
+            out[f"fanout_{transport}_sends"] = int(ok_n)
+            if n:
+                out[f"fanout_{transport}_avg_ms"] = round(s / n * 1e3, 3)
+        pool1 = connection_pool().stats
+        # O(pool size), not O(writes): the whole replicated bench must
+        # not open more upstream HTTP connections than the pool cap
+        out["http_pool_conns_created"] = \
+            pool1["created"] - pool0["created"]
+        out["http_pool_conns_reused"] = pool1["reused"] - pool0["reused"]
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -617,6 +722,11 @@ def main():
                     conc, quick=args.quick))
             except Exception as e:
                 smallfile["smallfile_hotset_error"] = str(e)[:200]
+            try:
+                smallfile.update(bench_replicated_write(
+                    conc, quick=args.quick))
+            except Exception as e:
+                smallfile["replicated_write_error"] = str(e)[:200]
         except Exception as e:   # never fail the headline metric
             smallfile = {"smallfile_error": str(e)[:200]}
     # end-to-end disk path (VERDICT r3 missing #1)

@@ -177,6 +177,10 @@ class FilerServer:
         self.http.tracer = self.tracer
         self.rpc.tracer = self.tracer
         self._del_queue: "queue.Queue[str]" = queue.Queue()
+        # fid leasing: one master Assign RPC hands out WEED_FID_LEASE
+        # fids consumed locally — the per-small-write cluster RPC the
+        # reference's batched assigns amortize (operation.FidLeaser)
+        self._fid_leaser = operation.FidLeaser()
         self._stop = threading.Event()
         # aggregate feed = local events + peer filers' events
         # (meta_aggregator.go); peers follow our LOCAL stream only, so
@@ -290,26 +294,49 @@ class FilerServer:
             return fn(self.master_grpc)
 
     # -- chunk IO ----------------------------------------------------------
+    def _assign_and_upload_chunk(self, data: bytes, replication: str,
+                                 collection: str, ttl: str,
+                                 compressed: bool = False
+                                 ) -> tuple[str, dict]:
+        """Leased assign + upload with one re-assign retry: an upload
+        rejected because the leased volume changed state under us
+        (marked readonly by ec.encode/vacuum, moved by growth) must
+        invalidate the lease and take a FRESH assignment — failing the
+        user write over a stale lease would make leasing a correctness
+        change instead of a perf one."""
+        r = self._with_master(lambda m: self._fid_leaser.assign(
+            m, replication=replication, collection=collection, ttl=ttl))
+        try:
+            out = _upload_chunk(r, data, ttl=ttl, compressed=compressed)
+        except (RuntimeError, OSError, ConnectionError) as e:
+            vid = int(r.fid.split(",", 1)[0])
+            self._fid_leaser.invalidate_volume(vid)
+            LOG.debug("leased upload of %s failed (%s); retrying with a "
+                      "fresh assign", r.fid, e)
+            r = self._with_master(lambda m: self._fid_leaser.assign(
+                m, replication=replication, collection=collection,
+                ttl=ttl))
+            out = _upload_chunk(r, data, ttl=ttl, compressed=compressed)
+        return r.fid, out
+
     def _save_chunk(self, data: bytes, ts_ns: int, offset: int,
                     path: str = "", mime: str = "") -> FileChunk:
         rule = self.conf.match(path) if path else {}
         ttl = rule.get("ttl", "")
-        r = self._with_master(lambda m: operation.assign(
-            m, replication=rule.get("replication") or self.replication,
-            collection=rule.get("collection") or self.collection,
-            ttl=ttl))
         logical_size = len(data)
         # each chunk encodes independently (util/compression.encode_chunk:
         # compress-then-seal + the record/needle flags)
         ext = os.path.splitext(path)[1] if path else ""
         data, key_b64, compressed, needle_flag = compression.encode_chunk(
             data, encrypt=self.encrypt_data, ext=ext, mime=mime)
-        # the needle must carry the ttl too — needle expiry on read
-        # (storage/volume.py) is what actually retires the data; the
-        # TCP frame cannot express ttl (or the compressed flag), so such
-        # chunks stay on HTTP
-        out = _upload_chunk(r, data, ttl=ttl, compressed=needle_flag)
-        return FileChunk(file_id=r.fid, offset=offset, size=logical_size,
+        # the needle carries the ttl and compressed flag on the frame
+        # path too (extended 'X' frame) — needle expiry on read
+        # (storage/volume.py) is what actually retires the data
+        fid, out = self._assign_and_upload_chunk(
+            data, rule.get("replication") or self.replication,
+            rule.get("collection") or self.collection, ttl,
+            compressed=needle_flag)
+        return FileChunk(file_id=fid, offset=offset, size=logical_size,
                          modified_ts_ns=ts_ns, etag=out.get("eTag", ""),
                          cipher_key=key_b64, is_compressed=compressed)
 
@@ -317,10 +344,9 @@ class FilerServer:
         """Manifest blobs carry the nested chunks' cipher keys, so an
         encrypting filer seals them exactly like data chunks."""
         data, key_b64 = cipher.seal(data, self.encrypt_data)
-        r = self._with_master(lambda m: operation.assign(
-            m, replication=self.replication, collection=self.collection))
-        out = _upload_chunk(r, data)
-        return r.fid, out.get("eTag", ""), key_b64
+        fid, out = self._assign_and_upload_chunk(
+            data, self.replication, self.collection, "")
+        return fid, out.get("eTag", ""), key_b64
 
     def _read_chunk_blob(self, fid: str) -> bytes:
         return self._with_master(

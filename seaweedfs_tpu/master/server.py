@@ -148,7 +148,8 @@ class MasterServer:
                     if self.is_leader:
                         try:
                             vacuum_mod.vacuum(self.topo,
-                                              self.garbage_threshold)
+                                              self.garbage_threshold,
+                                              tracer=self.tracer)
                         except Exception as e:
                             LOG.debug("auto-vacuum pass failed: %s", e)
             threading.Thread(target=vacuum_loop, daemon=True).start()
@@ -512,7 +513,8 @@ class MasterServer:
         from . import vacuum as vacuum_mod
         threshold = float(req.get("garbage_threshold")
                           or self.garbage_threshold)
-        return {"vacuumed": vacuum_mod.vacuum(self.topo, threshold)}
+        return {"vacuumed": vacuum_mod.vacuum(self.topo, threshold,
+                                              tracer=self.tracer)}
 
     def _rpc_lookup_volume(self, req: dict) -> dict:
         self._check_partition()
@@ -648,5 +650,6 @@ class MasterServer:
         from . import vacuum as vacuum_mod
         threshold = float(req.qs("garbageThreshold")
                           or self.garbage_threshold)
-        vids = vacuum_mod.vacuum(self.topo, threshold)
+        vids = vacuum_mod.vacuum(self.topo, threshold,
+                                 tracer=self.tracer)
         return Response.json({"vacuumed": vids})

@@ -2,12 +2,22 @@
 scan every layout's volumes; for each volume whose replicas all report a
 garbage ratio over the threshold, run compact on every replica, then verify
 and reinstate it as writable.
+
+Every per-volume pass runs under its own trace id: the check/compact/
+commit RPCs carry it as `x-trace-id` metadata, so the volume servers'
+span rings and the master's log tell ONE story when a vacuum races a
+reader/writer (the ROADMAP soak `SizeMismatchError` suspect) — the
+volume-side swap logging (storage/volume.py) stamps the same trace.
 """
 
 from __future__ import annotations
 
 from ..pb.rpc import POOL, RpcError
 from ..topology import Topology
+from ..util import tracing
+from ..util.weedlog import logger
+
+LOG = logger(__name__)
 
 
 def _vs_client(dn):
@@ -15,42 +25,61 @@ def _vs_client(dn):
 
 
 def vacuum_one_volume(topo: Topology, vid: int, locations,
-                      garbage_threshold: float) -> bool:
+                      garbage_threshold: float,
+                      tracer: "tracing.Tracer | None" = None) -> bool:
     """Check → compact → commit across all replicas
     (batchVacuumVolumeCheck/Compact/Commit)."""
-    # phase 1: all replicas must agree the volume is dirty enough
-    for dn in locations:
-        try:
-            out = _vs_client(dn).call("VacuumVolumeCheck",
-                                      {"volume_id": vid})
-        except RpcError:
-            return False
-        if out.get("garbage_ratio", 0) < garbage_threshold:
-            return False
-    # phase 2: freeze writes by marking unwritable in every layout
-    for layout in topo.layouts.values():
-        layout.freeze_writable(vid)
-    # phase 3: compact each replica; on any failure leave readonly=safe
-    compacted = True
-    for dn in locations:
-        try:
-            _vs_client(dn).call("VacuumVolumeCompact", {"volume_id": vid},
-                                timeout=600)
-        except RpcError:
-            compacted = False
-    # phase 4: commit/reinstate
-    for layout in topo.layouts.values():
-        layout.refresh_writable(vid)
-    return compacted
+    tid = tracing.current_trace_id() or tracing.new_trace_id()
+    with tracing.trace_scope(tid):
+        # phase 1: all replicas must agree the volume is dirty enough
+        for dn in locations:
+            try:
+                out = _vs_client(dn).call("VacuumVolumeCheck",
+                                          {"volume_id": vid})
+            except RpcError:
+                return False
+            if out.get("garbage_ratio", 0) < garbage_threshold:
+                return False
+        LOG.info("vacuum volume %d trace=%s replicas=%s starting", vid,
+                 tid, [dn.url for dn in locations])
+        import time as _time
+        t0 = _time.time()
+        # phase 2: freeze writes by marking unwritable in every layout
+        for layout in topo.layouts.values():
+            layout.freeze_writable(vid)
+        # phase 3: compact each replica; on any failure leave readonly=safe
+        compacted = True
+        for dn in locations:
+            try:
+                _vs_client(dn).call("VacuumVolumeCompact",
+                                    {"volume_id": vid}, timeout=600)
+            except RpcError as e:
+                # the failed replica's identity matters: ITS on-disk
+                # state now disagrees with its compacted siblings
+                LOG.warning("vacuum volume %d trace=%s compact FAILED "
+                            "on %s: %s", vid, tid, dn.url, e)
+                compacted = False
+        # phase 4: commit/reinstate
+        for layout in topo.layouts.values():
+            layout.refresh_writable(vid)
+        if tracer is not None:
+            tracer.record(f"vacuum volume {vid}", tid, t0,
+                          _time.time() - t0,
+                          status="ok" if compacted else "error")
+        LOG.info("vacuum volume %d trace=%s done ok=%s", vid, tid,
+                 compacted)
+        return compacted
 
 
-def vacuum(topo: Topology, garbage_threshold: float = 0.3) -> list[int]:
+def vacuum(topo: Topology, garbage_threshold: float = 0.3,
+           tracer: "tracing.Tracer | None" = None) -> list[int]:
     """Returns the vids vacuumed."""
     done = []
     for layout in list(topo.layouts.values()):
         for vid, locations in list(layout.vid_to_locations.items()):
             if not locations:
                 continue
-            if vacuum_one_volume(topo, vid, locations, garbage_threshold):
+            if vacuum_one_volume(topo, vid, locations, garbage_threshold,
+                                 tracer=tracer):
                 done.append(vid)
     return done

@@ -8,6 +8,7 @@ assign_and_upload (the `weed upload` flow).
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 
@@ -148,9 +149,21 @@ def _tcp_call(addr: str, op: str, fid: str, jwt: str = "",
     return payload
 
 
-def upload_data_tcp(tcp_addr: str, fid: str, data: bytes,
-                    jwt: str = "") -> dict:
-    reply = _tcp_call(tcp_addr, "W", fid, jwt, data)
+def upload_data_tcp(tcp_addr: str, fid: str, data, jwt: str = "",
+                    ttl: str = "", compressed: bool = False,
+                    replicate: bool = False) -> dict:
+    """Frame write.  Plain payloads use the original 'W' frame; any
+    extension (ttl, the compressed needle flag, the replicate marker)
+    upgrades to the 'X' frame whose body carries a 2-byte header + ttl
+    prefix (volume_server/tcp.py) — wire-compatible with old peers for
+    the common case."""
+    if ttl or compressed or replicate:
+        from ..volume_server.tcp import pack_ext_body
+        reply = _tcp_call(tcp_addr, "X", fid, jwt,
+                          pack_ext_body(data, replicate=replicate,
+                                        compressed=compressed, ttl=ttl))
+    else:
+        reply = _tcp_call(tcp_addr, "W", fid, jwt, data)
     # the write reply has ONE producer shape
     # ('{"name":"","size":N,"eTag":"H"}', volume_server/tcp.py _handle);
     # parse it with two finds instead of the JSON decoder — measurable
@@ -233,21 +246,31 @@ _TCP_DEAD: dict = {}
 _TCP_DEAD_TTL = 60.0
 
 
+def tcp_dead(addr: str) -> bool:
+    """Is this frame port negative-cached as unreachable?"""
+    return _TCP_DEAD.get(addr, 0) >= time.time()
+
+
+def mark_tcp_dead(addr: str) -> None:
+    _TCP_DEAD[addr] = time.time() + _TCP_DEAD_TTL
+
+
 def upload_to(r: AssignResult, fid: str, data: bytes,
               ttl: str = "", compressed: bool = False) -> dict:
     """Upload one blob against an assign result, picking the raw-TCP
     fast path when the server advertises one — THE fast-path selection
     logic, shared by every client (benchmark, upload CLI, filer chunk
-    writes, tests).  Falls back to HTTP when the frame cannot express
-    the request (ttl; the compressed needle flag) or the TCP port is
-    dead (negative-cached for .TCP_DEAD_TTL so one unreachable port
-    does not tax every upload with a connect timeout)."""
-    if r.tcp_url and not ttl and not compressed and \
-            _TCP_DEAD.get(r.tcp_url, 0) < time.time():
+    writes, tests).  The extended frame carries ttl and the compressed
+    needle flag, so those no longer force HTTP; the fallback remains for
+    dead TCP ports (negative-cached for .TCP_DEAD_TTL so one
+    unreachable port does not tax every upload with a connect
+    timeout)."""
+    if r.tcp_url and not tcp_dead(r.tcp_url):
         try:
-            return upload_data_tcp(r.tcp_url, fid, data, jwt=r.auth)
+            return upload_data_tcp(r.tcp_url, fid, data, jwt=r.auth,
+                                   ttl=ttl, compressed=compressed)
         except (OSError, ConnectionError):
-            _TCP_DEAD[r.tcp_url] = time.time() + _TCP_DEAD_TTL
+            mark_tcp_dead(r.tcp_url)
     return upload_data(r.url, fid, data, jwt=r.auth, ttl=ttl,
                        compressed=compressed)
 
@@ -258,6 +281,118 @@ def assign_and_upload(master_grpc: str, data: bytes,
     r = assign(master_grpc, **kw)
     upload_to(r, r.fid, data, compressed=compressed)
     return r.fid
+
+
+# -- fid leasing (reference operation/assign.go count semantics) ------------
+
+def _lease_size_default() -> int:
+    try:
+        return max(1, int(os.environ.get("WEED_FID_LEASE", "16")))
+    except ValueError:
+        return 16
+
+
+# lease TTL must sit well under the master's write-JWT expiry (10s
+# default): a leased fid is only useful while its range token verifies
+FID_LEASE_TTL = 5.0
+
+
+class _Lease:
+    __slots__ = ("r", "fids", "expires")
+
+    def __init__(self, r: AssignResult, fids: list[str], expires: float):
+        self.r = r
+        self.fids = fids
+        self.expires = expires
+
+
+class FidLeaser:
+    """Amortize master Assign RPCs on the small-write path: one count=N
+    assign returns a lease of N consecutive fids (the master reserves
+    the key range and scopes the write JWT to it) consumed locally —
+    one cluster RPC per N writes instead of per write.
+
+    Leases are keyed by placement (replication/collection/ttl/dc), age
+    out on FID_LEASE_TTL (under the JWT expiry), and are invalidated on
+    volume state change: callers report upload failures via
+    `invalidate_volume` (a volume marked readonly / grown away from
+    rejects the write), after which the next assign re-asks the master.
+    Thread-safe; `stats` counts assign RPCs vs locally-served fids so
+    benchmarks can assert assign_rpcs <= writes / lease_size."""
+
+    def __init__(self, lease_size: "int | None" = None,
+                 ttl_seconds: float = FID_LEASE_TTL):
+        self.lease_size = (_lease_size_default() if lease_size is None
+                           else max(1, lease_size))
+        self.ttl_seconds = ttl_seconds
+        self._leases: dict[tuple, _Lease] = {}
+        self._lock = _threading.Lock()
+        # single-flight refills: without this, N workers hitting an
+        # empty lease together issue N count=lease_size assigns — the
+        # amortization collapses to ~writes/concurrency under load
+        self._refill_locks: dict[tuple, _threading.Lock] = {}
+        self.stats = {"assign_rpcs": 0, "leased": 0}
+
+    def _pop(self, key: tuple) -> "AssignResult | None":
+        with self._lock:
+            lease = self._leases.get(key)
+            if lease is None:
+                return None
+            if not lease.fids or time.time() >= lease.expires:
+                del self._leases[key]
+                return None
+            fid = lease.fids.pop(0)
+            self.stats["leased"] += 1
+            r = lease.r
+            return AssignResult(fid=fid, url=r.url,
+                                public_url=r.public_url, count=1,
+                                replicas=r.replicas, auth=r.auth,
+                                tcp_url=r.tcp_url)
+
+    def assign(self, master_grpc: str, replication: str = "",
+               collection: str = "", ttl: str = "",
+               data_center: str = "") -> AssignResult:
+        if self.lease_size <= 1:
+            return assign(master_grpc, replication=replication,
+                          collection=collection, ttl=ttl,
+                          data_center=data_center)
+        key = (master_grpc, replication, collection, ttl, data_center)
+        out = self._pop(key)
+        if out is not None:
+            return out
+        with self._lock:
+            refill = self._refill_locks.setdefault(key,
+                                                   _threading.Lock())
+        with refill:
+            # another worker may have refilled while we queued here
+            out = self._pop(key)
+            if out is not None:
+                return out
+            r = assign(master_grpc, count=self.lease_size,
+                       replication=replication, collection=collection,
+                       ttl=ttl, data_center=data_center)
+            self.stats["assign_rpcs"] += 1
+            fids = derive_fids(r)
+            with self._lock:
+                self._leases[key] = _Lease(
+                    r, fids[1:], time.time() + self.ttl_seconds)
+        return AssignResult(fid=fids[0], url=r.url,
+                            public_url=r.public_url, count=1,
+                            replicas=r.replicas, auth=r.auth,
+                            tcp_url=r.tcp_url)
+
+    def invalidate_volume(self, vid: int) -> None:
+        """Drop every lease pointing at `vid` (upload failed: readonly
+        mark, volume moved, server gone) — the next assign re-asks."""
+        with self._lock:
+            self._leases = {
+                k: lease for k, lease in self._leases.items()
+                if not lease.fids
+                or int(lease.fids[0].split(",", 1)[0]) != vid}
+
+    def invalidate_all(self) -> None:
+        with self._lock:
+            self._leases.clear()
 
 
 # vid -> (expires, locations): the client-side vid cache every reader

@@ -15,8 +15,11 @@ import time
 from ..pb.rpc import RpcError
 from ..storage.ec.layout import TOTAL_SHARDS_COUNT
 from ..storage.ec.shard_bits import ShardBits
+from ..util.weedlog import logger
 from .commands import (CommandEnv, ShellError, command, iter_data_nodes,
                        node_grpc, parse_flags)
+
+LOG = logger(__name__)
 
 
 # -- planning (pure) -------------------------------------------------------
@@ -123,7 +126,35 @@ def do_ec_encode(env: CommandEnv, vid: int, collection: str = "",
 
     `kind` selects the code family beyond the reference's fixed RS:
     "clay" (MSR, 1/q repair IO) or "lrc" (local groups; `lrc_locals`
-    local parities within parity_shards) — see storage/ec/codes.py."""
+    local parities within parity_shards) — see storage/ec/codes.py.
+
+    The whole flow runs under ONE trace id (minted here, propagated as
+    x-trace-id metadata on every RPC): the freeze → generate → spread →
+    delete sequence swaps live volume state on several servers, and a
+    failure part-way through is a prime suspect for the soak
+    SizeMismatchError — the id ties this orchestration to the
+    volume-side swap logs."""
+    from ..util import tracing
+    tid = tracing.current_trace_id() or tracing.new_trace_id()
+    with tracing.trace_scope(tid):
+        try:
+            return _do_ec_encode_traced(env, vid, tid, collection,
+                                        data_shards, parity_shards,
+                                        kind, lrc_locals)
+        except Exception as e:
+            # the failure path IS the interesting path: replicas may be
+            # frozen readonly with shards half-spread — name the trace
+            # so an operator (and the soak test's logs) can walk it
+            LOG.warning("ec.encode volume %d trace=%s FAILED mid-flow: "
+                        "%s (replicas may be readonly with partial "
+                        "shards)", vid, tid, e)
+            raise
+
+
+def _do_ec_encode_traced(env: CommandEnv, vid: int, tid: str,
+                         collection: str, data_shards: int,
+                         parity_shards: int, kind: str,
+                         lrc_locals: int) -> dict:
     topo = env.topology()
     locations = _volume_locations(env, vid)
     if not locations:

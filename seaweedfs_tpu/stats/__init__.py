@@ -174,6 +174,16 @@ class ServerMetrics:
         self.needle_cache_bytes = r.gauge(
             "seaweedfs_volume_needle_cache_bytes",
             "bytes held by the hot-needle cache")
+        # write-replication fan-out: per-replica send latency and
+        # outcome, split by transport (frame fast path vs pooled HTTP)
+        # — the bench's fan-out breakdown and the no-socket-churn
+        # acceptance check read these
+        self.replica_fanout_ops = r.counter(
+            "seaweedfs_volume_replica_fanout_total",
+            "replica fan-out sends", ["transport", "result"])
+        self.replica_fanout_latency = r.histogram(
+            "seaweedfs_volume_replica_fanout_seconds",
+            "per-replica fan-out send latency", ["transport"])
         # repair-IO accounting per rebuild plan (rs-full / clay-plane /
         # clay-decode / lrc-local / lrc-global): makes the clay/LRC
         # reduced-read advantage observable in production, not just in

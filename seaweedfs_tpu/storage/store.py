@@ -331,10 +331,15 @@ class Store:
         max_key = 0
         for loc in self.locations:
             hb.max_volume_count += loc.max_volume_count
-            for v in loc.volumes.values():
+            # snapshot copies: the heartbeat thread walks these maps
+            # while AllocateVolume / ec-mount RPCs mutate them — a
+            # mid-walk resize kills the whole heartbeat stream and the
+            # master unregisters this server (write fan-out then sees a
+            # one-replica location list)
+            for v in list(loc.volumes.values()):
                 hb.volumes.append(v.info())
                 max_key = max(max_key, v.max_file_key())
-            for vid, ecv in loc.ec_volumes.items():
+            for vid, ecv in list(loc.ec_volumes.items()):
                 hb.ec_shards.append({
                     "id": vid,
                     "collection": ecv.collection,

@@ -462,6 +462,15 @@ class Volume:
         self._stop_write_worker()
         with self._lock:
             before = self.content_size()
+            # swap-point forensics (ROADMAP soak SizeMismatchError): the
+            # (map size, dat size) pair BEFORE and AFTER the swap, tagged
+            # with the orchestrator's trace id, is what lets a torn
+            # map/backend state be attributed to a specific vacuum pass
+            from ..util import tracing as _tracing
+            tid = _tracing.current_trace_id() or "-"
+            LOG.info("vacuum volume %d trace=%s swap-in: map=%d needles "
+                     "dat=%d bytes", self.id, tid, self.nm.file_count(),
+                     before)
             base = self.base_path
             cpd, cpx = base + ".cpd", base + ".cpx"
             new_sb = SuperBlock(
@@ -497,6 +506,9 @@ class Volume:
             # ONE atomic swap: lock-free readers pick up the fresh pair
             # together (never old map + new backend)
             self._read_ref = (self.nm, self.data_backend)
+            LOG.info("vacuum volume %d trace=%s swap-out: map=%d "
+                     "needles dat=%d bytes", self.id, tid,
+                     self.nm.file_count(), self.content_size())
             return before - self.content_size()
 
     # -- lifecycle ---------------------------------------------------------

@@ -26,6 +26,7 @@ import os
 import threading
 import time
 import urllib.parse
+from concurrent.futures import ThreadPoolExecutor
 
 from ..pb.rpc import POOL, RpcError, RpcServer, from_b64, to_b64
 from ..storage import ec as ec_pkg
@@ -126,6 +127,19 @@ class VolumeServer:
         self._public_url = public_url
         from .tcp import TcpDataServer
         self.tcp = TcpDataServer(self, host=host)
+        # persistent replica fan-out pool: the previous design spawned
+        # one thread PER WRITE PER REPLICA — thread creation cost on
+        # every replicated write, and each thread's fresh TCP connection
+        # churned a socket per request.  Executor workers persist, so
+        # their per-thread frame connections (operation._tcp_sock) and
+        # the shared HTTP pool stay warm across writes.
+        try:
+            workers = max(2, int(os.environ.get("WEED_FANOUT_WORKERS",
+                                                "8")))
+        except ValueError:
+            workers = 8
+        self._fanout = ThreadPoolExecutor(max_workers=workers,
+                                          thread_name_prefix="vs-fanout")
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -144,6 +158,7 @@ class VolumeServer:
         self.http.stop()
         self.rpc.stop()
         self.tcp.stop()
+        self._fanout.shutdown(wait=False)
         self.store.close()
 
     @property
@@ -466,17 +481,20 @@ class VolumeServer:
         return Response.json({"size": size}, status=202)
 
     # -- raw-TCP data fast path (volume_server/tcp.py frames) --------------
-    def tcp_write(self, fid_str: str, body: bytes,
-                  jwt: str) -> tuple[int, str]:
+    def tcp_write(self, fid_str: str, body, jwt: str,
+                  replicate: bool = False, compressed: bool = False,
+                  ttl: str = "") -> tuple[int, str]:
         """The HTTP write handler's semantics — jwt gate, replication
-        fan-out — minus what a TCP frame cannot express (name/mime/ttl/
-        fsync params; durable group-commit writes stay HTTP-only).
-        Skipping the Request/Response wrapping and its twelve per-op
-        query-string parses halved the server-side cost on 1KB writes
-        (BENCH_NOTES.md).  -> (size, etag); every avoidable per-op
-        allocation matters here: the jwt check reuses the parsed needle
-        key, and the replication query string is built only when
-        replicas actually exist."""
+        fan-out — minus what a frame cannot express (name/mime/fsync
+        params; durable group-commit writes stay HTTP-only).  The
+        extended frame ('X') carries replicate/compressed/ttl, so
+        replication fan-out and filer ttl'd or pre-gzipped chunk
+        uploads ride frames too.  Skipping the Request/Response
+        wrapping and its twelve per-op query-string parses halved the
+        server-side cost on 1KB writes (BENCH_NOTES.md).
+        -> (size, etag); every avoidable per-op allocation matters
+        here: the jwt check reuses the parsed needle key, and the
+        fan-out work is built only when replicas actually exist."""
         t0 = time.time()
         fid = FileId.parse(fid_str)
         if self.jwt_signing_key:
@@ -496,19 +514,27 @@ class VolumeServer:
                 except JwtError as e:
                     raise ValueError(f"jwt: {e}") from None
         n = Needle(id=fid.key, cookie=fid.cookie, data=body)
+        if ttl:
+            n.set_ttl(TTL.parse(ttl))
+        if compressed:
+            n.set_is_compressed()
         try:
             size = self.store.write_volume_needle(fid.volume_id, n)
         except NotFoundError:
             raise ValueError(f"volume {fid.volume_id} not local") from None
         self.needle_cache.invalidate(fid.volume_id, fid.key)
-        err = self._fan_out(
-            fid,
-            lambda: "type=replicate"
-            + (f"&jwt={urllib.parse.quote(jwt, safe='')}" if jwt
-               else ""),
-            "POST", body)
-        if err:
-            raise ValueError(f"replication failed: {err}")
+        if not replicate:
+            err = self._fan_out(
+                fid, "POST", body,
+                lambda: "type=replicate"
+                + (f"&jwt={urllib.parse.quote(jwt, safe='')}" if jwt
+                   else "")
+                + (f"&ttl={urllib.parse.quote(ttl, safe='')}" if ttl
+                   else "")
+                + ("&compressed=1" if compressed else ""),
+                jwt=jwt, ttl=ttl, compressed=compressed, tcp_ok=True)
+            if err:
+                raise ValueError(f"replication failed: {err}")
         self.metrics.volume_requests.inc("write")
         self.metrics.volume_latency.observe("write",
                                             value=time.time() - t0)
@@ -613,17 +639,28 @@ class VolumeServer:
         for arg in ("name", "mime", "ttl", "jwt"):
             if req.qs(arg):
                 qs += f"&{arg}={urllib.parse.quote(req.qs(arg), safe='')}"
-        if req.headers.get("Content-Encoding", "").lower() == "gzip" \
-                or req.qs("compressed"):
+        compressed = req.headers.get("Content-Encoding",
+                                     "").lower() == "gzip" \
+            or bool(req.qs("compressed"))
+        if compressed:
             qs += "&compressed=1"  # replicas must keep the needle flag
+        jwt = req.qs("jwt")
         auth = req.headers.get("Authorization", "")
-        if "jwt=" not in qs and auth[:7] in ("BEARER ", "Bearer "):
-            qs += f"&jwt={urllib.parse.quote(auth[7:], safe='')}"
-        return self._fan_out(fid, qs, method, body)
+        if not jwt and auth[:7] in ("BEARER ", "Bearer "):
+            jwt = auth[7:]
+            qs += f"&jwt={urllib.parse.quote(jwt, safe='')}"
+        # name/mime have no frame slot: such writes replicate over HTTP
+        tcp_ok = method == "POST" and not req.qs("name") \
+            and not req.qs("mime")
+        return self._fan_out(fid, method, body, qs, jwt=jwt,
+                             ttl=req.qs("ttl"), compressed=compressed,
+                             tcp_ok=tcp_ok)
 
-    def _fan_out(self, fid: FileId, qs, method: str,
-                 body: bytes | None) -> str:
-        """The shared replica fan-out (HTTP and TCP write paths).
+    def _fan_out(self, fid: FileId, method: str, body, qs,
+                 jwt: str = "", ttl: str = "", compressed: bool = False,
+                 tcp_ok: bool = False) -> str:
+        """The shared replica fan-out (HTTP and TCP write paths), run on
+        the persistent executor — no thread construction per write.
         Transport errors count as replication failures — a DOWN replica
         must fail the write loudly, never silently skip it.  `qs` may be
         a zero-arg callable so hot callers defer the query-string build
@@ -633,27 +670,71 @@ class VolumeServer:
         if not locs:
             return ""
         if callable(qs):
-            qs = qs()
-        errors: list[str] = []
-        threads = []
+            # stay lazy until a send actually takes the HTTP branch (the
+            # frame fast path never needs the query string) — memoized
+            # so multi-replica HTTP fan-out builds it once; a racing
+            # duplicate build is harmless (pure string work)
+            build, cache = qs, []
 
-        def send(url):
-            try:
-                status, _, _ = http_request(
-                    f"http://{url}/{fid}?{qs}", method=method, body=body)
-            except (OSError, ConnectionError) as e:
-                errors.append(f"{url}: {e}")
-                return
-            if status >= 300:
-                errors.append(f"{url}: HTTP {status}")
-
-        for loc in locs:
-            t = threading.Thread(target=send, args=(loc["url"],))
-            t.start()
-            threads.append(t)
-        for t in threads:
-            t.join()
+            def qs_lazy():
+                if not cache:
+                    cache.append(build())
+                return cache[0]
+            qs = qs_lazy
+        if len(locs) == 1:
+            # one replica: send inline — a queue hop + future wait buys
+            # nothing when there is no parallelism to gain
+            err = self._send_replica(locs[0], fid, method, body, qs,
+                                     jwt, ttl, compressed, tcp_ok)
+            return err or ""
+        futs = [self._fanout.submit(self._send_replica, loc, fid, method,
+                                    body, qs, jwt, ttl, compressed,
+                                    tcp_ok)
+                for loc in locs]
+        errors = [e for e in (f.result() for f in futs) if e]
         return "; ".join(errors)
+
+    def _send_replica(self, loc: dict, fid: FileId, method: str, body,
+                      qs, jwt: str, ttl: str, compressed: bool,
+                      tcp_ok: bool) -> "str | None":
+        """One replica send: frame fast path when the replica advertises
+        a TCP port (the replicate flag stops it fanning out again), HTTP
+        through the shared pool otherwise.  A dead TCP port falls back
+        to HTTP (and is negative-cached); a server-side rejection is
+        real and fails the write."""
+        t0 = time.time()
+        from .. import operation
+        tcp = loc.get("tcp_url", "")
+        if tcp_ok and tcp and not operation.tcp_dead(tcp):
+            try:
+                operation.upload_data_tcp(tcp, str(fid), body, jwt=jwt,
+                                          replicate=True, ttl=ttl,
+                                          compressed=compressed)
+                self.metrics.replica_fanout_ops.inc("tcp", "ok")
+                self.metrics.replica_fanout_latency.observe(
+                    "tcp", value=time.time() - t0)
+                return None
+            except (OSError, ConnectionError):
+                operation.mark_tcp_dead(tcp)   # fall through to HTTP
+            except RuntimeError as e:
+                self.metrics.replica_fanout_ops.inc("tcp", "error")
+                return f"{loc['url']}: {e}"
+        if callable(qs):
+            qs = qs()   # HTTP branch: the query string is finally needed
+        try:
+            status, _, _ = http_request(
+                f"http://{loc['url']}/{fid}?{qs}", method=method,
+                body=body)
+        except (OSError, ConnectionError) as e:
+            self.metrics.replica_fanout_ops.inc("http", "error")
+            return f"{loc['url']}: {e}"
+        if status >= 300:
+            self.metrics.replica_fanout_ops.inc("http", "error")
+            return f"{loc['url']}: HTTP {status}"
+        self.metrics.replica_fanout_ops.inc("http", "ok")
+        self.metrics.replica_fanout_latency.observe(
+            "http", value=time.time() - t0)
+        return None
 
     # -- EC remote shard plumbing -----------------------------------------
     def _ec_shard_locations(self, vid: int) -> dict[int, list[str]]:
@@ -1061,7 +1142,13 @@ class VolumeServer:
         if v is None:
             raise RpcError(f"volume {vid} not found")
         v.sync()
-        LOG.info("ec encode volume %d (%d bytes) starting", vid,
+        # swap-point forensics: record the (map size, dat size) pair
+        # this encode froze, under the orchestrator's trace id — if the
+        # soak's SizeMismatchError needle maps to this window, the
+        # ec.encode flow is the culprit (ROADMAP open item)
+        LOG.info("ec encode volume %d trace=%s starting: map=%d needles "
+                 "dat=%d bytes", vid,
+                 tracing.current_trace_id() or "-", v.nm.file_count(),
                  v.content_size())
         geo = DEFAULT_GEOMETRY
         if req.get("data_shards") or req.get("code_kind"):
@@ -1165,6 +1252,14 @@ class VolumeServer:
         self.store.unmount_ec_shards(vid, list(range(total)))
         for loc in self.store.locations:
             loc.load_existing_volumes()
+        v = self.store.find_volume(vid)
+        if v is not None:
+            # the decode just swapped a live volume into place: log the
+            # (map size, dat size) pair it came up with (soak forensics)
+            LOG.info("ec decode volume %d trace=%s mounted: map=%d "
+                     "needles dat=%d bytes", vid,
+                     tracing.current_trace_id() or "-",
+                     v.nm.file_count(), v.content_size())
         return {}
 
     def _rpc_ec_geometry(self, req: dict) -> dict:

@@ -9,10 +9,18 @@ email-parser headers), which dominates 1KB blob IO; the TCP frame path
 is a single recv/send pair per op.
 
 Frame (client -> server), little-endian:
-    op:u8 ('W' write | 'R' read | 'D' delete)
+    op:u8 ('W' write | 'X' extended write | 'R' read | 'D' delete)
     fid_len:u16, fid bytes
     jwt_len:u16, jwt bytes
     body_len:u32, body bytes            (writes; 0 otherwise)
+
+The extended write ('X') keeps this exact layout — the generic parsers
+(Python and native C) stay oblivious — and carries its extensions as a
+prefix INSIDE the body slot:
+    flags:u8 (1 = replicate: do not fan out; 2 = compressed: set the
+              needle's gzip flag), ttl_len:u8, ttl bytes, payload...
+This is what lets replication fan-out and filer ttl'd/compressed chunk
+uploads ride the frame path instead of falling back to HTTP.
 Reply (server -> client):
     status:u8 (0 ok, 1 error)
     payload_len:u32, payload bytes      (R: needle data; W/D: json ack;
@@ -42,6 +50,37 @@ _HDR = struct.Struct("<BH")
 # runs in the handler, after the body is read).  The filer write path
 # autochunks at 8MB; 64MB leaves ample headroom for direct blob writes.
 MAX_FRAME_BODY = 64 << 20
+
+
+# extended-write body-prefix flags
+XFLAG_REPLICATE = 1     # this IS a replica copy: do not fan out again
+XFLAG_COMPRESSED = 2    # payload is pre-gzipped: set the needle flag
+
+_EXT_HDR = struct.Struct("<BB")  # flags, ttl_len
+
+
+def pack_ext_body(payload: bytes, replicate: bool = False,
+                  compressed: bool = False, ttl: str = "") -> bytes:
+    """Prefix `payload` with the extended-write header ('X' frames)."""
+    flags = (XFLAG_REPLICATE if replicate else 0) \
+        | (XFLAG_COMPRESSED if compressed else 0)
+    ttl_b = ttl.encode()
+    # join, not +: payload may be a memoryview (replica fan-out forwards
+    # the received frame's body without copying it first)
+    return b"".join((_EXT_HDR.pack(flags, len(ttl_b)), ttl_b, payload))
+
+
+def unpack_ext_body(body: bytes) -> tuple[bool, bool, str, bytes]:
+    """-> (replicate, compressed, ttl, payload).  The payload is
+    materialized as bytes: the needle CRC path hands it to a ctypes
+    c_char_p, which only accepts bytes (the strip copy is 2+ttl bytes
+    of overhead on a payload the HTTP path would copy anyway)."""
+    if len(body) < 2:
+        raise ValueError("extended write frame too short")
+    flags, ttl_len = _EXT_HDR.unpack_from(body)
+    ttl = bytes(body[2:2 + ttl_len]).decode()
+    return (bool(flags & XFLAG_REPLICATE), bool(flags & XFLAG_COMPRESSED),
+            ttl, bytes(body[2 + ttl_len:]))
 
 
 class FrameTooLarge(ValueError):
@@ -244,6 +283,13 @@ class TcpDataServer:
             # this fixed shape (size is an int, etag is hex — nothing
             # needs escaping), at a third of the encoder's cost on the
             # 1KB-write hot path
+            return b'{"name":"","size":%d,"eTag":"%s"}' \
+                % (size, etag.encode())
+        if op == "X":
+            replicate, compressed, ttl, payload = unpack_ext_body(body)
+            size, etag = self.vs.tcp_write(fid, payload, jwt,
+                                           replicate=replicate,
+                                           compressed=compressed, ttl=ttl)
             return b'{"name":"","size":%d,"eTag":"%s"}' \
                 % (size, etag.encode())
         if op == "R":

@@ -135,12 +135,16 @@ def test_upload_to_dead_tcp_port_negative_cache(cluster, monkeypatch):
     r2.tcp_url = r.tcp_url
     operation.upload_to(r2, r2.fid, b"second")           # cached: no retry
     assert len(attempts) == 1
-    # ttl'd uploads never try TCP (the frame cannot express ttl)
+    # ttl'd uploads ride the extended frame now: after the negative
+    # cache clears, TCP is tried once more, fails, and HTTP still
+    # carries the ttl through
     r3 = operation.assign(cluster.master_grpc, ttl="1m")
     r3.tcp_url = r.tcp_url
     operation._TCP_DEAD.clear()
-    operation.upload_to(r3, r3.fid, b"third", ttl="1m")
-    assert len(attempts) == 1     # TCP never tried for ttl'd uploads
+    out3 = operation.upload_to(r3, r3.fid, b"third", ttl="1m")
+    assert out3.get("size") == len(b"third")
+    assert len(attempts) == 2     # one fresh TCP attempt, then fallback
+    assert operation._TCP_DEAD[r.tcp_url] > _time.time()
     blocker.close()
 
 

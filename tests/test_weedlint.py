@@ -56,6 +56,11 @@ def test_bad_resource_fixture():
     assert got == [("WL040", 8), ("WL040", 13), ("WL040", 17)]
 
 
+def test_bad_dataplane_fixture():
+    got = _ids_lines(_findings(os.path.join(FIXTURES, "bad_dataplane.py")))
+    assert got == [("WL050", 7), ("WL050", 9), ("WL050", 16)]
+
+
 def test_good_fixture_is_clean():
     assert _findings(os.path.join(FIXTURES, "good.py")) == []
 

@@ -1,0 +1,290 @@
+"""Write-path overhaul matrix (ISSUE 5): shared bounded HTTP pool
+(stale-socket retry, exhaustion blocking vs overflow), executor fan-out
+failing loudly on a DOWN replica, extended-frame writes, and fid-lease
+amortization/invalidation."""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.testing import SimCluster
+from seaweedfs_tpu.util.http import (ConnectionPool, HttpServer, Response,
+                                     http_request, reset_connection_pool)
+
+
+@pytest.fixture()
+def fresh_pool():
+    """Isolate each test's pool stats; restore a default pool after."""
+    pool = reset_connection_pool()
+    yield pool
+    reset_connection_pool()
+
+
+# -- pool correctness -------------------------------------------------------
+
+def test_pool_bounded_and_reused(fresh_pool):
+    srv = HttpServer()
+    srv.route("GET", "/ok", lambda req: Response(200, b"ok"))
+    srv.start()
+    pool = reset_connection_pool(size=2)
+    try:
+        errs = []
+
+        def hammer():
+            try:
+                for _ in range(50):
+                    status, body, _ = http_request(f"{srv.address}/ok")
+                    assert status == 200 and body == b"ok"
+            except Exception as e:   # pragma: no cover - diagnostic
+                errs.append(e)
+
+        threads = [threading.Thread(target=hammer) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        # O(pool size) sockets for 300 requests; overflow absorbs the
+        # burst beyond the cap and never errors
+        assert pool.stats["created"] <= 2
+        assert pool.stats["reused"] > 200
+    finally:
+        srv.stop()
+
+
+def test_pool_stale_socket_retry(fresh_pool):
+    """A keep-alive socket whose server restarted must be retried once
+    on a fresh connection, transparently."""
+    srv = HttpServer()
+    srv.route("GET", "/v", lambda req: Response(200, b"one"))
+    srv.start()
+    port = srv.port
+    assert http_request(f"{srv.address}/v")[1] == b"one"
+    srv.stop()   # pooled client socket is now stale
+    srv2 = HttpServer(port=port)
+    srv2.route("GET", "/v", lambda req: Response(200, b"two"))
+    srv2.start()
+    try:
+        status, body, _ = http_request(f"{srv2.address}/v")
+        assert (status, body) == (200, b"two")
+    finally:
+        srv2.stop()
+
+
+def test_pool_exhaustion_blocks_for_returned_conn(fresh_pool):
+    """At capacity, a caller briefly waits and reuses the connection the
+    in-flight request returns — no overflow socket."""
+    srv = HttpServer()
+    srv.route("GET", "/slow",
+              lambda req: (time.sleep(0.2), Response(200, b"s"))[1])
+    srv.route("GET", "/fast", lambda req: Response(200, b"f"))
+    srv.start()
+    pool = reset_connection_pool(size=1, wait=5.0)
+    try:
+        t = threading.Thread(
+            target=lambda: http_request(f"{srv.address}/slow"))
+        t.start()
+        time.sleep(0.05)   # let the slow request check out the one conn
+        status, body, _ = http_request(f"{srv.address}/fast")
+        t.join()
+        assert (status, body) == (200, b"f")
+        assert pool.stats["overflow"] == 0
+        assert pool.stats["waited"] >= 1
+        assert pool.stats["created"] == 1
+    finally:
+        srv.stop()
+
+
+def test_pool_exhaustion_overflows_after_wait(fresh_pool):
+    """When no connection comes back within the wait budget, the pool
+    overflows with a throwaway socket instead of deadlocking."""
+    srv = HttpServer()
+    srv.route("GET", "/slow",
+              lambda req: (time.sleep(0.3), Response(200, b"s"))[1])
+    srv.start()
+    pool = reset_connection_pool(size=1, wait=0.02)
+    try:
+        results = []
+
+        def call():
+            results.append(http_request(f"{srv.address}/slow")[0])
+
+        threads = [threading.Thread(target=call) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == [200, 200, 200]
+        assert pool.stats["overflow"] >= 1
+        # overflow sockets are not pooled: idle count stays at the cap
+        assert pool.idle_count("127.0.0.1", srv.port) <= 1
+    finally:
+        srv.stop()
+
+
+def test_fresh_connection_failure_is_not_retried(fresh_pool):
+    """A refused FRESH connection must raise (retrying could double-
+    apply a POST); only reused keep-alive sockets get the retry."""
+    import socket
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    port = blocker.getsockname()[1]   # bound, not listening -> refused
+    try:
+        with pytest.raises(OSError):
+            http_request(f"127.0.0.1:{port}/x", method="POST", body=b"b",
+                         timeout=2.0)
+    finally:
+        blocker.close()
+
+
+# -- extended write frame ---------------------------------------------------
+
+def test_ext_frame_roundtrip():
+    from seaweedfs_tpu.volume_server.tcp import (pack_ext_body,
+                                                 unpack_ext_body)
+    body = pack_ext_body(b"payload", replicate=True, compressed=True,
+                         ttl="5m")
+    assert unpack_ext_body(body) == (True, True, "5m", b"payload")
+    body = pack_ext_body(b"", replicate=False, compressed=False, ttl="")
+    assert unpack_ext_body(body) == (False, False, "", b"")
+
+
+# -- replica fan-out --------------------------------------------------------
+
+def test_fanout_fails_loudly_when_replica_down(tmp_path):
+    """A DOWN replica must fail the write with an error, never silently
+    skip — on BOTH the frame and HTTP entry paths."""
+    with SimCluster(volume_servers=2, base_dir=str(tmp_path)) as c:
+        r = operation.assign(c.master_grpc, replication="010")
+        # kill the replica's DATA planes only (heartbeat keeps it
+        # registered, so the fan-out still targets it)
+        replica = next(vs for vs in c.volume_servers
+                       if vs.url != r.url)
+        replica.http.stop()
+        replica.tcp.stop()
+        with pytest.raises(RuntimeError, match="replication failed"):
+            operation.upload_data_tcp(r.tcp_url, r.fid, b"doomed",
+                                      jwt=r.auth)
+        status, body, _ = http_request(
+            f"http://{r.url}/{r.fid}" + (f"?jwt={r.auth}" if r.auth
+                                         else ""),
+            method="POST", body=b"doomed")
+        assert status == 500 and b"replication failed" in body
+
+
+def test_no_connection_churn_replicated_writes(tmp_path):
+    """Acceptance: a replicated write burst opens O(pool size) upstream
+    connections, not O(writes), and every fan-out send rides a
+    persistent transport."""
+    from seaweedfs_tpu.util.http import connection_pool
+    with SimCluster(volume_servers=2, base_dir=str(tmp_path)) as c:
+        pool0 = dict(connection_pool().stats)
+        n = 60
+        r = operation.assign(c.master_grpc, count=n, replication="010")
+        for fid in operation.derive_fids(r):
+            operation.upload_to(r, fid, b"x" * 512)
+        sends = sum(
+            vs.metrics.replica_fanout_ops.value("tcp", "ok")
+            + vs.metrics.replica_fanout_ops.value("http", "ok")
+            for vs in c.volume_servers)
+        assert sends == n
+        created = connection_pool().stats["created"] - pool0["created"]
+        assert created <= connection_pool().size
+
+
+# -- fid leasing ------------------------------------------------------------
+
+def test_fid_lease_amortizes_assigns(tmp_path):
+    with SimCluster(volume_servers=1, base_dir=str(tmp_path)) as c:
+        leaser = operation.FidLeaser(lease_size=10)
+        for _ in range(30):
+            r = leaser.assign(c.master_grpc)
+            operation.upload_to(r, r.fid, b"leased")
+        assert leaser.stats == {"assign_rpcs": 3, "leased": 27}
+
+
+def test_fid_lease_single_flight_refill(tmp_path):
+    """Concurrent workers hitting an empty lease must trigger ONE
+    refill RPC, not one per worker."""
+    with SimCluster(volume_servers=1, base_dir=str(tmp_path)) as c:
+        leaser = operation.FidLeaser(lease_size=40)
+        errs = []
+
+        def worker():
+            try:
+                for _ in range(10):
+                    r = leaser.assign(c.master_grpc)
+                    operation.upload_to(r, r.fid, b"w")
+            except Exception as e:   # pragma: no cover - diagnostic
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert leaser.stats["assign_rpcs"] == 1   # 40 fids, 40 writes
+
+
+def test_fid_lease_ttl_expiry(tmp_path):
+    """A lease must never outlive its TTL (the write JWT it rides on
+    expires): after the window, the next assign re-asks the master."""
+    with SimCluster(volume_servers=1, base_dir=str(tmp_path)) as c:
+        leaser = operation.FidLeaser(lease_size=10, ttl_seconds=0.05)
+        leaser.assign(c.master_grpc)
+        time.sleep(0.1)
+        leaser.assign(c.master_grpc)
+        assert leaser.stats["assign_rpcs"] == 2
+
+
+def test_fid_lease_invalidation_on_readonly(tmp_path):
+    """A volume frozen readonly under a live lease (vacuum/ec.encode
+    do exactly this) must fail the leased upload loudly; invalidation
+    plus one fresh assign lands on a writable volume."""
+    from seaweedfs_tpu.pb.rpc import POOL
+    with SimCluster(volume_servers=1, base_dir=str(tmp_path)) as c:
+        leaser = operation.FidLeaser(lease_size=10)
+        r = leaser.assign(c.master_grpc)
+        operation.upload_to(r, r.fid, b"before")
+        vid = int(r.fid.split(",", 1)[0])
+        holder = next(vs for vs in c.volume_servers
+                      if vs.store.has_volume(vid))
+        POOL.client(holder.grpc_address, "VolumeServer").call(
+            "VolumeMarkReadonly", {"volume_id": vid})
+        c.sync_heartbeats()   # master stops routing writes to vid
+        r2 = leaser.assign(c.master_grpc)
+        if int(r2.fid.split(",", 1)[0]) == vid:
+            # still the stale lease: the upload must fail loudly...
+            with pytest.raises((RuntimeError, OSError)):
+                operation.upload_to(r2, r2.fid, b"stale")
+            # ...and invalidation + re-assign must recover
+            leaser.invalidate_volume(vid)
+            r2 = leaser.assign(c.master_grpc)
+        assert int(r2.fid.split(",", 1)[0]) != vid
+        operation.upload_to(r2, r2.fid, b"after")
+        assert operation.read_file(c.master_grpc, r2.fid) == b"after"
+
+
+def test_filer_write_survives_readonly_under_lease(tmp_path):
+    """End to end: the filer's leased chunk writes retry with a fresh
+    assign when every leased volume goes readonly mid-stream."""
+    with SimCluster(volume_servers=1, filers=1,
+                    base_dir=str(tmp_path)) as c:
+        filer = c.filers[0]
+        status, _, _ = http_request(f"{filer.address}/d/a.txt",
+                                    method="PUT", body=b"first")
+        assert status == 201
+        # freeze EVERY volume the filer could hold a lease on
+        for vs in c.volume_servers:
+            for loc in vs.store.locations:
+                for v in list(loc.volumes.values()):
+                    v.read_only = True
+        c.sync_heartbeats()
+        status, body, _ = http_request(f"{filer.address}/d/b.txt",
+                                       method="PUT", body=b"second")
+        assert status == 201, body
+        status, body, _ = http_request(f"{filer.address}/d/b.txt")
+        assert status == 200 and body == b"second"

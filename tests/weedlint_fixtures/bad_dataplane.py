@@ -1,0 +1,26 @@
+"""WL050 corpus: per-call threads / raw HTTP on the serving path."""
+import threading
+import urllib.request
+
+
+def handle(req):
+    t = threading.Thread(target=print)        # handler spawns a thread
+    t.start()
+    urllib.request.urlopen("http://x/")       # raw client in a handler
+    return t
+
+
+def fan_out(urls, body):
+    threads = []
+    for u in urls:
+        t = threading.Thread(target=print, args=(u,))   # per-call spawn
+        threads.append(t)
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def spawn_workers(peers):
+    # clean: long-lived daemons, never joined here (raft peer loops)
+    for p in peers:
+        threading.Thread(target=print, args=(p,), daemon=True).start()

@@ -5,3 +5,4 @@ from . import jaxpurity    # noqa: F401
 from . import wire         # noqa: F401
 from . import exceptions   # noqa: F401
 from . import resources    # noqa: F401
+from . import dataplane    # noqa: F401

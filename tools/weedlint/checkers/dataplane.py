@@ -1,0 +1,113 @@
+"""WL050 dataplane-hot-path — per-call thread construction and raw HTTP
+client use on the serving path.
+
+The write-path overhaul (ISSUE 5) moved replica fan-out onto a
+persistent executor and every intra-cluster HTTP hop onto the shared
+bounded connection pool (util/http.py).  This checker keeps those
+properties from regressing:
+
+- Inside a REQUEST HANDLER (any function with a parameter named ``req``
+  or ``request`` — the repo's Handler signature), constructing a
+  ``threading.Thread`` or calling a raw HTTP client
+  (``urllib.request.urlopen`` / ``http.client.HTTPConnection``) is
+  flagged: handlers must submit to a shared executor and go through the
+  pooled ``util.http.http_request``.
+- Anywhere, the spawn-and-wait fan-out idiom — ``threading.Thread``
+  constructed inside a ``for``/``while`` loop in a function that also
+  ``join()``s threads — is flagged: that shape runs once per call and
+  pays thread construction plus a cold connection every time.  Spawning
+  long-lived workers in a loop (raft peer loops, aggregator followers)
+  does not join them in-function and stays clean.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .. import Finding, ModuleContext, register
+from ..astutil import dotted_name
+
+_THREAD = {"threading.Thread", "Thread"}
+_RAW_HTTP = {"urllib.request.urlopen", "http.client.HTTPConnection",
+             "http.client.HTTPSConnection"}
+
+
+def _is_handler(fn: ast.AST) -> bool:
+    args = fn.args
+    names = [a.arg for a in (args.posonlyargs + args.args
+                             + args.kwonlyargs)]
+    return "req" in names or "request" in names
+
+
+def _joins_threads(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join":
+            return True
+    return False
+
+
+def _loop_thread_calls(fn: ast.AST) -> "list[ast.Call]":
+    """threading.Thread(...) calls lexically inside a for/while body of
+    this function (not nested functions — they get their own pass)."""
+    out: list[ast.Call] = []
+    nested = {id(sub) for node in ast.iter_child_nodes(fn)
+              for sub in ast.walk(node)
+              if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+              and sub is not fn}
+
+    def walk(node: ast.AST, in_loop: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if id(child) in nested and isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            child_in_loop = in_loop or isinstance(child,
+                                                  (ast.For, ast.While))
+            if in_loop and isinstance(child, ast.Call) \
+                    and dotted_name(child.func) in _THREAD:
+                out.append(child)
+            walk(child, child_in_loop)
+
+    walk(fn, False)
+    return out
+
+
+@register("WL050", "dataplane-hot-path")
+def check_dataplane(ctx: ModuleContext) -> Iterator[Finding]:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        handler = _is_handler(fn)
+        if handler:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name in _THREAD:
+                    yield Finding(
+                        "WL050", "dataplane-hot-path", ctx.path,
+                        node.lineno,
+                        "request handler constructs a thread per call",
+                        "submit the work to a persistent executor "
+                        "(concurrent.futures.ThreadPoolExecutor held "
+                        "on the server)")
+                elif name in _RAW_HTTP:
+                    yield Finding(
+                        "WL050", "dataplane-hot-path", ctx.path,
+                        node.lineno,
+                        "request handler uses a raw HTTP client "
+                        "(connection per request)",
+                        "route the hop through the pooled "
+                        "util.http.http_request")
+        if _joins_threads(fn):
+            for call in _loop_thread_calls(fn):
+                yield Finding(
+                    "WL050", "dataplane-hot-path", ctx.path,
+                    call.lineno,
+                    "per-call fan-out: threads constructed in a loop "
+                    "and joined in the same function",
+                    "replace the spawn-and-wait shape with a shared "
+                    "ThreadPoolExecutor (futures keep the fail-loud "
+                    "error collection)")
