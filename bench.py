@@ -267,6 +267,109 @@ def bench_hotset_reread(concurrency: int, quick: bool = False,
         return out
 
 
+def bench_degraded_read(concurrency: int, quick: bool = False,
+                        n_files: int = 400, runs: int = 2) -> dict:
+    """Degraded-mode extras (ISSUE 6): read latency with one replica
+    hard-killed, and how long reads take to recover after the kill.
+
+    Reads ride the production failover path — cached TCP routes to the
+    dead server fail once, get negative-cached, and the walk lands on
+    the survivor — so `degraded` p99 includes the real discovery cost,
+    and `post_kill_recovery_ms` is the wall time from the kill to the
+    first successful read of an affected blob."""
+    import threading
+
+    from seaweedfs_tpu import operation
+    from seaweedfs_tpu.testing import SimCluster
+
+    if quick:
+        n_files, runs = 100, 1
+    payload = b"d" * 1024
+    healthy_p99, degraded_p99, recovery = [], [], []
+    degraded_rps = []
+
+    def read_all(master_grpc, fids) -> list[float]:
+        lat: list[float] = []
+        lock = threading.Lock()
+        work = list(fids)
+
+        def reader():
+            while True:
+                with lock:
+                    if not work:
+                        return
+                    fid = work.pop()
+                t0 = time.perf_counter()
+                operation.read_file(master_grpc, fid)
+                dt = time.perf_counter() - t0
+                with lock:
+                    lat.append(dt)
+
+        threads = [threading.Thread(target=reader)
+                   for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return lat
+
+    for _ in range(runs):
+        with SimCluster(volume_servers=3, racks=2,
+                        max_volumes=60) as cluster:
+            fids = []
+            for _ in range(n_files):
+                fids.append(operation.assign_and_upload(
+                    cluster.master_grpc, payload, replication="010"))
+            lat = read_all(cluster.master_grpc, fids)
+            healthy_p99.append(
+                float(np.percentile(lat, 99)) * 1000)
+            # pick a blob held by server 0, then kill that server
+            victim_url = cluster.volume_servers[0].url
+            affected = [f for f in fids
+                        if any(l["url"] == victim_url
+                               for l in operation.lookup_volume(
+                                   cluster.master_grpc,
+                                   int(f.split(",")[0])))]
+            t_kill = time.perf_counter()
+            cluster.kill_volume_server(0)
+            probe = affected[0] if affected else fids[0]
+            probe_deadline = t_kill + 30.0
+            while True:
+                try:
+                    operation.read_file(cluster.master_grpc, probe)
+                    break
+                except Exception:
+                    if time.perf_counter() >= probe_deadline:
+                        # surfaces as degraded_read_error in the extras
+                        # instead of hanging the whole bench run
+                        raise RuntimeError(
+                            f"read of {probe} never recovered within "
+                            f"30s of the replica kill")
+                    time.sleep(0.01)
+            recovery.append((time.perf_counter() - t_kill) * 1000)
+            t0 = time.perf_counter()
+            lat = read_all(cluster.master_grpc, fids)
+            wall = time.perf_counter() - t0
+            degraded_p99.append(
+                float(np.percentile(lat, 99)) * 1000)
+            degraded_rps.append(len(lat) / wall if wall else 0.0)
+
+    h_med, h_spread = spread(healthy_p99)
+    d_med, d_spread = spread(degraded_p99)
+    r_med, r_spread = spread(recovery)
+    rps_med, rps_spread = spread(degraded_rps, digits=1)
+    return {
+        "degraded_healthy_read_p99_ms": h_med,
+        "degraded_healthy_read_p99_ms_spread": h_spread,
+        "degraded_one_replica_down_read_p99_ms": d_med,
+        "degraded_one_replica_down_read_p99_ms_spread": d_spread,
+        "degraded_one_replica_down_read_rps": rps_med,
+        "degraded_one_replica_down_read_rps_spread": rps_spread,
+        "post_kill_recovery_ms": r_med,
+        "post_kill_recovery_ms_spread": r_spread,
+    }
+
+
 def bench_replicated_write(concurrency: int, quick: bool = False,
                            n_files: int = 1000, runs: int = 3) -> dict:
     """Replicated small-write throughput (ISSUE 5): replication 001
@@ -727,6 +830,11 @@ def main():
                     conc, quick=args.quick))
             except Exception as e:
                 smallfile["replicated_write_error"] = str(e)[:200]
+            try:
+                smallfile.update(bench_degraded_read(
+                    conc, quick=args.quick))
+            except Exception as e:
+                smallfile["degraded_read_error"] = str(e)[:200]
         except Exception as e:   # never fail the headline metric
             smallfile = {"smallfile_error": str(e)[:200]}
     # end-to-end disk path (VERDICT r3 missing #1)

@@ -94,28 +94,37 @@ def _tcp_sock(addr: str):
         socks = _TCP_LOCAL.socks = {}
     cached = socks.get(addr)
     if cached is None:
+        from ..util import faults
+        from ..util.retry import (default_connect_timeout,
+                                  default_rpc_timeout)
+        if faults.ACTIVE:
+            faults.raise_if_planned("tcp.connect", addr)
         host, _, port = addr.rpartition(":")
-        sock = _socket.create_connection((host, int(port)), timeout=30)
+        sock = _socket.create_connection(
+            (host, int(port)), timeout=default_connect_timeout())
         sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
         import sys as _sys
         fp = _fastpath() if _sys.platform == "linux" else None
         ctx = rf = None
+        io_timeout = default_rpc_timeout()
         if fp is not None:
             # the C loop needs a BLOCKING fd (a Python-level timeout
             # flips the socket non-blocking and raw recv sees EAGAIN);
-            # keep the 30s guard at the OS level instead.  The 'll'
-            # timeval packing assumes Linux LP64 — hence the platform
-            # gate above: anywhere else it would be garbage or zero
-            # (blocking forever), so those hosts take the Python path
+            # keep the request-timeout guard at the OS level instead.
+            # The 'll' timeval packing assumes Linux LP64 — hence the
+            # platform gate above: anywhere else it would be garbage or
+            # zero (blocking forever), so those hosts take the Python
+            # path
             import struct as _struct
             sock.settimeout(None)
-            tv = _struct.pack("ll", 30, 0)
+            tv = _struct.pack("ll", max(1, int(io_timeout)), 0)
             sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVTIMEO, tv)
             sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDTIMEO, tv)
             ctx = fp.conn_new(sock.fileno())
         else:
             # only built when the C ctx is absent: two readers on one
             # socket would steal bytes from each other
+            sock.settimeout(io_timeout)
             rf = sock.makefile("rb")
         # the resolved C module rides in the tuple so the per-call path
         # skips the module-attribute chase (~3us/op on this box)
@@ -244,6 +253,23 @@ def delete_file_tcp(tcp_addr: str, fid: str, jwt: str = "") -> dict:
 # full connect timeout before the HTTP fallback.
 _TCP_DEAD: dict = {}
 _TCP_DEAD_TTL = 60.0
+
+# HTTP locations whose TRANSPORT recently failed (refused/reset/timeout)
+# -> retry-after timestamp.  The read failover walks every replica; this
+# per-location negative cache makes repeat reads skip a dead replica's
+# connect timeout instead of re-paying it per request.  Short TTL: a
+# restarted server must come back within one heartbeat-ish window, and
+# server-side errors (404/500) never land here — only transport death.
+_HTTP_DEAD: dict = {}
+_HTTP_DEAD_TTL = 5.0
+
+
+def http_dead(url: str) -> bool:
+    return _HTTP_DEAD.get(url, 0) >= time.time()
+
+
+def mark_http_dead(url: str) -> None:
+    _HTTP_DEAD[url] = time.time() + _HTTP_DEAD_TTL
 
 
 def tcp_dead(addr: str) -> bool:
@@ -452,9 +478,16 @@ def read_file(master_grpc: str, fid: str, stored: bool = True) -> bytes:
 
 def _read_file_resolve(master_grpc: str, fid: str, vid: int,
                        stored: bool) -> bytes:
+    """Replica failover: walk EVERY location (TCP fast path first, HTTP
+    fallback per replica) before giving up, negative-caching each dead
+    transport so the next read skips it.  One fresh-lookup round covers
+    the volume-moved case; a second pass ignores the negative caches so
+    a fully-blacklisted location list still gets one real try instead
+    of a spurious total failure."""
     import http.client
     last_err = ""
-    for fresh in (False, True):
+    for fresh, ignore_dead in ((False, False), (True, False),
+                               (True, True)):
         if fresh:
             # every cached location failed — the volume may have moved;
             # evict and retry against the master's current view
@@ -462,9 +495,11 @@ def _read_file_resolve(master_grpc: str, fid: str, vid: int,
         locs = lookup_volume(master_grpc, vid)
         if not locs:
             raise RuntimeError(f"volume {vid} has no locations")
+        now = time.time()
         for loc in locs:
             if loc.get("tcp_url") and stored \
-                    and _TCP_DEAD.get(loc["tcp_url"], 0) < time.time():
+                    and (ignore_dead
+                         or _TCP_DEAD.get(loc["tcp_url"], 0) < now):
                 # transparent raw-TCP fast path; HTTP remains the
                 # fallback (wdclient/volume_tcp_client.go)
                 try:
@@ -479,6 +514,9 @@ def _read_file_resolve(master_grpc: str, fid: str, vid: int,
                 except RuntimeError as e:
                     last_err = str(e)
                     continue    # server-side error (e.g. not found)
+            if not ignore_dead and http_dead(loc["url"]):
+                last_err = last_err or f"{loc['url']}: negative-cached"
+                continue
             try:
                 # Accept-Encoding: gzip = "give me the STORED bytes" —
                 # internal readers decode via the chunk record's flags
@@ -490,9 +528,13 @@ def _read_file_resolve(master_grpc: str, fid: str, vid: int,
                     headers={"Accept-Encoding":
                              "gzip" if stored else "identity"})
             except (OSError, http.client.HTTPException) as e:
+                # transport death, not a server answer: negative-cache
+                # the LOCATION so the failover walk stays cheap
+                mark_http_dead(loc["url"])
                 last_err = f"{loc['url']}: {e}"
                 continue
             if status == 200:
+                _HTTP_DEAD.pop(loc["url"], None)
                 return body
             last_err = f"{loc['url']}: HTTP {status}"
     raise RuntimeError(f"read {fid} failed: {last_err}")

@@ -29,7 +29,8 @@ from typing import Callable, Iterator
 
 import grpc
 
-from ..util import tracing
+from ..util import faults, tracing
+from ..util.retry import default_rpc_timeout
 from ..util.weedlog import logger
 
 LOG = logger(__name__)
@@ -147,6 +148,16 @@ class RpcServer:
             t0 = time.time()
             status = "ok"
             try:
+                if faults.ACTIVE:
+                    # server-side dispatch chaos: drop/error abort before
+                    # the handler runs (the peer-crashed-mid-request
+                    # shape); delay sleeps inside the handler slot
+                    p = faults.hit("rpc.handle",
+                                   f"{self.host}:{self.port}/{label}")
+                    if p is not None:
+                        raise RpcError(
+                            f"injected fault #{p.rule_id}: {p.mode} "
+                            f"{label}")
                 with tracing.trace_scope(tid):
                     return fn(request) or {}
             except RpcError as e:
@@ -218,7 +229,14 @@ class RpcClient:
         self._channel = channel
 
     def call(self, method: str, payload: dict | None = None,
-             timeout: float = 30.0) -> dict:
+             timeout: "float | None" = None) -> dict:
+        """Unary call.  ``timeout=None`` takes the process default
+        (WEED_RPC_TIMEOUT via util/retry.py) — per-attempt deadlines are
+        policy, not per-call-site constants."""
+        if timeout is None:
+            timeout = default_rpc_timeout()
+        if faults.ACTIVE:
+            self._maybe_fault(method)
         fn = self._channel.unary_unary(
             f"/{self.service}/{method}",
             request_serializer=_ser, response_deserializer=_de)
@@ -227,6 +245,17 @@ class RpcClient:
                       metadata=_trace_metadata())
         except grpc.RpcError as e:
             raise RpcError(e.details() or str(e.code())) from None
+
+    def _maybe_fault(self, method: str) -> None:
+        """Client-side rpc chaos (util/faults.py ``rpc.call``): 'drop'
+        and 'error' surface as RpcError like a dead/refusing peer."""
+        p = faults.hit("rpc.call",
+                       f"{self.address}/{self.service}/{method}")
+        if p is not None:
+            raise RpcError(
+                f"injected fault #{p.rule_id}: "
+                f"{'dropped' if p.mode == 'drop' else 'error'} "
+                f"{self.service}/{method} @ {self.address}")
 
     def stream(self, method: str, requests: Iterator[dict],
                timeout: float | None = None) -> Iterator[dict]:

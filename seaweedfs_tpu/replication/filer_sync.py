@@ -14,7 +14,11 @@ import threading
 
 from .. import operation
 from ..pb.rpc import POOL, RpcError, from_b64, to_b64
+from ..util.retry import background_reconnect
+from ..util.weedlog import logger
 from . import FilerSink, Replicator
+
+LOG = logger(__name__)
 
 
 def _offset_key(source_signature: str, path_prefix: str) -> bytes:
@@ -103,12 +107,22 @@ class SyncDirection:
 
     def start(self) -> None:
         def loop():
+            # healthy polls keep the old 0.5s cadence; failures back off
+            # (jittered) so a down source filer isn't re-dialed on a
+            # fixed beat by every sync direction at once
+            policy = background_reconnect()
+            failures = 0
             while not self._stop.is_set():
                 try:
                     self.run_once()
-                except RpcError:
-                    pass
-                self._stop.wait(0.5)
+                    failures = 0
+                except RpcError as e:
+                    failures += 1
+                    LOG.debug("sync %s -> %s failed (%d consecutive): "
+                              "%s", self.source_filer, self.target_filer,
+                              failures, e)
+                self._stop.wait(0.5 if not failures
+                                else policy.backoff(failures))
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
 

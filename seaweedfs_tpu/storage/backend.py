@@ -14,6 +14,8 @@ import os
 import threading
 from abc import ABC, abstractmethod
 
+from ..util import faults
+
 
 class BackendStorageFile(ABC):
     @abstractmethod
@@ -62,6 +64,8 @@ class DiskFile(BackendStorageFile):
         self._size = os.fstat(self.fd).st_size
 
     def read_at(self, size: int, offset: int) -> bytes:
+        if faults.ACTIVE:
+            faults.raise_if_planned("disk.pread", self.path)
         chunks = []
         remaining, off = size, offset
         while remaining > 0:
@@ -74,6 +78,19 @@ class DiskFile(BackendStorageFile):
         return b"".join(chunks)
 
     def write_at(self, data: bytes, offset: int) -> int:
+        if faults.ACTIVE:
+            p = faults.hit("disk.pwrite", self.path)
+            if p is not None:
+                if p.mode == "torn":
+                    # write a real short prefix (torn record on disk),
+                    # then fail like a crashed device would
+                    n = p.torn_bytes if p.torn_bytes >= 0 \
+                        else len(data) // 2
+                    if n > 0:
+                        os.pwrite(self.fd, bytes(data[:n]), offset)
+                        if offset + n > self._size:
+                            self._size = offset + n
+                raise p.error(f"pwrite {self.path}")
         view = memoryview(data)
         written = 0
         while written < len(data):
@@ -90,12 +107,26 @@ class DiskFile(BackendStorageFile):
         return end
 
     def truncate(self, size: int) -> None:
+        if faults.ACTIVE:
+            # with a torn-pwrite fault this is the crash point: the
+            # append path's rollback truncate failing leaves the torn
+            # record on disk, exactly like power loss mid-append
+            faults.raise_if_planned("disk.truncate", self.path)
         os.ftruncate(self.fd, size)
         self._size = size
 
     def get_stat(self) -> tuple[int, float]:
         st = os.fstat(self.fd)
-        self._size = st.st_size
+        if faults.ACTIVE:
+            # deterministic stall point between the fstat and the return
+            # (tests force the historical stat/append interleaving here)
+            faults.hit("disk.stat", self.path)
+        # NB: must NOT write self._size here.  get_stat is called without
+        # the volume lock (heartbeat collect, vacuum garbage checks); a
+        # stale st_size assigned after a concurrent locked append rolled
+        # the cached EOF back, making the next append overwrite the
+        # previous acked record — the soak SizeMismatchError.  The cache
+        # is owned by write_at/truncate alone, which run under the lock.
         return st.st_size, st.st_mtime
 
     def size(self) -> int:
@@ -104,6 +135,8 @@ class DiskFile(BackendStorageFile):
         return self._size
 
     def sync(self) -> None:
+        if faults.ACTIVE:
+            faults.raise_if_planned("disk.fsync", self.path)
         os.fsync(self.fd)
 
     def close(self) -> None:

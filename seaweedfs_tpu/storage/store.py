@@ -36,6 +36,7 @@ class DiskLocation:
         self.disk_type = disk_type
         self.volumes: dict[int, Volume] = {}
         self.ec_volumes: dict[int, object] = {}  # vid -> EcVolume (storage.ec)
+        self.on_degrade = None   # propagated onto every opened Volume
         self._lock = threading.RLock()
         # vids being created: reserved under _lock, volume files opened
         # outside it (opening .dat/.idx can block on a slow disk)
@@ -62,9 +63,10 @@ class DiskLocation:
             if vid in self.volumes:
                 continue
             try:
-                self.volumes[vid] = Volume(
-                    self.directory, collection, vid,
-                    needle_map_kind=self.needle_map_kind)
+                v = Volume(self.directory, collection, vid,
+                           needle_map_kind=self.needle_map_kind)
+                v.on_degrade = self.on_degrade
+                self.volumes[vid] = v
             except Exception as e:
                 # one corrupt volume must not keep the server down, but
                 # an operator has to be able to find out it was skipped
@@ -116,6 +118,7 @@ class DiskLocation:
             v = Volume(self.directory, collection, vid,
                        needle_map_kind=needle_map_kind or self.needle_map_kind,
                        replica_placement=replica_placement, ttl=ttl)
+            v.on_degrade = self.on_degrade
             with self._lock:
                 self.volumes[vid] = v
             return v
@@ -177,6 +180,16 @@ class Store:
         self.ip = ip
         self.port = port
         self.public_url = public_url or (f"{ip}:{port}" if ip else "")
+
+    def set_on_degrade(self, cb) -> None:
+        """Hook degrade notifications (Volume._degrade) on every current
+        AND future volume — the volume server uses this to push an
+        immediate heartbeat when a disk fault flips a volume
+        read-only."""
+        for loc in self.locations:
+            loc.on_degrade = cb
+            for v in loc.volumes.values():
+                v.on_degrade = cb
 
     # -- volume routing ---------------------------------------------------
     def find_volume(self, vid: int) -> Volume | None:

@@ -88,6 +88,7 @@ class VolumeInfo:
     ttl: int
     compact_revision: int
     modified_at_second: int = 0
+    degraded_reason: str = ""  # why read_only flipped (IO fault), if so
 
 
 class Volume:
@@ -106,6 +107,13 @@ class Volume:
         self.backend_kind = backend_kind
         self._lock = threading.RLock()
         self.last_modified = 0
+        # set when a write-path IO error degraded this volume to
+        # read-only (ENOSPC, a dying disk); reported via /status and the
+        # heartbeat path so the master stops assigning here
+        self.degraded_reason = ""
+        # notified (vid) after a degrade flip — the volume server hooks
+        # this to push an immediate heartbeat (store.set_on_degrade)
+        self.on_degrade = None
 
         base = volume_file_name(directory, collection, vid)
         self.base_path = base
@@ -175,6 +183,12 @@ class Volume:
         if self.read_only:
             raise VolumeError(f"volume {self.id} is read-only")
         with self._lock:
+            if self.read_only:
+                # re-check under the lock: a freeze (ec.encode's
+                # mark-readonly, a disk-fault degrade) that takes the
+                # lock as a barrier afterwards is then guaranteed no
+                # straggler write can land post-barrier
+                raise VolumeError(f"volume {self.id} is read-only")
             # dedup identical re-write (volume_write.go:35-63 hasSameLastEntry
             # spirit: equal id+cookie+data -> skip)
             if n.id != 0:
@@ -193,12 +207,43 @@ class Volume:
                         # is the first sign of a corrupt tail
                         LOG.debug("dedup read of needle %s failed: %s",
                                   n.id, e)
-            offset, size, _ = n.append_to(self.data_backend, self.version)
+            try:
+                offset, size, _ = n.append_to(self.data_backend,
+                                              self.version)
+            except OSError as e:
+                # disk gone bad / ENOSPC: degrade to read-only instead
+                # of failing every future write the same way.  append_to
+                # already truncated the torn tail, so the volume keeps
+                # SERVING; the heartbeat reports read_only and the
+                # master routes new writes elsewhere (f4's "never lose
+                # acked data" posture: fail THIS write loudly, protect
+                # the rest).
+                self._degrade(f"write: {e}")
+                raise VolumeError(
+                    f"volume {self.id} degraded to read-only: {e}"
+                ) from e
             # the map records the *body* size written in the header (n.size),
             # which is what ReadBytes validates against (volume_write.go nm.Put)
+            prev = self.nm.get(n.id) if fsync else None
             self.nm.put(n.id, offset, n.size)
             if fsync:
-                self.data_backend.sync()
+                try:
+                    self.data_backend.sync()
+                except OSError as e:
+                    # an unsyncable record is NOT durable: roll the map
+                    # entry back before failing, or a later reader gets
+                    # bytes the caller was told did not commit.  A
+                    # same-id overwrite rolls back to the PRIOR record
+                    # (still acked, still on disk), not to a tombstone.
+                    if prev is not None and t.size_is_valid(prev.size) \
+                            and prev.offset:
+                        self.nm.put(n.id, prev.offset, prev.size)
+                    else:
+                        self.nm.delete(n.id, offset)
+                    self._degrade(f"fsync: {e}")
+                    raise VolumeError(
+                        f"volume {self.id} degraded to read-only: {e}"
+                    ) from e
             self.last_modified = int(time.time())
             return size
 
@@ -229,8 +274,14 @@ class Volume:
                             break
                         batch.append(nxt)
                     sizes: dict[int, int] = {}
+                    prevs: dict[int, "object | None"] = {}
                     for n, fut in batch:
                         try:
+                            # snapshot the prior entry right before the
+                            # write: a failed batch fsync must roll a
+                            # same-id overwrite back to its acked prior
+                            # version, not to a tombstone
+                            prevs[id(fut)] = self.nm.get(n.id)
                             sizes[id(fut)] = self.write_needle(
                                 n, fsync=False)
                         except Exception as e:
@@ -242,6 +293,33 @@ class Volume:
                         self._gc_sync_count = getattr(
                             self, "_gc_sync_count", 0) + 1
                     except Exception as e:
+                        # none of the batch is durable: roll the map
+                        # entries back before failing the futures, and
+                        # degrade — an unsyncable disk must stop taking
+                        # writes (see write_needle's fsync path).  The
+                        # rollback itself appends to .idx on the same
+                        # failing disk, so it must never be allowed to
+                        # kill this worker: queued futures would then
+                        # hang instead of failing fast.
+                        try:
+                            with self._lock:
+                                for n, fut in batch:
+                                    prev = prevs.get(id(fut))
+                                    if prev is not None \
+                                            and t.size_is_valid(
+                                                prev.size) \
+                                            and prev.offset:
+                                        self.nm.put(n.id, prev.offset,
+                                                    prev.size)
+                                    else:
+                                        self.nm.delete(n.id, 0)
+                        except Exception as e2:
+                            LOG.warning(
+                                "group-commit rollback on volume %d "
+                                "failed (degrading anyway): %s",
+                                self.id, e2)
+                        if isinstance(e, OSError):
+                            self._degrade(f"group-commit fsync: {e}")
                         for _, fut in batch:
                             if not fut.done():
                                 fut.set_exception(e)
@@ -252,9 +330,11 @@ class Volume:
                             # returns on the non-fsync path
                             fut.set_result(sizes[id(fut)])
 
-            t = threading.Thread(target=worker, daemon=True)
-            t.start()
-            self._gc_thread = t
+            # NB: not named `t` — the worker closure must keep seeing
+            # the module-level `types as t` alias
+            worker_thread = threading.Thread(target=worker, daemon=True)
+            worker_thread.start()
+            self._gc_thread = worker_thread
 
     def write_needle_durable(self, n: Needle):
         """Queue a durable (fsynced) write; returns a Future.  Concurrent
@@ -408,6 +488,8 @@ class Volume:
         if self.read_only:
             raise VolumeError(f"volume {self.id} is read-only")
         with self._lock:
+            if self.read_only:   # see write_needle: freeze barrier
+                raise VolumeError(f"volume {self.id} is read-only")
             nv = self.nm.get(n_id)
             if nv is None or t.size_is_deleted(nv.size):
                 return 0
@@ -418,7 +500,13 @@ class Volume:
                     raise CookieMismatchError(
                         f"cookie mismatch deleting needle {n_id:x}")
             tomb = Needle(id=n_id, cookie=cookie or 0)
-            tomb.append_to(self.data_backend, self.version)
+            try:
+                tomb.append_to(self.data_backend, self.version)
+            except OSError as e:
+                self._degrade(f"delete: {e}")
+                raise VolumeError(
+                    f"volume {self.id} degraded to read-only: {e}"
+                ) from e
             self.nm.delete(n_id, nv.offset)
             self.last_modified = int(time.time())
             return nv.size
@@ -448,6 +536,7 @@ class Volume:
             ttl=self.super_block.ttl.to_uint32(),
             compact_revision=self.super_block.compaction_revision,
             modified_at_second=self.last_modified,
+            degraded_reason=self.degraded_reason,
         )
 
     def max_file_key(self) -> int:
@@ -511,7 +600,39 @@ class Volume:
                      self.nm.file_count(), self.content_size())
             return before - self.content_size()
 
+    # -- degradation (write-path IO faults) --------------------------------
+    def _degrade(self, reason: str) -> None:
+        """Flip to read-only after a write-path IO error.  Reads keep
+        being served (locally and from replicas); the master learns via
+        the next heartbeat (nudged immediately through on_degrade) and
+        stops assigning new writes here."""
+        if self.read_only:
+            return
+        self.read_only = True
+        self.degraded_reason = reason
+        LOG.warning("volume %d degraded to read-only: %s", self.id,
+                    reason)
+        cb = self.on_degrade
+        if cb is not None:
+            try:
+                cb(self.id)
+            except Exception as e:
+                LOG.debug("degrade callback for volume %d failed: %s",
+                          self.id, e)
+
     # -- lifecycle ---------------------------------------------------------
+    def freeze_writes(self) -> None:
+        """Mark read-only AND drain: once this returns, no in-flight
+        write/delete can still append — a straggler that passed the
+        fast read_only check before the flag flipped is either already
+        done (it held the lock we now barrier on) or will fail the
+        under-lock re-check.  Snapshot flows (ec encode) need this
+        guarantee: their .idx/.dat reads run by path, outside the
+        volume lock."""
+        self.read_only = True
+        with self._lock:
+            pass
+
     def sync(self) -> None:
         self.data_backend.sync()
         self.nm.sync()

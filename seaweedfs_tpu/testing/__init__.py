@@ -18,6 +18,7 @@ SimCluster directly).
 from __future__ import annotations
 
 import os
+import random
 import socket
 import tempfile
 import time
@@ -26,6 +27,8 @@ from .. import operation
 from ..filer import FilerServer
 from ..master import MasterServer
 from ..s3 import S3ApiServer
+from ..util import faults
+from ..util.retry import RetryPolicy
 from ..util.weedlog import logger
 from ..volume_server import VolumeServer
 
@@ -146,6 +149,9 @@ class SimCluster:
         return self
 
     def stop(self) -> None:
+        # disarm chaos first: the process-wide fault plane must never
+        # outlive the cluster that armed it
+        faults.clear()
         # best-effort teardown: every server gets its stop() even if an
         # earlier one died mid-shutdown, but failures are logged — a
         # silently half-stopped cluster leaks ports into the next test
@@ -224,19 +230,102 @@ class SimCluster:
         return self._retry(lambda: operation.read_file(
             self.master_grpc, fid))
 
-    @staticmethod
-    def _retry(fn, timeout: float = 8.0):
+    def _retry(self, fn, timeout: float = 8.0):
         """Clients retry through elections — a raft leader change makes
         master RPCs fail for a bounded window (clients in the reference
-        do the same via masterclient leader-chasing)."""
-        deadline = time.time() + timeout
-        while True:
-            try:
-                return fn()
-            except Exception:
-                if time.time() >= deadline:
-                    raise
-                time.sleep(0.2)
+        do the same via masterclient leader-chasing).  Jittered
+        exponential backoff under a deadline (util/retry.py).  Seeds
+        derive from (cluster seed, call sequence): deterministic for a
+        single-threaded chaos drive — seed 0 included — while distinct
+        per call so concurrent retriers stay decorrelated."""
+        self._retry_seq = getattr(self, "_retry_seq", 0) + 1
+        seed = (self._seed * 2_654_435_761 + self._retry_seq) \
+            & 0xFFFFFFFF
+        return RetryPolicy(total_deadline=timeout, base_delay=0.05,
+                           max_delay=0.8,
+                           rng=random.Random(seed)).call(fn)
+
+    # -- fine-grained fault injection (util/faults.py) ---------------------
+    # Chaos verbs arm rules in the process-wide fault plane, scoped to one
+    # server by key substring (volume dir / grpc address / data address).
+    # Every rule's RNG seeds from (cluster seed, injection order), so a
+    # probabilistic chaos schedule REPLAYS for a given cluster seed.
+
+    def _next_chaos_seed(self) -> int:
+        self._chaos_seq = getattr(self, "_chaos_seq", 0) + 1
+        return (self._seed * 1_000_003 + self._chaos_seq) & 0x7FFFFFFF
+
+    def inject_disk_fault(self, i: int, op: str = "pwrite",
+                          mode: str = "error", prob: float = 1.0,
+                          nth: int = 0, times: int = 0,
+                          latency: float = 0.05,
+                          torn_bytes: int = -1) -> int:
+        """Fault volume server i's disk IO.  op: pread|pwrite|fsync|
+        truncate (modes: error|enospc|latency, plus torn for pwrite) or
+        stat (latency only — a deterministic stall point between fstat
+        and return, used to force stat/append interleavings).  Returns
+        the rule id."""
+        return faults.inject(
+            f"disk.{op}", mode=mode,
+            match=os.path.abspath(self._vs_dirs[i]) + os.sep,
+            prob=prob, nth=nth, times=times, latency=latency,
+            torn_bytes=torn_bytes, seed=self._next_chaos_seed())
+
+    def inject_rpc_fault(self, i: "int | None" = None,
+                         master: "int | None" = None, method: str = "",
+                         mode: str = "drop", side: str = "call",
+                         prob: float = 1.0, nth: int = 0,
+                         times: int = 0, latency: float = 0.05) -> int:
+        """Fault the RPC surface of volume server i (or master
+        `master`).  mode: drop|delay|error; side: call (client stub) or
+        handle (server dispatch).  `method` narrows to one RPC name."""
+        if master is not None:
+            m = self.masters[master]
+            assert m is not None, "master is down"
+            addr = m.grpc_address
+        else:
+            vs = self.volume_servers[i]
+            assert vs is not None, "volume server is down"
+            addr = vs.grpc_address
+        # keys are "<addr>/<Service>/<Method>"; a tuple match requires
+        # BOTH substrings, so (addr, "/Method") scopes to one RPC on one
+        # server while addr alone blankets the server
+        match = (addr, f"/{method}") if method else addr
+        return faults.inject(
+            f"rpc.{side}", mode=mode, match=match, prob=prob,
+            nth=nth, times=times, latency=latency,
+            seed=self._next_chaos_seed())
+
+    def inject_http_fault(self, i: int, mode: str = "refuse",
+                          side: str = "request", prob: float = 1.0,
+                          nth: int = 0, times: int = 0,
+                          latency: float = 0.05) -> int:
+        """Fault volume server i's HTTP data path.  side=request hits
+        the shared client pool (refuse|reset|delay); side=serve hits the
+        serving loop (reset = truncate mid-body, delay)."""
+        vs = self.volume_servers[i]
+        assert vs is not None, "volume server is down"
+        return faults.inject(
+            f"http.{side}", mode=mode, match=vs.url, prob=prob, nth=nth,
+            times=times, latency=latency, seed=self._next_chaos_seed())
+
+    def inject_tcp_fault(self, i: int, mode: str = "refuse",
+                         prob: float = 1.0, nth: int = 0,
+                         times: int = 0) -> int:
+        """Refuse new raw-TCP frame connections to volume server i (the
+        small-blob fast path; clients must fall back to HTTP)."""
+        vs = self.volume_servers[i]
+        assert vs is not None, "volume server is down"
+        return faults.inject(
+            "tcp.connect", mode=mode,
+            match=f"{vs.http.host}:{vs.tcp.port}", prob=prob, nth=nth,
+            times=times, seed=self._next_chaos_seed())
+
+    def clear_faults(self) -> None:
+        faults.clear()
+
+    def fault_stats(self) -> list[dict]:
+        return faults.stats()
 
     # -- fault injection ---------------------------------------------------
     def kill_master(self, i: int) -> None:

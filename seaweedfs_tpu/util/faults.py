@@ -1,0 +1,205 @@
+"""Deterministic, seedable fault injection for the data plane.
+
+Real failure testing needs faults at the boundaries where production
+actually breaks — the disk syscalls, the HTTP sockets, the RPC mesh —
+not just process kills.  This module is the single registry those
+boundaries consult:
+
+- storage/backend.py hooks ``disk.pread`` / ``disk.pwrite`` /
+  ``disk.fsync`` (modes: error, torn short write, enospc, latency)
+- util/http.py hooks ``http.request`` on the pooled client (refuse,
+  reset mid-body, delay) and ``http.serve`` on the serving loop
+  (reset mid-response, delay)
+- pb/rpc.py hooks ``rpc.call`` on the client stub and ``rpc.handle``
+  on the server dispatch (drop, delay, error)
+
+Every rule carries its own ``random.Random(seed)``, so a probabilistic
+fault schedule REPLAYS exactly for a given seed: the same calls fire the
+same faults in the same order.  Rules can instead fire on the nth
+matching call (``nth``), and are bounded by ``times`` so one injection
+cannot poison an entire run.
+
+The hot paths stay free: sites call :func:`hit` only after checking the
+module-level ``ACTIVE`` flag, a single global read that is false
+whenever no rules are armed.
+
+    from seaweedfs_tpu.util import faults
+    faults.inject("disk.pwrite", match="vol0/", mode="enospc",
+                  prob=0.25, seed=7, times=3)
+    ...
+    faults.clear()
+
+``match`` is a substring test against the site's key (the file path for
+disk sites, ``host:port`` for http, ``address/Service/Method`` for rpc),
+which is how SimCluster scopes chaos verbs to one server.
+"""
+
+from __future__ import annotations
+
+import errno
+import itertools
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .weedlog import logger
+
+LOG = logger(__name__)
+
+# single-read gate for the hot paths: False <=> no rules are armed
+ACTIVE = False
+
+_LOCK = threading.Lock()
+_RULES: "list[FaultRule]" = []
+_SEQ = itertools.count(1)
+
+
+class FaultError(OSError):
+    """An injected transport/IO failure (distinguishable in logs from
+    organic errors; still an OSError so production handling paths treat
+    it exactly like the real thing)."""
+
+
+@dataclass
+class FaultRule:
+    site: str                  # "disk.pwrite", "rpc.call", ...
+    mode: str                  # site-specific action, see plan()
+    # substring of the site key ("" = all); a tuple/list means ALL
+    # substrings must be present (server AND method scoping)
+    match: "str | tuple" = ""
+    prob: float = 1.0          # fire probability per matching call
+    nth: int = 0               # fire only on the nth matching call (1-based)
+    times: int = 0             # max fires (0 = unlimited)
+    latency: float = 0.05     # seconds, for delay/latency modes
+    torn_bytes: int = -1       # short-write length (-1 = half)
+    seed: int = 0
+    rule_id: int = 0
+    _rng: random.Random = field(default_factory=random.Random, repr=False)
+    _calls: int = 0
+    _fired: int = 0
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+
+    def consider(self, key: str) -> bool:
+        """One matching call arrived; decide (deterministically) whether
+        this rule fires.  Callers hold _LOCK."""
+        if self.match:
+            needles = ((self.match,) if isinstance(self.match, str)
+                       else self.match)
+            if any(m not in key for m in needles):
+                return False
+        if self.times and self._fired >= self.times:
+            return False
+        self._calls += 1
+        if self.nth:
+            if self._calls != self.nth:
+                return False
+        elif self.prob < 1.0 and self._rng.random() >= self.prob:
+            return False
+        self._fired += 1
+        return True
+
+
+@dataclass
+class FaultPlan:
+    """What an armed rule tells the hooked site to do."""
+    mode: str
+    latency: float = 0.0
+    torn_bytes: int = -1
+    rule_id: int = 0
+
+    def error(self, what: str) -> FaultError:
+        e = FaultError(f"injected fault #{self.rule_id}: {what}")
+        if self.mode == "enospc":
+            e.errno = errno.ENOSPC
+        elif self.mode in ("refuse", "reset"):
+            e.errno = (errno.ECONNREFUSED if self.mode == "refuse"
+                       else errno.ECONNRESET)
+        else:
+            e.errno = errno.EIO
+        return e
+
+
+def inject(site: str, mode: str, match: "str | tuple" = "",
+           prob: float = 1.0,
+           nth: int = 0, times: int = 0, latency: float = 0.05,
+           torn_bytes: int = -1, seed: int = 0) -> int:
+    """Arm one rule; returns its id (for :func:`remove`).
+
+    Modes by site family:
+      disk.*   error | enospc | torn (pwrite only) | latency
+      http.*   refuse | reset | delay
+      rpc.*    drop | delay | error
+    """
+    global ACTIVE
+    rule = FaultRule(site=site, mode=mode, match=match, prob=prob,
+                     nth=nth, times=times, latency=latency,
+                     torn_bytes=torn_bytes, seed=seed)
+    with _LOCK:
+        rule.rule_id = next(_SEQ)
+        _RULES.append(rule)
+        ACTIVE = True
+    LOG.info("fault armed #%d site=%s mode=%s match=%r prob=%s nth=%s "
+             "times=%s seed=%s", rule.rule_id, site, mode, match, prob,
+             nth, times, seed)
+    return rule.rule_id
+
+
+def remove(rule_id: int) -> None:
+    global ACTIVE
+    with _LOCK:
+        _RULES[:] = [r for r in _RULES if r.rule_id != rule_id]
+        ACTIVE = bool(_RULES)
+
+
+def clear() -> None:
+    """Disarm everything (test teardown MUST call this)."""
+    global ACTIVE
+    with _LOCK:
+        _RULES.clear()
+        ACTIVE = False
+
+
+def stats() -> list[dict]:
+    """Fired/considered counters per armed rule (assertable in tests)."""
+    with _LOCK:
+        return [{"id": r.rule_id, "site": r.site, "mode": r.mode,
+                 "match": r.match, "calls": r._calls, "fired": r._fired}
+                for r in _RULES]
+
+
+def plan(site: str, key: str) -> "FaultPlan | None":
+    """The slow half of the hook: find the first armed rule that fires
+    for (site, key).  Sites call this only when ACTIVE is True."""
+    with _LOCK:
+        for r in _RULES:
+            if r.site == site and r.consider(key):
+                LOG.info("fault FIRED #%d site=%s mode=%s key=%s "
+                         "(fire %d)", r.rule_id, site, r.mode, key,
+                         r._fired)
+                return FaultPlan(mode=r.mode, latency=r.latency,
+                                 torn_bytes=r.torn_bytes,
+                                 rule_id=r.rule_id)
+    return None
+
+
+def hit(site: str, key: str) -> "FaultPlan | None":
+    """Convenience for raise-or-delay sites: sleeps through delay/latency
+    plans itself and returns None; returns the plan for modes the caller
+    must act out (error/enospc/torn/drop/refuse/reset)."""
+    p = plan(site, key)
+    if p is None:
+        return None
+    if p.mode in ("delay", "latency"):
+        time.sleep(p.latency)
+        return None
+    return p
+
+
+def raise_if_planned(site: str, key: str, what: str = "") -> None:
+    """For sites where every actionable mode is 'raise an error'."""
+    p = hit(site, key)
+    if p is not None:
+        raise p.error(what or key)

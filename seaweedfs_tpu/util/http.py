@@ -33,7 +33,7 @@ import urllib.request
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from . import tracing
+from . import faults, tracing
 from .weedlog import logger
 
 LOG = logger(__name__)
@@ -255,9 +255,13 @@ class HttpServer:
 
     # -- accept / serve loops ----------------------------------------------
     def _accept_loop(self) -> None:
+        from .retry import RetryPolicy
+        backoff = RetryPolicy(base_delay=0.05, max_delay=1.0)
+        failures = 0
         while not self._stop.is_set():
             try:
                 conn, addr = self._sock.accept()
+                failures = 0
             except OSError as e:
                 if self._stop.is_set():
                     return
@@ -268,8 +272,12 @@ class HttpServer:
                 import errno
                 if e.errno in (errno.EBADF, errno.EINVAL):
                     return
-                LOG.warning("accept failed (transient): %s", e)
-                time.sleep(0.05)
+                failures += 1
+                LOG.warning("accept failed (%d consecutive): %s",
+                            failures, e)
+                # jittered, growing pause: under EMFILE a tight retry
+                # burns the CPU the serving threads need to free fds
+                time.sleep(backoff.backoff(min(failures, 6)))
                 continue
             # Nagle + delayed-ACK adds a uniform ~40ms to every
             # request/response exchange; the data path cannot afford it
@@ -293,6 +301,8 @@ class HttpServer:
                 if req is None:       # clean EOF between requests
                     return
                 resp = self._dispatch(req)
+                if faults.ACTIVE and self._serve_fault(conn, req, resp):
+                    return            # injected mid-body reset
                 try:
                     self._emit(conn, req.method, resp, close=close)
                 except (BrokenPipeError, ConnectionResetError, OSError):
@@ -429,10 +439,25 @@ class HttpServer:
                                   else f"http {resp.status}"))
         return resp
 
+    def _serve_fault(self, conn, req: Request, resp: Response) -> bool:
+        """Serve-side chaos (util/faults.py ``http.serve``): a 'reset'
+        plan advertises the full Content-Length, sends half the body and
+        slams the connection — the torn-response shape clients must
+        survive.  Returns True when the connection was killed."""
+        p = faults.hit("http.serve", f"{self.host}:{self.port} {req.path}")
+        if p is None or p.mode != "reset":
+            return False
+        head = self._build_head(resp, close=True)
+        try:
+            conn.sendall(bytes(head) + bytes(resp.body[:len(resp.body)
+                                                       // 2]))
+            conn.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        return True
+
     @staticmethod
-    def _emit(conn, method: str, resp: Response, close: bool) -> None:
-        """Prebuilt status line + cached Date + ONE gather-write of head
-        and body (see _sendmsg_all)."""
+    def _build_head(resp: Response, close: bool) -> bytearray:
         head = bytearray(_status_line(resp.status))
         head += _SERVER_HDR
         head += _date_header()
@@ -450,6 +475,13 @@ class HttpServer:
         if close:
             head += b"Connection: close\r\n"
         head += b"\r\n"
+        return head
+
+    @classmethod
+    def _emit(cls, conn, method: str, resp: Response, close: bool) -> None:
+        """Prebuilt status line + cached Date + ONE gather-write of head
+        and body (see _sendmsg_all)."""
+        head = cls._build_head(resp, close)
         if method != "HEAD" and resp.body:
             _sendmsg_all(conn, [bytes(head), resp.body])
         else:
@@ -607,6 +639,20 @@ class ConnectionPool:
                 "TLS in front (the reference uses mTLS on gRPC, plain "
                 "HTTP on the data path)")
         key = (parsed.hostname, parsed.port)
+        if faults.ACTIVE:
+            # client-side chaos: connect refusal / reset surface as the
+            # REAL exception types so callers' failover paths run
+            # organically (faults.py)
+            p = faults.hit("http.request",
+                           f"{parsed.hostname}:{parsed.port}")
+            if p is not None:
+                if p.mode == "refuse":
+                    raise ConnectionRefusedError(
+                        f"injected fault #{p.rule_id}: connect refused "
+                        f"{parsed.netloc}")
+                raise ConnectionResetError(
+                    f"injected fault #{p.rule_id}: reset by "
+                    f"{parsed.netloc}")
         path = parsed.path + (f"?{parsed.query}" if parsed.query else "")
         for attempt in (0, 1):
             conn, reused = self._acquire(key, timeout,
@@ -670,12 +716,17 @@ def reset_connection_pool(size: "int | None" = None,
 
 
 def http_request(url: str, method: str = "GET", body: bytes | None = None,
-                 headers: dict | None = None, timeout: float = 30.0
+                 headers: dict | None = None,
+                 timeout: "float | None" = None
                  ) -> tuple[int, bytes, dict]:
     """-> (status, body, headers); non-2xx does NOT raise.  Keep-alive
     pooled per host (bounded by WEED_HTTP_POOL).  Propagates the ambient
     trace id (X-Trace-Id) so multi-hop requests correlate across
-    servers."""
+    servers.  ``timeout=None`` takes WEED_HTTP_TIMEOUT (util/retry.py)
+    — one knob for the fleet, not a constant per call site."""
+    if timeout is None:
+        from .retry import default_http_timeout
+        timeout = default_http_timeout()
     if not url.startswith("http"):
         url = "http://" + url
     headers = dict(headers or {})
@@ -685,7 +736,7 @@ def http_request(url: str, method: str = "GET", body: bytes | None = None,
     return _POOL.request(url, method, body, headers, timeout)
 
 
-def http_get_json(url: str, timeout: float = 30.0) -> dict:
+def http_get_json(url: str, timeout: "float | None" = None) -> dict:
     status, body, _ = http_request(url, timeout=timeout)
     out = json.loads(body) if body else {}
     if status >= 400:

@@ -1,0 +1,164 @@
+"""RetryPolicy — the one retry/deadline vocabulary for the data plane.
+
+Before this module every layer had its own loop: fixed 0.2s polls in the
+test harness, bare ``while True`` reconnects in wdclient, hardcoded 30s
+socket timeouts in the RPC stub and HTTP client.  Fixed-interval retries
+synchronize clients into thundering herds and unbounded timeouts turn a
+dead peer into a hung request; the antidote is the same everywhere —
+jittered exponential backoff under a total deadline, with a per-attempt
+timeout so one black-holed call cannot eat the whole budget.
+
+    policy = RetryPolicy(total_deadline=8.0, base_delay=0.05)
+    result = policy.call(lambda: client.call("Assign", req))
+
+``call`` retries on the exception types in ``retry_on`` until the
+deadline (or ``max_attempts``) is exhausted, then re-raises the last
+error.  ``attempts()`` is the loop-shaped flavor for callers that need
+per-attempt control.
+
+Per-attempt timeouts for blocking APIs that accept one (gRPC calls,
+socket connects) come from :func:`default_rpc_timeout` /
+:func:`default_http_timeout` / :func:`default_connect_timeout`, which
+honor the ``WEED_RPC_TIMEOUT`` / ``WEED_HTTP_TIMEOUT`` /
+``WEED_CONNECT_TIMEOUT`` env knobs so operators can tighten the fleet
+without a deploy.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, TypeVar
+
+T = TypeVar("T")
+
+
+def _env_seconds(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def default_rpc_timeout() -> float:
+    """Per-attempt deadline for control-plane gRPC calls
+    (WEED_RPC_TIMEOUT, default 30s like the reference's grpc dial)."""
+    return _env_seconds("WEED_RPC_TIMEOUT", 30.0)
+
+
+def default_http_timeout() -> float:
+    """Per-attempt socket timeout for data-plane HTTP hops
+    (WEED_HTTP_TIMEOUT)."""
+    return _env_seconds("WEED_HTTP_TIMEOUT", 30.0)
+
+
+def default_connect_timeout() -> float:
+    """TCP connect budget for the raw frame fast path
+    (WEED_CONNECT_TIMEOUT).  Connects either succeed in RTT time or
+    the port is dead — far shorter than a request timeout."""
+    return _env_seconds("WEED_CONNECT_TIMEOUT", 5.0)
+
+
+@dataclass
+class Attempt:
+    """One iteration handed out by RetryPolicy.attempts()."""
+    number: int               # 1-based
+    remaining: float          # seconds left in the total deadline
+    timeout: float            # suggested per-attempt timeout
+
+
+@dataclass
+class RetryPolicy:
+    """Jittered exponential backoff + total deadline + per-attempt cap.
+
+    ``total_deadline`` bounds the whole operation (all attempts plus
+    sleeps).  ``max_attempts=0`` means attempts are bounded by the
+    deadline alone.  Jitter is uniform in
+    ``[delay*(1-jitter), delay*(1+jitter)]`` — decorrelated enough that
+    retries from many clients do not re-synchronize.  A seeded ``rng``
+    makes schedules reproducible in tests.
+    """
+
+    total_deadline: float = 10.0
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5
+    max_attempts: int = 0
+    per_attempt_timeout: float = 0.0   # 0 = min(deadline remainder, rpc default)
+    retry_on: tuple = (Exception,)
+    rng: random.Random = field(default_factory=random.Random, repr=False)
+
+    def backoff(self, attempt: int) -> float:
+        """Sleep before attempt N+1 (after the Nth failure), jittered.
+        Safe for unbounded failure counters: the exponent is clamped
+        (2.0**1024 raises OverflowError, which would kill the reconnect
+        loops that feed this ever-growing counts)."""
+        exp = min(max(attempt - 1, 0), 64)
+        delay = min(self.max_delay,
+                    self.base_delay * (self.multiplier ** exp))
+        if self.jitter:
+            delay *= 1.0 + self.jitter * (2.0 * self.rng.random() - 1.0)
+        return max(0.0, delay)
+
+    def _timeout_for(self, remaining: float) -> float:
+        cap = self.per_attempt_timeout or default_rpc_timeout()
+        return max(0.001, min(cap, remaining))
+
+    def attempts(self) -> Iterator[Attempt]:
+        """Yield attempts until deadline/max_attempts run out, sleeping
+        the backoff between them.  The caller breaks on success; the
+        first attempt is always granted."""
+        deadline = time.time() + self.total_deadline
+        n = 0
+        while True:
+            n += 1
+            remaining = max(deadline - time.time(), 0.001)
+            yield Attempt(number=n, remaining=remaining,
+                          timeout=self._timeout_for(remaining))
+            # still here => the caller's attempt failed
+            if self.max_attempts and n >= self.max_attempts:
+                return
+            sleep = min(self.backoff(n), deadline - time.time())
+            if deadline - time.time() <= 0:
+                return
+            if sleep > 0:
+                time.sleep(sleep)
+
+    def call(self, fn: Callable[[], T], describe: str = "") -> T:
+        """Run ``fn`` under this policy; re-raises the last error once
+        the budget is spent.  ``describe`` names the operation in the
+        raised error's chain for log forensics."""
+        last: "BaseException | None" = None
+        for attempt in self.attempts():
+            try:
+                return fn()
+            except self.retry_on as e:     # noqa: PERF203 (retry loop)
+                last = e
+        if last is None:
+            raise TimeoutError(
+                f"retry budget empty before first attempt"
+                f"{': ' + describe if describe else ''}")
+        raise last
+
+
+# Shared profiles.  These are starting points, not mandates — callers
+# with tighter SLOs construct their own.
+
+def cluster_default(total_deadline: float = 8.0,
+                    seed: "int | None" = None) -> RetryPolicy:
+    """Client-through-election profile: what upload/read helpers use to
+    ride out a raft leader change or a heartbeat re-registration gap."""
+    return RetryPolicy(total_deadline=total_deadline, base_delay=0.05,
+                       max_delay=1.0,
+                       rng=random.Random(seed))
+
+
+def background_reconnect(seed: "int | None" = None) -> RetryPolicy:
+    """Long-lived stream reconnect profile (wdclient KeepConnected,
+    heartbeat loops, filer sync): effectively no deadline, backoff
+    capped low enough that recovery after a master restart is quick."""
+    return RetryPolicy(total_deadline=float("inf"), base_delay=0.2,
+                       max_delay=5.0, rng=random.Random(seed))

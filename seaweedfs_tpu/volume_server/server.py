@@ -104,6 +104,10 @@ class VolumeServer:
         self.needle_cache = HotNeedleCache()
         self.pulse_seconds = pulse_seconds
         self.store = Store(directories, max_volume_counts)
+        # a disk fault that degrades a volume to read-only must reach
+        # the master NOW, not a pulse later — one heartbeat is the
+        # acceptance window for the master to stop assigning there
+        self.store.set_on_degrade(self._on_volume_degraded)
         self.http = HttpServer(host, port)
         self.rpc = RpcServer(host, grpc_port)
         self.volume_size_limit = 0
@@ -234,6 +238,14 @@ class VolumeServer:
             return True
         except RpcError:
             return False
+
+    def _on_volume_degraded(self, vid: int) -> None:
+        """A write-path IO fault flipped volume `vid` read-only
+        (storage/volume.py _degrade): push the state to the master
+        immediately so the very next Assign excludes it."""
+        LOG.warning("volume %d degraded; pushing immediate heartbeat",
+                    vid)
+        self._hb_wake.set()
 
     def heartbeat_now(self, timeout: float = 5.0) -> None:
         """Push a fresh snapshot through the PERSISTENT stream and wait for
@@ -1034,10 +1046,20 @@ class VolumeServer:
     # vacuum
     def _rpc_vacuum_check(self, req: dict) -> dict:
         v = self._find_volume(req)
+        if v.read_only:
+            # frozen (ec.encode snapshot in flight) or degraded (dying
+            # disk): report clean so the master's sweep skips it — a
+            # compact would swap .dat/.idx under the encoder's by-path
+            # reads, or write .cpd to a disk that just failed
+            return {"garbage_ratio": 0.0}
         return {"garbage_ratio": v.garbage_level()}
 
     def _rpc_vacuum_compact(self, req: dict) -> dict:
-        reclaimed = self._find_volume(req).vacuum()
+        v = self._find_volume(req)
+        if v.read_only:
+            raise RpcError(f"volume {v.id} is read-only "
+                           f"(frozen/degraded); refusing compact")
+        reclaimed = v.vacuum()
         return {"reclaimed_bytes": reclaimed}
 
     def _rpc_vacuum_commit(self, req: dict) -> dict:
@@ -1141,6 +1163,13 @@ class VolumeServer:
         v = self.store.find_volume(vid)
         if v is None:
             raise RpcError(f"volume {vid} not found")
+        # freeze + drain BEFORE snapshotting: the encoder reads .idx and
+        # .dat by path outside the volume lock, so a straggler write
+        # already past the orchestration's mark-readonly would otherwise
+        # append AFTER the .ecx snapshot — an acked needle the EC volume
+        # then doesn't index (the soak's lost-write sibling of the
+        # stat/append race)
+        v.freeze_writes()
         v.sync()
         # swap-point forensics: record the (map size, dat size) pair
         # this encode froze, under the orchestrator's trace id — if the

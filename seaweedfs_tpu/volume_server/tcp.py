@@ -161,7 +161,9 @@ def _reply_error_and_drain(conn: socket.socket, msg: str,
     try:
         send_err(msg.encode())
         conn.shutdown(socket.SHUT_WR)
-        conn.settimeout(1.0)
+        # drain cap, not a request timeout: bounds how long the
+        # teardown babysits a desynced peer
+        conn.settimeout(1.0)  # weedlint: disable=WL060
         drained = 0
         while drained < (1 << 20):
             piece = conn.recv(64 << 10)

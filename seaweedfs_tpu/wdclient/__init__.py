@@ -17,6 +17,7 @@ import threading
 import time
 
 from ..pb.rpc import POOL, RpcError
+from ..util.retry import background_reconnect
 from ..util.weedlog import logger
 
 LOG = logger(__name__)
@@ -24,6 +25,11 @@ LOG = logger(__name__)
 # RPC-fallback location entries expire after the freshest staleness tier
 # the volume servers use for their own lookups (store_ec.go:227)
 LOOKUP_TTL = 11.0
+# empty/failed lookups are cached too — briefly.  A dead vid hammered by
+# readers must cost the master one RPC per TTL, not one per read; one
+# second keeps the storm bounded while a just-heartbeated volume still
+# becomes visible within a pulse.
+NEGATIVE_LOOKUP_TTL = 1.0
 
 
 def resolve_leader(masters: str, timeout: float = 2.0) -> str:
@@ -98,6 +104,10 @@ class MasterClient:
                                            if e["url"] != loc["url"]]
 
     def _keep_connected_loop(self) -> None:
+        # jittered backoff between reconnects: a master restart must not
+        # see every client re-dial on the same fixed beat
+        policy = background_reconnect()
+        failures = 0
         while not self._stop.is_set():
             try:
                 client = POOL.client(self.master_grpc, "Seaweed")
@@ -105,12 +115,16 @@ class MasterClient:
                         "KeepConnected",
                         iter([{"client_type": self.client_type,
                                "client_name": self.client_name}])):
+                    failures = 0
                     self._apply(msg)
                     if self._stop.is_set():
                         break
-            except RpcError:
-                pass
-            self._stop.wait(1.0)
+            except RpcError as e:
+                failures += 1
+                LOG.debug("KeepConnected stream to %s failed "
+                          "(%d consecutive): %s", self.master_grpc,
+                          failures, e)
+            self._stop.wait(policy.backoff(max(failures, 1)))
             if self.masters and not self._stop.is_set():
                 # the homed master may be dead; chase the current leader
                 try:
@@ -126,7 +140,10 @@ class MasterClient:
             if not cached:
                 rpc = self._vid_rpc.get(vid)
                 if rpc and rpc[0] > now:
-                    cached = rpc[1]
+                    # an unexpired entry answers even when EMPTY: the
+                    # negative cache is what keeps a dead vid from
+                    # storming the master with one RPC per read
+                    return list(rpc[1])
         if cached:
             return list(cached)
         try:
@@ -135,13 +152,15 @@ class MasterClient:
                               {"volume_or_file_ids": [str(vid)]})
             locs = out["volume_id_locations"][str(vid)]["locations"]
         except (RpcError, KeyError):
-            return []
+            locs = []
         with self._lock:
             if locs:
                 # TTL'd, NOT permanent: the stream owns long-lived
                 # entries; a fallback answer must age out or a volume
                 # move strands every reader on the dead location
                 self._vid_rpc[vid] = (now + LOOKUP_TTL, locs)
+            else:
+                self._vid_rpc[vid] = (now + NEGATIVE_LOOKUP_TTL, [])
         return locs
 
     def lookup_file_id(self, fid: str) -> list[str]:

@@ -1,0 +1,153 @@
+"""Chaos suite: seeded fine-grained faults against a live SimCluster.
+
+The acceptance bar (ISSUE 6): under seeded disk faults and replica
+kills, zero acked-write loss, reads succeed with one replica down, and
+a faulted volume flips read-only and is excluded from new assigns
+within one heartbeat.
+"""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.testing import SimCluster
+from seaweedfs_tpu.util import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    # the client-side negative caches must not leak chaos verdicts
+    # between tests (ports get reused across clusters)
+    operation._TCP_DEAD.clear()
+    operation._HTTP_DEAD.clear()
+    operation._TCP_ROUTE.clear()
+    operation._LOOKUP_CACHE.clear()
+    yield
+    faults.clear()
+    operation._TCP_DEAD.clear()
+    operation._HTTP_DEAD.clear()
+    operation._TCP_ROUTE.clear()
+    operation._LOOKUP_CACHE.clear()
+
+
+def test_disk_fault_degrades_volume_and_master_stops_assigning(tmp_path):
+    """A write-path disk fault flips the volume read-only; the nudged
+    heartbeat excludes it from new assigns within one pulse; reads of
+    already-acked data keep working; no acked write is lost."""
+    with SimCluster(volume_servers=2, base_dir=str(tmp_path),
+                    pulse_seconds=0.3) as c:
+        acked = {c.upload(b"seed-%d" % i): b"seed-%d" % i
+                 for i in range(8)}
+        # every write to server 0's disk now dies with ENOSPC
+        c.inject_disk_fault(0, op="pwrite", mode="enospc")
+        degraded: set[int] = set()
+        still_acked = 0
+        deadline = time.time() + 10
+        while time.time() < deadline and not degraded:
+            data = b"post-fault-%d" % still_acked
+            try:
+                fid = c.upload(data)
+            except Exception:
+                continue     # un-acked: allowed to fail, must not lose
+            acked[fid] = data
+            still_acked += 1
+            for loc in c.volume_servers[0].store.locations:
+                degraded |= {vid for vid, v in loc.volumes.items()
+                             if v.read_only and v.degraded_reason}
+        assert degraded, "no volume degraded under a 100% write fault"
+        # within one heartbeat the master must stop assigning there
+        c.sync_heartbeats()
+        m = c.masters[c.leader_index()]
+        for layout in m.topo.layouts.values():
+            assert not (degraded & layout.writables)
+        # un-fault the disk: READS of every acked fid must succeed
+        # (degraded volume still serves; new writes went elsewhere)
+        c.clear_faults()
+        for fid, want in acked.items():
+            assert c.read(fid) == want, fid
+
+
+def test_reads_survive_one_replica_down(tmp_path):
+    """Replicated reads fail over: with one holder hard-killed, every
+    acked blob still reads (the failover walk + negative caches)."""
+    with SimCluster(volume_servers=2, racks=2, base_dir=str(tmp_path),
+                    pulse_seconds=0.3) as c:
+        acked = {}
+        for i in range(10):
+            data = b"r-%d" % i
+            acked[c.upload(data, replication="010")] = data
+        c.kill_volume_server(1)
+        for fid, want in acked.items():
+            assert c.read(fid) == want, fid
+        # and repeat reads stay fast-pathed through the survivor
+        for fid, want in list(acked.items())[:3]:
+            assert c.read(fid) == want, fid
+
+
+def test_rpc_fault_drop_is_ridden_out_by_retry(tmp_path):
+    """A dropped master Assign surfaces as RpcError; the harness retry
+    policy (jittered, deadline-bounded) rides through it."""
+    with SimCluster(volume_servers=1, base_dir=str(tmp_path)) as c:
+        c.inject_rpc_fault(master=0, method="Assign", mode="drop",
+                           side="call", nth=1, times=1)
+        fid = c.upload(b"made it")
+        assert c.read(fid) == b"made it"
+        fired = [s for s in c.fault_stats() if s["site"] == "rpc.call"]
+        assert fired and fired[0]["fired"] == 1
+
+
+def test_http_midbody_reset_does_not_corrupt_reads(tmp_path):
+    """A serve-side reset truncates one response mid-body; the client
+    must never accept the truncated bytes as the blob."""
+    with SimCluster(volume_servers=1, base_dir=str(tmp_path)) as c:
+        data = b"Z" * 4096
+        fid = c.upload(data)
+        # force the HTTP path (kill the TCP fast route) and reset the
+        # first served response mid-body
+        c.inject_tcp_fault(0, mode="refuse")
+        c.inject_http_fault(0, side="serve", mode="reset", nth=1,
+                            times=1)
+        got = c.read(fid)
+        assert got == data
+
+
+def test_seeded_chaos_schedule_replays(tmp_path):
+    """Two clusters with the same seed arm rule RNGs identically: the
+    per-call fire/skip schedule is reproducible."""
+    def schedule(seed):
+        faults.clear()
+        with SimCluster(volume_servers=1, base_dir=str(tmp_path /
+                                                       f"s{seed}"),
+                        seed=seed) as c:
+            rid = c.inject_disk_fault(0, op="pread", mode="error",
+                                      prob=0.5)
+            rule = [r for r in faults._RULES if r.rule_id == rid][0]
+            return [rule._rng.random() for _ in range(32)]
+
+    assert schedule(7) == schedule(7)
+    assert schedule(7) != schedule(8)
+
+
+def test_probabilistic_disk_faults_zero_acked_loss(tmp_path):
+    """The headline guarantee: under seeded probabilistic disk faults on
+    one server, every write the client was ACKED for reads back intact;
+    failed writes fail loudly."""
+    with SimCluster(volume_servers=2, base_dir=str(tmp_path),
+                    pulse_seconds=0.3, seed=2024) as c:
+        c.inject_disk_fault(0, op="pwrite", mode="error", prob=0.3)
+        acked = {}
+        rejected = 0
+        for i in range(40):
+            data = b"blob-%d" % i
+            try:
+                fid = operation.assign_and_upload(c.master_grpc, data)
+            except Exception:
+                rejected += 1
+                continue
+            acked[fid] = data
+        c.clear_faults()
+        assert acked, "nothing got through"
+        for fid, want in acked.items():
+            assert c.read(fid) == want, fid

@@ -164,6 +164,23 @@ def test_master_client_vid_cache(cluster):
     mc.stop()
 
 
+def test_master_client_negative_lookup_cached(cluster):
+    """A missing/failed vid lookup is negative-cached briefly: a dead
+    vid hammered by readers costs the master ONE LookupVolume RPC per
+    negative TTL, not one per read (ISSUE 6 satellite)."""
+    master, _servers = cluster
+    mc = MasterClient(master.grpc_address)      # no stream: RPC path
+    before = master.metrics.master_lookup.value()
+    dead_vid = 999_999
+    for _ in range(10):
+        assert mc.lookup(dead_vid) == []
+    rpcs = master.metrics.master_lookup.value() - before
+    assert rpcs == 1, f"negative lookup not cached: {rpcs} RPCs"
+    # the entry ages out (1s TTL) rather than pinning the miss forever
+    entry = mc._vid_rpc[dead_vid]
+    assert entry[1] == [] and entry[0] <= time.time() + 1.05
+
+
 def test_ec_encode_spread_degraded_read(cluster):
     """The SURVEY §3.5 flow: encode a volume to EC shards via the TPU codec,
     spread shards over servers, drop the source volume, read through any

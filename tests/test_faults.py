@@ -1,0 +1,242 @@
+"""Unit coverage for the deterministic fault plane (util/faults.py) and
+the unified RetryPolicy (util/retry.py)."""
+
+import random
+import time
+
+import pytest
+
+from seaweedfs_tpu.storage.backend import DiskFile
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume, VolumeError
+from seaweedfs_tpu.util import faults
+from seaweedfs_tpu.util.retry import RetryPolicy
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# -- fault plane ------------------------------------------------------------
+
+def test_inactive_plane_is_free(tmp_path):
+    assert not faults.ACTIVE
+    f = DiskFile(str(tmp_path / "x.dat"))
+    f.write_at(b"hello", 0)
+    assert f.read_at(5, 0) == b"hello"
+    f.close()
+
+
+def test_match_scopes_by_substring(tmp_path):
+    faults.inject("disk.pwrite", mode="error", match="volA/")
+    (tmp_path / "volA").mkdir()
+    (tmp_path / "volB").mkdir()
+    fa = DiskFile(str(tmp_path / "volA" / "1.dat"))
+    fb = DiskFile(str(tmp_path / "volB" / "1.dat"))
+    with pytest.raises(OSError):
+        fa.write_at(b"x", 0)
+    assert fb.write_at(b"x", 0) == 1      # other server untouched
+    fa.close()
+    fb.close()
+
+
+def test_tuple_match_requires_all_substrings():
+    faults.inject("rpc.call", mode="drop", match=("127.0.0.1:99", "/Assign"))
+    assert faults.plan("rpc.call", "127.0.0.1:99/Seaweed/Assign") is not None
+    assert faults.plan("rpc.call", "127.0.0.1:99/Seaweed/Lookup") is None
+    assert faults.plan("rpc.call", "127.0.0.1:11/Seaweed/Assign") is None
+
+
+def test_enospc_sets_errno(tmp_path):
+    import errno
+    faults.inject("disk.pwrite", mode="enospc")
+    f = DiskFile(str(tmp_path / "1.dat"))
+    with pytest.raises(OSError) as ei:
+        f.write_at(b"data", 0)
+    assert ei.value.errno == errno.ENOSPC
+    f.close()
+
+
+def test_torn_write_leaves_prefix_on_disk(tmp_path):
+    faults.inject("disk.pwrite", mode="torn", torn_bytes=3)
+    f = DiskFile(str(tmp_path / "1.dat"))
+    with pytest.raises(OSError):
+        f.write_at(b"abcdef", 0)
+    faults.clear()
+    assert f.read_at(16, 0) == b"abc"     # the torn prefix persisted
+    f.close()
+
+
+def test_nth_call_and_times_bound(tmp_path):
+    faults.inject("disk.pread", mode="error", nth=2, times=1)
+    f = DiskFile(str(tmp_path / "1.dat"))
+    f.write_at(b"abc", 0)
+    assert f.read_at(3, 0) == b"abc"      # call 1: clean
+    with pytest.raises(OSError):
+        f.read_at(3, 0)                   # call 2: fires
+    assert f.read_at(3, 0) == b"abc"      # times=1 exhausted
+    f.close()
+
+
+def test_probabilistic_schedule_replays_for_seed():
+    def run(seed):
+        faults.clear()
+        faults.inject("disk.pread", mode="error", prob=0.4, seed=seed)
+        fired = []
+        for i in range(50):
+            fired.append(faults.plan("disk.pread", f"k{i}") is not None)
+        return fired
+
+    a, b = run(1234), run(1234)
+    assert a == b                          # deterministic replay
+    assert run(99) != a                    # and seed-sensitive
+    assert 5 < sum(a) < 45                 # actually probabilistic
+
+
+def test_latency_mode_delays_not_raises(tmp_path):
+    faults.inject("disk.pread", mode="latency", latency=0.15, times=1)
+    f = DiskFile(str(tmp_path / "1.dat"))
+    f.write_at(b"abc", 0)
+    t0 = time.time()
+    assert f.read_at(3, 0) == b"abc"
+    assert time.time() - t0 >= 0.13
+    f.close()
+
+
+def test_stats_expose_fired_counts():
+    rid = faults.inject("rpc.call", mode="drop", times=2)
+    faults.plan("rpc.call", "x")
+    faults.plan("rpc.call", "x")
+    faults.plan("rpc.call", "x")          # beyond times: no fire
+    st = [s for s in faults.stats() if s["id"] == rid][0]
+    assert st["fired"] == 2
+
+
+def test_write_fault_degrades_volume_to_readonly(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    v.write_needle(Needle(id=1, cookie=1, data=b"ok"))
+    seen = []
+    v.on_degrade = seen.append
+    faults.inject("disk.pwrite", mode="enospc", times=1)
+    with pytest.raises(VolumeError, match="degraded"):
+        v.write_needle(Needle(id=2, cookie=2, data=b"x" * 100))
+    assert v.read_only
+    assert "write" in v.degraded_reason
+    assert seen == [1]
+    # reads keep working on the degraded volume
+    assert bytes(v.read_needle(1).data) == b"ok"
+    # further writes are refused cleanly (read-only), not as IO errors
+    faults.clear()
+    with pytest.raises(VolumeError, match="read-only"):
+        v.write_needle(Needle(id=3, cookie=3, data=b"y"))
+    v.close()
+
+
+def test_group_commit_fsync_failure_restores_prior_version(tmp_path):
+    """A failed batch fsync must roll a same-id durable overwrite back
+    to its acked prior version (not a tombstone), degrade the volume,
+    and keep the worker alive so later durable writes fail FAST."""
+    v = Volume(str(tmp_path), "", 1)
+    v.write_needle(Needle(id=5, cookie=5, data=b"v1" * 50), fsync=True)
+    faults.inject("disk.fsync", mode="error", times=1)
+    fut = v.write_needle_durable(Needle(id=5, cookie=5, data=b"v2" * 50))
+    with pytest.raises(OSError):
+        fut.result(timeout=10)
+    faults.clear()
+    assert v.read_only and "fsync" in v.degraded_reason
+    # prior acked version survived the rollback
+    assert bytes(v.read_needle(5).data) == b"v1" * 50
+    # the worker is alive and further durable writes fail promptly with
+    # the read-only error, not a queue hang
+    fut2 = v.write_needle_durable(Needle(id=6, cookie=6, data=b"x"))
+    with pytest.raises(VolumeError, match="read-only"):
+        fut2.result(timeout=5)
+    v.close()
+
+
+# -- retry policy -----------------------------------------------------------
+
+def test_retrypolicy_eventually_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("nope")
+        return "ok"
+
+    p = RetryPolicy(total_deadline=5.0, base_delay=0.01,
+                    rng=random.Random(1))
+    assert p.call(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_retrypolicy_deadline_bounds_total_time():
+    p = RetryPolicy(total_deadline=0.3, base_delay=0.05,
+                    rng=random.Random(1))
+    t0 = time.time()
+    with pytest.raises(ValueError):
+        p.call(lambda: (_ for _ in ()).throw(ValueError("always")))
+    assert time.time() - t0 < 1.5
+
+
+def test_retrypolicy_max_attempts():
+    calls = []
+    p = RetryPolicy(total_deadline=30.0, base_delay=0.001, max_attempts=4)
+    with pytest.raises(RuntimeError):
+        p.call(lambda: calls.append(1) or (_ for _ in ()).throw(
+            RuntimeError()))
+    assert len(calls) == 4
+
+
+def test_backoff_grows_and_jitters_within_band():
+    p = RetryPolicy(base_delay=0.1, multiplier=2.0, max_delay=10.0,
+                    jitter=0.5, rng=random.Random(7))
+    for attempt, nominal in ((1, 0.1), (2, 0.2), (3, 0.4)):
+        for _ in range(20):
+            d = p.backoff(attempt)
+            assert nominal * 0.5 <= d <= nominal * 1.5
+
+
+def test_backoff_survives_unbounded_failure_counts():
+    """Reconnect loops feed ever-growing consecutive-failure counts;
+    the exponent must clamp (2.0**1024 raises OverflowError, which
+    would kill the daemon thread)."""
+    p = RetryPolicy(base_delay=0.2, max_delay=5.0, jitter=0.0)
+    for attempt in (1, 64, 1025, 10_000_000):
+        assert 0.0 <= p.backoff(attempt) <= 5.0
+
+
+def test_backoff_schedule_replays_for_seed():
+    a = RetryPolicy(rng=random.Random(42))
+    b = RetryPolicy(rng=random.Random(42))
+    assert [a.backoff(i) for i in range(1, 6)] \
+        == [b.backoff(i) for i in range(1, 6)]
+
+
+def test_retrypolicy_only_retries_listed_types():
+    p = RetryPolicy(total_deadline=5.0, retry_on=(ConnectionError,))
+    calls = []
+
+    def wrong_type():
+        calls.append(1)
+        raise ValueError("not retryable")
+
+    with pytest.raises(ValueError):
+        p.call(wrong_type)
+    assert len(calls) == 1
+
+
+def test_attempts_timeout_shrinks_toward_deadline():
+    p = RetryPolicy(total_deadline=0.5, per_attempt_timeout=30.0,
+                    base_delay=0.01, rng=random.Random(3))
+    timeouts = []
+    for att in p.attempts():
+        timeouts.append(att.timeout)
+        if att.number >= 3:
+            break
+    assert all(t <= 0.5 for t in timeouts)
+    assert timeouts == sorted(timeouts, reverse=True)

@@ -12,6 +12,49 @@ import pytest
 from seaweedfs_tpu import operation
 from seaweedfs_tpu.pb.rpc import POOL
 from seaweedfs_tpu.testing import SimCluster
+from seaweedfs_tpu.util import faults
+
+
+def test_stat_append_interleaving_regression(tmp_path):
+    """Regression for the soak's persistent SizeMismatchError (ROADMAP
+    seed bug, root-caused in ISSUE 6).
+
+    The losing interleaving: a lock-free stat path (heartbeat collect /
+    VacuumVolumeCheck -> content_size -> DiskFile.get_stat) fstats the
+    .dat, gets descheduled under CPU overload, a locked writer appends
+    needle A and advances the cached EOF — then the stat path resumed
+    and WROTE THE STALE st_size BACK into the cache.  The next append
+    (needle B) landed at A's offset, overwriting A's acked record: the
+    needle map then disagreed with .dat durably, and every read of A
+    failed SizeMismatchError forever (vacuum/ec-encode sealed the torn
+    state into .cpd/.ecx, which is why the soak saw it persist).
+
+    This test forces that exact schedule deterministically via the
+    ``disk.stat`` fault hook: stall get_stat after its fstat while an
+    append lands, then append again.  With the fix (get_stat no longer
+    writes the cached EOF) both needles read back intact.
+    """
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(str(tmp_path), "", 1)
+    try:
+        v.write_needle(Needle(id=1, cookie=1, data=b"A" * 2706))
+        faults.inject("disk.stat", mode="latency", match="1.dat",
+                      latency=0.4, times=1)
+        stat_thread = threading.Thread(target=v.content_size)
+        stat_thread.start()
+        time.sleep(0.1)        # stat thread is now stalled post-fstat
+        v.write_needle(Needle(id=2, cookie=2, data=b"B" * 2706))
+        stat_thread.join()     # historical bug: rolls cached EOF back
+        v.write_needle(Needle(id=3, cookie=3, data=b"C" * 1978))
+        # pre-fix: needle 3 overwrote needle 2's record; reading 2
+        # raised SizeMismatchError persistently
+        assert bytes(v.read_needle(2).data) == b"B" * 2706
+        assert bytes(v.read_needle(3).data) == b"C" * 1978
+    finally:
+        faults.clear()
+        v.close()
 
 
 @pytest.mark.parametrize("seconds", [8])

@@ -56,6 +56,11 @@ def test_bad_resource_fixture():
     assert got == [("WL040", 8), ("WL040", 13), ("WL040", 17)]
 
 
+def test_bad_retry_fixture():
+    got = _ids_lines(_findings(os.path.join(FIXTURES, "bad_retry.py")))
+    assert got == [("WL060", 12), ("WL060", 16), ("WL060", 20)]
+
+
 def test_bad_dataplane_fixture():
     got = _ids_lines(_findings(os.path.join(FIXTURES, "bad_dataplane.py")))
     assert got == [("WL050", 7), ("WL050", 9), ("WL050", 16)]
@@ -157,5 +162,6 @@ def test_cli_list_checkers():
     r = _run_cli("--list-checkers")
     assert r.returncode == 0
     for cid in ("WL001", "WL002", "WL010", "WL011", "WL012",
-                "WL020", "WL021", "WL022", "WL030", "WL040"):
+                "WL020", "WL021", "WL022", "WL030", "WL040",
+                "WL050", "WL060"):
         assert cid in r.stdout

@@ -1,0 +1,40 @@
+"""WL060 corpus: constant-sleep retry loops / hardcoded socket
+timeouts."""
+import socket
+import time
+
+
+def fetch_with_fixed_retry(fn):
+    while True:
+        try:
+            return fn()
+        except OSError:
+            time.sleep(0.2)                     # constant, no deadline
+
+
+def connect(addr):
+    return socket.create_connection(addr, timeout=30)   # hardcoded
+
+
+def tune(sock):
+    sock.settimeout(30.0)                       # hardcoded
+
+
+def poll_until(fn, deadline_seconds=5.0):
+    # clean: deadline-bounded wait
+    deadline = time.time() + deadline_seconds
+    while time.time() < deadline:
+        try:
+            return fn()
+        except OSError:
+            time.sleep(0.1)
+    raise TimeoutError
+
+
+def backoff_loop(fn, policy):
+    # clean: sleeps come from the shared policy
+    for attempt in range(5):
+        try:
+            return fn()
+        except OSError:
+            time.sleep(policy.backoff(attempt))

@@ -6,3 +6,4 @@ from . import wire         # noqa: F401
 from . import exceptions   # noqa: F401
 from . import resources    # noqa: F401
 from . import dataplane    # noqa: F401
+from . import retryhygiene  # noqa: F401

@@ -1,0 +1,123 @@
+"""WL060 retry-hygiene — constant-sleep retry loops without a deadline,
+and hardcoded socket timeouts, in dataplane modules.
+
+ISSUE 6 unified retry/deadline policy into ``util/retry.RetryPolicy``
+(jittered exponential backoff + total deadline + per-attempt timeout)
+and made socket/RPC timeouts env-tunable.  This checker keeps the two
+regressions out:
+
+- A ``for``/``while`` loop that both catches exceptions (``try`` in its
+  body) and sleeps a NUMERIC LITERAL (``time.sleep(0.2)``) is the
+  fixed-interval retry shape: clients synchronize into thundering herds
+  and nothing bounds the total wait.  The loop is clean when its
+  enclosing function mentions a deadline (a name containing
+  ``deadline`` or ``remaining``) or uses RetryPolicy machinery
+  (``RetryPolicy`` / ``.attempts`` / ``.backoff``).
+- ``socket.create_connection(..., timeout=<literal>)`` and
+  ``sock.settimeout(<literal>)`` hardcode per-socket deadlines that
+  should derive from ``util/retry``'s env-tunable defaults
+  (WEED_RPC_TIMEOUT / WEED_HTTP_TIMEOUT / WEED_CONNECT_TIMEOUT).
+
+Scoped to dataplane modules (storage/volume_server/operation/wdclient/
+util/pb/replication/filer/master/testing) — a CLI progress loop may
+sleep however it likes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .. import Finding, ModuleContext, register
+from ..astutil import dotted_name
+
+_DATAPLANE_PARTS = (
+    "seaweedfs_tpu/storage", "seaweedfs_tpu/volume_server",
+    "seaweedfs_tpu/operation", "seaweedfs_tpu/wdclient",
+    "seaweedfs_tpu/util", "seaweedfs_tpu/pb",
+    "seaweedfs_tpu/replication", "seaweedfs_tpu/filer",
+    "seaweedfs_tpu/master", "seaweedfs_tpu/testing",
+)
+
+_SLEEPS = {"time.sleep", "sleep"}
+_DEADLINE_MARKERS = ("deadline", "remaining")
+_POLICY_MARKERS = {"RetryPolicy", "attempts", "backoff",
+                   "background_reconnect", "cluster_default"}
+
+
+def _is_dataplane(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(part in p for part in _DATAPLANE_PARTS) \
+        or "weedlint_fixtures" in p
+
+
+def _numeric_literal(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) \
+        and isinstance(node.value, (int, float)) \
+        and not isinstance(node.value, bool)
+
+
+def _fn_has_deadline_or_policy(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            low = node.id.lower()
+            if any(m in low for m in _DEADLINE_MARKERS) \
+                    or node.id in _POLICY_MARKERS:
+                return True
+        elif isinstance(node, ast.Attribute):
+            if node.attr in _POLICY_MARKERS:
+                return True
+    return False
+
+
+def _loop_findings(fn: ast.AST, ctx: ModuleContext) -> Iterator[Finding]:
+    if _fn_has_deadline_or_policy(fn):
+        return
+    for loop in ast.walk(fn):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        has_try = any(isinstance(n, ast.Try) for n in ast.walk(loop))
+        if not has_try:
+            continue
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Call) \
+                    and dotted_name(node.func) in _SLEEPS \
+                    and node.args and _numeric_literal(node.args[0]):
+                yield Finding(
+                    "WL060", "retry-hygiene", ctx.path, node.lineno,
+                    "retry loop sleeps a constant with no deadline",
+                    "use util.retry.RetryPolicy (jittered backoff "
+                    "under a total deadline) or derive the sleep from "
+                    "policy.backoff(attempt)")
+
+
+@register("WL060", "retry-hygiene")
+def check_retry_hygiene(ctx: ModuleContext) -> Iterator[Finding]:
+    if not _is_dataplane(ctx.path):
+        return
+    for fn in ast.walk(ctx.tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield from _loop_findings(fn, ctx)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name and name.endswith("create_connection"):
+            for kw in node.keywords:
+                if kw.arg == "timeout" and _numeric_literal(kw.value):
+                    yield Finding(
+                        "WL060", "retry-hygiene", ctx.path, node.lineno,
+                        "hardcoded socket connect timeout",
+                        "take the budget from util.retry."
+                        "default_connect_timeout() (WEED_CONNECT_"
+                        "TIMEOUT) so operators can tune the fleet")
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "settimeout" \
+                and node.args and _numeric_literal(node.args[0]) \
+                and node.args[0].value not in (0,):
+            yield Finding(
+                "WL060", "retry-hygiene", ctx.path, node.lineno,
+                "hardcoded socket timeout",
+                "derive from util.retry.default_rpc_timeout()/"
+                "default_http_timeout() (env-tunable) instead of a "
+                "literal")
