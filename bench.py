@@ -370,6 +370,71 @@ def bench_degraded_read(concurrency: int, quick: bool = False,
     }
 
 
+def bench_self_healing(quick: bool = False, n_files: int = 80,
+                       runs: int = 2) -> dict:
+    """Self-healing extras (ISSUE 7): `repair_mttr_s` is the wall time
+    from hard-killing one replica holder to the repair loop restoring
+    full R=2 replication (loss observed -> VolumeCopy -> heartbeat
+    registered), and `scrub_volumes_per_s` is the anti-entropy digest
+    sweep rate over replicated volumes (shallow digests — the per-tick
+    cost, not the deep CRC scan)."""
+    from seaweedfs_tpu import operation
+    from seaweedfs_tpu.testing import SimCluster
+
+    if quick:
+        n_files, runs = 30, 1
+    payload = b"h" * 1024
+    mttrs, scrub_rates = [], []
+    for _ in range(runs):
+        with SimCluster(volume_servers=3, racks=2, max_volumes=60,
+                        pulse_seconds=0.3, repair_interval=0.25,
+                        repair={"grace": 0.2, "scrub_interval": 0.0,
+                                "liveness_staleness": 0.0,
+                                "backoff_base": 0.3,
+                                "scrub_quiet_seconds": 0.0,
+                                "max_inflight": 4}) as cluster:
+            fids = [operation.assign_and_upload(
+                cluster.master_grpc, payload, replication="010")
+                for _ in range(n_files)]
+            vids = sorted({int(f.split(",")[0]) for f in fids})
+            leader = cluster.masters[cluster.leader_index()]
+            # scrub rate first, on the healthy cluster
+            planner = leader.repair
+            planner.cfg.scrub_batch = max(len(vids), 1)
+            t0 = time.perf_counter()
+            checked = planner.scrub_once(deep=False)
+            dt = time.perf_counter() - t0
+            if checked and dt > 0:
+                scrub_rates.append(checked / dt)
+            # kill-to-fully-replicated; the loss must first be
+            # OBSERVED (stream break -> unregister) or the poll reads
+            # the stale pre-kill topology and under-reports MTTR
+            victim = cluster.volume_servers[0].url
+            affected = [v for v in vids
+                        if any(dn.url == victim
+                               for dn in leader.topo.lookup("", v))]
+            if not affected:
+                continue  # victim held nothing: no MTTR to measure
+            t_kill = time.perf_counter()
+            cluster.kill_volume_server(0)
+            obs_deadline = time.perf_counter() + 15
+            while time.perf_counter() < obs_deadline and all(
+                    len(leader.topo.lookup("", v)) >= 2
+                    for v in affected):
+                time.sleep(0.01)
+            cluster.wait_for_replication(vids, copies=2, timeout=60.0)
+            mttrs.append(time.perf_counter() - t_kill)
+    out = {}
+    if mttrs:  # empty when every victim held no affected volume
+        out["repair_mttr_s"], out["repair_mttr_s_spread"] = \
+            spread(mttrs, digits=3)
+    if scrub_rates:
+        out["scrub_volumes_per_s"], \
+            out["scrub_volumes_per_s_spread"] = spread(scrub_rates,
+                                                       digits=1)
+    return out
+
+
 def bench_replicated_write(concurrency: int, quick: bool = False,
                            n_files: int = 1000, runs: int = 3) -> dict:
     """Replicated small-write throughput (ISSUE 5): replication 001
@@ -835,6 +900,10 @@ def main():
                     conc, quick=args.quick))
             except Exception as e:
                 smallfile["degraded_read_error"] = str(e)[:200]
+            try:
+                smallfile.update(bench_self_healing(quick=args.quick))
+            except Exception as e:
+                smallfile["self_healing_error"] = str(e)[:200]
         except Exception as e:   # never fail the headline metric
             smallfile = {"smallfile_error": str(e)[:200]}
     # end-to-end disk path (VERDICT r3 missing #1)

@@ -1,0 +1,595 @@
+"""Master-driven self-healing: liveness sweep + repair planner loop +
+anti-entropy scrub.
+
+PR 6 made the data plane fail cleanly; this module makes it *heal*.
+Three leader-only concerns share one periodic tick:
+
+1. **Liveness sweep** — heartbeat-stream death is not the only way a
+   node dies: a wedged process keeps its TCP stream open while sending
+   nothing, and without this sweep it holds its topology slot (and its
+   replicas count as live) forever.  Any node whose ``last_seen`` is
+   older than the staleness window is unregistered exactly like a
+   broken stream.  A freshly-promoted leader waits one full window
+   before sweeping: it inherits no heartbeat history for nodes it never
+   heard from, and absence-of-history must not read as death (no
+   mass-unregister on election).
+
+2. **Repair planner** — diff desired vs. actual state each tick:
+   under-/over-replicated volumes via ``plan_fix_replication`` and
+   missing EC shards via the shard map, executed through the existing
+   ``VolumeCopy`` / ec-rebuild paths.  Execution is throttled (N
+   concurrent repairs + a bytes/s token bucket), backed off per volume
+   on repeated failure, and flap-damped: a volume must stay degraded
+   for ``grace`` seconds before repair fires, so a partition blip whose
+   node returns within the window never triggers a re-replication
+   storm.
+
+3. **Anti-entropy scrub** — replicas are digested over offset-free
+   needle content (storage/scrub.py) and compared; divergent replicas
+   reconcile by tailing the authoritative copy (``VolumeSyncFrom`` →
+   ``VolumeTailSender``).  A rotating low-rate deep pass re-reads every
+   record (CRC verified) so bit rot routes into the same repair queue.
+
+Everything is observable (/metrics families + the ``repair.status``
+shell verb) and deterministic for a given cluster seed: the backoff
+jitter RNG derives from it, so a chaos convergence schedule replays.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+
+from ..pb.rpc import POOL, RpcError
+from ..shell.command_ec import collect_ec_shard_map, do_ec_rebuild
+from ..shell.command_volume import plan_fix_replication
+from ..shell.commands import iter_data_nodes, node_grpc
+from ..util import tracing
+from ..util.retry import _env_seconds as _env_float
+from ..util.weedlog import logger
+
+LOG = logger(__name__)
+
+
+@dataclass
+class RepairConfig:
+    """Knobs for the self-healing loop (env-tunable via WEED_REPAIR_*)."""
+    interval: float = 5.0            # planner tick period
+    liveness_staleness: float = 20.0  # unregister after this silence; 0=off
+    grace: float = 3.0               # flap damper: degraded-for before repair
+    max_inflight: int = 2            # concurrent repair executions
+    bytes_per_second: float = 0.0    # repair copy throttle; 0 = unthrottled
+    burst_bytes: float = 256 << 20   # token bucket capacity
+    backoff_base: float = 1.0        # per-volume failure backoff (exp, jittered)
+    backoff_cap: float = 30.0
+    # a trim only fires when every surviving copy's node was heard
+    # from this recently — set to ~2x the volume-server pulse
+    trim_survivor_fresh_s: float = 10.0
+    scrub_interval: float = 30.0     # anti-entropy pass period; 0 = off
+    scrub_batch: int = 4             # volumes digested per scrub pass
+    deep_scrub_every: int = 8        # every Nth scrubbed volume: CRC scan
+    scrub_quiet_seconds: float = 5.0  # skip volumes written this recently
+
+    @classmethod
+    def from_env(cls) -> "RepairConfig":
+        # interval defaults to 0 here (loop OFF unless the operator
+        # sets WEED_REPAIR_INTERVAL or the server passes an interval);
+        # the dataclass default of 5.0 is for direct construction
+        return cls(
+            interval=_env_float("WEED_REPAIR_INTERVAL", 0.0),
+            liveness_staleness=_env_float("WEED_REPAIR_STALENESS", 20.0),
+            grace=_env_float("WEED_REPAIR_GRACE", 3.0),
+            max_inflight=int(_env_float("WEED_REPAIR_INFLIGHT", 2)),
+            bytes_per_second=_env_float("WEED_REPAIR_BYTES_PER_S", 0.0),
+            backoff_base=_env_float("WEED_REPAIR_BACKOFF", 1.0),
+            trim_survivor_fresh_s=_env_float("WEED_REPAIR_TRIM_FRESH",
+                                             10.0),
+            scrub_interval=_env_float("WEED_SCRUB_INTERVAL", 30.0),
+            scrub_batch=int(_env_float("WEED_SCRUB_BATCH", 4)),
+        )
+
+
+class TokenBucket:
+    """Bytes/s cap on repair traffic.  A repair larger than the burst
+    still passes once the bucket is full, and its full cost is charged
+    (tokens go negative), stalling later repairs until the debt refills
+    — average-rate limiting that never starves big volumes."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = rate
+        self.burst = max(burst, 1.0)
+        self._tokens = self.burst
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def try_acquire(self, n: float) -> bool:
+        if self.rate <= 0:
+            return True
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            need = min(max(n, 1.0), self.burst)
+            if self._tokens < need:
+                return False
+            self._tokens -= max(n, 1.0)
+            return True
+
+
+class _PlannerEnv:
+    """CommandEnv-shaped adapter the EC rebuild flow runs on: topology
+    comes straight from the leader's in-memory tree (no self-RPC)."""
+
+    def __init__(self, master):
+        self._m = master
+
+    def topology(self) -> dict:
+        return self._m.topo.to_dict()
+
+    def master(self):
+        return POOL.client(self._m.grpc_address, "Seaweed")
+
+    def volume_server(self, grpc_addr: str):
+        return POOL.client(grpc_addr, "VolumeServer")
+
+    def confirm_is_locked(self) -> None:
+        pass  # the planner runs ON the leader; no shell admin lease
+
+
+class RepairPlanner:
+    def __init__(self, master, config: "RepairConfig | None" = None):
+        self.master = master
+        self.cfg = config or RepairConfig.from_env()
+        self.metrics = master.metrics
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, self.cfg.max_inflight),
+            thread_name_prefix="repair")
+        self._bucket = TokenBucket(self.cfg.bytes_per_second,
+                                   self.cfg.burst_bytes)
+        # jitter RNG seeded from the cluster seed: a convergence
+        # schedule (which retries when) replays for a given seed
+        self._rng = random.Random(getattr(master, "_seed", None))
+        self._leader_since: "float | None" = None
+        # (kind, vid) -> first time the degradation was observed;
+        # survives across ticks so grace + MTTR both measure from there
+        self._first_seen: dict[tuple, float] = {}
+        self._backoff: dict[tuple, tuple[int, float]] = {}
+        self._inflight: set[tuple] = set()
+        self._ec_total: dict[int, int] = {}  # vid -> stripe width (immutable)
+        self._scrub_cursor = 0
+        self._last_scrub = time.time()  # first scrub one interval in
+        self.queue_depth = 0
+        self.last_mttr_s: "float | None" = None
+        self.counters = {
+            "repairs_ok": 0, "repairs_failed": 0,
+            "liveness_unregistered": 0,
+            "scrub_checked": 0, "scrub_divergent": 0,
+            "scrub_reconciled": 0, "scrub_crc_errors": 0,
+        }
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._pool.shutdown(wait=False)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.cfg.interval):
+            # leadership is re-checked EVERY iteration (weedlint WL070):
+            # a deposed leader must stop mutating topology immediately,
+            # and a promoted one starts its election grace window here
+            if not self.master.is_leader:
+                self._leader_since = None
+                continue
+            try:
+                self.tick()
+            except Exception as e:
+                LOG.warning("repair tick failed: %s", e)
+
+    # -- the tick -----------------------------------------------------------
+    def tick(self) -> dict:
+        """One full planner pass; callable synchronously (RepairTick
+        RPC, tests, bench) as well as from the background loop."""
+        if not self.master.is_leader:
+            self._leader_since = None
+            return {"skipped": "not leader"}
+        now = time.time()
+        if self._leader_since is None:
+            self._leader_since = now
+        self._liveness_sweep(now)
+        jobs = self._plan(self.master.topo.to_dict())
+        launched = self._schedule(jobs, now)
+        scrubbed = 0
+        if self.cfg.scrub_interval > 0 \
+                and now - self._last_scrub >= self.cfg.scrub_interval:
+            scrubbed = self.scrub_once()
+        return {"planned": len(jobs), "launched": launched,
+                "scrubbed": scrubbed, "queue_depth": self.queue_depth}
+
+    # -- 1. liveness sweep --------------------------------------------------
+    def _liveness_sweep(self, now: float) -> None:
+        stale = self.cfg.liveness_staleness
+        if stale <= 0:
+            return
+        # election grace: a fresh leader has no heartbeat history for
+        # nodes it never heard from — one full staleness window must
+        # pass after promotion before silence reads as death
+        if now - (self._leader_since or now) < stale:
+            return
+        for dn in self.master.topo.data_nodes():
+            if not dn.is_active:
+                continue
+            silent = now - dn.last_seen
+            if silent <= stale:
+                continue
+            LOG.warning("liveness sweep: volume server %s silent for "
+                        "%.1fs (stream open but mute); unregistering",
+                        dn.id, silent)
+            self.master.topo.unregister_data_node(dn)
+            self.master._publish_node_change(dn, is_add=False)
+            self.counters["liveness_unregistered"] += 1
+            self.metrics.liveness_unregister_total.inc()
+
+    # -- 2. planning --------------------------------------------------------
+    def _plan(self, topo: dict) -> dict[tuple, dict]:
+        jobs: dict[tuple, dict] = {}
+        for fx in plan_fix_replication(topo):
+            kind = "trim" if fx.get("action") == "trim" else "fix"
+            if kind == "trim":
+                # ONE trim per volume per tick: concurrent trims of
+                # the same volume would each pass the live-count guard
+                # before either deletion lands in topology; excess > 1
+                # resolves over successive ticks against fresh state
+                jobs[("trim", fx["volume_id"])] = dict(fx, kind=kind)
+                continue
+            # copies key per TARGET node: an R=3 volume that lost two
+            # holders gets two independent jobs running concurrently
+            # under max_inflight, not one per tick
+            jobs[(kind, fx["volume_id"], fx.get("to") or "")] = \
+                dict(fx, kind=kind)
+        ec_colls = topo.get("ec_collections", {})
+        for vid, holders in sorted(collect_ec_shard_map(topo).items()):
+            present = {s for ids in holders.values() for s in ids}
+            total = self._ec_stripe_width(topo, vid, holders)
+            if total and len(present) < total:
+                jobs[("ec", vid)] = {
+                    "kind": "ec", "volume_id": vid,
+                    "collection": ec_colls.get(str(vid), ""), "size": 0}
+        return jobs
+
+    def _ec_stripe_width(self, topo: dict, vid: int,
+                         holders: dict[str, list[int]]) -> int:
+        """Total shard count for an EC volume (wide stripes make 14 a
+        wrong guess) — probed once from a holder's .vif and cached."""
+        cached = self._ec_total.get(vid)
+        if cached:
+            return cached
+        grpc_by_id = {dn["id"]: node_grpc(dn)
+                      for _, _, dn in iter_data_nodes(topo)}
+        for nid in holders:
+            addr = grpc_by_id.get(nid)
+            if not addr:
+                continue
+            try:
+                out = POOL.client(addr, "VolumeServer").call(
+                    "VolumeEcGeometry", {"volume_id": vid}, timeout=5)
+            except RpcError:
+                continue
+            self._ec_total[vid] = int(out["total_shards"])
+            return self._ec_total[vid]
+        return 0
+
+    # -- 3. scheduling (flap damper + backoff + throttle) --------------------
+    def _schedule(self, jobs: dict[tuple, dict], now: float) -> int:
+        current = set(jobs)
+        for key in list(self._first_seen):
+            if key[0] == "scrub":
+                # scrub keys are managed at detection time (a clean
+                # re-digest pops them); GC the stragglers whose volume
+                # can never be re-scrubbed (replica trimmed away, node
+                # gone) or MTTR would later measure from a stale epoch
+                if now - self._first_seen[key] > 600 \
+                        and key not in self._inflight:
+                    self._first_seen.pop(key, None)
+                    self._backoff.pop(key, None)
+                continue
+            if key not in current and key not in self._inflight:
+                # healed (by repair or by the node coming back inside
+                # the grace window — the flap case): forget it
+                self._first_seen.pop(key, None)
+                self._backoff.pop(key, None)
+        launched, deferred = 0, 0
+        for key, job in sorted(jobs.items()):
+            first = self._first_seen.setdefault(key, now)
+            if key in self._inflight:
+                continue
+            if now - first < self.cfg.grace:
+                deferred += 1
+                continue
+            fails_retry = self._backoff.get(key)
+            if fails_retry and now < fails_retry[1]:
+                deferred += 1
+                continue
+            if self._launch(key, job):
+                launched += 1
+            else:
+                deferred += 1
+        self.queue_depth = deferred
+        self.metrics.repair_queue_depth.set(value=float(deferred))
+        return launched
+
+    def _launch(self, key: tuple, job: dict) -> bool:
+        with self._lock:
+            if key in self._inflight:
+                return True
+            if len(self._inflight) >= self.cfg.max_inflight:
+                return False
+            if not self._bucket.try_acquire(float(job.get("size") or 0)):
+                return False
+            self._inflight.add(key)
+        self.metrics.repairs_in_flight.set(
+            value=float(len(self._inflight)))
+        self._pool.submit(self._execute, key, job)
+        return True
+
+    # -- 4. execution --------------------------------------------------------
+    def _execute(self, key: tuple, job: dict) -> None:
+        tid = tracing.new_trace_id()
+        try:
+            with tracing.trace_scope(tid):
+                # deposed while queued: executing would mutate cluster
+                # state this master no longer owns
+                if not self.master.is_leader:
+                    raise RpcError("lost leadership before repair ran")
+                {"fix": self._exec_fix, "trim": self._exec_trim,
+                 "ec": self._exec_ec, "scrub": self._exec_scrub,
+                 }[job["kind"]](job)
+        except Exception as e:
+            with self._lock:
+                fails = self._backoff.get(key, (0, 0.0))[0] + 1
+                delay = min(self.cfg.backoff_cap,
+                            self.cfg.backoff_base * (2 ** (fails - 1)))
+                delay *= 0.5 + self._rng.random()  # seeded: replayable
+                self._backoff[key] = (fails, time.time() + delay)
+                self.counters["repairs_failed"] += 1
+            self.metrics.repair_total.inc(job["kind"], "error")
+            LOG.warning("repair %s volume %s trace=%s FAILED (attempt "
+                        "%d, retry in %.1fs): %s", job["kind"],
+                        job.get("volume_id"), tid, fails, delay, e)
+        else:
+            first = self._first_seen.pop(key, None)
+            mttr = time.time() - first if first else 0.0
+            with self._lock:
+                self._backoff.pop(key, None)
+                self.counters["repairs_ok"] += 1
+                if key[0] == "scrub":
+                    self.counters["scrub_reconciled"] += 1
+                self.last_mttr_s = round(mttr, 3)
+            self.metrics.repair_total.inc(job["kind"], "ok")
+            self.metrics.repair_mttr_seconds.observe(value=mttr)
+            self._after_heal(job)
+            LOG.info("repair %s volume %s trace=%s healed in %.2fs",
+                     job["kind"], job.get("volume_id"), tid, mttr)
+        finally:
+            with self._lock:
+                self._inflight.discard(key)
+            self.metrics.repairs_in_flight.set(
+                value=float(len(self._inflight)))
+
+    def _after_heal(self, job: dict) -> None:
+        """Healed replicas must serve immediately: push fresh locations
+        through KeepConnected (subscribed MasterClients drop their
+        negative-TTL lookup entries on the delta) and clear this
+        process's transport negative caches for the healed holder."""
+        from .. import operation
+        for url in (job.get("to"), job.get("node")):
+            if url:
+                operation.mark_http_alive(url)
+        vid = job.get("volume_id")
+        if vid is None:
+            return
+        try:
+            self.master._publish_volume_location(
+                vid, job.get("collection", ""))
+        except Exception as e:
+            LOG.debug("post-repair publish for volume %s failed: %s",
+                      vid, e)
+
+    def _exec_fix(self, job: dict) -> None:
+        POOL.client(job["to_grpc"], "VolumeServer").call(
+            "VolumeCopy", {"volume_id": job["volume_id"],
+                           "collection": job.get("collection", ""),
+                           "source_data_node": job["from_grpc"]},
+            timeout=600)
+
+    def _exec_trim(self, job: dict) -> None:
+        # re-validate against the LIVE topology: between the planning
+        # snapshot and this (queued) execution another holder may have
+        # died — trimming then would delete the last surviving copy
+        locs = self.master.topo.lookup(job.get("collection", ""),
+                                       job["volume_id"])
+        if len(locs) <= job.get("copy_count", 1):
+            raise RpcError(
+                f"trim aborted: volume {job['volume_id']} no longer "
+                f"over-replicated ({len(locs)} copies)")
+        if not any(dn.id == job["node"] for dn in locs):
+            raise RpcError(
+                f"trim aborted: {job['node']} no longer holds volume "
+                f"{job['volume_id']}")
+        # topology is heartbeat-fed, so a holder mid-death can still be
+        # counted: only trim when every REMAINING copy sits on a node
+        # heard from recently — stale survivors make the count a lie.
+        # The window is an explicit knob (the master cannot see the
+        # volume servers' pulse setting)
+        fresh_within = max(self.cfg.trim_survivor_fresh_s, 1.0)
+        now = time.time()
+        stale = [dn.id for dn in locs if dn.id != job["node"]
+                 and now - dn.last_seen > fresh_within]
+        if stale:
+            raise RpcError(
+                f"trim aborted: surviving holders {stale} not heard "
+                f"from within {fresh_within:.0f}s")
+        POOL.client(job["node_grpc"], "VolumeServer").call(
+            "VolumeDelete", {"volume_id": job["volume_id"]})
+
+    def _exec_ec(self, job: dict) -> None:
+        do_ec_rebuild(_PlannerEnv(self.master), job["volume_id"],
+                      job.get("collection", ""))
+
+    def _exec_scrub(self, job: dict) -> None:
+        """Reconcile divergent replicas: ONE-directional full sync from
+        the newest-activity (authoritative) copy — adds missing
+        needles, overwrites divergent/rotten ones, replays tombstones.
+        A target holding newer unique needles becomes the
+        newest-activity replica afterwards, so the next pass flows the
+        other way; see storage/scrub.py for why any pass toward the
+        older replica risks resurrecting deletes."""
+        vid = job["volume_id"]
+        coll = job.get("collection", "")
+        for target in job["targets"]:
+            POOL.client(target, "VolumeServer").call(
+                "VolumeSyncFrom",
+                {"volume_id": vid, "collection": coll,
+                 "source_data_node": job["auth_grpc"]}, timeout=600)
+        for rotten, clean_src, keys in job.get("rot", []):
+            POOL.client(rotten, "VolumeServer").call(
+                "VolumeSyncFrom",
+                {"volume_id": vid, "collection": coll,
+                 "source_data_node": clean_src, "only_keys": keys},
+                timeout=600)
+
+    # -- 5. anti-entropy scrub ----------------------------------------------
+    def scrub_once(self, deep: "bool | None" = None) -> int:
+        """One scrub batch over replicated volumes (round-robin cursor);
+        returns volumes checked.  Divergence routes into the same
+        repair queue (throttle + backoff) as replica loss."""
+        topo = self.master.topo.to_dict()
+        groups: dict[int, list] = {}
+        for _, _, dn in iter_data_nodes(topo):
+            if not dn.get("is_active", True):
+                continue
+            for v in dn["volumes"]:
+                groups.setdefault(v["id"], []).append((dn, v))
+        vids = sorted(vid for vid, hs in groups.items() if len(hs) >= 2)
+        if not vids:
+            self._last_scrub = time.time()
+            return 0
+        checked = 0
+        for _ in range(min(self.cfg.scrub_batch, len(vids))):
+            vid = vids[self._scrub_cursor % len(vids)]
+            self._scrub_cursor += 1
+            use_deep = deep if deep is not None else (
+                self.cfg.deep_scrub_every > 0
+                and self._scrub_cursor % self.cfg.deep_scrub_every == 0)
+            self._scrub_volume(vid, groups[vid], use_deep)
+            checked += 1
+        self._last_scrub = time.time()
+        return checked
+
+    def _scrub_volume(self, vid: int, holders: list, deep: bool) -> None:
+        newest = max((vm.get("modified_at_second", 0)
+                      for _, vm in holders), default=0)
+        if newest and time.time() - newest < self.cfg.scrub_quiet_seconds:
+            # an actively-written volume digests differently on every
+            # replica while the fan-out is in flight — not divergence
+            return
+        digests = []
+        for dn, _vmeta in holders:
+            addr = node_grpc(dn)
+            try:
+                d = POOL.client(addr, "VolumeServer").call(
+                    "VolumeNeedleDigest",
+                    {"volume_id": vid, "deep": deep}, timeout=60)
+            except RpcError as e:
+                LOG.debug("scrub digest of volume %d on %s failed: %s",
+                          vid, addr, e)
+                continue
+            digests.append((addr, d))
+        self.counters["scrub_checked"] += 1
+        self.metrics.scrub_total.inc("checked")
+        if len(digests) < 2:
+            return
+        crc_total = sum(d["crc_errors"] for _, d in digests)
+        self.counters["scrub_crc_errors"] += crc_total
+        if len({d["digest"] for _, d in digests}) == 1 and crc_total == 0:
+            self.metrics.scrub_total.inc("clean")
+            # healed outside the sync path (replica trimmed, organic
+            # catch-up): drop the divergence bookkeeping so a future
+            # divergence measures MTTR from ITS detection, not this one
+            self._first_seen.pop(("scrub", vid), None)
+            self._backoff.pop(("scrub", vid), None)
+            return
+        self.counters["scrub_divergent"] += 1
+        self.metrics.scrub_total.inc("divergent")
+        # authoritative copy: ALWAYS the newest activity (a replica
+        # that processed a delete the others missed has fewer needles
+        # but newer state — choosing by count, or demoting it for an
+        # unrelated rotten record, would resurrect the deleted data).
+        # Bit rot heals separately below, scoped to the rotten keys.
+        auth = max(digests, key=lambda x: (x[1].get("last_modified", 0),
+                                           x[1]["file_count"],
+                                           x[1]["bytes_live"]))
+        targets = [addr for addr, _ in digests if addr != auth[0]]
+        # rotten replicas get a key-scoped repair from a CRC-clean
+        # peer: precise (only the unreadable needles), so it cannot
+        # resurrect anything, and it works even when the rotten
+        # replica is itself the authority
+        clean = [addr for addr, d in digests if d["crc_errors"] == 0]
+        rot = [(addr, clean[0], d["crc_error_keys"])
+               for addr, d in digests
+               if d["crc_errors"] and d["crc_error_keys"] and clean]
+        LOG.warning("scrub: volume %d replicas diverge (crc_errors=%d) "
+                    "— reconciling %s from %s", vid, crc_total,
+                    targets, auth[0])
+        key = ("scrub", vid)
+        now = time.time()
+        self._first_seen.setdefault(key, now)
+        fails_retry = self._backoff.get(key)
+        if fails_retry and now < fails_retry[1]:
+            return
+        self._launch(key, {
+            "kind": "scrub", "volume_id": vid,
+            "collection": holders[0][1].get("collection", ""),
+            "auth_grpc": auth[0], "targets": targets, "rot": rot,
+            "size": max(d.get("bytes_live", 0) for _, d in digests)})
+
+    # -- status (repair.status verb / RepairStatus RPC) ----------------------
+    def status(self) -> dict:
+        now = time.time()
+
+        def fmt(key: tuple) -> str:
+            return ":".join(str(p) for p in key)
+
+        with self._lock:
+            return {
+                "enabled": True,
+                "is_leader": self.master.is_leader,
+                "queue_depth": self.queue_depth,
+                "in_flight": sorted(fmt(k) for k in self._inflight),
+                "counters": dict(self.counters),
+                "last_mttr_s": self.last_mttr_s,
+                "backoff": {fmt(k): round(t - now, 2)
+                            for k, (_, t) in self._backoff.items()},
+                "pending_for_s": {fmt(k): round(now - t, 2)
+                                  for k, t in self._first_seen.items()},
+                "scrub_cursor": self._scrub_cursor,
+                "config": {
+                    "interval": self.cfg.interval,
+                    "liveness_staleness": self.cfg.liveness_staleness,
+                    "grace": self.cfg.grace,
+                    "max_inflight": self.cfg.max_inflight,
+                    "bytes_per_second": self.cfg.bytes_per_second,
+                    "scrub_interval": self.cfg.scrub_interval,
+                    "scrub_batch": self.cfg.scrub_batch,
+                },
+            }

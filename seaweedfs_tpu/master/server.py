@@ -66,7 +66,9 @@ class MasterServer:
                  raft_dir: str | None = None,
                  election_timeout: float = 0.4,
                  follow: str = "",
-                 seed: int | None = None):
+                 seed: int | None = None,
+                 repair_interval: float = 0.0,
+                 repair: dict | None = None):
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024, seed=seed)
         self.sequencer = MemorySequencer()
@@ -92,6 +94,13 @@ class MasterServer:
         self._partitioned = False
         self.auto_vacuum_interval = auto_vacuum_interval
         self._stop_vacuum = threading.Event()
+        # self-healing subsystem (master/repair.py): liveness sweep +
+        # repair planner + anti-entropy scrub, leader-only, off unless
+        # an interval is configured (repair_interval or
+        # WEED_REPAIR_INTERVAL); `repair` overrides RepairConfig fields
+        self._repair_interval = repair_interval
+        self._repair_overrides = repair or {}
+        self.repair = None
         self._seed = seed
         self._rng = random.Random(seed)
         self._grow_lock = threading.Lock()
@@ -153,9 +162,23 @@ class MasterServer:
                         except Exception as e:
                             LOG.debug("auto-vacuum pass failed: %s", e)
             threading.Thread(target=vacuum_loop, daemon=True).start()
+        # precedence: constructor param > WEED_REPAIR_INTERVAL env >
+        # off.  The env path must work alone — an operator exporting
+        # WEED_REPAIR_INTERVAL=5 per the README gets the loop
+        from .repair import RepairConfig, RepairPlanner
+        repair_cfg = RepairConfig.from_env()
+        if self._repair_interval > 0:
+            repair_cfg.interval = self._repair_interval
+        for k, v in self._repair_overrides.items():
+            setattr(repair_cfg, k, v)
+        if repair_cfg.interval > 0:
+            self.repair = RepairPlanner(self, repair_cfg)
+            self.repair.start()
 
     def stop(self) -> None:
         self._stop_vacuum.set()
+        if self.repair is not None:
+            self.repair.stop()
         if self._follower_client is not None:
             self._follower_client.stop()
         if self.ha:
@@ -337,6 +360,16 @@ class MasterServer:
                 self._publish_node_change(dn, is_add=False)
 
     def _ingest_heartbeat(self, hb: dict, dn: DataNode | None) -> DataNode:
+        if dn is not None and (not dn.is_active or dn.parent is None):
+            # the liveness sweep unregistered this node while its
+            # stream stayed open (wedged process that recovered): a
+            # fresh heartbeat is the node coming back — re-register
+            # rather than silently updating an unlinked ghost.  The
+            # heartbeat carries the full volume snapshot, so the new
+            # node repopulates in one pulse.
+            LOG.info("volume server %s re-registering after liveness "
+                     "sweep", dn.id)
+            dn = None
         if dn is None:
             dn = self.topo.get_or_create_data_node(
                 hb.get("data_center", ""), hb.get("rack", ""),
@@ -425,9 +458,15 @@ class MasterServer:
 
     def _publish_volume_location(self, vid: int, collection: str) -> None:
         for dn in self.topo.lookup(collection, vid):
+            # tcp_port rides along like the node-change publish: the
+            # post-repair delta is what clears clients' _TCP_DEAD
+            # entries, and without it the healed replica's frame fast
+            # path stays negative-cached for the full TTL
             self._publish({"volume_location": {
                 "url": dn.url, "public_url": dn.public_url,
-                "grpc_port": dn.grpc_port, "new_vids": [vid]}})
+                "grpc_port": dn.grpc_port,
+                "tcp_port": getattr(dn, "tcp_port", 0),
+                "new_vids": [vid]}})
 
     # -- admin lock (LeaseAdminToken, master_grpc_server_admin.go) ----------
     def _lease_admin_token(self, req: dict) -> dict:
@@ -473,6 +512,8 @@ class MasterServer:
                 "VolumeList": lambda req: {"topology": self.topo.to_dict()},
                 "ListClusterNodes": self._rpc_list_cluster_nodes,
                 "Vacuum": self._rpc_vacuum,
+                "RepairStatus": self._rpc_repair_status,
+                "RepairTick": self._rpc_repair_tick,
                 # observability over gRPC (shell cluster.trace /
                 # metrics.dump reach the master through its grpc
                 # address; HTTP /debug/traces serves the same spans)
@@ -508,6 +549,26 @@ class MasterServer:
                 "leaders": {t: next(iter(counts))
                             for t, counts in self.cluster_nodes.items()
                             if counts}}
+
+    def _rpc_repair_status(self, req: dict) -> dict:
+        if self.repair is None:
+            return {"enabled": False}
+        return self.repair.status()
+
+    def _rpc_repair_tick(self, req: dict) -> dict:
+        """Run one synchronous planner pass (the `repair.now` verb);
+        optionally force a scrub batch (`scrub`, with `deep` selecting
+        the CRC scan)."""
+        if self.repair is None:
+            raise RpcError("repair loop not enabled on this master "
+                           "(set repair_interval / WEED_REPAIR_INTERVAL)")
+        if not self.is_leader:
+            raise RpcError("not the leader; repair runs on the leader")
+        out = self.repair.tick()
+        if req.get("scrub"):
+            out["scrubbed"] = self.repair.scrub_once(
+                deep=bool(req.get("deep")) or None)
+        return out
 
     def _rpc_vacuum(self, req: dict) -> dict:
         from . import vacuum as vacuum_mod

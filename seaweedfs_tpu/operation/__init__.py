@@ -272,6 +272,18 @@ def mark_http_dead(url: str) -> None:
     _HTTP_DEAD[url] = time.time() + _HTTP_DEAD_TTL
 
 
+def mark_http_alive(url: str) -> None:
+    """Drop a location's negative-cache entry NOW — called when the
+    master announces the node healed (repair completed, node
+    re-registered) so recovered replicas serve reads immediately
+    instead of waiting out the TTL."""
+    _HTTP_DEAD.pop(url, None)
+
+
+def mark_tcp_alive(addr: str) -> None:
+    _TCP_DEAD.pop(addr, None)
+
+
 def tcp_dead(addr: str) -> bool:
     """Is this frame port negative-cached as unreachable?"""
     return _TCP_DEAD.get(addr, 0) >= time.time()

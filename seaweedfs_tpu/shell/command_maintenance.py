@@ -171,3 +171,21 @@ def cmd_evacuate(env: CommandEnv, args: list[str]) -> str:
                    "shard_ids": [mv["shard_id"]]})
     return json.dumps({"evacuated_volumes": len(plan),
                        "evacuated_shards": len(ec_moves)})
+
+@command("repair.status",
+         "self-healing loop status: queue depth, in-flight repairs, "
+         "MTTR, scrub/liveness counters, per-volume backoff")
+def cmd_repair_status(env: CommandEnv, args: list[str]) -> str:
+    return json.dumps(env.master().call("RepairStatus", {}), indent=2,
+                      default=str)
+
+
+@command("repair.now",
+         "run one synchronous repair planner pass on the leader "
+         "[-scrub] [-deep]")
+def cmd_repair_now(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    out = env.master().call("RepairTick", {
+        "scrub": flags.get("scrub") == "true",
+        "deep": flags.get("deep") == "true"}, timeout=600)
+    return json.dumps(out)

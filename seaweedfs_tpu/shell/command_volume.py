@@ -54,41 +54,121 @@ def plan_volume_balance(topo: dict, collection: str | None = None
 
 
 def plan_fix_replication(topo: dict) -> list[dict]:
-    """Find under-replicated volumes and pick a target server per missing
-    replica (command_volume_fix_replication.go).  Targets prefer nodes in
-    other racks that don't hold the volume, emptiest first."""
-    nodes = [(dc, rack, dn) for dc, rack, dn in iter_data_nodes(topo)]
-    replicas: dict[int, list[tuple[str, str, dict]]] = {}
+    """Diff desired vs. actual replica counts
+    (command_volume_fix_replication.go), extended for the repair loop:
+
+    - under-replicated volumes get `copy` fixes whose targets honor the
+      ReplicaPlacement distribution (same-rack / other-rack / other-DC
+      needs filled in priority order, emptiest candidate first; any
+      candidate as a last resort — a misplaced copy beats none)
+    - over-replicated volumes get `trim` fixes, preferring to remove
+      the degraded/read-only copy, then the copy on the fullest node
+    - nodes marked inactive (swept mid-churn between snapshot and
+      execution) never serve as source, target, or counted replica
+    """
+    nodes = [(dc, rack, dn) for dc, rack, dn in iter_data_nodes(topo)
+             if dn.get("is_active", True)]
+    replicas: dict[int, list[tuple[str, str, dict, dict]]] = {}
     meta: dict[int, dict] = {}
     for dc, rack, dn in nodes:
         for v in dn["volumes"]:
-            replicas.setdefault(v["id"], []).append((dc, rack, dn))
+            replicas.setdefault(v["id"], []).append((dc, rack, dn, v))
             meta[v["id"]] = v
     fixes = []
     for vid, holders in sorted(replicas.items()):
         rp = ReplicaPlacement.from_byte(
             meta[vid].get("replica_placement", 0))
         missing = rp.copy_count() - len(holders)
-        if missing <= 0:
+        if missing < 0:
+            fixes.extend(_plan_trims(vid, holders, -missing, meta))
             continue
-        holder_ids = {dn["id"] for _, _, dn in holders}
-        holder_racks = {(dc, rack) for dc, rack, _ in holders}
+        if missing == 0:
+            continue
+        # source: a healthy copy — a degraded/read-only replica may be
+        # the torn one; copy from it only if nothing better holds it
+        src_order = sorted(holders,
+                           key=lambda h: bool(h[3].get("read_only")))
+        src = src_order[0][2]
+        holder_ids = {dn["id"] for _, _, dn, _ in holders}
         candidates = [(dc, rack, dn) for dc, rack, dn in nodes
                       if dn["id"] not in holder_ids
                       and len(dn["volumes"]) < dn.get("max_volumes", 7)]
-        # other-rack first, then emptiest
-        candidates.sort(key=lambda c: (
-            (c[0], c[1]) in holder_racks, len(c[2]["volumes"])))
-        for _ in range(missing):
-            if not candidates:
+        for want in _placement_needs(rp, holders, missing):
+            pick = _pick_candidate(candidates, want, holders)
+            if pick is None:
                 break
-            dc, rack, dn = candidates.pop(0)
-            src = holders[0][2]
-            fixes.append({"volume_id": vid,
+            candidates.remove(pick)
+            dc, rack, dn = pick
+            fixes.append({"volume_id": vid, "action": "copy",
                           "collection": meta[vid].get("collection", ""),
+                          # the copy moves this many bytes — the repair
+                          # loop's bytes/s throttle charges it
+                          "size": meta[vid].get("size", 0),
                           "from_grpc": node_grpc(src),
                           "to": dn["id"], "to_grpc": node_grpc(dn)})
+            holders = holders + [(dc, rack, dn, meta[vid])]
     return fixes
+
+
+def _plan_trims(vid: int, holders: list, excess: int,
+                meta: dict) -> list[dict]:
+    """Over-replicated: drop `excess` copies, degraded/read-only copies
+    first, then copies on the fullest nodes."""
+    order = sorted(
+        holders,
+        key=lambda h: (not bool(h[3].get("degraded_reason")),
+                       not bool(h[3].get("read_only")),
+                       -len(h[2]["volumes"])))
+    rp = ReplicaPlacement.from_byte(meta[vid].get("replica_placement", 0))
+    return [{"volume_id": vid, "action": "trim",
+             "collection": meta[vid].get("collection", ""),
+             # executors re-validate against live topology: a trim must
+             # never fire once the count has fallen back to copy_count
+             "copy_count": rp.copy_count(),
+             "node": dn["id"], "node_grpc": node_grpc(dn)}
+            for _, _, dn, _ in order[:excess]]
+
+
+def _placement_needs(rp: ReplicaPlacement, holders: list,
+                     missing: int) -> list[str]:
+    """Which distribution slot each missing replica should fill,
+    measured against the primary (first holder's) DC/rack."""
+    p_dc, p_rack = holders[0][0], holders[0][1]
+    same_rack = sum(1 for dc, rk, _, _ in holders
+                    if (dc, rk) == (p_dc, p_rack)) - 1
+    diff_rack = sum(1 for dc, rk, _, _ in holders
+                    if dc == p_dc and rk != p_rack)
+    diff_dc = sum(1 for dc, _, _, _ in holders if dc != p_dc)
+    needs = []
+    for _ in range(missing):
+        if diff_dc < rp.diff_data_center_count:
+            needs.append("diff_dc")
+            diff_dc += 1
+        elif diff_rack < rp.diff_rack_count:
+            needs.append("diff_rack")
+            diff_rack += 1
+        else:
+            needs.append("same_rack")
+            same_rack += 1
+    return needs
+
+
+def _pick_candidate(candidates: list, want: str, holders: list):
+    """Emptiest candidate satisfying the placement need; falls back to
+    the emptiest anywhere when the need is unsatisfiable."""
+    p_dc, p_rack = holders[0][0], holders[0][1]
+
+    def matches(c) -> bool:
+        dc, rack, _ = c
+        if want == "diff_dc":
+            return dc != p_dc
+        if want == "diff_rack":
+            return dc == p_dc and rack != p_rack
+        return (dc, rack) == (p_dc, p_rack)
+
+    ranked = sorted(candidates,
+                    key=lambda c: (not matches(c), len(c[2]["volumes"])))
+    return ranked[0] if ranked else None
 
 
 # -- commands --------------------------------------------------------------
@@ -133,12 +213,35 @@ def cmd_fix_replication(env: CommandEnv, args: list[str]) -> str:
         return json.dumps({"planned_fixes": fixes})
     env.confirm_is_locked()
     applied = []
+    trimmed_vids: set = set()
     for fx in fixes:
-        dst = env.volume_server(fx["to_grpc"])
-        dst.call("VolumeCopy", {"volume_id": fx["volume_id"],
-                                "collection": fx.get("collection", ""),
-                                "source_data_node": fx["from_grpc"]},
-                 timeout=600)
+        if fx.get("action") == "trim":
+            # re-validate against the LIVE topology right before the
+            # delete: earlier fixes in this loop take minutes, and a
+            # holder dying meanwhile would make this trim remove the
+            # last surviving copy (same guard as the repair loop's
+            # _exec_trim).  At most ONE trim per volume per invocation
+            # — the topology is heartbeat-fed, so a second trim could
+            # still count the copy the first one just deleted; rerun
+            # the command for remaining excess against fresh state.
+            vid = fx["volume_id"]
+            if vid in trimmed_vids:
+                continue
+            holders = [dn for _, _, dn in iter_data_nodes(env.topology())
+                       if dn.get("is_active", True)
+                       and any(v["id"] == vid for v in dn["volumes"])]
+            if len(holders) <= fx.get("copy_count", 1) \
+                    or not any(dn["id"] == fx["node"] for dn in holders):
+                continue
+            env.volume_server(fx["node_grpc"]).call(
+                "VolumeDelete", {"volume_id": vid})
+            trimmed_vids.add(vid)
+        else:
+            dst = env.volume_server(fx["to_grpc"])
+            dst.call("VolumeCopy", {"volume_id": fx["volume_id"],
+                                    "collection": fx.get("collection", ""),
+                                    "source_data_node": fx["from_grpc"]},
+                     timeout=600)
         applied.append(fx["volume_id"])
     return json.dumps({"fixed": applied})
 

@@ -195,6 +195,29 @@ class ServerMetrics:
         self.ec_rebuilds = r.counter(
             "seaweedfs_volume_ec_rebuild_total",
             "EC shard rebuilds executed", ["plan_kind"])
+        # self-healing observability (master/repair.py): queue depth,
+        # executions by kind/result, MTTR from degradation detection to
+        # heal, anti-entropy scrub outcomes, liveness-sweep kills —
+        # what an operator needs to trust the cluster repairs itself
+        self.repair_queue_depth = r.gauge(
+            "seaweedfs_master_repair_queue_depth",
+            "repair jobs awaiting execution (throttled/backoff/grace)")
+        self.repairs_in_flight = r.gauge(
+            "seaweedfs_master_repairs_in_flight",
+            "repair executions currently running")
+        self.repair_total = r.counter(
+            "seaweedfs_master_repair_total",
+            "repair executions", ["kind", "result"])
+        self.repair_mttr_seconds = r.histogram(
+            "seaweedfs_master_repair_mttr_seconds",
+            "time from degradation detection to heal",
+            buckets=[0.5, 1, 2, 5, 10, 30, 60, 300, 1800])
+        self.scrub_total = r.counter(
+            "seaweedfs_master_scrub_total",
+            "anti-entropy scrub volume checks", ["result"])
+        self.liveness_unregister_total = r.counter(
+            "seaweedfs_master_liveness_unregister_total",
+            "nodes unregistered by the liveness sweep")
 
     def render(self) -> str:
         return self.registry.render()

@@ -1,0 +1,154 @@
+"""Anti-entropy scrub primitives: replica-comparable needle digests and
+tail-record reconciliation.
+
+Two replicas of a volume hold the same *logical* needles at different
+physical offsets (each appended independently, each vacuumed on its own
+schedule), so equality can only be judged over offset-free content:
+(key, size) from the needle map for the cheap sweep, plus (cookie, crc)
+read from the record for the deep bit-rot scan.  Each live needle folds
+to a 64-bit mixed hash and the per-volume digest is the XOR of the
+folds — order-independent (Merkle-ish without the tree: replicas
+iterate their maps in different orders) and incremental-friendly.
+
+Reconciliation applies `VolumeTailSender` records from the authoritative
+replica: missing needles are written, divergent ones overwritten,
+tombstones re-applied.  It is deliberately ONE-directional per pass —
+"needle missing on the target" is indistinguishable from "needle
+deleted on the target after the source last saw it", so any pass that
+writes toward the replica with *older* activity risks resurrecting a
+deleted needle.  The planner therefore always syncs from the replica
+with the newest activity; a target that held newer unique needles
+becomes the newest-activity replica after the pass (applying records
+bumps its clock) and the next pass flows the other way — convergent
+over rounds without ping-pong, because propagated tombstones land
+*after* the stale adds in every .dat tail.
+
+Used by the volume server's `VolumeNeedleDigest` / `VolumeSyncFrom`
+RPCs and the master's repair planner (master/repair.py).
+"""
+
+from __future__ import annotations
+
+from . import types as t
+from .needle import Needle
+from .volume import NotFoundError, Volume
+from ..util.weedlog import logger
+
+LOG = logger(__name__)
+
+_MASK = (1 << 64) - 1
+# odd multipliers keep each field's contribution full-width before the mix
+_P_KEY = 0x9E3779B97F4A7C15
+_P_SIZE = 0xC2B2AE3D27D4EB4F
+_P_COOKIE = 0x165667B19E3779F9
+_P_CRC = 0x27D4EB2F165667C5
+
+
+def fold_needle(key: int, size: int, cookie: int = 0,
+                checksum: int = 0) -> int:
+    """One needle's offset-free 64-bit contribution.  The +1 biases keep
+    a zero field from erasing its multiplier; the final xor-shift mix
+    (splitmix64 finalizer) avalanches so XOR-combining many folds stays
+    collision-resistant."""
+    h = ((key * _P_KEY) ^ ((size + 1) * _P_SIZE)
+         ^ ((cookie + 1) * _P_COOKIE) ^ ((checksum + 1) * _P_CRC)) & _MASK
+    h ^= h >> 30
+    h = (h * 0xBF58476D1CE4E5B9) & _MASK
+    h ^= h >> 27
+    h = (h * 0x94D049BB133111EB) & _MASK
+    return h ^ (h >> 31)
+
+
+def volume_digest(v: Volume, deep: bool = False,
+                  max_error_keys: int = 32) -> dict:
+    """Digest the volume's live needles.
+
+    deep=False folds (key, size) straight off the needle map — no disk
+    IO, cheap enough for every scrub tick.  deep=True reads every record
+    (CRC verified by Needle.read_from) and folds (key, size, cookie,
+    crc) — the low-rate bit-rot scan.  Records that fail to read under
+    deep mode are reported in crc_error_keys (capped) and counted; they
+    contribute a key-derived sentinel so two replicas rotten in
+    different places still digest differently.
+    """
+    nm, backend = v._read_ref
+    try:
+        entries = list(nm.items())
+    except RuntimeError:
+        # map mutated under the lock-free snapshot iteration: one
+        # coherent retry under the volume lock (same contract as
+        # reads).  The backend MUST be re-fetched with the map — a
+        # vacuum just swapped both, and pairing the new map's offsets
+        # with the old .dat reads garbage at every offset (deep mode
+        # would report the whole volume as rotten)
+        with v._lock:
+            entries = list(v.nm.items())
+            backend = v.data_backend
+    digest = 0
+    count = 0
+    bytes_live = 0
+    crc_errors = 0
+    error_keys: list[int] = []
+    for nv in entries:
+        if nv.offset == 0 or t.size_is_deleted(nv.size):
+            continue
+        if deep:
+            try:
+                n = Needle.read_from(backend, nv.offset, nv.size,
+                                     v.version)
+                h = fold_needle(nv.key, nv.size, n.cookie, n.checksum)
+            except Exception as e:
+                crc_errors += 1
+                if len(error_keys) < max_error_keys:
+                    error_keys.append(nv.key)
+                LOG.warning("scrub: volume %d needle %x unreadable at "
+                            "offset %d: %s", v.id, nv.key, nv.offset, e)
+                h = fold_needle(nv.key, nv.size, 0xFFFFFFFF, 0xFFFFFFFF)
+        else:
+            h = fold_needle(nv.key, nv.size)
+        digest ^= h
+        count += 1
+        bytes_live += nv.size
+    return {"digest": digest, "file_count": count,
+            "bytes_live": bytes_live, "deep": deep,
+            # the authority signal: newest write/delete activity wins
+            # when replicas diverge (a count-based choice would pick
+            # the replica that MISSED a delete and resurrect the data).
+            # ns resolution — second ties are the write-then-delete
+            # case this exists to break.  Cross-host clock skew bounds
+            # its precision; a vector clock would be exact, documented
+            # as the known limitation.
+            "last_modified": v.last_modified_ns
+            or v.last_modified * 1_000_000_000,
+            "crc_errors": crc_errors, "crc_error_keys": error_keys}
+
+
+def apply_tail_record(v: Volume, needle_id: int, cookie: int,
+                      data: bytes, is_delete: bool = False,
+                      is_compressed: bool = False) -> bool:
+    """Apply one VolumeTailSender record to a local replica; returns
+    True when the replica changed.  Identical needles are left alone
+    (and the volume's own write dedup backstops that), divergent or
+    unreadable (bit-rotten) ones are overwritten by a fresh append —
+    the append updates the map offset, so the rotten bytes become
+    unreferenced garbage for the next vacuum."""
+    if is_delete:
+        if not v.has_needle(needle_id):
+            return False
+        v.delete_needle(needle_id)
+        return True
+    try:
+        local = v.read_needle(needle_id)
+        if local.cookie == cookie and bytes(local.data) == data:
+            return False
+    except NotFoundError:
+        pass  # missing here: write it
+    except Exception as e:
+        # unreadable local record (CRC rot, torn bytes): replace it
+        LOG.info("scrub: replacing unreadable needle %x in volume %d: "
+                 "%s", needle_id, v.id, e)
+    n = Needle(id=needle_id, cookie=cookie, data=data)
+    if is_compressed:
+        n.set_is_compressed()
+    v.write_needle(n)
+    return True

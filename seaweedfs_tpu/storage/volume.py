@@ -107,6 +107,11 @@ class Volume:
         self.backend_kind = backend_kind
         self._lock = threading.RLock()
         self.last_modified = 0
+        # ns-resolution activity clock: the scrub's authority signal.
+        # Seconds (last_modified) tie too easily — a write and the
+        # delete that follows it often share a second, and a tie there
+        # picks authority by needle count, which resurrects the delete.
+        self.last_modified_ns = 0
         # set when a write-path IO error degraded this volume to
         # read-only (ENOSPC, a dying disk); reported via /status and the
         # heartbeat path so the master stops assigning here
@@ -138,6 +143,19 @@ class Volume:
                 ttl=ttl)
             self.data_backend.write_at(self.super_block.to_bytes(), 0)
         self.version = self.super_block.version
+        if dat_exists and tiered is None:
+            # restore the activity clocks across restarts from the
+            # .dat mtime (every append — writes AND tombstones —
+            # touches it).  A zero clock after restart would hand
+            # scrub authority to any replica that stayed up, even one
+            # that missed this replica's deletes (resurrection), and
+            # would misreport the volume as infinitely quiet.
+            try:
+                st = os.stat(base + ".dat")
+                self.last_modified_ns = st.st_mtime_ns
+                self.last_modified = int(st.st_mtime)
+            except OSError:
+                pass
         self._check_and_fix(base)
         self.nm: NeedleMapper = new_needle_map(needle_map_kind, base)
         # the read snapshot: (needle map, data backend) swapped as ONE
@@ -245,6 +263,7 @@ class Volume:
                         f"volume {self.id} degraded to read-only: {e}"
                     ) from e
             self.last_modified = int(time.time())
+            self.last_modified_ns = time.time_ns()
             return size
 
     # -- group-commit write path (volume_write.go:233-306) ----------------
@@ -509,6 +528,7 @@ class Volume:
                 ) from e
             self.nm.delete(n_id, nv.offset)
             self.last_modified = int(time.time())
+            self.last_modified_ns = time.time_ns()
             return nv.size
 
     # -- stats ------------------------------------------------------------

@@ -53,7 +53,14 @@ class SimCluster:
                  jwt_key: "str | None" = None,
                  tls: bool = False,
                  base_dir: "str | None" = None, seed: int = 0,
-                 encrypt_data: bool = False):
+                 encrypt_data: bool = False,
+                 repair_interval: float = 0.0,
+                 repair: "dict | None" = None):
+        # self-healing loop (master/repair.py): off by default so kill/
+        # partition tests observe raw degradation; chaos-convergence
+        # tests turn it on with tight knobs via `repair={...}`
+        self._repair_interval = repair_interval
+        self._repair = repair
         self.encrypt_data = encrypt_data
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="simcluster-")
         self.pulse = pulse_seconds
@@ -94,7 +101,8 @@ class SimCluster:
             if self.peers else None
         return MasterServer(
             grpc_port=port, peers=self.peers, jwt_signing_key=self.jwt_key,
-            raft_dir=raft_dir, election_timeout=0.3, seed=self._seed + i)
+            raft_dir=raft_dir, election_timeout=0.3, seed=self._seed + i,
+            repair_interval=self._repair_interval, repair=self._repair)
 
     def _make_vs(self, i: int) -> VolumeServer:
         return VolumeServer(
@@ -216,6 +224,30 @@ class SimCluster:
                 pass
             time.sleep(0.05)
         raise TimeoutError(f"{n} volume servers never registered")
+
+    def wait_for_replication(self, vids, copies: int = 2,
+                             timeout: float = 20.0) -> float:
+        """Block until every given volume id has >= `copies` locations
+        in the leader's topology (the repair-convergence wait); returns
+        the wall time it took.  Raises TimeoutError listing the volumes
+        still under-replicated."""
+        t0 = time.time()
+        deadline = t0 + timeout
+        lagging = list(vids)
+        while time.time() < deadline:
+            try:
+                m = self.masters[self.leader_index()]
+            except RuntimeError:
+                time.sleep(0.05)
+                continue
+            lagging = [vid for vid in vids
+                       if len(m.topo.lookup("", vid)) < copies]
+            if not lagging:
+                return time.time() - t0
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"volumes {lagging} still under {copies} copies after "
+            f"{timeout}s")
 
     def sync_heartbeats(self) -> None:
         for vs in self.volume_servers:

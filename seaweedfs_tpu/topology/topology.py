@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time as _time
 from typing import Optional
 
 from ..storage.ec.shard_bits import ShardBits
@@ -203,6 +204,12 @@ class Topology:
                         "grpc_port": dn.grpc_port,
                         "public_url": dn.public_url,
                         "max_volumes": dn.max_volumes,
+                        # mid-churn guard: a node swept between this
+                        # snapshot and plan execution flips inactive;
+                        # planners must not copy from/to it
+                        "is_active": dn.is_active,
+                        "last_seen_age_s": round(
+                            max(0.0, _time.time() - dn.last_seen), 3),
                         "volumes": [vars(v) for v in dn.volumes.values()],
                         "ec_shards": {str(vid): int(bits)
                                       for vid, bits in dn.ec_shards.items()},

@@ -826,6 +826,8 @@ class VolumeServer:
                 "VolumeEcBlobDelete": self._rpc_ec_blob_delete,
                 "VolumeEcShardsToVolume": self._rpc_ec_to_volume,
                 "VolumeEcGeometry": self._rpc_ec_geometry,
+                "VolumeNeedleDigest": self._rpc_needle_digest,
+                "VolumeSyncFrom": self._rpc_volume_sync_from,
             },
             stream={
                 "VolumeEcShardRead": self._rpc_ec_shard_read,
@@ -833,6 +835,47 @@ class VolumeServer:
                 "Query": self._rpc_query,
                 "VolumeTailSender": self._rpc_volume_tail,
             })
+
+    def _rpc_needle_digest(self, req: dict) -> dict:
+        """Offset-free digest of the volume's live needles (the
+        anti-entropy scrub's comparison unit, storage/scrub.py).
+        deep=True re-reads every record with CRC verification — the
+        bit-rot scan — and reports unreadable keys."""
+        from ..storage import scrub
+        return scrub.volume_digest(self._find_volume(req),
+                                   deep=bool(req.get("deep")))
+
+    def _rpc_volume_sync_from(self, req: dict) -> dict:
+        """Reconcile this replica from an authoritative peer by tailing
+        its VolumeTailSender stream (the repair planner's divergence
+        fix): missing needles are written, divergent or bit-rotten ones
+        overwritten, tombstones re-applied.  `only_keys` scopes the
+        apply to those needle ids — the planner's bit-rot repair,
+        which must touch nothing but the unreadable records."""
+        from ..storage import scrub
+        vid = int(req["volume_id"])
+        v = self._find_volume(req)
+        only = {int(k) for k in req.get("only_keys", [])} or None
+        src = POOL.client(req["source_data_node"], "VolumeServer")
+        applied = 0
+        for r in src.stream("VolumeTailSender", iter([{
+                "volume_id": vid,
+                "since_ns": int(req.get("since_ns", 0))}])):
+            if only is not None and int(r["needle_id"]) not in only:
+                continue
+            changed = scrub.apply_tail_record(
+                v, int(r["needle_id"]), int(r["cookie"]),
+                from_b64(r["needle_blob"]),
+                is_delete=bool(r.get("is_delete")),
+                is_compressed=bool(r.get("is_compressed")))
+            if changed:
+                applied += 1
+                self.needle_cache.invalidate(vid, int(r["needle_id"]))
+        if applied:
+            # reconciled content changes the heartbeat counters; tell
+            # the master now, not a pulse later
+            self._hb_wake.set()
+        return {"applied": applied}
 
     def _rpc_volume_tail(self, requests):
         """Stream needles appended after since_ns — the incremental
@@ -861,6 +904,7 @@ class VolumeServer:
                 yield {"needle_id": full.id, "cookie": full.cookie,
                        "append_at_ns": full.append_at_ns,
                        "is_delete": full.size == 0 and not full.data,
+                       "is_compressed": full.is_compressed(),
                        "needle_blob": to_b64(bytes(full.data))}
 
     def _rpc_query(self, requests):
@@ -952,6 +996,10 @@ class VolumeServer:
         # coarse but rare: a recreated vid must never serve the old
         # volume's cached needles
         self.needle_cache.clear()
+        # the repair loop's trim guard reads the master's topology:
+        # this deletion must be visible there NOW, or a second trim of
+        # the same volume still counts the removed copy
+        self._hb_wake.set()
         return {}
 
     def _find_volume(self, req: dict):
@@ -1041,6 +1089,9 @@ class VolumeServer:
         loc.load_existing_volumes()
         if not self.store.has_volume(vid):
             raise RpcError(f"volume {vid} failed to load after copy")
+        # the repair loop's MTTR depends on the master learning about
+        # the new replica immediately, not a pulse later
+        self._hb_wake.set()
         return {"last_append_at_ns": 0}
 
     # vacuum
@@ -1256,6 +1307,7 @@ class VolumeServer:
         self.store.mount_ec_shards(
             int(req["volume_id"]), req.get("collection", ""),
             [int(s) for s in req.get("shard_ids", [])])
+        self._hb_wake.set()  # rebuilt/moved shards register this pulse
         return {}
 
     def _rpc_ec_unmount(self, req: dict) -> dict:

@@ -98,10 +98,22 @@ class MasterClient:
                 lst = self._vid_map.setdefault(int(vid), [])
                 if entry not in lst:
                     lst.append(entry)
+                # a fresh stream-fed location supersedes any RPC-cached
+                # answer — ESPECIALLY a negative one: a repaired volume
+                # must serve immediately, not after the negative TTL
+                self._vid_rpc.pop(int(vid), None)
             for vid in loc.get("deleted_vids", []):
                 lst = self._vid_map.get(int(vid), [])
                 self._vid_map[int(vid)] = [e for e in lst
                                            if e["url"] != loc["url"]]
+        if loc.get("new_vids"):
+            # the node is demonstrably alive (the master just announced
+            # volumes on it): clear the process-wide transport negative
+            # caches so reads stop skipping the healed replica
+            from .. import operation
+            operation.mark_http_alive(loc["url"])
+            if entry.get("tcp_url"):
+                operation.mark_tcp_alive(entry["tcp_url"])
 
     def _keep_connected_loop(self) -> None:
         # jittered backoff between reconnects: a master restart must not

@@ -64,6 +64,92 @@ def test_plan_fix_replication_finds_under_replicated():
     assert fix["to"] != "n2"
 
 
+_GRPC = {"n1": "10.0.0.1:81", "n2": "10.0.0.2:81",
+         "n3": "10.0.0.3:81", "n4": "10.0.0.4:81"}
+
+
+def _two_rack_topo(vol_by_node: dict, rp: int = 0, extra: dict = None):
+    """dc1 racks r1(n1,n2) r2(n3,n4); vol_by_node: node -> [vid].
+    `extra` overrides node dicts (key "n1") or volume dicts
+    (key ("n1", vid))."""
+    extra = extra or {}
+
+    def node(nid):
+        ip = _GRPC[nid].split(":")[0]
+        return dict({"id": nid, "ip": ip, "port": 80,
+                     "grpc_port": 81, "public_url": nid,
+                     "max_volumes": 20,
+                     "volumes": [dict({"id": v, "size": 100,
+                                       "collection": "",
+                                       "replica_placement": rp,
+                                       "modified_at_second": 0},
+                                      **extra.get((nid, v), {}))
+                                 for v in vol_by_node.get(nid, [])]},
+                    **extra.get(nid, {}))
+    return {"max_volume_id": 10, "data_centers": [{
+        "id": "dc1", "racks": [
+            {"id": "r1", "data_nodes": [node("n1"), node("n2")]},
+            {"id": "r2", "data_nodes": [node("n3"), node("n4")]},
+        ]}]}
+
+
+def test_plan_fix_replication_trims_over_replicated_prefers_degraded():
+    """rp=000 (one copy) held twice: trim exactly one, and it must be
+    the degraded/read-only copy, not the healthy one."""
+    topo = _two_rack_topo({"n1": [1], "n3": [1]}, rp=0, extra={
+        ("n3", 1): {"read_only": True, "degraded_reason": "write: io"}})
+    fixes = plan_fix_replication(topo)
+    trims = [f for f in fixes if f.get("action") == "trim"]
+    assert len(trims) == 1
+    assert trims[0]["volume_id"] == 1 and trims[0]["node"] == "n3"
+
+
+def test_plan_fix_replication_target_respects_rack_placement():
+    """rp=010 needs the new copy in a DIFFERENT rack from the holder,
+    even when a same-rack node is emptier."""
+    topo = _two_rack_topo({"n1": [1], "n4": [7, 8, 9]}, rp=10)
+    fixes = [f for f in plan_fix_replication(topo)
+             if f["volume_id"] == 1]
+    assert fixes, "under-replicated 010 volume must get a fix"
+    assert fixes[0]["to"] == "n3", \
+        "010 placement requires the other rack (emptiest there)"
+
+
+def test_plan_fix_replication_same_rack_placement():
+    """rp=001 wants the copy in the SAME rack as the holder."""
+    topo = _two_rack_topo({"n1": [1]}, rp=1)
+    fixes = plan_fix_replication(topo)
+    assert fixes and fixes[0]["to"] == "n2"
+
+
+def test_plan_fix_replication_skips_just_unregistered_source():
+    """Mid-churn: a holder swept between snapshot and execution is
+    inactive — its copy neither counts nor serves as a copy source."""
+    topo = _two_rack_topo({"n1": [1], "n3": [1]}, rp=10, extra={
+        "n1": {"is_active": False}})
+    fixes = plan_fix_replication(topo)
+    copy = next(f for f in fixes
+                if f["volume_id"] == 1 and f.get("action") == "copy")
+    # n1's ghost copy is invisible: source must be n3, and the new
+    # target must not be the dead n1
+    assert copy["from_grpc"] == _GRPC["n3"]
+    assert copy["to"] != "n1"
+
+
+def test_plan_fix_replication_source_prefers_healthy_copy():
+    """Copying FROM the degraded replica risks propagating its torn
+    state; the healthy holder must be the source."""
+    topo = _two_rack_topo({"n1": [1], "n2": [1]}, rp=11, extra={
+        ("n1", 1): {"read_only": True, "degraded_reason": "write: io"}})
+    # rp=011 wants 3 copies (1 same-rack + 1 diff-rack); the missing
+    # one belongs in r2, sourced from the healthy n2
+    fixes = [f for f in plan_fix_replication(topo)
+             if f.get("action") == "copy"]
+    assert fixes
+    assert fixes[0]["from_grpc"] == _GRPC["n2"]
+    assert fixes[0]["to"] in ("n3", "n4")
+
+
 def test_collect_volume_ids_for_ec_encode():
     topo = fake_topo()
     vids = collect_volume_ids_for_ec_encode(

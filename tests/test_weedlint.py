@@ -61,6 +61,12 @@ def test_bad_retry_fixture():
     assert got == [("WL060", 12), ("WL060", 16), ("WL060", 20)]
 
 
+def test_bad_leadership_fixture():
+    got = _ids_lines(_findings(os.path.join(FIXTURES,
+                                            "bad_leadership.py")))
+    assert got == [("WL070", 8), ("WL070", 16)]
+
+
 def test_bad_dataplane_fixture():
     got = _ids_lines(_findings(os.path.join(FIXTURES, "bad_dataplane.py")))
     assert got == [("WL050", 7), ("WL050", 9), ("WL050", 16)]

@@ -1,0 +1,31 @@
+"""WL070 fixtures: topology-mutating loops that never (or only once)
+check leadership.  Line numbers are pinned by tests/test_weedlint.py."""
+
+
+def repair_loop_never_checks(topo, stop):
+    while not stop.is_set():
+        for dn in topo.data_nodes():
+            topo.unregister_data_node(dn)   # line 8: WL070
+        stop.wait(1.0)
+
+
+def repair_loop_stale_snapshot(master, stop):
+    leader = master.is_leader   # checked ONCE, before the loop
+    while not stop.is_set():
+        if leader:
+            master.topo.unregister_data_node(None)   # line 16: WL070
+        stop.wait(1.0)
+
+
+def good_loop_checks_per_iteration(master, stop):
+    while not stop.is_set():
+        if not master.is_leader:
+            continue
+        master.topo.unregister_data_node(None)   # clean: gated per tick
+        stop.wait(1.0)
+
+
+def good_loop_checks_in_condition(master, stop):
+    while master.is_leader and not stop.is_set():
+        master.topo.set_volume_unavailable(1, None)   # clean: test expr
+        stop.wait(1.0)

@@ -7,3 +7,4 @@ from . import exceptions   # noqa: F401
 from . import resources    # noqa: F401
 from . import dataplane    # noqa: F401
 from . import retryhygiene  # noqa: F401
+from . import leadership   # noqa: F401

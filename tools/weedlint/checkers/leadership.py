@@ -1,0 +1,75 @@
+"""WL070 leadership-gated topology mutation — repair/scrub-style loops
+that mutate cluster topology without re-checking leadership per
+iteration.
+
+ISSUE 7's repair planner runs long-lived `while` loops on the master
+that unregister nodes and rewrite replica state.  A master can be
+deposed at ANY time (raft election, partition heal); a loop that checks
+``is_leader`` once before entering — or never — keeps mutating topology
+it no longer owns, and two masters repairing the same volumes is a
+split-brain re-replication storm.  The rule: a ``while`` loop whose body
+calls a topology mutator must reference ``is_leader`` somewhere inside
+the loop (the test expression counts: it is re-evaluated every
+iteration).  A stale snapshot taken before the loop
+(``leader = self.is_leader``) does not count — that is exactly the
+checked-once bug.
+
+Scoped to master modules (the only place leadership exists) and the
+fixture corpus.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .. import Finding, ModuleContext, register
+
+_SCOPE_PARTS = ("seaweedfs_tpu/master",)
+
+# Topology-mutating calls: the master-side state a deposed leader must
+# stop touching (topology.py / volume_layout.py mutators).
+_MUTATORS = {
+    "unregister_data_node", "register_volume", "unregister_volume",
+    "sync_data_node", "sync_ec_shards", "set_volume_unavailable",
+    "set_volume_readonly", "set_volume_writable", "unlink_child",
+    "freeze_writable",
+}
+
+
+def _in_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(part in p for part in _SCOPE_PARTS) \
+        or "weedlint_fixtures" in p
+
+
+def _references_is_leader(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr == "is_leader":
+            return True
+        if isinstance(n, ast.Name) and n.id == "is_leader":
+            return True
+    return False
+
+
+@register("WL070", "leadership-gate")
+def check_leadership_gate(ctx: ModuleContext) -> Iterator[Finding]:
+    if not _in_scope(ctx.path):
+        return
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, ast.While):
+            continue
+        if _references_is_leader(loop):
+            continue  # re-checked per iteration (body or test expr)
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                yield Finding(
+                    "WL070", "leadership-gate", ctx.path, node.lineno,
+                    f"topology mutator {node.func.attr}() inside a "
+                    "while loop that never re-checks is_leader",
+                    "check is_leader EVERY iteration (in the loop body "
+                    "or the while condition), not once before the "
+                    "loop — a deposed master must stop mutating "
+                    "topology immediately")
