@@ -424,13 +424,22 @@ class FilerServer:
             # the entry must expire with its TTL-volume chunks, or it
             # dangles after the master reclaims the volume
             ttl_sec = TTL.parse(rule["ttl"]).minutes() * 60
+        # `Seaweed-<name>` headers ride into extended attributes (the
+        # upstream convention, filer_server_handlers_write.go
+        # SaveAmzMetaData analogue): the S3 gateway stamps ownership and
+        # ACL grants this way in the SAME upload round-trip instead of a
+        # lookup+update pair per PUT
+        extended = {"etag": hashlib.md5(body).hexdigest()}
+        for h, v in req.headers.items():
+            if h.lower().startswith("seaweed-"):
+                extended[h[len("Seaweed-"):]] = v
         entry = Entry(
             full_path=path.rstrip("/"),
             attr=Attr(mtime=now, crtime=now, mode=0o660,
                       mime=req.headers.get("Content-Type", ""),
                       ttl_sec=ttl_sec),
             chunks=chunks,
-            extended={"etag": hashlib.md5(body).hexdigest()})
+            extended=extended)
         self.filer.create_entry(entry)
         return Response.json({"name": entry.name,
                               "size": total_size(chunks)}, status=201)

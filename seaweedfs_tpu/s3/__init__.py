@@ -1,5 +1,9 @@
 """S3 gateway: SigV4-authenticated REST over the filer (reference weed/s3api)."""
 
+from .acl import (ACL_ATTR, OWNER_ATTR, POLICY_ATTR, AccessControlPolicy,
+                  AclError, Grant, acl_allows, canned_acl,
+                  grants_from_headers, parse_bucket_policy,
+                  policy_decision)
 from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_TAGGING,
                    ACTION_WRITE, Identity, IdentityAccessManagement,
                    S3AuthError, presign_url, sign_v4)

@@ -1,0 +1,503 @@
+"""S3 access control — the real ACL engine plus bucket policy.
+
+Capability-equivalent to the reference fork's flagship feature
+(weed/s3api/acl.go + filer_util_acl.go, ~730 LoC): per-bucket and
+per-object AccessControlPolicy documents (owner + grant list) expressed
+as canned ACLs, ``x-amz-grant-*`` headers, or ``<AccessControlPolicy>``
+XML bodies, persisted in the filer entry's ``extended`` attributes and
+evaluated on every S3 verb by the gateway's authz gate
+(s3/server.py ``_authz``), fused with IAM identity actions and the
+bucket policy document.
+
+Evaluation semantics (the fork's model, documented here because AWS
+leaves room):
+
+- The OWNER of a resource always holds FULL_CONTROL over it: the bucket
+  owner over the bucket (and over bucket-targeted object actions such as
+  PutObject/DeleteObject — the bucket is the tenant boundary), the
+  object owner over the object.  The bucket owner does NOT implicitly
+  read foreign objects: that is what the ``bucket-owner-read`` /
+  ``bucket-owner-full-control`` canned ACLs grant at upload time.
+- Object-targeted reads also honor the BUCKET's explicit grants (the
+  cascade that makes a ``public-read`` bucket serve its objects to
+  anonymous clients, acl.go's bucket-default path).
+- The AllUsers group matches every requester; AuthenticatedUsers
+  matches any non-anonymous identity (including presigned access, which
+  authenticates as the signer).
+
+Nothing here talks to the filer: the engine is pure data + decisions,
+so it unit-tests without a cluster and the server wires persistence.
+"""
+
+from __future__ import annotations
+
+import json
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+
+from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_TAGGING,
+                   ACTION_WRITE, ANONYMOUS_NAME)
+
+# extended-attribute keys on filer entries (filer/entry.py Entry.extended
+# carries them end-to-end; the shell's quota.* markers use the same plane)
+ACL_ATTR = "s3.acl"        # JSON AccessControlPolicy grants
+OWNER_ATTR = "s3.owner"    # identity name that created the resource
+POLICY_ATTR = "s3.policy"  # bucket policy JSON document (buckets only)
+
+# the identity name unauthenticated requests get — ONE constant, shared
+# with Identity.is_anonymous (auth.py): drift here would let anonymous
+# traffic match AuthenticatedUsers grants
+ANONYMOUS = ANONYMOUS_NAME
+
+# -- permissions (acl.go Permission) ----------------------------------------
+PERM_FULL_CONTROL = "FULL_CONTROL"
+PERM_READ = "READ"
+PERM_WRITE = "WRITE"
+PERM_READ_ACP = "READ_ACP"
+PERM_WRITE_ACP = "WRITE_ACP"
+PERMISSIONS = frozenset({PERM_FULL_CONTROL, PERM_READ, PERM_WRITE,
+                         PERM_READ_ACP, PERM_WRITE_ACP})
+
+# -- grantee groups (acl.go s3_constants) -----------------------------------
+GROUP_ALL_USERS = "http://acs.amazonaws.com/groups/global/AllUsers"
+GROUP_AUTH_USERS = \
+    "http://acs.amazonaws.com/groups/global/AuthenticatedUsers"
+GROUPS = frozenset({GROUP_ALL_USERS, GROUP_AUTH_USERS})
+
+XMLNS_S3 = "http://s3.amazonaws.com/doc/2006-03-01/"
+XMLNS_XSI = "http://www.w3.org/2001/XMLSchema-instance"
+
+
+class AclError(Exception):
+    """Malformed ACL/policy input -> 400 at the handler."""
+
+
+@dataclass(frozen=True)
+class Grant:
+    """One ACL grant: a permission for a canonical user OR a group."""
+    permission: str
+    grantee_id: str = ""     # canonical user id (identity name)
+    group_uri: str = ""      # mutually exclusive with grantee_id
+    display_name: str = ""
+
+    def matches(self, requester: str, authenticated: bool) -> bool:
+        if self.group_uri == GROUP_ALL_USERS:
+            return True
+        if self.group_uri == GROUP_AUTH_USERS:
+            return authenticated
+        return bool(self.grantee_id) and self.grantee_id == requester \
+            and authenticated
+
+    def implies(self, permission: str) -> bool:
+        return self.permission == PERM_FULL_CONTROL \
+            or self.permission == permission
+
+
+@dataclass
+class AccessControlPolicy:
+    owner: str = ""
+    grants: list[Grant] = field(default_factory=list)
+
+    # -- JSON persistence (the extended-attr payload) ----------------------
+    def to_json(self) -> str:
+        grants = []
+        for g in self.grants:
+            d = {"permission": g.permission}
+            if g.grantee_id:
+                d["id"] = g.grantee_id
+            if g.group_uri:
+                d["uri"] = g.group_uri
+            if g.display_name:
+                d["display"] = g.display_name
+            grants.append(d)
+        return json.dumps({"owner": self.owner, "grants": grants},
+                          separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, payload: str) -> "AccessControlPolicy":
+        try:
+            d = json.loads(payload)
+            grants = [Grant(permission=g["permission"],
+                            grantee_id=g.get("id", ""),
+                            group_uri=g.get("uri", ""),
+                            display_name=g.get("display", ""))
+                      for g in d.get("grants", [])]
+            return cls(owner=d.get("owner", ""), grants=grants)
+        except (ValueError, KeyError, TypeError) as e:
+            raise AclError(f"stored ACL is corrupt: {e}") from None
+
+    # -- XML wire format (Get/PutAcl bodies) -------------------------------
+    def to_xml(self) -> bytes:
+        root = ET.Element("AccessControlPolicy", {"xmlns": XMLNS_S3})
+        owner = ET.SubElement(root, "Owner")
+        ET.SubElement(owner, "ID").text = self.owner
+        ET.SubElement(owner, "DisplayName").text = self.owner
+        acl = ET.SubElement(root, "AccessControlList")
+        for g in self.grants:
+            grant = ET.SubElement(acl, "Grant")
+            if g.group_uri:
+                grantee = ET.SubElement(grant, "Grantee", {
+                    "xmlns:xsi": XMLNS_XSI, "xsi:type": "Group"})
+                ET.SubElement(grantee, "URI").text = g.group_uri
+            else:
+                grantee = ET.SubElement(grant, "Grantee", {
+                    "xmlns:xsi": XMLNS_XSI, "xsi:type": "CanonicalUser"})
+                ET.SubElement(grantee, "ID").text = g.grantee_id
+                ET.SubElement(grantee, "DisplayName").text = \
+                    g.display_name or g.grantee_id
+            ET.SubElement(grant, "Permission").text = g.permission
+        return (b'<?xml version="1.0" encoding="UTF-8"?>'
+                + ET.tostring(root))
+
+    @classmethod
+    def from_xml(cls, body: bytes) -> "AccessControlPolicy":
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError as e:
+            raise AclError(f"MalformedACLError: {e}") from None
+        if _local(root.tag) != "AccessControlPolicy":
+            raise AclError("body must be an <AccessControlPolicy>")
+        owner = ""
+        grants: list[Grant] = []
+        for child in root:
+            tag = _local(child.tag)
+            if tag == "Owner":
+                owner = _child_text(child, "ID")
+            elif tag == "AccessControlList":
+                for grant_el in child:
+                    if _local(grant_el.tag) != "Grant":
+                        continue
+                    grants.append(_parse_grant(grant_el))
+        return cls(owner=owner, grants=grants)
+
+
+def _local(tag: str) -> str:
+    return tag.rsplit("}", 1)[-1]
+
+
+def _child_text(el: ET.Element, name: str) -> str:
+    for child in el:
+        if _local(child.tag) == name:
+            return child.text or ""
+    return ""
+
+
+def _parse_grant(grant_el: ET.Element) -> Grant:
+    permission = _child_text(grant_el, "Permission")
+    if permission not in PERMISSIONS:
+        raise AclError(f"unknown Permission {permission!r}")
+    for child in grant_el:
+        if _local(child.tag) != "Grantee":
+            continue
+        gtype = next((v for k, v in child.attrib.items()
+                      if _local(k) == "type"), "")
+        uri = _child_text(child, "URI")
+        gid = _child_text(child, "ID")
+        if uri or gtype == "Group":
+            if uri not in GROUPS:
+                raise AclError(f"unknown grantee group {uri!r}")
+            return Grant(permission=permission, group_uri=uri)
+        if gtype == "AmazonCustomerByEmail":
+            raise AclError("email grantees are not supported; grant "
+                           "by canonical ID or group URI")
+        if not gid:
+            raise AclError("Grantee needs an ID or a group URI")
+        return Grant(permission=permission, grantee_id=gid,
+                     display_name=_child_text(child, "DisplayName"))
+    raise AclError("Grant without a Grantee")
+
+
+# -- canned ACLs (acl.go canned expansion) ----------------------------------
+
+CANNED_ACLS = frozenset({
+    "private", "public-read", "public-read-write", "authenticated-read",
+    "bucket-owner-read", "bucket-owner-full-control",
+})
+
+
+def canned_acl(name: str, owner: str,
+               bucket_owner: str = "") -> AccessControlPolicy:
+    """Expand a canned ACL into its grant list.  ``bucket_owner`` feeds
+    the object-only ``bucket-owner-*`` canned forms."""
+    if name not in CANNED_ACLS:
+        raise AclError(f"unknown canned ACL {name!r}")
+    grants = [Grant(permission=PERM_FULL_CONTROL, grantee_id=owner)]
+    if name == "public-read":
+        grants.append(Grant(PERM_READ, group_uri=GROUP_ALL_USERS))
+    elif name == "public-read-write":
+        grants.append(Grant(PERM_READ, group_uri=GROUP_ALL_USERS))
+        grants.append(Grant(PERM_WRITE, group_uri=GROUP_ALL_USERS))
+    elif name == "authenticated-read":
+        grants.append(Grant(PERM_READ, group_uri=GROUP_AUTH_USERS))
+    elif name == "bucket-owner-read":
+        if bucket_owner and bucket_owner != owner:
+            grants.append(Grant(PERM_READ, grantee_id=bucket_owner))
+    elif name == "bucket-owner-full-control":
+        if bucket_owner and bucket_owner != owner:
+            grants.append(Grant(PERM_FULL_CONTROL,
+                                grantee_id=bucket_owner))
+    return AccessControlPolicy(owner=owner, grants=grants)
+
+
+# -- x-amz-grant-* headers --------------------------------------------------
+
+GRANT_HEADERS = {
+    "x-amz-grant-read": PERM_READ,
+    "x-amz-grant-write": PERM_WRITE,
+    "x-amz-grant-read-acp": PERM_READ_ACP,
+    "x-amz-grant-write-acp": PERM_WRITE_ACP,
+    "x-amz-grant-full-control": PERM_FULL_CONTROL,
+}
+
+
+def grants_from_headers(headers) -> "list[Grant] | None":
+    """Parse ``x-amz-grant-<perm>: id="name", uri="http://..."`` headers
+    -> grant list, or None when no grant header is present.  Email
+    grantees are rejected (no identity directory maps emails)."""
+    out: list[Grant] = []
+    seen = False
+    for header, permission in GRANT_HEADERS.items():
+        value = headers.get(header, "")
+        if not value:
+            continue
+        seen = True
+        for part in value.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            kind, _, val = part.partition("=")
+            kind = kind.strip().lower()
+            val = val.strip().strip('"')
+            if not val:
+                raise AclError(f"empty grantee in {header}")
+            if kind == "id":
+                out.append(Grant(permission=permission, grantee_id=val))
+            elif kind == "uri":
+                if val not in GROUPS:
+                    raise AclError(f"unknown grantee group {val!r}")
+                out.append(Grant(permission=permission, group_uri=val))
+            elif kind == "emailaddress":
+                raise AclError("email grantees are not supported; "
+                               "grant by id= or uri=")
+            else:
+                raise AclError(f"malformed grantee {part!r} in {header}")
+    return out if seen else None
+
+
+def has_acl_source(headers, body: bytes) -> bool:
+    """Does the request carry ANY ACL input (body, canned header, or
+    grant headers)?  PutAcl must 400 on none — AWS's
+    MissingSecurityHeader — rather than silently reset to private."""
+    return bool(body) or bool(headers.get("x-amz-acl", "")) \
+        or any(headers.get(h, "") for h in GRANT_HEADERS)
+
+
+def acl_from_request(headers, body: bytes, owner: str,
+                     bucket_owner: str = "") -> AccessControlPolicy:
+    """The PutAcl / object-create ACL source precedence: XML body,
+    x-amz-grant-* headers, x-amz-acl canned header, default private —
+    mixing body with headers (or canned with explicit grants) is
+    rejected like AWS's InvalidRequest."""
+    canned = headers.get("x-amz-acl", "")
+    grants = grants_from_headers(headers)
+    sources = sum((1 if body else 0, 1 if canned else 0,
+                   0 if grants is None else 1))
+    if sources > 1:
+        raise AclError("specify the ACL via canned header, grant "
+                       "headers, OR an XML body — not several at once")
+    if body:
+        acp = AccessControlPolicy.from_xml(body)
+        # the stored owner is authoritative; an XML Owner cannot
+        # transfer ownership
+        acp.owner = owner
+        return acp
+    if grants is not None:
+        return AccessControlPolicy(owner=owner, grants=grants)
+    return canned_acl(canned or "private", owner, bucket_owner)
+
+
+# -- evaluation -------------------------------------------------------------
+
+def acl_allows(acp: "AccessControlPolicy | None", requester: str,
+               authenticated: bool, permission: str) -> bool:
+    """Do the EXPLICIT grants permit? (Owner implicit-full-control is the
+    caller's rule — it needs the resource owner, which may live in a
+    separate extended attr on entries that predate ACL stamping.)"""
+    if acp is None:
+        return False
+    return any(g.implies(permission)
+               and g.matches(requester, authenticated)
+               for g in acp.grants)
+
+
+# Which ACL permission each S3 action needs, and on whose ACL —
+# mirroring the reference fork's action table (acl.go:401-441): object
+# creation/deletion are BUCKET-write concerns (the tenant boundary),
+# reads are object concerns (with the bucket-grant cascade applied by
+# the gate), and the *_ACP permissions guard the ACL sub-resource
+# itself.  Actions absent from this table (bucket CRUD, policy CRUD,
+# ListAllMyBuckets) have no ACL path: only IAM, bucket policy, or
+# resource ownership can allow them.
+ACL_ACTION_MAP: dict[str, tuple[str, str]] = {
+    "s3:GetObject": ("object", PERM_READ),
+    "s3:GetObjectTagging": ("object", PERM_READ),
+    "s3:GetObjectAcl": ("object", PERM_READ_ACP),
+    "s3:PutObjectAcl": ("object", PERM_WRITE_ACP),
+    "s3:PutObject": ("bucket", PERM_WRITE),
+    "s3:DeleteObject": ("bucket", PERM_WRITE),
+    "s3:PutObjectTagging": ("bucket", PERM_WRITE),
+    "s3:DeleteObjectTagging": ("bucket", PERM_WRITE),
+    "s3:AbortMultipartUpload": ("bucket", PERM_WRITE),
+    "s3:ListMultipartUploadParts": ("bucket", PERM_READ),
+    "s3:ListBucket": ("bucket", PERM_READ),
+    "s3:ListBucketMultipartUploads": ("bucket", PERM_READ),
+    "s3:GetBucketLocation": ("bucket", PERM_READ),
+    "s3:GetBucketAcl": ("bucket", PERM_READ_ACP),
+    "s3:PutBucketAcl": ("bucket", PERM_WRITE_ACP),
+}
+
+# s3:Action -> the coarse IAM action strings identities carry
+# (auth.py Identity.can_do; optionally bucket-scoped "Read:bucketA").
+IAM_ACTION_MAP: dict[str, str] = {
+    "s3:GetObject": ACTION_READ,
+    "s3:GetObjectTagging": ACTION_READ,
+    "s3:GetObjectAcl": ACTION_READ,
+    "s3:GetBucketAcl": ACTION_READ,
+    "s3:GetBucketLocation": ACTION_READ,
+    "s3:ListMultipartUploadParts": ACTION_READ,
+    "s3:PutObject": ACTION_WRITE,
+    "s3:DeleteObject": ACTION_WRITE,
+    "s3:AbortMultipartUpload": ACTION_WRITE,
+    # ACL WRITES are Admin-grade on the IAM route: a coarse global
+    # "Write" must not be able to flip a foreign bucket public (owners
+    # and WRITE_ACP grantees still pass via the ACL route)
+    "s3:PutObjectAcl": ACTION_ADMIN,
+    "s3:PutBucketAcl": ACTION_ADMIN,
+    "s3:PutObjectTagging": ACTION_TAGGING,
+    "s3:DeleteObjectTagging": ACTION_TAGGING,
+    "s3:ListBucket": ACTION_LIST,
+    "s3:ListBucketMultipartUploads": ACTION_LIST,
+    "s3:CreateBucket": ACTION_ADMIN,
+    "s3:DeleteBucket": ACTION_ADMIN,
+    "s3:GetBucketPolicy": ACTION_ADMIN,
+    "s3:PutBucketPolicy": ACTION_ADMIN,
+    "s3:DeleteBucketPolicy": ACTION_ADMIN,
+}
+
+
+# -- bucket policy ----------------------------------------------------------
+
+def parse_bucket_policy(text: str) -> dict:
+    """Strict parse/validation of a bucket policy document.  Supported:
+    Effect Allow/Deny, Principal "*" / {"AWS": names}, Action strings
+    with trailing-* wildcards, Resource arns with trailing-* wildcards.
+    Unsupported elements (Condition, NotPrincipal, NotAction, ...) are
+    REJECTED at PUT time: silently ignoring a restriction the operator
+    wrote would widen access."""
+    try:
+        doc = json.loads(text)
+    except ValueError as e:
+        raise AclError(f"policy is not JSON: {e}") from None
+    if not isinstance(doc, dict):
+        raise AclError("policy must be a JSON object")
+    statements = doc.get("Statement")
+    if not isinstance(statements, list) or not statements:
+        raise AclError("policy needs a non-empty Statement list")
+    for stmt in statements:
+        if not isinstance(stmt, dict):
+            raise AclError("each Statement must be an object")
+        unknown = set(stmt) - {"Sid", "Effect", "Principal", "Action",
+                               "Resource"}
+        if unknown:
+            raise AclError(f"unsupported Statement elements: "
+                           f"{sorted(unknown)}")
+        if stmt.get("Effect") not in ("Allow", "Deny"):
+            raise AclError("Effect must be Allow or Deny")
+        for req in ("Principal", "Action", "Resource"):
+            if req not in stmt:
+                raise AclError(f"Statement needs {req}")
+        for action in _listify(stmt["Action"]):
+            if not isinstance(action, str) \
+                    or not action.startswith("s3:"):
+                raise AclError(f"unsupported Action {action!r}")
+            _require_trailing_glob(action)
+        for arn in _listify(stmt["Resource"]):
+            if not isinstance(arn, str) \
+                    or not arn.startswith("arn:aws:s3:::"):
+                raise AclError(f"unsupported Resource {arn!r}")
+            _require_trailing_glob(arn)
+        _principal_names(stmt["Principal"])  # validates shape
+    return doc
+
+
+def _listify(v) -> list:
+    return v if isinstance(v, list) else [v]
+
+
+def _principal_names(principal) -> "list[str] | str":
+    """-> "*" (everyone) or the list of identity names."""
+    if principal == "*":
+        return "*"
+    if isinstance(principal, dict) and "AWS" in principal:
+        names = _listify(principal["AWS"])
+        if not all(isinstance(n, str) for n in names):
+            raise AclError("Principal.AWS must be strings")
+        return "*" if "*" in names else names
+    raise AclError('Principal must be "*" or {"AWS": [...]}')
+
+
+def _require_trailing_glob(pattern: str) -> None:
+    """Only a TRAILING ``*`` is evaluated (_glob_match); accepting
+    ``b/*.secret`` at PUT and then comparing it literally would leave
+    the operator's restriction silently inert — the exact
+    widen-by-ignoring failure this parser exists to reject."""
+    if "*" in pattern[:-1]:
+        raise AclError(f"only a trailing * wildcard is supported, "
+                       f"got {pattern!r}")
+
+
+def _glob_match(pattern: str, value: str) -> bool:
+    if pattern.endswith("*"):
+        return value.startswith(pattern[:-1])
+    return pattern == value
+
+
+def policy_decision(doc: "dict | None", requester: str,
+                    authenticated: bool, action: str, bucket: str,
+                    key: str = "") -> "str | None":
+    """Evaluate the bucket policy -> "allow" | "deny" | None (silent).
+    An explicit Deny wins over any Allow (the AWS evaluation order the
+    gate relies on)."""
+    if not doc:
+        return None
+    resource = f"arn:aws:s3:::{bucket}"
+    if key:
+        resource += f"/{key}"
+    decision = None
+    statements = doc.get("Statement", [])
+    if not isinstance(statements, list):
+        return None
+    for stmt in statements:
+        try:
+            names = _principal_names(stmt["Principal"])
+            if names != "*" and (not authenticated
+                                 or requester not in names):
+                continue
+            if not any(_glob_match(a, action)
+                       for a in _listify(stmt["Action"])):
+                continue
+            if not any(_glob_match(r, resource)
+                       for r in _listify(stmt["Resource"])):
+                continue
+            effect = stmt["Effect"]
+        except (AclError, KeyError, TypeError, AttributeError):
+            # a statement written past the PUT validation (direct filer
+            # edit) must not crash the gate: it is skipped.  A skipped
+            # Allow grants nothing; a skipped Deny falls back to the
+            # default-deny unless some OTHER source allows — the PUT
+            # handler is the place malformed documents get rejected
+            continue
+        if effect == "Deny":
+            return "deny"
+        decision = "allow"
+    return decision

@@ -34,7 +34,8 @@ class AuditLog:
     def record(self, *, remote: str, requester: str, method: str,
                bucket: str, key: str, action: str, status: int,
                nbytes: int, duration_ms: float,
-               forwarded_for: str = "") -> None:
+               forwarded_for: str = "", authz: str = "",
+               authz_source: str = "") -> None:
         entry = {
             "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
             "remote": remote,
@@ -47,6 +48,12 @@ class AuditLog:
             "bytes": nbytes,
             "duration_ms": round(duration_ms, 2),
         }
+        if authz:
+            # the fused gate's verdict + which source decided it
+            # (iam | bucket-policy | acl-grant | anonymous) — the
+            # forensic trail for "who allowed this"
+            entry["authz"] = authz
+            entry["authz_source"] = authz_source
         if forwarded_for:
             entry["forwarded_for"] = forwarded_for
         line = json.dumps(entry, separators=(",", ":")) + "\n"

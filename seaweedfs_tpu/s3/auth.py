@@ -21,6 +21,8 @@ ACTION_WRITE = "Write"
 ACTION_LIST = "List"
 ACTION_TAGGING = "Tagging"
 
+ANONYMOUS_NAME = "anonymous"
+
 
 # SigV2 CanonicalizedResource sub-resources (AWS V2 signing spec)
 V2_SUBRESOURCES = frozenset({
@@ -57,9 +59,14 @@ class Identity:
         for a in self.actions:
             if a == action:
                 return True
-            if bucket and a == f"{action}:{bucket}":
+            if bucket and a in (f"{action}:{bucket}",
+                                f"{ACTION_ADMIN}:{bucket}"):
                 return True
         return False
+
+    @property
+    def is_anonymous(self) -> bool:
+        return self.name == ANONYMOUS_NAME
 
 
 class IdentityAccessManagement:
@@ -111,10 +118,22 @@ class IdentityAccessManagement:
             return self._verify_sigv2(method, path, query, headers)
         if "X-Amz-Signature" in _flat(query):
             return self._verify_presigned(method, path, query, headers)
+        if auth:
+            # an Authorization header that parses as NONE of the
+            # supported schemes is broken credentials, not anonymity —
+            # downgrading it would hand a misconfigured client silent
+            # public-ACL 200s instead of the error it needs to see
+            raise S3AuthError("CredentialsNotSupported",
+                              "unsupported Authorization scheme", 400)
+        # no credentials at all: a configured "anonymous" identity
+        # (which may carry IAM actions) or a synthesized action-less
+        # one — the authz gate decides via AllUsers/public grants, so a
+        # public-read bucket serves unauthenticated clients while
+        # everything else still denies (the fork's anonymous flow)
         anon = self.lookup_anonymous()
         if anon is not None:
             return anon
-        raise S3AuthError("AccessDenied", "no credentials provided")
+        return Identity(name=ANONYMOUS_NAME, actions=[])
 
     def _verify_sigv2(self, method: str, path: str, query: dict,
                       headers: dict) -> Identity:
@@ -268,6 +287,7 @@ class IdentityAccessManagement:
         if ident is None:
             raise S3AuthError("InvalidAccessKeyId",
                               "access key does not exist")
+        _require_amz_headers_signed(headers, signed_headers)
         amz_date = headers.get("X-Amz-Date") or headers.get("Date", "")
         payload_hash = headers.get("X-Amz-Content-Sha256",
                                    "UNSIGNED-PAYLOAD")
@@ -304,6 +324,7 @@ class IdentityAccessManagement:
         if ident is None:
             raise S3AuthError("InvalidAccessKeyId",
                               "access key does not exist")
+        _require_amz_headers_signed(headers, signed_headers)
         # expiry window (doesPresignedSignatureMatch rejects expired URLs)
         import time as _time
         try:
@@ -397,6 +418,33 @@ def _check_trailers(raw: bytes, payload: bytes,
         if not hmac.compare_digest(want.encode(), trailer_sig):
             raise S3AuthError("SignatureDoesNotMatch",
                               "trailer signature mismatch")
+
+
+def _require_amz_headers_signed(headers: dict,
+                                signed_headers: list) -> None:
+    """AWS SigV4 mandates every ``x-amz-*`` header PRESENT on the
+    request be included in SignedHeaders — otherwise an on-path party
+    could append e.g. ``x-amz-acl: public-read-write`` to a validly
+    signed PUT and flip a tenant's object world-writable without
+    breaking the signature.  (SigV2 is immune by construction: its
+    canonical string folds in ALL x-amz headers.)"""
+    signed = {h.lower() for h in signed_headers}
+    # x-amz-date and x-amz-content-sha256 are SELF-protecting: both
+    # feed the signature computation directly (string-to-sign /
+    # canonical payload hash), so any tampering already breaks
+    # verification — and AWS's own worked examples leave the hash
+    # header out of SignedHeaders for non-S3 services
+    self_protecting = {"x-amz-date", "x-amz-content-sha256"}
+    unsigned = sorted(
+        h.lower() for h in headers
+        if h.lower().startswith("x-amz-")
+        and h.lower() not in signed
+        and h.lower() not in self_protecting)
+    if unsigned:
+        raise S3AuthError(
+            "AccessDenied",
+            "request has x-amz headers that are not signed: "
+            + ", ".join(unsigned))
 
 
 def _parse_auth_header(auth: str) -> dict:

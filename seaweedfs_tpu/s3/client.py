@@ -51,24 +51,27 @@ class S3Client:
 
     # -- signing ------------------------------------------------------------
     def _signed_headers(self, method: str, path: str, query: dict,
-                        body: bytes) -> dict:
+                        body: bytes,
+                        amz_extras: dict | None = None) -> dict:
         payload_hash = hashlib.sha256(body).hexdigest()
         headers = {
             "Host": self.host,
             "x-amz-content-sha256": payload_hash,
             "x-amz-date": time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()),
         }
+        if amz_extras:
+            headers.update(amz_extras)
         if not self.access_key:
             return headers      # anonymous (auth-disabled gateway)
         amz_date = headers["x-amz-date"]
         date = amz_date[:8]
-        signed = ["host", "x-amz-content-sha256", "x-amz-date"]
+        signed = sorted(h.lower() for h in headers)
         sig = sign_v4(method, path, query, headers, signed, payload_hash,
                       amz_date, date, self.region, "s3", self.secret_key)
         scope = f"{date}/{self.region}/s3/aws4_request"
         headers["Authorization"] = (
             f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
-            f"SignedHeaders={';'.join(sorted(signed))}, Signature={sig}")
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}")
         return headers
 
     def _request(self, method: str, path: str,
@@ -77,11 +80,18 @@ class S3Client:
                  ok: tuple = (200, 204)) -> tuple[int, bytes, dict]:
         query = query or {}
         epath = urllib.parse.quote(path, safe="/-_.~")
-        headers = self._signed_headers(method, epath, query, body)
-        if extra_headers:
-            # unsigned extras (Range etc.) ride outside the signature,
-            # mirroring how real SDKs keep Range out of SignedHeaders
-            headers.update(extra_headers)
+        # x-amz-* extras (ACL/grant headers) MUST ride inside the
+        # signature — the verifier rejects unsigned x-amz headers
+        # (tamper hazard); other extras (Range etc.) stay outside,
+        # mirroring how real SDKs keep Range out of SignedHeaders
+        extra_headers = dict(extra_headers or {})
+        amz_extras = {k: v for k, v in extra_headers.items()
+                      if k.lower().startswith("x-amz-")}
+        headers = self._signed_headers(method, epath, query, body,
+                                       amz_extras)
+        for k, v in extra_headers.items():
+            if k.lower() not in {a.lower() for a in amz_extras}:
+                headers[k] = v
         url = f"{self.endpoint}{epath}"
         if query:
             url += "?" + urllib.parse.urlencode(query)
@@ -93,15 +103,56 @@ class S3Client:
         return status, rbody, rheaders
 
     # -- buckets ------------------------------------------------------------
-    def create_bucket(self, bucket: str) -> None:
-        self._request("PUT", f"/{bucket}", ok=(200, 204, 409))
+    def create_bucket(self, bucket: str, acl: str = "") -> None:
+        self._request("PUT", f"/{bucket}", ok=(200, 204, 409),
+                      extra_headers=_acl_headers(acl, None))
 
     def delete_bucket(self, bucket: str) -> None:
         self._request("DELETE", f"/{bucket}", ok=(200, 204, 404))
 
+    # -- ACL / policy (the grant helpers tests drive the engine with) -------
+    def get_acl(self, bucket: str, key: str = "") -> dict:
+        """-> {"owner": id, "grants": [{"permission", "grantee"}]}
+        parsed by the SAME AccessControlPolicy parser the server uses —
+        one wire-format reader, so a serialization drift fails the
+        round-trip instead of being silently re-accepted."""
+        from .acl import AccessControlPolicy
+        path = f"/{bucket}/{key}" if key else f"/{bucket}"
+        _, body, _ = self._request("GET", path, query={"acl": ""})
+        acp = AccessControlPolicy.from_xml(body)
+        return {"owner": acp.owner,
+                "grants": [{"permission": g.permission,
+                            "grantee": g.grantee_id or g.group_uri}
+                           for g in acp.grants]}
+
+    def put_acl(self, bucket: str, key: str = "", canned: str = "",
+                grants: "dict[str, str] | None" = None,
+                xml: bytes = b"") -> None:
+        """Set the ACL via a canned name, x-amz-grant-* headers
+        ({header-suffix: grantee-spec}, e.g. {"read": 'uri="..."'}
+        or {"full-control": 'id="alice"'}), or a raw XML body."""
+        path = f"/{bucket}/{key}" if key else f"/{bucket}"
+        self._request("PUT", path, query={"acl": ""}, body=xml,
+                      extra_headers=_acl_headers(canned, grants))
+
+    def put_bucket_policy(self, bucket: str, policy_json: str) -> None:
+        self._request("PUT", f"/{bucket}", query={"policy": ""},
+                      body=policy_json.encode())
+
+    def get_bucket_policy(self, bucket: str) -> str:
+        _, body, _ = self._request("GET", f"/{bucket}",
+                                   query={"policy": ""})
+        return body.decode()
+
+    def delete_bucket_policy(self, bucket: str) -> None:
+        self._request("DELETE", f"/{bucket}", query={"policy": ""})
+
     # -- objects ------------------------------------------------------------
-    def put_object(self, bucket: str, key: str, data: bytes) -> None:
-        self._request("PUT", f"/{bucket}/{key}", body=data)
+    def put_object(self, bucket: str, key: str, data: bytes,
+                   acl: str = "",
+                   grants: "dict[str, str] | None" = None) -> None:
+        self._request("PUT", f"/{bucket}/{key}", body=data,
+                      extra_headers=_acl_headers(acl, grants))
 
     def put_object_stream(self, bucket: str, key: str, fileobj,
                           chunk: int = 64 << 20) -> None:
@@ -189,6 +240,20 @@ class S3Client:
                     token = el.text or ""
             if not truncated or not token:
                 return out
+
+
+def _acl_headers(canned: str,
+                 grants: "dict[str, str] | None") -> "dict | None":
+    """x-amz-acl / x-amz-grant-* headers for object/bucket writes.
+    _request signs every x-amz-* extra (the verifier rejects unsigned
+    x-amz headers as a tamper hazard); only non-amz extras like Range
+    ride outside the signature."""
+    headers: dict[str, str] = {}
+    if canned:
+        headers["x-amz-acl"] = canned
+    for suffix, spec in (grants or {}).items():
+        headers[f"x-amz-grant-{suffix}"] = spec
+    return headers or None
 
 
 def _parse_http_date(s: str) -> float:

@@ -85,6 +85,9 @@ class IamApiServer:
                  port: int = 0):
         self.iam = iam
         self.filer_grpc = filer_grpc
+        # managed policies (CreatePolicy): name -> policy document JSON,
+        # persisted alongside the identities in the same config blob
+        self.policies: dict[str, str] = {}
         self.http = HttpServer(host, port)
         self.http.route("*", "/", self._dispatch)
         self._load()
@@ -108,6 +111,8 @@ class IamApiServer:
              "credentials": [{"accessKey": i.access_key,
                               "secretKey": i.secret_key}],
              "actions": i.actions} for i in self.iam.identities]}
+        if self.policies:
+            cfg["policies"] = dict(self.policies)
         try:
             persist_identity_config(self.filer_grpc, cfg)
         except RpcError:
@@ -123,6 +128,7 @@ class IamApiServer:
                 cfg = json.loads(from_b64(out["value"]))
                 self.iam.identities = \
                     IdentityAccessManagement.from_config(cfg).identities
+                self.policies = dict(cfg.get("policies", {}))
         except (RpcError, ValueError):
             pass
 
@@ -270,4 +276,62 @@ class IamApiServer:
         ident.actions = []
         self._persist()
         return Response(200, _resp("DeleteUserPolicy"),
+                        content_type="application/xml")
+
+    def _do_CreatePolicy(self, p: dict) -> Response:
+        """Managed policy (iamapi CreatePolicy): validated, stored by
+        name in the shared config blob, attachable later via
+        PutUserPolicy's document shape."""
+        name = p.get("PolicyName", "")
+        if not name:
+            return _error("InvalidInput", "missing PolicyName")
+        if name in self.policies:
+            return _error("EntityAlreadyExists", name, 409)
+        doc = p.get("PolicyDocument", "")
+        try:
+            parsed = json.loads(doc)
+            if not isinstance(parsed, dict) \
+                    or not isinstance(parsed.get("Statement", None),
+                                      list):
+                raise ValueError("policy needs a Statement list")
+        except ValueError as e:
+            return _error("MalformedPolicyDocument", str(e))
+        self.policies[name] = doc
+        self._persist()
+
+        def body(r):
+            pol = ET.SubElement(r, "Policy")
+            ET.SubElement(pol, "PolicyName").text = name
+            ET.SubElement(pol, "PolicyId").text = uuid.uuid4().hex
+            ET.SubElement(pol, "Arn").text = \
+                f"arn:aws:iam:::policy/{name}"
+            ET.SubElement(pol, "AttachmentCount").text = "0"
+            ET.SubElement(pol, "DefaultVersionId").text = "v1"
+        return Response(200, _resp("CreatePolicy", body),
+                        content_type="application/xml")
+
+    def _do_ListAccessKeys(self, p: dict) -> Response:
+        """ListAccessKeys: one user's key metadata when UserName is
+        given (404 for an unknown user), every identity's otherwise —
+        the audit view `aws iam list-access-keys` expects."""
+        name = p.get("UserName", "")
+        if name:
+            ident = self._find(name)
+            if ident is None:
+                return _error("NoSuchEntity", name, 404)
+            idents = [ident]
+        else:
+            idents = list(self.iam.identities)
+
+        def body(r):
+            keys = ET.SubElement(r, "AccessKeyMetadata")
+            for i in idents:
+                if not i.access_key:
+                    continue
+                m = ET.SubElement(keys, "member")
+                ET.SubElement(m, "UserName").text = i.name
+                ET.SubElement(m, "AccessKeyId").text = i.access_key
+                ET.SubElement(m, "Status").text = "Active"
+            ET.SubElement(r, "IsTruncated").text = "false"
+        return Response(200, _resp("ListAccessKeys", body),
                         content_type="application/xml")

@@ -24,14 +24,19 @@ import urllib.parse
 import uuid
 import xml.etree.ElementTree as ET
 
+from dataclasses import dataclass
+
 from ..filer.entry import Entry, FileChunk
 from ..filer.filechunks import total_size
 from ..pb.rpc import POOL, RpcError
+from ..stats import ServerMetrics
 from ..util.http import HttpServer, Request, Response, http_request
 from ..util.weedlog import logger
-from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_TAGGING,
-                   ACTION_WRITE, Identity, IdentityAccessManagement,
-                   S3AuthError)
+from . import acl as aclmod
+from .acl import (ACL_ATTR, OWNER_ATTR, POLICY_ATTR, AccessControlPolicy,
+                  AclError)
+from .auth import (ACTION_ADMIN, ACTION_LIST, Identity,
+                   IdentityAccessManagement, S3AuthError)
 
 BUCKETS_PATH = "/buckets"
 UPLOADS_DIR = ".uploads"
@@ -40,17 +45,28 @@ UPLOADS_DIR = ".uploads"
 # must 501 instead of falling through to the plain bucket/object
 # handlers — before this gate, `PUT /bucket/key?acl` silently
 # OVERWROTE the object's data with the ACL XML body (VERDICT r5 gap #1
-# hazard).  Routing-relevant params (tagging/uploadId/...), listing
+# hazard).  ?acl and ?policy graduated to real handlers (the ACL engine,
+# ISSUE 8).  Routing-relevant params (tagging/uploadId/...), listing
 # params (prefix/marker/...), auth params (X-Amz-*) and response
 # overrides (response-*) are not sub-resources and pass through.
 NOT_IMPLEMENTED_SUBRESOURCES = frozenset({
-    "acl", "accelerate", "analytics", "attributes", "cors", "encryption",
+    "accelerate", "analytics", "attributes", "cors", "encryption",
     "intelligent-tiering", "inventory", "legal-hold", "lifecycle",
     "logging", "metrics", "notification", "object-lock",
-    "ownershipControls", "policy", "policyStatus", "publicAccessBlock",
+    "ownershipControls", "policyStatus", "publicAccessBlock",
     "replication", "requestPayment", "restore", "retention", "select",
     "torrent", "versioning", "versions", "website",
 })
+
+
+@dataclass
+class _BucketMeta:
+    """Authz-relevant bucket state, one filer lookup, briefly cached."""
+    exists: bool = False
+    owner: str = ""
+    acl: "AccessControlPolicy | None" = None
+    policy: "dict | None" = None
+    quota_exceeded: bool = False
 
 LOG = logger(__name__)
 
@@ -80,15 +96,52 @@ class S3ApiServer:
     def __init__(self, filer_http: str, filer_grpc: str,
                  host: str = "127.0.0.1", port: int = 0,
                  iam: IdentityAccessManagement | None = None,
-                 audit_log=None):
+                 audit_log=None, enforce_authz: bool = True):
         self.filer_http = filer_http
         self.filer_grpc = filer_grpc
         self.iam = iam or IdentityAccessManagement()
         self.audit = audit_log      # s3/audit.py AuditLog or None
+        # bench knob: short-circuit the fused gate to measure its cost —
+        # NEVER disable in production (the gate is the tenant boundary)
+        self.enforce_authz = enforce_authz
+        self.metrics = ServerMetrics()
         self.http = HttpServer(host, port)
+        # exact route: the bare GET /metrics is the Prometheus scrape;
+        # query-carrying requests (a bucket literally named "metrics":
+        # ?list-type, ?acl, ?location, ...) re-enter the S3 dispatch
+        self.http.route("GET", "/metrics", self._http_metrics, exact=True)
         self.http.route("*", "/", self._dispatch)
         self._iam_stop = threading.Event()
-        self._quota_cache: dict[str, tuple[bool, float]] = {}
+        self._bucket_meta_cache: "dict[str, tuple[_BucketMeta, float]]" \
+            = {}
+
+    def _http_metrics(self, req: Request) -> Response:
+        # a QUERY-carrying GET /metrics is an S3 operation on a bucket
+        # literally named "metrics" (ListObjects, ?acl, ?location, ...)
+        # — only the bare path is the Prometheus scrape, which never
+        # sends params
+        if req.query:
+            return self._dispatch(req)
+        # the scrape lives on the TENANT-facing port: with IAM enabled
+        # it requires any signed identity — per-tenant allow/deny rates
+        # are operational intelligence, not public data (upstream
+        # sidesteps this by scraping a separate port)
+        if self.iam.is_enabled():
+            try:
+                ident = self.iam.authenticate(
+                    req.method, req.path, req.query, req.headers,
+                    req.body)
+            except S3AuthError as e:
+                return Response(e.status,
+                                _error_xml(e.code, str(e), req.path),
+                                content_type="application/xml")
+            if ident.is_anonymous:
+                return Response(
+                    403, _error_xml("AccessDenied",
+                                    "metrics require authentication"),
+                    content_type="application/xml")
+        return Response(200, self.metrics.render().encode(),
+                        content_type="text/plain; version=0.0.4")
 
     def start(self) -> None:
         self.http.start()
@@ -149,33 +202,41 @@ class S3ApiServer:
 
     # -- routing (s3api_server.go registerRouter) --------------------------
     def _dispatch(self, req: Request) -> Response:
-        if self.audit is None:
-            return self._dispatch_inner(req)
         t0 = time.time()
         resp = None
         try:
             resp = self._dispatch_inner(req)
             return resp
         finally:
-            status = resp.status if resp is not None else 500
-            # bytes: request size for uploads, response size for reads —
-            # never the error XML's length for a rejected PUT
-            if req.method in ("PUT", "POST"):
-                nbytes = len(req.body or b"")
-            else:
-                nbytes = len(resp.body) if resp is not None                     and resp.body else 0
-            self.audit.record(
-                # the SOCKET address — X-Forwarded-For is client-supplied
-                # and must not launder the forensic field (it is recorded
-                # separately when present)
-                remote=req.remote_addr,
-                forwarded_for=req.headers.get("X-Forwarded-For", ""),
-                requester=getattr(req, "_audit_requester", "anonymous"),
-                method=req.method,
-                bucket=getattr(req, "_audit_bucket", ""),
-                key=getattr(req, "_audit_key", ""),
-                action=req.method.lower(), status=status, nbytes=nbytes,
-                duration_ms=(time.time() - t0) * 1000)
+            self.metrics.s3_requests.inc(
+                getattr(req, "_s3_action", req.method.lower()))
+            if self.audit is not None:
+                status = resp.status if resp is not None else 500
+                # bytes: request size for uploads, response size for
+                # reads — never the error XML's length for a rejected PUT
+                if req.method in ("PUT", "POST"):
+                    nbytes = len(req.body or b"")
+                else:
+                    nbytes = len(resp.body) if resp is not None \
+                        and resp.body else 0
+                authz, authz_source = getattr(req, "_audit_authz",
+                                              ("", ""))
+                self.audit.record(
+                    # the SOCKET address — X-Forwarded-For is
+                    # client-supplied and must not launder the forensic
+                    # field (it is recorded separately when present)
+                    remote=req.remote_addr,
+                    forwarded_for=req.headers.get("X-Forwarded-For", ""),
+                    requester=getattr(req, "_audit_requester",
+                                      "anonymous"),
+                    method=req.method,
+                    bucket=getattr(req, "_audit_bucket", ""),
+                    key=getattr(req, "_audit_key", ""),
+                    action=getattr(req, "_s3_action",
+                                   req.method.lower()),
+                    status=status, nbytes=nbytes,
+                    duration_ms=(time.time() - t0) * 1000,
+                    authz=authz, authz_source=authz_source)
 
     def _dispatch_inner(self, req: Request) -> Response:
         path = urllib.parse.unquote(req.path)
@@ -216,6 +277,12 @@ class S3ApiServer:
         except S3AuthError as e:
             return Response(e.status, _error_xml(e.code, str(e), path),
                             content_type="application/xml")
+        except AclError as e:
+            # corrupt stored ACL surfacing on a read path — the data
+            # plane is fine, the metadata needs operator attention
+            return Response(500, _error_xml("InternalError",
+                                            f"stored ACL: {e}", path),
+                            content_type="application/xml")
         except RpcError as e:
             if "not found" in str(e):
                 return Response(404, _error_xml("NoSuchKey", str(e), path),
@@ -223,15 +290,207 @@ class S3ApiServer:
             return Response(500, _error_xml("InternalError", str(e), path),
                             content_type="application/xml")
 
-    def _require(self, ident: Identity, action: str, bucket: str) -> None:
-        if not ident.can_do(action, bucket):
-            raise S3AuthError("AccessDenied",
-                              f"{ident.name} may not {action} on {bucket}")
+    # -- the fused authorization gate (acl.go authzAcl + auth middleware) --
+    def _decide(self, req: Request, result: str, source: str,
+                record: bool = True) -> None:
+        req._audit_authz = (result, source)
+        if record:
+            self.metrics.s3_authz.inc(result, source)
+
+    def _authz(self, req: Request, ident: Identity, action: str,
+               bucket: str, key: str = "", record: bool = True) -> None:
+        """Authorize `action` or raise AccessDenied.  Fuses three
+        sources in order (first match decides):
+
+        1. IAM identity actions (``Identity.can_do``) — the coarse
+           per-identity grants, optionally bucket-scoped;
+        2. the bucket policy document (Allow grants);
+        3. ACL: resource ownership, then object grants, then the
+           bucket-grant cascade (AllUsers / AuthenticatedUsers groups
+           cover anonymous and presigned access).
+
+        An explicit bucket-policy Deny wins over EVERY allow source —
+        including IAM — with one escape hatch: identities holding the
+        GLOBAL (unscoped) Admin action bypass policy denies, so an
+        operator can always remove a lockout policy (AWS needs the
+        account root for the same rescue).
+
+        Every routed handler passes through here before touching the
+        filer/volume plane (enforced by weedlint WL080); the decision
+        and its deciding source land in the audit log and the
+        ``seaweedfs_s3_authz_total{result,source}`` metric family."""
+        req._s3_action = action
+        if not self.iam.is_enabled() or not self.enforce_authz:
+            self._decide(req, "allow", "iam", record)  # open gateway
+            return
+        anonymous = ident.is_anonymous
+        meta = self._bucket_meta(bucket) if bucket else _BucketMeta()
+        decision = aclmod.policy_decision(
+            meta.policy, ident.name, not anonymous, action, bucket, key)
+        if decision == "deny" and ACTION_ADMIN not in ident.actions:
+            self._decide(req, "deny", "bucket-policy", record)
+            raise S3AuthError(
+                "AccessDenied",
+                f"bucket policy denies {action} on {bucket}")
+        # 1 -- IAM (a CONFIGURED "anonymous" identity may carry real
+        # actions; the synthesized one is action-less and never matches)
+        if action == "s3:ListAllMyBuckets":
+            # any signed identity may enumerate — per-bucket visibility
+            # is filtered by the handler — anonymous may not
+            if not anonymous:
+                self._decide(req, "allow", "iam", record)
+                return
+        elif ident.can_do(aclmod.IAM_ACTION_MAP.get(action, ACTION_ADMIN),
+                          bucket):
+            self._decide(req, "allow", "iam", record)
+            return
+        # 2 -- bucket policy allow
+        if decision == "allow":
+            self._decide(req, "allow", "bucket-policy", record)
+            return
+        # 3 -- ACL (ownership + grants)
+        if self._acl_allows(meta, ident, action, bucket, key, anonymous):
+            self._decide(req, "allow", "acl-grant", record)
+            return
+        self._decide(req, "deny",
+                     "anonymous" if anonymous else "iam", record)
+        raise S3AuthError(
+            "AccessDenied",
+            f"{ident.name} may not {action} on "
+            f"{bucket}{'/' + key if key else ''}")
+
+    def _authz_soft(self, req: Request, ident: Identity, action: str,
+                    bucket: str) -> None:
+        """Bulk-delete's bucket-level probe: evaluates and records the
+        decision but never raises — a multi-object DELETE answers
+        per-key <Error> elements (the AWS DeleteResult contract), and
+        enforcement happens per key inside the handler, where an
+        object-ARN-scoped policy statement can differ from the
+        bucket-level answer in BOTH directions."""
+        try:
+            self._authz(req, ident, action, bucket)
+        except S3AuthError:
+            pass
+
+    def _acl_allows(self, meta: _BucketMeta, ident: Identity,
+                    action: str, bucket: str, key: str,
+                    anonymous: bool) -> bool:
+        requester = ident.name
+        authenticated = not anonymous
+        target_perm = aclmod.ACL_ACTION_MAP.get(action)
+        if target_perm is None:
+            # no ACL path (bucket CRUD, policy CRUD): only the bucket
+            # owner — the tenant — may manage the bucket itself
+            return authenticated and bool(meta.owner) \
+                and requester == meta.owner
+        target, perm = target_perm
+        if target == "bucket":
+            if authenticated and meta.owner and requester == meta.owner:
+                return True  # owner holds implicit FULL_CONTROL
+            return aclmod.acl_allows(meta.acl, requester, authenticated,
+                                     perm)
+        # object target: the CACHED bucket-grant cascade first (what
+        # makes a public-read bucket serve its objects to anonymous
+        # clients — the flagship path pays no extra RPC), then the
+        # object's own owner/grants; all sources are allow-only ORs so
+        # the order is behavior-neutral
+        if aclmod.acl_allows(meta.acl, requester, authenticated, perm):
+            return True
+        obj = self._object_acl(bucket, key)
+        if obj is not None:
+            obj_owner, obj_acp = obj
+            if authenticated and obj_owner and requester == obj_owner:
+                return True
+            if aclmod.acl_allows(obj_acp, requester, authenticated,
+                                 perm):
+                return True
+        return False
+
+    _BUCKET_CACHE_MAX = 4096   # unauthenticated scans probe made-up
+    #                            bucket names; the cache must not grow
+    #                            with attacker-chosen keys
+
+    def _bucket_meta(self, bucket: str,
+                     fresh: bool = False) -> _BucketMeta:
+        """Owner/ACL/policy/quota of a bucket — ONE filer lookup per
+        bucket per few seconds, not per request (same contract as the
+        old quota cache it absorbed).  ``fresh=True`` bypasses the
+        cache for read-before-write decisions (bucket create)."""
+        now = time.time()
+        if not fresh:
+            cached = self._bucket_meta_cache.get(bucket)
+            if cached and now - cached[1] < 3.0:
+                return cached[0]
+        if len(self._bucket_meta_cache) >= self._BUCKET_CACHE_MAX:
+            # snapshot before filtering: requests on other connection
+            # threads insert concurrently, and iterating the live dict
+            # would raise "changed size during iteration"
+            live = {b: v
+                    for b, v in list(self._bucket_meta_cache.items())
+                    if now - v[1] < 3.0}
+            if len(live) >= self._BUCKET_CACHE_MAX:
+                live = {}
+            self._bucket_meta_cache = live
+        meta = _BucketMeta()
+        # _bucket_entry distinguishes "no bucket" from transport
+        # failure; the latter RAISES — treating it as missing would
+        # silently drop the bucket policy (incl. an explicit Deny) and
+        # serve the fail-open result for 3s
+        entry = self._bucket_entry(bucket)
+        if entry is not None:
+            ext = entry.get("extended", {}) or {}
+            meta.exists = True
+            meta.owner = ext.get(OWNER_ATTR, "")
+            meta.quota_exceeded = ext.get("quota.exceeded") == "1"
+            if ext.get(ACL_ATTR):
+                try:
+                    meta.acl = AccessControlPolicy.from_json(
+                        ext[ACL_ATTR])
+                    meta.owner = meta.owner or meta.acl.owner
+                except AclError as e:
+                    LOG.warning("bucket %s has a corrupt ACL (%s); "
+                                "treating as private", bucket, e)
+            if ext.get(POLICY_ATTR):
+                try:
+                    meta.policy = json.loads(ext[POLICY_ATTR])
+                except ValueError as e:
+                    LOG.warning("bucket %s has a corrupt policy (%s); "
+                                "ignoring it", bucket, e)
+        self._bucket_meta_cache[bucket] = (meta, now)
+        return meta
+
+    def _invalidate_bucket(self, bucket: str) -> None:
+        self._bucket_meta_cache.pop(bucket, None)
+
+    def _object_acl(self, bucket: str,
+                    key: str) -> "tuple[str, AccessControlPolicy | None] | None":
+        """(owner, acl) of an object, or None when it does not exist.
+        Looked up only when IAM and bucket policy have not already
+        decided — the hot authorized path never pays this RPC twice."""
+        if not key:
+            return None
+        try:
+            entry = self._entry_of(bucket, key)
+        except RpcError as e:
+            if "not found" not in str(e):
+                raise  # transport blip must not skew the decision
+            return None
+        ext = entry.get("extended", {}) or {}
+        acp = None
+        if ext.get(ACL_ATTR):
+            try:
+                acp = AccessControlPolicy.from_json(ext[ACL_ATTR])
+            except AclError as e:
+                LOG.warning("object %s/%s has a corrupt ACL (%s); "
+                            "treating as private", bucket, key, e)
+        owner = ext.get(OWNER_ATTR, "") or (acp.owner if acp else "")
+        return owner, acp
 
     def _route(self, req: Request, ident: Identity, bucket: str,
                key: str) -> Response:
         q = req.query
         if not bucket:
+            self._authz(req, ident, "s3:ListAllMyBuckets", "")
             return self._list_buckets(ident)
         known_unimplemented = NOT_IMPLEMENTED_SUBRESOURCES.intersection(q)
         if known_unimplemented:
@@ -242,79 +501,123 @@ class S3ApiServer:
                            f"sub-resource ?{sub} is not implemented",
                            req.path),
                 content_type="application/xml")
-        if "location" in q and not key and req.method == "GET":
-            # GetBucketLocation: common SDK existence probe — it must
-            # 404 for a missing bucket; this deployment has a single
-            # region, expressed as the default (empty) constraint
-            self._require(ident, ACTION_READ, bucket)
-            try:
-                self._filer().call("LookupDirectoryEntry", {
-                    "directory": BUCKETS_PATH, "name": bucket})
-            except RpcError:
+        if "acl" in q:
+            if req.method == "GET" and key:
+                self._authz(req, ident, "s3:GetObjectAcl", bucket, key)
+                return self._get_object_acl(bucket, key)
+            if req.method == "PUT" and key:
+                self._authz(req, ident, "s3:PutObjectAcl", bucket, key)
+                return self._put_object_acl(bucket, key, req)
+            if req.method == "GET":
+                self._authz(req, ident, "s3:GetBucketAcl", bucket)
+                return self._get_bucket_acl(bucket)
+            if req.method == "PUT":
+                self._authz(req, ident, "s3:PutBucketAcl", bucket)
+                return self._put_bucket_acl(bucket, ident, req)
+            return Response.error("method not allowed", 405)
+        if "policy" in q:
+            if key:
+                # ?policy is a BUCKET sub-resource; on an object path it
+                # must never fall through to the plain object handlers
+                # (the pre-PR-1 overwrite hazard all over again)
                 return Response(
-                    404, _error_xml("NoSuchBucket",
-                                    f"bucket {bucket} not found",
-                                    req.path),
+                    501,
+                    _error_xml("NotImplemented",
+                               "?policy is a bucket sub-resource",
+                               req.path),
                     content_type="application/xml")
-            return Response(
-                200, _xml(ET.Element("LocationConstraint")),
-                content_type="application/xml")
+            if req.method == "GET":
+                self._authz(req, ident, "s3:GetBucketPolicy", bucket)
+                return self._get_bucket_policy(bucket)
+            if req.method == "PUT":
+                self._authz(req, ident, "s3:PutBucketPolicy", bucket)
+                return self._put_bucket_policy(bucket, req.body)
+            if req.method == "DELETE":
+                self._authz(req, ident, "s3:DeleteBucketPolicy", bucket)
+                return self._delete_bucket_policy(bucket)
+            return Response.error("method not allowed", 405)
+        if "location" in q and not key and req.method == "GET":
+            self._authz(req, ident, "s3:GetBucketLocation", bucket)
+            return self._get_bucket_location(bucket, req)
         if not key:
             if req.method == "PUT":
-                self._require(ident, ACTION_ADMIN, bucket)
-                return self._create_bucket(bucket)
+                self._authz(req, ident, "s3:CreateBucket", bucket)
+                return self._create_bucket(bucket, ident, req)
             if req.method == "DELETE":
-                self._require(ident, ACTION_ADMIN, bucket)
+                self._authz(req, ident, "s3:DeleteBucket", bucket)
                 return self._delete_bucket(bucket)
             if req.method == "HEAD":
-                self._require(ident, ACTION_READ, bucket)
-                return self._head_bucket(bucket)
+                # existence probe: List is the AWS-faithful mapping,
+                # but Read-only identities keep their pre-ACL-engine
+                # head_bucket behavior via the location fallback.  The
+                # first attempt records NOTHING — its interim deny
+                # would show up as a false per-tenant deny spike in
+                # seaweedfs_s3_authz_total; the outcome that counts is
+                # recorded exactly once below.
+                try:
+                    self._authz(req, ident, "s3:ListBucket", bucket,
+                                record=False)
+                    self.metrics.s3_authz.inc(*req._audit_authz)
+                    return self._head_bucket(bucket)
+                except S3AuthError:
+                    self._authz(req, ident, "s3:GetBucketLocation",
+                                bucket)
+                    return self._head_bucket(bucket)
             if req.method == "POST" and "delete" in q:
-                self._require(ident, ACTION_WRITE, bucket)
-                return self._delete_objects(bucket, req.body)
+                self._authz_soft(req, ident, "s3:DeleteObject", bucket)
+                return self._delete_objects(bucket, ident, req)
             if req.method == "GET":
-                self._require(ident, ACTION_LIST, bucket)
                 if "uploads" in q:
+                    self._authz(req, ident,
+                                "s3:ListBucketMultipartUploads", bucket)
                     return self._list_multipart_uploads(bucket)
+                self._authz(req, ident, "s3:ListBucket", bucket)
                 return self._list_objects(bucket, req)
             return Response.error("method not allowed", 405)
         # object-level
         if req.method == "PUT":
             if "partNumber" in q and "uploadId" in q:
-                self._require(ident, ACTION_WRITE, bucket)
+                self._authz(req, ident, "s3:PutObject", bucket, key)
                 return self._upload_part(bucket, key, req)
             if "tagging" in q:
-                self._require(ident, ACTION_TAGGING, bucket)
+                self._authz(req, ident, "s3:PutObjectTagging", bucket,
+                            key)
                 return self._put_tagging(bucket, key, req.body)
-            self._require(ident, ACTION_WRITE, bucket)
             if req.headers.get("X-Amz-Copy-Source"):
-                return self._copy_object(bucket, key, req)
-            return self._put_object(bucket, key, req)
+                self._authz(req, ident, "s3:PutObject", bucket, key)
+                return self._copy_object(bucket, key, ident, req)
+            self._authz(req, ident, "s3:PutObject", bucket, key)
+            return self._put_object(bucket, key, ident, req)
         if req.method in ("GET", "HEAD"):
             if "tagging" in q:
-                self._require(ident, ACTION_READ, bucket)
+                self._authz(req, ident, "s3:GetObjectTagging", bucket,
+                            key)
                 return self._get_tagging(bucket, key)
             if "uploadId" in q:
-                self._require(ident, ACTION_READ, bucket)
+                self._authz(req, ident, "s3:ListMultipartUploadParts",
+                            bucket, key)
                 return self._list_parts(bucket, key, q["uploadId"][0])
-            self._require(ident, ACTION_READ, bucket)
+            self._authz(req, ident, "s3:GetObject", bucket, key)
             return self._get_object(bucket, key, req)
         if req.method == "POST":
             if "uploads" in q:
-                self._require(ident, ACTION_WRITE, bucket)
-                return self._initiate_multipart(bucket, key)
+                self._authz(req, ident, "s3:PutObject", bucket, key)
+                return self._initiate_multipart(bucket, key, ident, req)
             if "uploadId" in q:
-                self._require(ident, ACTION_WRITE, bucket)
+                self._authz(req, ident, "s3:PutObject", bucket, key)
                 return self._complete_multipart(bucket, key,
                                                 q["uploadId"][0])
         if req.method == "DELETE":
             if "uploadId" in q:
-                self._require(ident, ACTION_WRITE, bucket)
-                return self._abort_multipart(bucket, key, q["uploadId"][0])
+                self._authz(req, ident, "s3:AbortMultipartUpload",
+                            bucket, key)
+                return self._abort_multipart(bucket, key,
+                                             q["uploadId"][0])
             if "tagging" in q:
-                self._require(ident, ACTION_TAGGING, bucket)
+                self._authz(req, ident, "s3:DeleteObjectTagging",
+                            bucket, key)
                 return self._put_tagging(bucket, key, b"")
-            self._require(ident, ACTION_WRITE, bucket)
+            self._authz(req, ident, "s3:DeleteObject", bucket, key)
             return self._delete_object(bucket, key)
         return Response.error("method not allowed", 405)
 
@@ -332,7 +635,9 @@ class S3ApiServer:
                 if not e["attr"].get("mode", 0) & 0o40000:
                     continue
                 name = e["full_path"].rsplit("/", 1)[-1]
-                if not ident.can_do(ACTION_LIST, name):
+                is_owner = (e.get("extended", {}) or {}).get(
+                    OWNER_ATTR, "") == ident.name
+                if not is_owner and not ident.can_do(ACTION_LIST, name):
                     continue
                 b = _el(buckets, "Bucket")
                 _el(b, "Name", name)
@@ -341,18 +646,74 @@ class S3ApiServer:
             pass  # no buckets yet
         return Response(200, _xml(root), content_type="application/xml")
 
-    def _create_bucket(self, bucket: str) -> Response:
-        self._filer().call("CreateEntry", {"entry": {
+    def _create_bucket(self, bucket: str, ident: Identity,
+                       req: Request) -> Response:
+        if bucket == "metrics":
+            # the gateway serves its Prometheus scrape at GET /metrics;
+            # a bucket by that name would collide with the bare-path
+            # scrape on ListObjects V1 (which carries no query string
+            # to disambiguate on) — the name is reserved
+            return Response(
+                400, _error_xml("InvalidBucketName",
+                                "'metrics' is reserved for the "
+                                "gateway's scrape endpoint", bucket),
+                content_type="application/xml")
+        # fresh lookup: deciding "may I stamp ownership?" off a 3s-old
+        # cache would let a racing create silently re-stamp the owner
+        meta = self._bucket_meta(bucket, fresh=True)
+        if meta.exists:
+            # never re-stamp ownership over a live bucket: a second PUT
+            # is idempotent for the owner, a conflict for anyone else
+            if not self.iam.is_enabled() or meta.owner in ("",
+                                                           ident.name):
+                return Response(200, b"")
+            return Response(
+                409, _error_xml("BucketAlreadyExists",
+                                f"bucket {bucket} is owned by "
+                                f"{meta.owner}", bucket),
+                content_type="application/xml")
+        extended: dict[str, str] = {}
+        if self.iam.is_enabled():
+            # ownership stamped at create — the tenant boundary every
+            # later ACL/policy decision anchors on
+            extended[OWNER_ATTR] = ident.name
+            try:
+                acp = aclmod.acl_from_request(req.headers, b"",
+                                              owner=ident.name)
+            except AclError as e:
+                return Response(400, _error_xml("InvalidArgument",
+                                                str(e), bucket),
+                                content_type="application/xml")
+            extended[ACL_ATTR] = acp.to_json()
+        entry: dict = {
             "full_path": f"{BUCKETS_PATH}/{bucket}",
             "attr": {"mtime": time.time(), "crtime": time.time(),
-                     "mode": 0o40000 | 0o770}}})
+                     "mode": 0o40000 | 0o770}}
+        if extended:
+            entry["extended"] = extended
+        self._filer().call("CreateEntry", {"entry": entry})
+        self._invalidate_bucket(bucket)
         return Response(200, b"")
 
     def _delete_bucket(self, bucket: str) -> Response:
         self._filer().call("DeleteEntry", {
             "directory": BUCKETS_PATH, "name": bucket,
             "is_recursive": True, "ignore_recursive_error": True})
+        self._invalidate_bucket(bucket)
         return Response(204, b"")
+
+    def _get_bucket_location(self, bucket: str, req: Request) -> Response:
+        # GetBucketLocation: common SDK existence probe — it must 404
+        # for a genuinely missing bucket (and ONLY then; _bucket_entry
+        # surfaces transport failures as 500); this deployment has a
+        # single region, expressed as the default (empty) constraint
+        if self._bucket_entry(bucket) is None:
+            return Response(
+                404, _error_xml("NoSuchBucket",
+                                f"bucket {bucket} not found", req.path),
+                content_type="application/xml")
+        return Response(200, _xml(ET.Element("LocationConstraint")),
+                        content_type="application/xml")
 
     def _head_bucket(self, bucket: str) -> Response:
         try:
@@ -370,25 +731,34 @@ class S3ApiServer:
     def _quota_exceeded(self, bucket: str) -> bool:
         """Bucket write gate set by `s3.bucket.quota.check`
         (command_s3_bucket_quota_check.go marks over-quota buckets
-        read-only).  Cached briefly — one filer lookup per bucket per
-        few seconds, not per PUT."""
-        now = time.time()
-        cached = self._quota_cache.get(bucket)
-        if cached and now - cached[1] < 3.0:
-            return cached[0]
-        exceeded = False
-        try:
-            entry = self._filer().call("LookupDirectoryEntry", {
-                "directory": BUCKETS_PATH, "name": bucket})["entry"]
-            exceeded = entry.get("extended", {}) \
-                .get("quota.exceeded") == "1"
-        except RpcError:
-            pass
-        self._quota_cache[bucket] = (exceeded, now)
-        return exceeded
+        read-only).  Rides the cached bucket meta — one filer lookup
+        per bucket per few seconds, not per PUT."""
+        return self._bucket_meta(bucket).quota_exceeded
+
+    def _acl_stamp_headers(self, ident: Identity, req: "Request | None",
+                           bucket: str,
+                           canned: str = "") -> "dict[str, str]":
+        """Ownership + ACL stamped onto the filer upload via Seaweed-*
+        headers — the grants ride the SAME round-trip as the bytes, no
+        follow-up UpdateEntry on the write hot path.  Raises AclError
+        on a malformed x-amz-acl / x-amz-grant-* input."""
+        if not self.iam.is_enabled() or not self.enforce_authz:
+            return {}  # stamping is part of the authz plane
+        bucket_owner = self._bucket_meta(bucket).owner
+        if canned:      # POST-policy form field
+            acp = aclmod.canned_acl(canned, ident.name, bucket_owner)
+        elif req is not None:
+            acp = aclmod.acl_from_request(req.headers, b"",
+                                          owner=ident.name,
+                                          bucket_owner=bucket_owner)
+        else:
+            acp = aclmod.canned_acl("private", ident.name, bucket_owner)
+        return {f"Seaweed-{OWNER_ATTR}": ident.name,
+                f"Seaweed-{ACL_ATTR}": acp.to_json()}
 
     def _store_object(self, bucket: str, key: str, data: bytes,
-                      content_type: str = ""
+                      content_type: str = "",
+                      extra_headers: "dict[str, str] | None" = None
                       ) -> "tuple[str, Response | None]":
         """Quota gate + filer upload + error mapping — the storage tail
         shared by PUT object and POST-policy uploads.  -> (etag, None)
@@ -396,7 +766,9 @@ class S3ApiServer:
         denied = self._quota_response(bucket)
         if denied:
             return "", denied
-        headers = {"Content-Type": content_type} if content_type else {}
+        headers = dict(extra_headers or {})
+        if content_type:
+            headers["Content-Type"] = content_type
         status, body, _ = http_request(self._object_url(bucket, key),
                                        method="POST", body=data,
                                        headers=headers)
@@ -407,9 +779,17 @@ class S3ApiServer:
                 content_type="application/xml")
         return hashlib.md5(data).hexdigest(), None
 
-    def _put_object(self, bucket: str, key: str, req: Request) -> Response:
+    def _put_object(self, bucket: str, key: str, ident: Identity,
+                    req: Request) -> Response:
+        try:
+            stamp = self._acl_stamp_headers(ident, req, bucket)
+        except AclError as e:
+            return Response(400, _error_xml("InvalidArgument", str(e),
+                                            key),
+                            content_type="application/xml")
         etag, err = self._store_object(
-            bucket, key, req.body, req.headers.get("Content-Type", ""))
+            bucket, key, req.body, req.headers.get("Content-Type", ""),
+            extra_headers=stamp)
         if err is not None:
             return err
         return Response(200, b"", headers={"ETag": f'"{etag}"'})
@@ -438,15 +818,15 @@ class S3ApiServer:
         req._audit_key = key  # the URL had none; the audit log should
         # policy-signature auth + condition checks (skipped entirely on
         # an open gateway, matching header-auth behavior)
+        ident = Identity(name="disabled", actions=[ACTION_ADMIN])
         if self.iam.is_enabled():
             if "x-amz-signature" not in fields \
                     and "signature" not in fields:
-                # credential-less form: the anonymous identity, exactly
-                # like header auth's fallback (auth.py authenticate)
-                ident = self.iam.lookup_anonymous()
-                if ident is None:
-                    raise S3AuthError("AccessDenied",
-                                      "no policy signature provided")
+                # credential-less form: the configured anonymous
+                # identity or the synthesized one — the fused gate
+                # decides (a public-read-write bucket accepts it)
+                ident = self.iam.lookup_anonymous() \
+                    or Identity(name=aclmod.ANONYMOUS, actions=[])
             else:
                 ident = pp.verify_policy_signature(self.iam, fields)
                 if not fields.get("policy"):
@@ -458,7 +838,7 @@ class S3ApiServer:
                         "authenticated POST requires a policy",
                         bucket), content_type="application/xml")
             req._audit_requester = ident.name
-            self._require(ident, ACTION_WRITE, bucket)
+            self._authz(req, ident, "s3:PutObject", bucket, key)
             policy_b64 = fields.get("policy", "")
             if policy_b64:
                 try:
@@ -491,8 +871,16 @@ class S3ApiServer:
                             "EntityTooLarge",
                             f"{len(file_bytes)} > {hi}", bucket),
                             content_type="application/xml")
+        try:
+            stamp = self._acl_stamp_headers(
+                ident, None, bucket, canned=fields.get("acl", ""))
+        except AclError as e:
+            return Response(400, _error_xml("InvalidArgument", str(e),
+                                            bucket),
+                            content_type="application/xml")
         etag, err = self._store_object(bucket, key, file_bytes,
-                                       fields.get("content-type", ""))
+                                       fields.get("content-type", ""),
+                                       extra_headers=stamp)
         if err is not None:
             return err
         redirect = fields.get("success_action_redirect", "")
@@ -545,35 +933,100 @@ class S3ApiServer:
         http_request(self._object_url(bucket, key), method="DELETE")
         return Response(204, b"")
 
-    def _copy_object(self, bucket: str, key: str, req: Request) -> Response:
+    def _copy_object(self, bucket: str, key: str, ident: Identity,
+                     req: Request) -> Response:
         denied = self._quota_response(bucket)
         if denied:
             return denied
         src = urllib.parse.unquote(req.headers["X-Amz-Copy-Source"])
         src = src.lstrip("/")
+        # reading the source is its own authorization question — a
+        # writable destination must not launder a forbidden read
+        copy_src_bucket, _, copy_src_key = src.partition("/")
+        dest_decision = getattr(req, "_audit_authz", ("", ""))
+        # point the audit context at the SOURCE for this check: if it
+        # denies, the log must name the resource that was probed, not
+        # the destination the attacker controls
+        req._audit_bucket, req._audit_key = copy_src_bucket, copy_src_key
+        self._authz(req, ident, "s3:GetObject", copy_src_bucket,
+                    copy_src_key)
+        # passed: the audit line describes the COPY (the routed action)
+        req._audit_bucket, req._audit_key = bucket, key
+        req._s3_action = "s3:PutObject"
+        req._audit_authz = dest_decision
         status, body, _ = http_request(
             f"http://{self.filer_http}{BUCKETS_PATH}/{src}")
         if status != 200:
             return Response(404, _error_xml("NoSuchKey", src),
                             content_type="application/xml")
-        resp = self._put_object(bucket, key, Request(
-            method="PUT", path=req.path, query={}, headers={}, body=body))
+        # ACL carried across the copy: explicit x-amz-acl / grant
+        # headers on the copy request win; otherwise the SOURCE
+        # object's grants ride along (the destination owner is the
+        # copier — ownership never transfers silently)
+        try:
+            if req.headers.get("x-amz-acl") \
+                    or aclmod.grants_from_headers(req.headers) is not None:
+                stamp = self._acl_stamp_headers(ident, req, bucket)
+            else:
+                stamp = self._acl_stamp_headers(ident, None, bucket)
+                if self.iam.is_enabled():
+                    src_acl = self._object_acl(copy_src_bucket,
+                                               copy_src_key)
+                    if src_acl is not None and src_acl[1] is not None:
+                        src_owner = src_acl[0]
+                        # carry the grants, NOT the old owner's control:
+                        # the source owner's (explicit) FULL_CONTROL
+                        # grant must not survive into another tenant's
+                        # copy — the copier's authority is the implicit
+                        # owner rule, group/third-party grants ride
+                        grants = [g for g in src_acl[1].grants
+                                  if g.group_uri
+                                  or g.grantee_id
+                                  not in ("", src_owner, ident.name)]
+                        acp = AccessControlPolicy(
+                            owner=ident.name, grants=grants)
+                        stamp[f"Seaweed-{ACL_ATTR}"] = acp.to_json()
+        except AclError as e:
+            return Response(400, _error_xml("InvalidArgument", str(e),
+                                            key),
+                            content_type="application/xml")
+        etag, err = self._store_object(bucket, key, body,
+                                       extra_headers=stamp)
+        if err is not None:
+            return err
         root = ET.Element("CopyObjectResult")
-        _el(root, "ETag", resp.headers.get("ETag", ""))
+        _el(root, "ETag", f'"{etag}"')
         _el(root, "LastModified", _iso(time.time()))
         return Response(200, _xml(root), content_type="application/xml")
 
-    def _delete_objects(self, bucket: str, body: bytes) -> Response:
-        root_in = ET.fromstring(body)
+    def _delete_objects(self, bucket: str, ident: Identity,
+                        req: Request) -> Response:
+        root_in = ET.fromstring(req.body)
         ns = ""
         if root_in.tag.startswith("{"):
             ns = root_in.tag.split("}")[0] + "}"
         root = ET.Element("DeleteResult")
+        # the route gate authorized the bucket-level shape; each key is
+        # STILL checked individually so object-ARN-scoped policy
+        # statements apply exactly as they do on single DELETEs — the
+        # bulk path must not be a policy bypass.  A denied key becomes
+        # a per-key <Error> (the AWS DeleteResult contract), never an
+        # abort of the whole batch.
+        bulk_decision = getattr(req, "_audit_authz", ("", ""))
         for obj in root_in.findall(f"{ns}Object"):
             key = obj.find(f"{ns}Key").text
+            try:
+                self._authz(req, ident, "s3:DeleteObject", bucket, key)
+            except S3AuthError as e:
+                err = _el(root, "Error")
+                _el(err, "Key", key)
+                _el(err, "Code", e.code)
+                _el(err, "Message", str(e))
+                continue
             http_request(self._object_url(bucket, key), method="DELETE")
             d = _el(root, "Deleted")
             _el(d, "Key", key)
+        req._audit_authz = bulk_decision  # the audit line names the batch
         return Response(200, _xml(root), content_type="application/xml")
 
     # -- listing (s3api_objects_list_handlers.go) --------------------------
@@ -673,16 +1126,30 @@ class S3ApiServer:
                 content_type="application/xml")
         return None
 
-    def _initiate_multipart(self, bucket: str, key: str) -> Response:
+    def _initiate_multipart(self, bucket: str, key: str,
+                            ident: Identity, req: Request) -> Response:
         denied = self._quota_response(bucket)
         if denied:
             return denied
         upload_id = uuid.uuid4().hex
+        extended = {"key": key}
+        # x-amz-acl / grant headers arrive on INITIATE; they ride the
+        # staging dir until Complete stitches the final entry (stamp is
+        # empty on an open gateway or with enforcement short-circuited)
+        try:
+            stamp = self._acl_stamp_headers(ident, req, bucket)
+        except AclError as e:
+            return Response(400, _error_xml("InvalidArgument",
+                                            str(e), key),
+                            content_type="application/xml")
+        if stamp:
+            extended[OWNER_ATTR] = ident.name
+            extended[ACL_ATTR] = stamp[f"Seaweed-{ACL_ATTR}"]
         self._filer().call("CreateEntry", {"entry": {
             "full_path": self._uploads_dir(bucket, upload_id),
             "attr": {"mtime": time.time(), "crtime": time.time(),
                      "mode": 0o40000 | 0o770},
-            "extended": {"key": key}}})
+            "extended": extended}})
         root = ET.Element("InitiateMultipartUploadResult")
         _el(root, "Bucket", bucket)
         _el(root, "Key", key)
@@ -733,6 +1200,19 @@ class S3ApiServer:
         """Stitch part entries' chunks into the final object — zero data
         copy (completeMultipartUpload filer_multipart.go:87)."""
         updir = self._uploads_dir(bucket, upload_id)
+        # the staging dir's extended attrs carry the ACL/owner stamped
+        # at initiate — they transfer onto the final object entry
+        upload_ext: dict = {}
+        try:
+            up_entry = self._filer().call("LookupDirectoryEntry", {
+                "directory": updir.rsplit("/", 1)[0],
+                "name": upload_id})["entry"]
+            upload_ext = up_entry.get("extended", {}) or {}
+        except RpcError as e:
+            if "not found" not in str(e):
+                # a transport blip must not complete the object with
+                # its owner/ACL stamp silently stripped
+                raise
         parts = []
         for r in self._filer().stream("ListEntries",
                                       iter([{"directory": updir}])):
@@ -758,12 +1238,16 @@ class S3ApiServer:
                     "cipher_key": ch.get("cipher_key", ""),
                     "is_compressed": ch.get("is_compressed", False)})
             offset += _entry_size(e)
+        final_ext = {"etag": f"{upload_id}-{len(parts)}"}
+        for attr in (OWNER_ATTR, ACL_ATTR):
+            if upload_ext.get(attr):
+                final_ext[attr] = upload_ext[attr]
         self._filer().call("CreateEntry", {"entry": {
             "full_path": f"{BUCKETS_PATH}/{bucket}/{key}",
             "attr": {"mtime": time.time(), "crtime": time.time(),
                      "mode": 0o660},
             "chunks": chunks,
-            "extended": {"etag": f"{upload_id}-{len(parts)}"}}})
+            "extended": final_ext}})
         # remove the staging dir WITHOUT deleting chunk data (the final
         # entry owns the chunks now): strip chunks from part entries first
         for _, e in parts:
@@ -840,6 +1324,163 @@ class S3ApiServer:
                 _el(t, "Key", k[len("x-amz-tag-"):])
                 _el(t, "Value", v)
         return Response(200, _xml(root), content_type="application/xml")
+
+    # -- ACL sub-resource (acl.go GetBucketAclHandler & friends) -----------
+    def _bucket_entry(self, bucket: str) -> "dict | None":
+        """The bucket's entry dict, or None when the bucket genuinely
+        does not exist.  Transport failures RAISE (-> 500): a filer
+        blip must never masquerade as NoSuchBucket — a config-sync
+        tool would treat that 404 as authoritative deletion."""
+        try:
+            return self._filer().call("LookupDirectoryEntry", {
+                "directory": BUCKETS_PATH, "name": bucket})["entry"]
+        except RpcError as e:
+            if "not found" in str(e):
+                return None
+            raise
+
+    @staticmethod
+    def _stored_acl(entry: dict) -> AccessControlPolicy:
+        """The entry's ACL, defaulting to owner-private for resources
+        that predate ACL stamping."""
+        ext = entry.get("extended", {}) or {}
+        owner = ext.get(OWNER_ATTR, "")
+        if ext.get(ACL_ATTR):
+            acp = AccessControlPolicy.from_json(ext[ACL_ATTR])
+            acp.owner = owner or acp.owner
+            return acp
+        return aclmod.canned_acl("private", owner)
+
+    def _get_bucket_acl(self, bucket: str) -> Response:
+        entry = self._bucket_entry(bucket)
+        if entry is None:
+            return Response(404, _error_xml("NoSuchBucket", bucket),
+                            content_type="application/xml")
+        return Response(200, self._stored_acl(entry).to_xml(),
+                        content_type="application/xml")
+
+    def _put_bucket_acl(self, bucket: str, ident: Identity,
+                        req: Request) -> Response:
+        entry = self._bucket_entry(bucket)
+        if entry is None:
+            return Response(404, _error_xml("NoSuchBucket", bucket),
+                            content_type="application/xml")
+        if not aclmod.has_acl_source(req.headers, req.body):
+            return Response(
+                400, _error_xml("MissingSecurityHeader",
+                                "PutAcl needs a canned header, grant "
+                                "headers, or an XML body", bucket),
+                content_type="application/xml")
+        ext = entry.get("extended", {}) or {}
+        owner = ext.get(OWNER_ATTR, "") or ident.name
+        try:
+            acp = aclmod.acl_from_request(req.headers, req.body,
+                                          owner=owner)
+        except AclError as e:
+            return Response(400, _error_xml("InvalidArgument", str(e),
+                                            bucket),
+                            content_type="application/xml")
+        ext[OWNER_ATTR] = owner
+        ext[ACL_ATTR] = acp.to_json()
+        entry["extended"] = ext
+        self._filer().call("UpdateEntry", {"entry": entry})
+        self._invalidate_bucket(bucket)
+        return Response(200, b"")
+
+    def _get_object_acl(self, bucket: str, key: str) -> Response:
+        try:
+            entry = self._entry_of(bucket, key)
+        except RpcError as e:
+            if "not found" not in str(e):
+                raise  # transport blip, not a missing object
+            return Response(404, _error_xml("NoSuchKey", key),
+                            content_type="application/xml")
+        acp = self._stored_acl(entry)
+        if not acp.owner:
+            # legacy object: surface the bucket owner rather than an
+            # empty <ID/> (the object predates ownership stamping)
+            acp.owner = self._bucket_meta(bucket).owner
+        return Response(200, acp.to_xml(),
+                        content_type="application/xml")
+
+    def _put_object_acl(self, bucket: str, key: str,
+                        req: Request) -> Response:
+        """PutObjectAcl — the request shape that used to OVERWRITE the
+        object's bytes before PR 1's 501 gate.  It round-trips the ACL
+        through the entry's extended attrs and leaves chunks untouched
+        (the regression test asserts data integrity across this)."""
+        try:
+            entry = self._entry_of(bucket, key)
+        except RpcError as e:
+            if "not found" not in str(e):
+                raise  # transport blip, not a missing object
+            return Response(404, _error_xml("NoSuchKey", key),
+                            content_type="application/xml")
+        if not aclmod.has_acl_source(req.headers, req.body):
+            return Response(
+                400, _error_xml("MissingSecurityHeader",
+                                "PutAcl needs a canned header, grant "
+                                "headers, or an XML body", key),
+                content_type="application/xml")
+        ext = entry.get("extended", {}) or {}
+        owner = ext.get(OWNER_ATTR, "")
+        try:
+            acp = aclmod.acl_from_request(req.headers, req.body,
+                                          owner=owner)
+        except AclError as e:
+            return Response(400, _error_xml("InvalidArgument", str(e),
+                                            key),
+                            content_type="application/xml")
+        ext[ACL_ATTR] = acp.to_json()
+        entry["extended"] = ext
+        self._filer().call("UpdateEntry", {"entry": entry})
+        return Response(200, b"")
+
+    # -- bucket policy sub-resource ----------------------------------------
+    def _get_bucket_policy(self, bucket: str) -> Response:
+        entry = self._bucket_entry(bucket)
+        if entry is None:
+            return Response(404, _error_xml("NoSuchBucket", bucket),
+                            content_type="application/xml")
+        policy = (entry.get("extended", {}) or {}).get(POLICY_ATTR, "")
+        if not policy:
+            return Response(
+                404, _error_xml("NoSuchBucketPolicy",
+                                f"bucket {bucket} has no policy"),
+                content_type="application/xml")
+        return Response(200, policy.encode(),
+                        content_type="application/json")
+
+    def _put_bucket_policy(self, bucket: str, body: bytes) -> Response:
+        try:
+            doc_text = body.decode()
+            aclmod.parse_bucket_policy(doc_text)
+        except (UnicodeDecodeError, AclError) as e:
+            return Response(400, _error_xml("MalformedPolicy", str(e),
+                                            bucket),
+                            content_type="application/xml")
+        entry = self._bucket_entry(bucket)
+        if entry is None:
+            return Response(404, _error_xml("NoSuchBucket", bucket),
+                            content_type="application/xml")
+        ext = entry.get("extended", {}) or {}
+        ext[POLICY_ATTR] = doc_text
+        entry["extended"] = ext
+        self._filer().call("UpdateEntry", {"entry": entry})
+        self._invalidate_bucket(bucket)
+        return Response(204, b"")
+
+    def _delete_bucket_policy(self, bucket: str) -> Response:
+        entry = self._bucket_entry(bucket)
+        if entry is None:
+            return Response(404, _error_xml("NoSuchBucket", bucket),
+                            content_type="application/xml")
+        ext = entry.get("extended", {}) or {}
+        ext.pop(POLICY_ATTR, None)
+        entry["extended"] = ext
+        self._filer().call("UpdateEntry", {"entry": entry})
+        self._invalidate_bucket(bucket)
+        return Response(204, b"")
 
 
 def _entry_size(e: dict) -> int:

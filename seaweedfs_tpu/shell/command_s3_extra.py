@@ -44,6 +44,63 @@ def cmd_s3_configure(env: CommandEnv, args: list[str]) -> str:
     return json.dumps(cfg)
 
 
+@command("s3.bucket.acl",
+         "show or set a bucket's ACL/authz state: -name b shows owner "
+         "+ ACL grants + policy; -canned private|public-read|"
+         "public-read-write|authenticated-read sets a canned ACL; "
+         "-owner name (re)stamps ownership")
+def cmd_s3_bucket_acl(env: CommandEnv, args: list[str]) -> str:
+    from ..s3.acl import (ACL_ATTR, OWNER_ATTR, POLICY_ATTR,
+                          AccessControlPolicy, AclError, canned_acl)
+    flags = parse_flags(args)
+    name = flags.get("name", "")
+    if not name:
+        raise ShellError("s3.bucket.acl needs -name")
+    client = _filer(env)
+    try:
+        entry = client.call("LookupDirectoryEntry", {
+            "directory": BUCKETS_PATH, "name": name})["entry"]
+    except RpcError:
+        raise ShellError(f"no bucket {name}") from None
+    ext = entry.get("extended", {}) or {}
+    changed = False
+    if flags.get("owner"):
+        ext[OWNER_ATTR] = flags["owner"]
+        changed = True
+    if flags.get("canned"):
+        owner = ext.get(OWNER_ATTR, "")
+        try:
+            ext[ACL_ATTR] = canned_acl(flags["canned"], owner).to_json()
+        except AclError as e:
+            raise ShellError(str(e)) from None
+        changed = True
+    if changed:
+        entry["extended"] = ext
+        client.call("UpdateEntry", {"entry": entry})
+    grants = []
+    if ext.get(ACL_ATTR):
+        try:
+            acp = AccessControlPolicy.from_json(ext[ACL_ATTR])
+            grants = [{"permission": g.permission,
+                       "grantee": g.grantee_id or g.group_uri}
+                      for g in acp.grants]
+        except AclError:
+            grants = [{"error": "corrupt stored ACL"}]
+    policy = None
+    if ext.get(POLICY_ATTR):
+        try:
+            policy = json.loads(ext[POLICY_ATTR])
+        except ValueError:
+            # the diagnostic verb must survive exactly the corrupt
+            # state it exists to inspect
+            policy = {"error": "corrupt stored policy"}
+    return json.dumps({
+        "bucket": name,
+        "owner": ext.get(OWNER_ATTR, ""),
+        "grants": grants,
+        "policy": policy})
+
+
 @command("s3.clean.uploads",
          "delete stale multipart upload staging dirs: "
          "[-timeAgo seconds, default 86400]")

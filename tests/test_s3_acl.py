@@ -1,0 +1,646 @@
+"""Multi-tenant S3 authorization (ISSUE 8): the fused IAM + bucket
+policy + ACL gate, driven request-level against a live stack.
+
+Covers the acceptance surface:
+- the conformance matrix (canned ACL x verb x identity class
+  {owner, other-identity, authenticated, anonymous});
+- the regression pin for the original footgun: put-object-acl-shaped
+  requests round-trip the ACL and leave object BYTES untouched
+  (replacing PR 1's 501 tests);
+- e2e: a public-read bucket served to an unauthenticated client, and a
+  denied cross-tenant write recorded in the audit log + the
+  seaweedfs_s3_authz_total{result,source} metric family;
+- bucket policy allow/deny (deny wins), grant headers, XML bodies,
+  bucket-owner-* canned forms, ACL carried across CopyObject,
+  multipart complete, and POST-policy uploads.
+"""
+
+import io
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer import FilerServer
+from seaweedfs_tpu.master import MasterServer
+from seaweedfs_tpu.s3 import IdentityAccessManagement, S3ApiServer
+from seaweedfs_tpu.s3.audit import AuditLog
+from seaweedfs_tpu.util.http import http_request
+from seaweedfs_tpu.volume_server import VolumeServer
+
+from test_s3 import S3Client, xml_root  # noqa: F401
+
+A_KEY, A_SECRET = "TENAKEY", "tenant-a-secret"
+B_KEY, B_SECRET = "TENBKEY", "tenant-b-secret"
+C_KEY, C_SECRET = "TENCKEY", "tenant-c-secret"
+D_KEY, D_SECRET = "TENDKEY", "tenant-d-secret"
+
+# every bucket the suite touches; tenant-a is scoped admin of its own
+TENANT_A_BUCKETS = [
+    "m-private", "m-public-read", "m-public-read-write",
+    "m-authenticated-read", "pub-bucket", "xt-a", "bo-bucket",
+    "pol-bucket", "reg-bucket", "cp-src", "cp-dst", "mp-bucket",
+    "pp-bucket", "bd-bucket",
+]
+
+
+class _ListSink:
+    """In-memory audit sink: records end up as parsed dicts."""
+
+    def __init__(self):
+        self.lines: list[dict] = []
+
+    def write(self, line: str) -> None:
+        self.lines.append(json.loads(line))
+
+
+@pytest.fixture(scope="module")
+def aclstack(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("aclstack")
+    master = MasterServer(seed=80)
+    master.start()
+    d = tmp_path / "vol"
+    d.mkdir()
+    vs = VolumeServer(master.grpc_address, [str(d)], pulse_seconds=0.5,
+                      max_volume_counts=[40])
+    vs.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topo.data_nodes()) < 1:
+        time.sleep(0.05)
+    filer = FilerServer(master.grpc_address, chunk_size=1 << 20)
+    filer.start()
+    iam = IdentityAccessManagement.from_config({"identities": [
+        {"name": "tenant-a",
+         "credentials": [{"accessKey": A_KEY, "secretKey": A_SECRET}],
+         "actions": [f"Admin:{b}" for b in TENANT_A_BUCKETS]},
+        {"name": "tenant-b",
+         "credentials": [{"accessKey": B_KEY, "secretKey": B_SECRET}],
+         "actions": ["Admin:xt-b"]},
+        {"name": "tenant-c",
+         "credentials": [{"accessKey": C_KEY, "secretKey": C_SECRET}],
+         "actions": []},
+        {"name": "tenant-d",
+         "credentials": [{"accessKey": D_KEY, "secretKey": D_SECRET}],
+         "actions": []},
+    ]})
+    sink = _ListSink()
+    s3 = S3ApiServer(filer.address, filer.grpc_address, iam=iam,
+                     audit_log=AuditLog(sink=sink))
+    s3.start()
+    clients = {
+        "owner": S3Client(s3.address, A_KEY, A_SECRET),
+        "other": S3Client(s3.address, B_KEY, B_SECRET),
+        "auth": S3Client(s3.address, C_KEY, C_SECRET),
+        "downer": S3Client(s3.address, D_KEY, D_SECRET),
+    }
+    yield s3, clients, sink
+    s3.stop()
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def anon_request(s3, method, path, body=b"", query=""):
+    url = f"http://{s3.address}{path}" + (f"?{query}" if query else "")
+    return http_request(url, method=method, body=body or None)
+
+
+def _fresh(s3, bucket):
+    """Drop the 3s bucket-meta cache so a just-written ACL/policy is
+    visible without sleeping."""
+    s3._invalidate_bucket(bucket)
+
+
+# -- regression pin: the original footgun ----------------------------------
+
+def test_put_object_acl_roundtrips_and_preserves_bytes(aclstack):
+    """`aws s3api put-object-acl`-shaped requests (PUT /b/k?acl with an
+    XML body, a canned header, or grant headers) must round-trip the
+    ACL and leave the object BYTES byte-identical — the request shape
+    that overwrote object data before PR 1."""
+    s3, clients, _ = aclstack
+    owner = clients["owner"]
+    owner.request("PUT", "/reg-bucket")
+    data = b"precious object bytes, do not clobber" * 100
+    owner.request("PUT", "/reg-bucket/key.bin", data)
+
+    # 1: XML body (the aws-cli --access-control-policy shape)
+    acl_xml = (
+        b'<AccessControlPolicy>'
+        b'<Owner><ID>tenant-a</ID></Owner>'
+        b'<AccessControlList>'
+        b'<Grant><Grantee xsi:type="CanonicalUser" xmlns:xsi='
+        b'"http://www.w3.org/2001/XMLSchema-instance">'
+        b'<ID>tenant-a</ID></Grantee>'
+        b'<Permission>FULL_CONTROL</Permission></Grant>'
+        b'<Grant><Grantee xsi:type="Group" xmlns:xsi='
+        b'"http://www.w3.org/2001/XMLSchema-instance">'
+        b'<URI>http://acs.amazonaws.com/groups/global/AllUsers</URI>'
+        b'</Grantee><Permission>READ</Permission></Grant>'
+        b'</AccessControlList></AccessControlPolicy>')
+    status, _, _ = owner.request("PUT", "/reg-bucket/key.bin", acl_xml,
+                                 query={"acl": ""})
+    assert status == 200
+    status, got, _ = owner.request("GET", "/reg-bucket/key.bin")
+    assert status == 200 and got == data            # bytes untouched
+    status, body, _ = owner.request("GET", "/reg-bucket/key.bin",
+                                    query={"acl": ""})
+    assert status == 200
+    assert b"AllUsers" in body and b"FULL_CONTROL" in body
+
+    # 2: canned header form
+    status, _, _ = owner.request(
+        "PUT", "/reg-bucket/key.bin", b"", query={"acl": ""},
+        headers={"x-amz-acl": "authenticated-read"})
+    assert status == 200
+    _, got, _ = owner.request("GET", "/reg-bucket/key.bin")
+    assert got == data
+    _, body, _ = owner.request("GET", "/reg-bucket/key.bin",
+                               query={"acl": ""})
+    assert b"AuthenticatedUsers" in body
+
+    # 3: grant headers form
+    status, _, _ = owner.request(
+        "PUT", "/reg-bucket/key.bin", b"", query={"acl": ""},
+        headers={"x-amz-grant-read": 'id="tenant-c"'})
+    assert status == 200
+    _, got, _ = owner.request("GET", "/reg-bucket/key.bin")
+    assert got == data
+    _, body, _ = owner.request("GET", "/reg-bucket/key.bin",
+                               query={"acl": ""})
+    assert b"tenant-c" in body
+
+    # mixing sources is rejected, and still leaves the data alone
+    status, body, _ = owner.request(
+        "PUT", "/reg-bucket/key.bin", acl_xml, query={"acl": ""},
+        headers={"x-amz-acl": "private"})
+    assert status == 400
+    assert xml_root(body).find("Code").text == "InvalidArgument"
+    _, got, _ = owner.request("GET", "/reg-bucket/key.bin")
+    assert got == data
+
+
+# -- the conformance matrix -------------------------------------------------
+
+# expected ALLOWED identity classes per verb; "anon" is the raw
+# unauthenticated client, "auth" a signed identity with no IAM grants,
+# "other" a signed tenant with IAM grants only on ITS OWN buckets
+MATRIX = {
+    "private": {
+        "get": {"owner"}, "list": {"owner"}, "put": {"owner"},
+        "getacl": {"owner"}, "putacl": {"owner"},
+    },
+    "public-read": {
+        "get": {"owner", "other", "auth", "anon"},
+        "list": {"owner", "other", "auth", "anon"},
+        "put": {"owner"},
+        "getacl": {"owner"}, "putacl": {"owner"},
+    },
+    "public-read-write": {
+        "get": {"owner", "other", "auth", "anon"},
+        "list": {"owner", "other", "auth", "anon"},
+        "put": {"owner", "other", "auth", "anon"},
+        "getacl": {"owner"}, "putacl": {"owner"},
+    },
+    "authenticated-read": {
+        "get": {"owner", "other", "auth"},
+        "list": {"owner", "other", "auth"},
+        "put": {"owner"},
+        "getacl": {"owner"}, "putacl": {"owner"},
+    },
+}
+
+
+@pytest.mark.parametrize("canned", sorted(MATRIX))
+def test_conformance_matrix(aclstack, canned):
+    s3, clients, _ = aclstack
+    bucket = f"m-{canned}"
+    owner = clients["owner"]
+    status, _, _ = owner.request("PUT", f"/{bucket}",
+                                 headers={"x-amz-acl": canned})
+    assert status == 200
+    status, _, _ = owner.request("PUT", f"/{bucket}/o.bin", b"matrix",
+                                 headers={"x-amz-acl": canned})
+    assert status == 200
+    _fresh(s3, bucket)
+    expected = MATRIX[canned]
+
+    def run(who, verb):
+        if who == "anon":
+            if verb == "get":
+                st, _, _ = anon_request(s3, "GET", f"/{bucket}/o.bin")
+            elif verb == "list":
+                st, _, _ = anon_request(s3, "GET", f"/{bucket}")
+            elif verb == "put":
+                st, _, _ = anon_request(s3, "PUT",
+                                        f"/{bucket}/w-anon.bin", b"x")
+            elif verb == "getacl":
+                st, _, _ = anon_request(s3, "GET", f"/{bucket}/o.bin",
+                                        query="acl")
+            else:
+                st, _, _ = anon_request(s3, "PUT", f"/{bucket}/o.bin",
+                                        b"", query="acl")
+            return st
+        cl = clients[who]
+        if verb == "get":
+            st, _, _ = cl.request("GET", f"/{bucket}/o.bin")
+        elif verb == "list":
+            st, _, _ = cl.request("GET", f"/{bucket}")
+        elif verb == "put":
+            st, _, _ = cl.request("PUT", f"/{bucket}/w-{who}.bin", b"x")
+        elif verb == "getacl":
+            st, _, _ = cl.request("GET", f"/{bucket}/o.bin",
+                                  query={"acl": ""})
+        else:  # putacl: same canned value keeps the matrix invariant
+            st, _, _ = cl.request("PUT", f"/{bucket}/o.bin", b"",
+                                  query={"acl": ""},
+                                  headers={"x-amz-acl": canned})
+        return st
+
+    for verb, allowed in expected.items():
+        for who in ("owner", "other", "auth", "anon"):
+            st = run(who, verb)
+            if who in allowed:
+                assert st < 400, (canned, verb, who, st)
+            else:
+                assert st == 403, (canned, verb, who, st)
+
+
+# -- e2e: anonymous public-read + audited deny ------------------------------
+
+def test_public_read_bucket_e2e_and_denied_write_audited(aclstack):
+    s3, clients, sink = aclstack
+    owner = clients["owner"]
+    owner.request("PUT", "/pub-bucket",
+                  headers={"x-amz-acl": "public-read"})
+    owner.request("PUT", "/pub-bucket/hello.txt", b"anyone may read")
+    _fresh(s3, "pub-bucket")
+    # unauthenticated client reads an object whose OWN acl is private —
+    # the bucket-grant cascade serves it (the fork's public-read flow)
+    status, got, _ = anon_request(s3, "GET", "/pub-bucket/hello.txt")
+    assert status == 200 and got == b"anyone may read"
+    # ... and lists the bucket
+    status, body, _ = anon_request(s3, "GET", "/pub-bucket")
+    assert status == 200 and b"hello.txt" in body
+    # but must not write
+    status, body, _ = anon_request(s3, "PUT", "/pub-bucket/evil.bin",
+                                   b"nope")
+    assert status == 403
+    assert b"AccessDenied" in body
+    # the decision is audited with its deciding source
+    denies = [e for e in sink.lines
+              if e.get("authz") == "deny" and e["bucket"] == "pub-bucket"
+              and e["key"] == "evil.bin"]
+    assert denies and denies[-1]["authz_source"] == "anonymous"
+    assert denies[-1]["requester"] == "anonymous"
+    allows = [e for e in sink.lines
+              if e.get("authz") == "allow"
+              and e["bucket"] == "pub-bucket"
+              and e["key"] == "hello.txt"
+              and e["requester"] == "anonymous"]
+    assert allows and allows[-1]["authz_source"] == "acl-grant"
+
+
+def test_cross_tenant_write_denied_and_metrics(aclstack):
+    s3, clients, sink = aclstack
+    clients["owner"].request("PUT", "/xt-a")
+    _fresh(s3, "xt-a")
+    status, body, _ = clients["other"].request("PUT", "/xt-a/steal.bin",
+                                               b"mine now")
+    assert status == 403
+    assert xml_root(body).find("Code").text == "AccessDenied"
+    status, _, _ = clients["owner"].request("GET", "/xt-a/steal.bin")
+    assert status == 404        # nothing was written
+    denies = [e for e in sink.lines
+              if e.get("authz") == "deny" and e["bucket"] == "xt-a"]
+    assert denies and denies[-1]["requester"] == "tenant-b"
+    assert denies[-1]["authz_source"] == "iam"
+    # the authz decision families are on the S3 /metrics scrape — for
+    # any SIGNED identity; anonymous scrapes of a tenant gateway's
+    # allow/deny rates are refused
+    status, _, _ = http_request(f"http://{s3.address}/metrics")
+    assert status == 403
+    status, body, _ = clients["auth"].request("GET", "/metrics")
+    assert status == 200
+    text = body.decode()
+    assert 'seaweedfs_s3_authz_total{result="deny",source="iam"}' in text
+    assert 'result="allow"' in text
+
+
+# -- bucket policy ----------------------------------------------------------
+
+def test_bucket_policy_allow_and_deny(aclstack):
+    s3, clients, _ = aclstack
+    owner, other, auth = (clients["owner"], clients["other"],
+                          clients["auth"])
+    owner.request("PUT", "/pol-bucket")
+    owner.request("PUT", "/pol-bucket/ok.txt", b"policy ok")
+    owner.request("PUT", "/pol-bucket/secret/x.txt", b"no peeking")
+    policy = json.dumps({"Statement": [
+        {"Effect": "Allow", "Principal": {"AWS": ["tenant-c"]},
+         "Action": "s3:GetObject",
+         "Resource": "arn:aws:s3:::pol-bucket/*"},
+        {"Effect": "Deny", "Principal": "*",
+         "Action": "s3:GetObject",
+         "Resource": "arn:aws:s3:::pol-bucket/secret/*"},
+    ]})
+    status, _, _ = owner.request("PUT", "/pol-bucket", policy.encode(),
+                                 query={"policy": ""})
+    assert status == 204
+    _fresh(s3, "pol-bucket")
+    # allowed by policy (tenant-c has zero IAM actions)
+    status, got, _ = auth.request("GET", "/pol-bucket/ok.txt")
+    assert status == 200 and got == b"policy ok"
+    # explicit deny beats the allow
+    status, _, _ = auth.request("GET", "/pol-bucket/secret/x.txt")
+    assert status == 403
+    # ... and beats the IAM route too: tenant-a is a bucket-SCOPED
+    # admin of pol-bucket, and the * deny still cuts it off (only the
+    # GLOBAL Admin action bypasses — the operator escape hatch)
+    status, _, _ = owner.request("GET", "/pol-bucket/secret/x.txt")
+    assert status == 403
+    # tenant-b is not a principal of the allow
+    status, _, _ = other.request("GET", "/pol-bucket/ok.txt")
+    assert status == 403
+    # round-trip + delete
+    status, body, _ = owner.request("GET", "/pol-bucket",
+                                    query={"policy": ""})
+    assert status == 200 and json.loads(body) == json.loads(policy)
+    status, _, _ = owner.request("DELETE", "/pol-bucket",
+                                 query={"policy": ""})
+    assert status == 204
+    _fresh(s3, "pol-bucket")
+    status, _, _ = auth.request("GET", "/pol-bucket/ok.txt")
+    assert status == 403        # the allow died with the policy
+    status, _, _ = owner.request("GET", "/pol-bucket/secret/x.txt")
+    assert status == 200        # ... and so did the deny
+    # malformed / unsupported documents are rejected at PUT
+    status, body, _ = owner.request("PUT", "/pol-bucket", b"not json",
+                                    query={"policy": ""})
+    assert status == 400
+    assert xml_root(body).find("Code").text == "MalformedPolicy"
+    cond = json.dumps({"Statement": [
+        {"Effect": "Allow", "Principal": "*", "Action": "s3:GetObject",
+         "Resource": "arn:aws:s3:::pol-bucket/*",
+         "Condition": {"IpAddress": {"aws:SourceIp": "1.2.3.4"}}}]})
+    status, _, _ = owner.request("PUT", "/pol-bucket", cond.encode(),
+                                 query={"policy": ""})
+    assert status == 400        # silently ignoring Condition would widen
+    # non-trailing wildcards never match at evaluation, so accepting
+    # them would leave the operator's Deny silently inert
+    inert = json.dumps({"Statement": [
+        {"Effect": "Deny", "Principal": "*", "Action": "s3:GetObject",
+         "Resource": "arn:aws:s3:::pol-bucket/*.secret"}]})
+    status, _, _ = owner.request("PUT", "/pol-bucket", inert.encode(),
+                                 query={"policy": ""})
+    assert status == 400
+
+
+def test_bulk_delete_honors_object_scoped_policy(aclstack):
+    """POST ?delete must evaluate EACH key against the policy — the
+    bulk path is not a bypass for object-ARN-scoped Deny statements,
+    and a denied key answers a per-key <Error> (AWS DeleteResult),
+    not a whole-batch 403."""
+    s3, clients, _ = aclstack
+    owner = clients["owner"]
+    owner.request("PUT", "/bd-bucket")
+    owner.request("PUT", "/bd-bucket/x.bin", b"deletable")
+    owner.request("PUT", "/bd-bucket/keep/y.bin", b"protected")
+    policy = json.dumps({"Statement": [
+        {"Effect": "Deny", "Principal": "*",
+         "Action": "s3:DeleteObject",
+         "Resource": "arn:aws:s3:::bd-bucket/keep/*"}]})
+    owner.request("PUT", "/bd-bucket", policy.encode(),
+                  query={"policy": ""})
+    _fresh(s3, "bd-bucket")
+    payload = (b"<Delete><Object><Key>x.bin</Key></Object>"
+               b"<Object><Key>keep/y.bin</Key></Object></Delete>")
+    status, body, _ = owner.request("POST", "/bd-bucket", payload,
+                                    query={"delete": ""})
+    assert status == 200
+    root = xml_root(body)
+    assert [d.find("Key").text for d in root.iter("Deleted")] \
+        == ["x.bin"]
+    errs = {e.find("Key").text: e.find("Code").text
+            for e in root.iter("Error")}
+    assert errs == {"keep/y.bin": "AccessDenied"}
+    status, got, _ = owner.request("GET", "/bd-bucket/keep/y.bin")
+    assert status == 200 and got == b"protected"   # survived the batch
+    status, _, _ = owner.request("GET", "/bd-bucket/x.bin")
+    assert status == 404
+
+
+# -- bucket-owner-* canned forms (distinct object owner) --------------------
+
+def test_bucket_owner_canned_acls(aclstack):
+    """bucket-owner-read / bucket-owner-full-control, observed from a
+    bucket owner who holds ZERO IAM grants (tenant-d) so every allow
+    must come from the ACL plane.  tenant-a creates the bucket and an
+    operator restamps ownership (the s3.bucket.acl -owner flow)."""
+    s3, clients, _ = aclstack
+    owner, other, downer = (clients["owner"], clients["other"],
+                            clients["downer"])
+    # tenant-b may write via an explicit WRITE grant (no READ cascade —
+    # the bucket stays otherwise private)
+    status, _, _ = owner.request(
+        "PUT", "/bo-bucket",
+        headers={"x-amz-grant-write": 'id="tenant-b"'})
+    assert status == 200
+    # operator hands the bucket to tenant-d (what the shell's
+    # `s3.bucket.acl -owner` verb does)
+    from seaweedfs_tpu.s3.acl import OWNER_ATTR
+    entry = s3._bucket_entry("bo-bucket")
+    entry.setdefault("extended", {})[OWNER_ATTR] = "tenant-d"
+    s3._filer().call("UpdateEntry", {"entry": entry})
+    _fresh(s3, "bo-bucket")
+    # tenant-b uploads, handing the bucket owner full control
+    status, _, _ = other.request(
+        "PUT", "/bo-bucket/full.bin", b"shared fully",
+        headers={"x-amz-acl": "bucket-owner-full-control"})
+    assert status == 200
+    # ... and another granting read only
+    status, _, _ = other.request(
+        "PUT", "/bo-bucket/read.bin", b"read only",
+        headers={"x-amz-acl": "bucket-owner-read"})
+    assert status == 200
+    # the bucket owner reads both — purely via the object grants
+    status, got, _ = downer.request("GET", "/bo-bucket/full.bin")
+    assert status == 200 and got == b"shared fully"
+    status, got, _ = downer.request("GET", "/bo-bucket/read.bin")
+    assert status == 200 and got == b"read only"
+    # full-control lets the bucket owner read/rewrite the ACL; the
+    # read-only grant does not reach the ACL sub-resource
+    status, _, _ = downer.request("GET", "/bo-bucket/full.bin",
+                                  query={"acl": ""})
+    assert status == 200
+    status, _, _ = downer.request("GET", "/bo-bucket/read.bin",
+                                  query={"acl": ""})
+    assert status == 403
+    # ... and the bucket owner can still DELETE either (bucket-target
+    # WRITE is theirs by ownership), the tenant boundary AWS keeps too
+    status, _, _ = downer.request("DELETE", "/bo-bucket/full.bin")
+    assert status == 204
+    # an uninvolved authenticated identity sees neither
+    status, _, _ = clients["auth"].request("GET", "/bo-bucket/read.bin")
+    assert status == 403
+
+
+# -- ACL carried across CopyObject / multipart / POST-policy ----------------
+
+def test_acl_carried_across_copy_and_multipart(aclstack):
+    s3, clients, _ = aclstack
+    owner = clients["owner"]
+    owner.request("PUT", "/cp-src")
+    owner.request("PUT", "/cp-dst")
+    owner.request("PUT", "/cp-src/orig.bin", b"copy me with grants",
+                  headers={"x-amz-acl": "public-read"})
+    # copy WITHOUT acl headers: the source grants ride along
+    status, _, _ = owner.request(
+        "PUT", "/cp-dst/copied.bin",
+        headers={"X-Amz-Copy-Source": "/cp-src/orig.bin"})
+    assert status == 200
+    _fresh(s3, "cp-dst")
+    status, got, _ = anon_request(s3, "GET", "/cp-dst/copied.bin")
+    assert status == 200 and got == b"copy me with grants"
+    # copy WITH an explicit canned header: the header wins
+    status, _, _ = owner.request(
+        "PUT", "/cp-dst/private.bin",
+        headers={"X-Amz-Copy-Source": "/cp-src/orig.bin",
+                 "x-amz-acl": "private"})
+    assert status == 200
+    status, _, _ = anon_request(s3, "GET", "/cp-dst/private.bin")
+    assert status == 403
+    # cross-tenant copy must NOT leak the source owner's control: the
+    # public-read object is readable by tenant-b, who copies it into
+    # its OWN bucket — tenant-a (source owner) gets no grant on the
+    # copy and cannot touch its ACL
+    other = clients["other"]
+    status, _, _ = other.request(
+        "PUT", "/xt-b/leeched.bin",
+        headers={"X-Amz-Copy-Source": "/cp-src/orig.bin"})
+    assert status == 200
+    status, body, _ = other.request("GET", "/xt-b/leeched.bin",
+                                    query={"acl": ""})
+    assert status == 200 and b"tenant-a" not in body
+    status, _, _ = owner.request("PUT", "/xt-b/leeched.bin", b"",
+                                 query={"acl": ""},
+                                 headers={"x-amz-acl": "private"})
+    assert status == 403        # source owner owns NOTHING here
+    # multipart: x-amz-acl arrives on INITIATE and lands on the object
+    owner.request("PUT", "/mp-bucket")
+    status, body, _ = owner.request(
+        "POST", "/mp-bucket/big.bin", query={"uploads": ""},
+        headers={"x-amz-acl": "public-read"})
+    upload_id = xml_root(body).find("UploadId").text
+    for num, part in ((1, b"A" * (1 << 20)), (2, b"B" * 512)):
+        status, _, _ = owner.request(
+            "PUT", "/mp-bucket/big.bin", part,
+            query={"partNumber": str(num), "uploadId": upload_id})
+        assert status == 200
+    status, _, _ = owner.request("POST", "/mp-bucket/big.bin",
+                                 query={"uploadId": upload_id})
+    assert status == 200
+    status, body, _ = owner.request("GET", "/mp-bucket/big.bin",
+                                    query={"acl": ""})
+    assert status == 200 and b"AllUsers" in body
+    status, got, _ = anon_request(s3, "GET", "/mp-bucket/big.bin")
+    assert status == 200 and got == b"A" * (1 << 20) + b"B" * 512
+
+
+def test_post_policy_acl_form_field(aclstack):
+    """The `acl` form field on a browser POST-policy upload stamps the
+    object's ACL like the x-amz-acl header does on PUT."""
+    import base64
+    import datetime as dt
+    import hashlib
+    import hmac
+
+    from seaweedfs_tpu.s3.auth import _signing_key
+    s3, clients, _ = aclstack
+    clients["owner"].request("PUT", "/pp-bucket")
+    _fresh(s3, "pp-bucket")
+    exp = dt.datetime.now(dt.timezone.utc) + dt.timedelta(minutes=5)
+    policy = base64.b64encode(json.dumps({
+        "expiration": exp.strftime("%Y-%m-%dT%H:%M:%S.000Z"),
+        "conditions": [{"bucket": "pp-bucket"},
+                       {"acl": "public-read"},
+                       ["starts-with", "$key", ""]],
+    }).encode()).decode()
+    date = dt.datetime.now(dt.timezone.utc).strftime("%Y%m%d")
+    sig = hmac.new(_signing_key(A_SECRET, date, "us-east-1", "s3"),
+                   policy.encode(), hashlib.sha256).hexdigest()
+    fields = {
+        "key": "form.bin", "acl": "public-read", "policy": policy,
+        "x-amz-algorithm": "AWS4-HMAC-SHA256",
+        "x-amz-credential": f"{A_KEY}/{date}/us-east-1/s3/aws4_request",
+        "x-amz-date": date + "T000000Z", "x-amz-signature": sig,
+    }
+    boundary = "----aclformboundary"
+    out = io.BytesIO()
+    for k, v in fields.items():
+        out.write((f"--{boundary}\r\nContent-Disposition: form-data; "
+                   f'name="{k}"\r\n\r\n{v}\r\n').encode())
+    out.write((f"--{boundary}\r\nContent-Disposition: form-data; "
+               'name="file"; filename="f.bin"\r\n\r\n').encode())
+    out.write(b"form upload data\r\n" + f"--{boundary}--\r\n".encode())
+    status, body, _ = http_request(
+        f"http://{s3.address}/pp-bucket", method="POST",
+        body=out.getvalue(),
+        headers={"Content-Type":
+                 f"multipart/form-data; boundary={boundary}"})
+    assert status == 204, body
+    status, got, _ = anon_request(s3, "GET", "/pp-bucket/form.bin")
+    assert status == 200 and got == b"form upload data"
+
+
+# -- enforcement short-circuit (the bench knob) -----------------------------
+
+def test_enforce_authz_off_short_circuits(aclstack, tmp_path):
+    """`enforce_authz=False` with IAM configured: the gate allows
+    everything and ACL stamping is off — AND the multipart path must
+    not trip over the missing stamp (regression: KeyError on
+    initiate)."""
+    s3, clients, _ = aclstack
+    srv = S3ApiServer(s3.filer_http, s3.filer_grpc, iam=s3.iam,
+                      enforce_authz=False)
+    srv.start()
+    try:
+        cl = S3Client(srv.address, C_KEY, C_SECRET)  # zero IAM grants
+        status, _, _ = cl.request("PUT", "/na-bucket")
+        assert status == 200
+        status, body, _ = cl.request("POST", "/na-bucket/mp.bin",
+                                     query={"uploads": ""})
+        assert status == 200, body
+        upload_id = xml_root(body).find("UploadId").text
+        status, _, _ = cl.request(
+            "PUT", "/na-bucket/mp.bin", b"short-circuited",
+            query={"partNumber": "1", "uploadId": upload_id})
+        assert status == 200
+        status, _, _ = cl.request("POST", "/na-bucket/mp.bin",
+                                  query={"uploadId": upload_id})
+        assert status == 200
+        status, got, _ = cl.request("GET", "/na-bucket/mp.bin")
+        assert status == 200 and got == b"short-circuited"
+    finally:
+        srv.stop()
+
+
+# -- presigned access counts as authenticated -------------------------------
+
+def test_presigned_reaches_authenticated_read(aclstack):
+    from seaweedfs_tpu.s3 import presign_url
+    s3, clients, _ = aclstack
+    owner = clients["owner"]
+    owner.request("PUT", "/m-authenticated-read/pre.bin", b"signed",
+                  headers={"x-amz-acl": "authenticated-read"})
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    # tenant-c holds no IAM actions: the grant route must carry it
+    url = presign_url(f"http://{s3.address}", "GET",
+                      "/m-authenticated-read/pre.bin", C_KEY, C_SECRET,
+                      amz_date)
+    status, got, _ = http_request(url)
+    assert status == 200 and got == b"signed"
+    # the same object stays closed to a raw anonymous request
+    status, _, _ = anon_request(s3, "GET",
+                                "/m-authenticated-read/pre.bin")
+    assert status == 403

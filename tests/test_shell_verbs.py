@@ -233,6 +233,33 @@ def test_s3_clean_uploads(cluster):
     assert out["removed"] == ["/buckets/up/.uploads/stale-upload"]
 
 
+def test_s3_bucket_acl_verb(cluster):
+    """s3.bucket.acl: show owner/grants/policy; set a canned ACL and
+    (re)stamp ownership — the operator's window into the authz plane."""
+    c, env = cluster
+    from seaweedfs_tpu.s3.client import S3Client
+    cl = S3Client(c.s3_server.address)
+    cl.create_bucket("aclb")
+    out = json.loads(shell.run_command(env, "s3.bucket.acl -name aclb"))
+    assert out == {"bucket": "aclb", "owner": "", "grants": [],
+                   "policy": None}  # open gateway: nothing stamped
+    out = json.loads(shell.run_command(
+        env, "s3.bucket.acl -name aclb -owner alice "
+             "-canned public-read"))
+    assert out["owner"] == "alice"
+    assert {"permission": "READ",
+            "grantee": "http://acs.amazonaws.com/groups/global/"
+                       "AllUsers"} in out["grants"]
+    assert {"permission": "FULL_CONTROL",
+            "grantee": "alice"} in out["grants"]
+    # unknown canned name / missing bucket fail loudly
+    with pytest.raises(shell.ShellError):
+        shell.run_command(env,
+                          "s3.bucket.acl -name aclb -canned bogus")
+    with pytest.raises(shell.ShellError):
+        shell.run_command(env, "s3.bucket.acl -name nope")
+
+
 def test_s3_bucket_quota_check_enforces(cluster):
     c, env = cluster
     from seaweedfs_tpu.s3.client import S3Client, S3ClientError

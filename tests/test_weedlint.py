@@ -72,6 +72,11 @@ def test_bad_dataplane_fixture():
     assert got == [("WL050", 7), ("WL050", 9), ("WL050", 16)]
 
 
+def test_bad_s3authz_fixture():
+    got = _ids_lines(_findings(os.path.join(FIXTURES, "bad_s3authz.py")))
+    assert got == [("WL080", 8), ("WL080", 10)]
+
+
 def test_good_fixture_is_clean():
     assert _findings(os.path.join(FIXTURES, "good.py")) == []
 
@@ -169,5 +174,5 @@ def test_cli_list_checkers():
     assert r.returncode == 0
     for cid in ("WL001", "WL002", "WL010", "WL011", "WL012",
                 "WL020", "WL021", "WL022", "WL030", "WL040",
-                "WL050", "WL060"):
+                "WL050", "WL060", "WL080"):
         assert cid in r.stdout

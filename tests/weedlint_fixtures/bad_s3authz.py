@@ -1,0 +1,33 @@
+"""Known-bad corpus for WL080 (s3-authz-gate): an S3-style router that
+dispatches handlers without passing the fused authz gate first."""
+
+
+class Server:
+    def _route(self, req, ident, bucket, key):
+        if req.method == "GET":
+            return self._get_object(bucket, key, req)       # line 8
+        if req.method == "HEAD":
+            entry = self._filer().call("Lookup", {})        # line 10
+            self._authz(req, ident, "s3:GetObject", bucket, key)
+            return entry
+        if req.method == "PUT":
+            self._authz(req, ident, "s3:PutObject", bucket, key)
+            return self._put_object(bucket, key, req)       # gated: ok
+        self._authz(req, ident, "s3:DeleteObject", bucket, key)
+        if req.method == "DELETE":
+            return self._delete_object(bucket, key)         # gated: ok
+
+    def _authz(self, req, ident, action, bucket, key=""):
+        pass
+
+    def _get_object(self, bucket, key, req):
+        pass
+
+    def _put_object(self, bucket, key, req):
+        pass
+
+    def _delete_object(self, bucket, key):
+        pass
+
+    def _filer(self):
+        pass

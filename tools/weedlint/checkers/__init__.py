@@ -8,3 +8,4 @@ from . import resources    # noqa: F401
 from . import dataplane    # noqa: F401
 from . import retryhygiene  # noqa: F401
 from . import leadership   # noqa: F401
+from . import s3authz      # noqa: F401

@@ -1,0 +1,125 @@
+"""WL080 s3-authz-gate — every S3 handler the router dispatches must
+pass through the fused authorization gate first.
+
+ISSUE 8's multi-tenant boundary lives in ONE place: the S3 router's
+``_authz`` call (s3/server.py), which fuses IAM identity actions, the
+bucket policy, and ACL grants before any handler touches the
+filer/volume plane.  The historical failure mode this pins down is a
+new verb wired into the router without a gate call — exactly how the
+pre-PR-1 ``?acl`` fall-through let unauthenticated requests overwrite
+object bytes.
+
+The rule: inside a function named ``_route``, any call on ``self``
+(``self._get_object(...)``, ``self._filer()``, ...) must be preceded —
+in the same statement suite or an enclosing one — by a ``self._authz``
+call.  Branch bodies inherit the gate state from their ancestors but
+never leak it to siblings: an ``_authz`` inside the GET branch does not
+authorize the PUT branch.  Scoped to the S3 server module (the only
+router with this contract) and the fixture corpus.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .. import Finding, ModuleContext, register
+
+_SCOPE_PARTS = ("seaweedfs_tpu/s3/server.py",)
+
+# self-calls that are part of the gate machinery itself, not handlers.
+# _authz_soft is the bulk-delete probe: it evaluates/records the same
+# fused decision but defers ENFORCEMENT to per-key _authz calls inside
+# the handler (AWS answers multi-delete with per-key errors, not 403).
+_GATE = {"_authz", "_authz_soft"}
+
+
+def _in_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(part in p for part in _SCOPE_PARTS) \
+        or "weedlint_fixtures" in p
+
+
+def _self_calls(node: ast.AST) -> "Iterator[ast.Call]":
+    """Calls of the shape ``self.<name>(...)`` anywhere under node."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) \
+                and isinstance(n.func, ast.Attribute) \
+                and isinstance(n.func.value, ast.Name) \
+                and n.func.value.id == "self":
+            yield n
+
+
+@register("WL080", "s3-authz-gate")
+def check_s3_authz_gate(ctx: ModuleContext) -> Iterator[Finding]:
+    if not _in_scope(ctx.path):
+        return
+    for fn in ast.walk(ctx.tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and fn.name == "_route":
+            yield from _check_suite(ctx, fn.body, gated=False)
+
+
+def _check_suite(ctx: ModuleContext, stmts: list,
+                 gated: bool) -> Iterator[Finding]:
+    """Walk a statement suite in order.  A ``self._authz(...)`` call
+    gates everything AFTER it at this level and inside nested suites;
+    sibling branches each start from the inherited state."""
+    for stmt in stmts:
+        if isinstance(stmt, (ast.If, ast.For, ast.While, ast.With,
+                             ast.Try)):
+            # the test/items expression runs before the body and must
+            # itself be gated if it dispatches
+            for expr in _stmt_head_exprs(stmt):
+                yield from _check_expr(ctx, expr, gated)
+            for suite in _stmt_suites(stmt):
+                yield from _check_suite(ctx, suite, gated)
+            # a gate inside ONE branch cannot authorize statements
+            # after the join — only an unconditional gate at this
+            # level flips the state (handled below for plain stmts)
+        else:
+            yield from _check_expr(ctx, stmt, gated)
+            if _calls_gate(stmt):
+                gated = True
+
+
+def _stmt_head_exprs(stmt: ast.AST) -> list:
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, ast.For):
+        return [stmt.iter]
+    if isinstance(stmt, ast.With):
+        return [item.context_expr for item in stmt.items]
+    return []
+
+
+def _stmt_suites(stmt: ast.AST) -> list:
+    if isinstance(stmt, (ast.If, ast.For, ast.While)):
+        return [stmt.body, stmt.orelse]
+    if isinstance(stmt, ast.With):
+        return [stmt.body]
+    if isinstance(stmt, ast.Try):
+        return [stmt.body, stmt.orelse, stmt.finalbody] \
+            + [h.body for h in stmt.handlers]
+    return []
+
+
+def _calls_gate(stmt: ast.AST) -> bool:
+    return any(c.func.attr in _GATE for c in _self_calls(stmt))
+
+
+def _check_expr(ctx: ModuleContext, node: ast.AST,
+                gated: bool) -> Iterator[Finding]:
+    if gated:
+        return
+    for call in _self_calls(node):
+        name = call.func.attr
+        if name in _GATE:
+            continue
+        yield Finding(
+            "WL080", "s3-authz-gate", ctx.path, call.lineno,
+            f"router dispatches self.{name}() before any "
+            "self._authz() gate on this path",
+            "call self._authz(req, ident, action, bucket, key) in "
+            "this branch BEFORE the handler — every routed verb "
+            "must pass the fused IAM+policy+ACL gate")
