@@ -495,6 +495,84 @@ def bench_s3_authz(quick: bool = False) -> dict:
     return out
 
 
+def bench_observability(quick: bool = False, n_files: int = 1500,
+                        passes: int = 3) -> dict:
+    """The observability tax (ISSUE 9): HTTP read rps with the span
+    plane on vs WEED_TRACE=0, and with the sampling profiler on vs off,
+    so the cost of always-on instrumentation is tracked next to the
+    perf numbers instead of assumed.  The HTTP data path is the honest
+    denominator — every request there mints/records a span when tracing
+    is on; the TCP frame path only pays when a trace actually rides the
+    frame."""
+    from seaweedfs_tpu import operation
+    from seaweedfs_tpu.testing import SimCluster
+    from seaweedfs_tpu.util import profiling, tracing
+    from seaweedfs_tpu.util.http import http_request
+
+    if quick:
+        n_files, passes = 300, 2
+    payload = b"o" * 1024
+    out: dict = {}
+    with SimCluster(volume_servers=1) as cluster:
+        r = operation.assign(cluster.master_grpc, count=n_files)
+        fids = operation.derive_fids(r)
+        for fid in fids:
+            operation.upload_to(r, fid, payload)
+        url = r.url
+
+        def one_pass() -> float:
+            t0 = time.perf_counter()
+            for fid in fids:
+                status, _, _ = http_request(f"http://{url}/{fid}")
+                assert status == 200
+            return len(fids) / (time.perf_counter() - t0)
+
+        def set_config(traced: bool, profiled: bool) -> None:
+            tracing.set_enabled(traced)
+            s = profiling.sampler()     # (re)starts the parked thread
+            if s is not None and not profiled:
+                s.stop()
+
+        was_traced = tracing.enabled()
+        rates: dict[str, list] = {"base": [], "traced": [],
+                                  "profiled": []}
+        configs = [("base", False, False), ("traced", True, False),
+                   ("profiled", False, True)]
+        try:
+            set_config(False, False)
+            one_pass()   # warm connections / needle cache, untimed
+            # interleave configs round-robin AND rotate the order each
+            # round: box-level drift (thermal, neighbors, allocator
+            # warm-up) ramps throughput over time, so both the round
+            # position and the global trend must hit every config
+            # equally
+            # rounds rounded UP to a multiple of 3 so every config sees
+            # every round position equally often (passes ~= samples per
+            # config)
+            for i in range((passes + 2) // 3 * 3):
+                for key, traced, profiled in (configs[i % 3:]
+                                              + configs[:i % 3]):
+                    set_config(traced, profiled)
+                    rates[key].append(one_pass())
+        finally:
+            tracing.set_enabled(was_traced)
+            profiling.sampler()             # leave the sampler running
+        for key, label in (("base", "obs_baseline_read_rps"),
+                           ("traced", "obs_traced_read_rps"),
+                           ("profiled", "obs_profiled_read_rps")):
+            out[label], out[f"{label}_spread"] = spread(rates[key],
+                                                        digits=1)
+        # overhead ratios compare BEST passes: scheduler blips only
+        # ever subtract throughput, so max-vs-max is the stable
+        # estimator on a contended box
+        base = max(rates["base"])
+        out["tracing_overhead_pct"] = round(
+            100.0 * (base - max(rates["traced"])) / base, 2)
+        out["profiler_overhead_pct"] = round(
+            100.0 * (base - max(rates["profiled"])) / base, 2)
+    return out
+
+
 def bench_replicated_write(concurrency: int, quick: bool = False,
                            n_files: int = 1000, runs: int = 3) -> dict:
     """Replicated small-write throughput (ISSUE 5): replication 001
@@ -968,6 +1046,10 @@ def main():
                 smallfile.update(bench_s3_authz(quick=args.quick))
             except Exception as e:
                 smallfile["s3_authz_error"] = str(e)[:200]
+            try:
+                smallfile.update(bench_observability(quick=args.quick))
+            except Exception as e:
+                smallfile["observability_error"] = str(e)[:200]
         except Exception as e:   # never fail the headline metric
             smallfile = {"smallfile_error": str(e)[:200]}
     # end-to-end disk path (VERDICT r3 missing #1)

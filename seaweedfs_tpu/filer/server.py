@@ -174,6 +174,8 @@ class FilerServer:
         # span ring behind GET /debug/traces
         self.metrics = ServerMetrics()
         self.tracer = Tracer("filer")
+        from ..util import profiling
+        profiling.sampler()  # always-on process sampler (WEED_PROFILE)
         self.http.tracer = self.tracer
         self.rpc.tracer = self.tracer
         self._del_queue: "queue.Queue[str]" = queue.Queue()
@@ -363,11 +365,14 @@ class FilerServer:
         self.http.route("GET", "/debug/traces",
                         tracing.traces_http_handler(self.tracer),
                         exact=True)
+        from ..util import profiling
+        self.http.route("GET", "/debug/profile",
+                        profiling.profile_http_handler(), exact=True)
         self.http.route("*", "/", self._http_dispatch)
 
     def _http_metrics(self, req: Request) -> Response:
-        return Response(200, self.metrics.render().encode(),
-                        content_type="text/plain; version=0.0.4")
+        from ..stats import metrics_response
+        return metrics_response(req, self.metrics.render)
 
     def _http_status(self, req: Request) -> Response:
         return Response.json({
@@ -395,8 +400,9 @@ class FilerServer:
             return Response.error("method not allowed", 405)
         finally:
             self.metrics.filer_requests.inc(kind)
-            self.metrics.filer_latency.observe(kind,
-                                               value=time.time() - t0)
+            self.metrics.filer_latency.observe(
+                kind, value=time.time() - t0,
+                trace_id=tracing.current_trace_id())
 
     def _http_write(self, path: str, req: Request) -> Response:
         """Auto-chunked upload (doPostAutoChunk)."""

@@ -1,0 +1,381 @@
+"""Cluster-wide observability federation on the master.
+
+Two verbs an operator previously had to ssh N servers for:
+
+- `GET /cluster/metrics` (and the `ClusterMetrics` RPC): every
+  registered server's /metrics page fused into ONE exposition page,
+  each sample relabeled with `server="host:port"` — plus
+  `seaweedfs_federation_up{server,role}` liveness samples.  Servers
+  that stop answering (or unregister) keep emitting `up 0` tombstones
+  for WEED_SCRAPE_TOMBSTONE_S so dashboards see the death instead of a
+  silently narrower page.
+- SLO burn: per-op (read/write on the volume plane, assign/lookup on
+  the master) p99 vs env-configurable targets and availability vs an
+  error-budget target, exported as `seaweedfs_slo_*` families on the
+  same page.  Targets: WEED_SLO_<OP>_P99_MS and WEED_SLO_AVAILABILITY
+  (per-op override WEED_SLO_<OP>_AVAILABILITY).
+
+Plus the span-tree feeder: `ClusterTrace` federates every server's
+/debug/traces ring buffer so `cluster.trace <id>` can assemble the full
+filer -> master -> volume -> replica tree from one RPC.
+
+Discovery matches each plane's own surface (same as the shell sweeps):
+volume servers from the topology answer over their HTTP data port,
+filers from the cluster registry answer over gRPC, the master answers
+locally."""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from ..pb.rpc import POOL
+from ..stats import parse_exposition, quantile_from_buckets
+from ..util.http import http_request
+from ..util.weedlog import logger
+
+LOG = logger(__name__)
+
+SLO_OPS = ("read", "write", "assign", "lookup")
+
+_P99_DEFAULTS_MS = {"read": 50.0, "write": 100.0,
+                    "assign": 20.0, "lookup": 20.0}
+
+
+def slo_targets() -> dict:
+    """{op: {"p99_ms": float, "availability": float}} from the env."""
+    out = {}
+    try:
+        avail_default = float(os.environ.get("WEED_SLO_AVAILABILITY",
+                                             "0.999"))
+    except ValueError:
+        avail_default = 0.999
+    for op in SLO_OPS:
+        try:
+            p99 = float(os.environ.get(f"WEED_SLO_{op.upper()}_P99_MS",
+                                       str(_P99_DEFAULTS_MS[op])))
+        except ValueError:
+            p99 = _P99_DEFAULTS_MS[op]
+        try:
+            avail = float(os.environ.get(
+                f"WEED_SLO_{op.upper()}_AVAILABILITY",
+                str(avail_default)))
+        except ValueError:
+            avail = avail_default
+        out[op] = {"p99_ms": p99, "availability": avail}
+    return out
+
+
+def _tombstone_ttl() -> float:
+    try:
+        return float(os.environ.get("WEED_SCRAPE_TOMBSTONE_S", "300"))
+    except ValueError:
+        return 300.0
+
+
+# sample line: name, optional {labels}, then everything else (value,
+# optionally an OpenMetrics exemplar) verbatim
+_SAMPLE_LINE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*?\})?( .*)$')
+
+
+def relabel_exposition(text: str, server: str) -> tuple[list, dict]:
+    """Inject `server="..."` into every sample line of one /metrics
+    page -> (sample_lines, {family: (help_line, type_line)}).  HELP and
+    TYPE lines are collected separately so the federated page emits
+    each family's metadata once instead of once per server."""
+    samples: list[str] = []
+    meta: dict[str, list] = {}
+    for line in text.splitlines():
+        line = line.rstrip()
+        if not line:
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            fam = line.split(" ", 3)[2]
+            meta.setdefault(fam, []).append(line)
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_LINE.match(line)
+        if m is None:
+            continue
+        name, labels, rest = m.groups()
+        inner = f'server="{server}"'
+        if labels:
+            inner += "," + labels[1:-1]
+        samples.append(f"{name}{{{inner}}}{rest}")
+    return samples, meta
+
+
+class ClusterObserver:
+    """Lives on the master; fans scrapes/trace fetches across the fleet
+    with bounded concurrency and per-node error isolation."""
+
+    def __init__(self, master):
+        self.master = master
+        # server -> {"role", "last_ok", "error"} — the tombstone memory;
+        # entries age out _tombstone_ttl() after their last success
+        self._seen: dict[str, dict] = {}
+        # persistent fan-out pool: federation runs inside request/RPC
+        # handlers (a 15s Prometheus scrape, two ClusterMetrics calls
+        # per cluster.top frame) — spawning and joining threads per call
+        # is the exact churn PR 5 removed from the data plane
+        self._pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="cluster-observe")
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+
+    # -- discovery ----------------------------------------------------------
+    def _targets(self) -> list[tuple[str, str]]:
+        """[(server_address, role)] for every currently-registered
+        server: the master itself, its HA peers, every topology volume
+        server, every registered filer."""
+        out = [(self.master.grpc_address, "master")]
+        out.extend((peer, "master") for peer in self.master._peers
+                   if peer != self.master.grpc_address)
+        try:
+            for dn in self.master.topo.data_nodes():
+                out.append((dn.url, "volume"))
+        except Exception as e:
+            LOG.debug("topology walk failed during federation: %s", e)
+        with self.master._sub_lock:
+            filers = list(self.master.cluster_nodes.get("filer", {}))
+        out.extend((addr, "filer") for addr in filers)
+        return out
+
+    def _map(self, fn, targets) -> dict:
+        """{server: result-or-Exception} with bounded concurrency and
+        per-node error isolation."""
+        futs = {server: self._pool.submit(fn, server, role)
+                for server, role in targets}
+        out: dict[str, object] = {}
+        for server, fut in futs.items():
+            try:
+                out[server] = fut.result()
+            except Exception as e:
+                out[server] = e
+        return out
+
+    # -- metrics federation --------------------------------------------------
+    def _fetch_metrics(self, server: str, role: str) -> str:
+        if role == "master":
+            if server == self.master.grpc_address:
+                return self.master.metrics.render()
+            return POOL.client(server, "Seaweed").call(
+                "Metrics", {})["text"]
+        if role == "volume":
+            status, body, _ = http_request(f"http://{server}/metrics",
+                                           timeout=5)
+            if status != 200:
+                raise RuntimeError(f"HTTP {status}")
+            return body.decode(errors="replace")
+        return POOL.client(server, "SeaweedFiler").call(
+            "Metrics", {})["text"]
+
+    def federate_metrics(self) -> str:
+        targets = self._targets()
+        results = self._map(self._fetch_metrics, targets)
+        now = time.time()
+        roles = dict(targets)
+        sample_lines: list[str] = []
+        parsed: list[tuple[str, dict, float]] = []
+        meta: dict[str, list] = {}
+        up: dict[str, int] = {}
+        for server, role in targets:
+            got = results.get(server)
+            if isinstance(got, str):
+                self._seen[server] = {"role": role, "last_ok": now,
+                                      "error": ""}
+                up[server] = 1
+                lines, fam_meta = relabel_exposition(got, server)
+                sample_lines.extend(lines)
+                # SLO math parses each server body once, here — not the
+                # whole federated page re-joined and re-parsed at the end
+                parsed.extend(parse_exposition(got))
+                for fam, m in fam_meta.items():
+                    meta.setdefault(fam, m)
+            else:
+                prev = self._seen.setdefault(
+                    server, {"role": role, "last_ok": 0.0, "error": ""})
+                prev["error"] = str(got)
+                up[server] = 0
+        # tombstones: servers seen recently but no longer registered (or
+        # just unreachable) still report up 0 until the TTL expires
+        ttl = _tombstone_ttl()
+        for server, info in list(self._seen.items()):
+            if server in up:
+                continue
+            if now - info["last_ok"] > ttl:
+                # pop, not del: concurrent federations (scrape + a
+                # cluster.top RPC) may expire the same tombstone
+                self._seen.pop(server, None)
+                continue
+            roles[server] = info["role"]
+            up[server] = 0
+        out = ["# HELP seaweedfs_federation_up server answered the "
+               "federated scrape (0 = stale tombstone)",
+               "# TYPE seaweedfs_federation_up gauge"]
+        for server in sorted(up):
+            role = roles.get(server,
+                             self._seen.get(server, {}).get("role", "?"))
+            out.append(f'seaweedfs_federation_up{{server="{server}",'
+                       f'role="{role}"}} {up[server]}')
+        # exposition format wants one contiguous block per family (HELP/
+        # TYPE then every sample), so group the relabeled lines by their
+        # family before emitting — histogram _bucket/_sum/_count samples
+        # fold back onto their base family
+        by_family: dict[str, list[str]] = {}
+        for line in sample_lines:
+            name = line.split("{", 1)[0]
+            fam = name
+            for sfx in ("_bucket", "_sum", "_count"):
+                if name.endswith(sfx) and name[:-len(sfx)] in meta:
+                    fam = name[:-len(sfx)]
+                    break
+            by_family.setdefault(fam, []).append(line)
+        for fam in sorted(by_family):
+            out.extend(meta.get(fam, []))
+            out.extend(by_family[fam])
+        out.append(self.render_slo(parsed))
+        return "\n".join(out) + "\n"
+
+    # -- SLO burn ------------------------------------------------------------
+    def render_slo(self, samples: "list[tuple[str, dict, float]]") -> str:
+        """seaweedfs_slo_* families from already-federated samples.
+
+        p99 comes from the cluster-wide histogram sum (all servers'
+        buckets added before the quantile, the histogram_quantile way);
+        availability is ok/(ok+5xx-class errors); the burn gauges are
+        the ratios an alert wants: p99/target and
+        (1-availability)/(1-target) — 1.0 = exactly on target."""
+        targets = slo_targets()
+        buckets: dict[str, dict[float, float]] = {op: {}
+                                                  for op in SLO_OPS}
+        totals: dict[str, float] = dict.fromkeys(SLO_OPS, 0.0)
+        errors: dict[str, float] = dict.fromkeys(SLO_OPS, 0.0)
+        for name, labels, value in samples:
+            op = labels.get("type") or labels.get("op") or ""
+            if op not in targets:
+                continue
+            if name in ("seaweedfs_volume_request_seconds_bucket",
+                        "seaweedfs_master_op_seconds_bucket"):
+                le = float("inf") if labels.get("le") == "+Inf" \
+                    else float(labels.get("le", "inf"))
+                buckets[op][le] = buckets[op].get(le, 0.0) + value
+            elif name in ("seaweedfs_volume_request_seconds_count",
+                          "seaweedfs_master_op_seconds_count"):
+                totals[op] += value
+            elif name in ("seaweedfs_volume_request_errors_total",
+                          "seaweedfs_master_op_errors_total"):
+                errors[op] += value
+        fams = [
+            ("seaweedfs_slo_p99_ms", "gauge",
+             "measured cluster p99 latency per op (ms)"),
+            ("seaweedfs_slo_p99_target_ms", "gauge",
+             "p99 latency target per op (WEED_SLO_<OP>_P99_MS)"),
+            ("seaweedfs_slo_p99_burn", "gauge",
+             "measured p99 / target (>1 = out of SLO)"),
+            ("seaweedfs_slo_availability", "gauge",
+             "ok requests / all requests per op"),
+            ("seaweedfs_slo_availability_target", "gauge",
+             "availability target per op (WEED_SLO_AVAILABILITY)"),
+            ("seaweedfs_slo_error_budget_burn", "gauge",
+             "(1-availability)/(1-target) (>1 = budget burning)"),
+        ]
+        lines: dict[str, list[str]] = {fam: [] for fam, _, _ in fams}
+        for op in SLO_OPS:
+            tgt = targets[op]
+            p99_s = quantile_from_buckets(
+                sorted(buckets[op].items()), 0.99)
+            if p99_s is not None:
+                p99_ms = round(p99_s * 1000.0, 3)
+                lines["seaweedfs_slo_p99_ms"].append(
+                    f'seaweedfs_slo_p99_ms{{op="{op}"}} {p99_ms}')
+                lines["seaweedfs_slo_p99_burn"].append(
+                    f'seaweedfs_slo_p99_burn{{op="{op}"}} '
+                    f'{round(p99_ms / tgt["p99_ms"], 4)}')
+            lines["seaweedfs_slo_p99_target_ms"].append(
+                f'seaweedfs_slo_p99_target_ms{{op="{op}"}} '
+                f'{tgt["p99_ms"]}')
+            ok_plus_err = totals[op] + errors[op]
+            avail = 1.0 if ok_plus_err <= 0 \
+                else totals[op] / ok_plus_err
+            lines["seaweedfs_slo_availability"].append(
+                f'seaweedfs_slo_availability{{op="{op}"}} '
+                f'{round(avail, 6)}')
+            lines["seaweedfs_slo_availability_target"].append(
+                f'seaweedfs_slo_availability_target{{op="{op}"}} '
+                f'{tgt["availability"]}')
+            budget = 1.0 - tgt["availability"]
+            burn = 0.0 if budget <= 0 else (1.0 - avail) / budget
+            lines["seaweedfs_slo_error_budget_burn"].append(
+                f'seaweedfs_slo_error_budget_burn{{op="{op}"}} '
+                f'{round(burn, 4)}')
+        # group samples under their family metadata
+        grouped = []
+        for fam, kind, help_text in fams:
+            grouped.append(f"# HELP {fam} {help_text}")
+            grouped.append(f"# TYPE {fam} {kind}")
+            grouped.extend(lines[fam])
+        return "\n".join(grouped)
+
+    # -- trace federation ----------------------------------------------------
+    def _fetch_traces(self, server: str, role: str, trace_id: str,
+                      limit: int, min_ms: float) -> list[dict]:
+        req = {"trace_id": trace_id, "limit": limit, "min_ms": min_ms}
+        if role == "master":
+            if server == self.master.grpc_address:
+                return self.master.tracer.snapshot(
+                    trace_id=trace_id, limit=limit, min_ms=min_ms)
+            return POOL.client(server, "Seaweed").call(
+                "DebugTraces", req).get("spans", [])
+        if role == "volume":
+            import json
+            import urllib.parse
+            qs = urllib.parse.urlencode(
+                {"trace_id": trace_id, "limit": limit,
+                 "min_ms": min_ms})
+            status, body, _ = http_request(
+                f"http://{server}/debug/traces?{qs}", timeout=5)
+            if status != 200:
+                raise RuntimeError(f"HTTP {status}")
+            return json.loads(body).get("spans", [])
+        return POOL.client(server, "SeaweedFiler").call(
+            "DebugTraces", req).get("spans", [])
+
+    def cluster_trace(self, trace_id: str = "", limit: int = 0,
+                      min_ms: float = 0.0) -> dict:
+        """Every server's matching spans in one reply (span-tree
+        assembly happens in the shell renderer).  Per-node failures are
+        reported inline — half a trace beats none mid-incident."""
+        targets = self._targets()
+        spans: list[dict] = []
+        errors: dict[str, str] = {}
+        results = self._map(
+            lambda server, role: self._fetch_traces(
+                server, role, trace_id, limit, min_ms), targets)
+        for server, got in results.items():
+            if isinstance(got, Exception):
+                errors[server] = str(got)
+            else:
+                spans.extend(got)
+        return {"spans": spans, "errors": errors,
+                "servers": [s for s, _ in targets]}
+
+
+def cluster_trace_rpc_handler(observer: ClusterObserver):
+    def handler(req: dict) -> dict:
+        return observer.cluster_trace(
+            trace_id=req.get("trace_id", ""),
+            limit=int(req.get("limit", 0) or 0),
+            min_ms=float(req.get("min_ms", 0) or 0))
+    return handler
+
+
+def cluster_metrics_rpc_handler(observer: ClusterObserver):
+    def handler(req: dict) -> dict:
+        return {"text": observer.federate_metrics()}
+    return handler

@@ -339,14 +339,22 @@ class RepairPlanner:
             self._inflight.add(key)
         self.metrics.repairs_in_flight.set(
             value=float(len(self._inflight)))
-        self._pool.submit(self._execute, key, job)
+        # planner-pool workers have no thread-local context: when the
+        # launch happens inside a traced request (repair.now RPC), the
+        # executing job must keep that trace instead of minting its own
+        self._pool.submit(tracing.propagate(self._execute), key, job)
         return True
 
     # -- 4. execution --------------------------------------------------------
     def _execute(self, key: tuple, job: dict) -> None:
-        tid = tracing.new_trace_id()
+        # adopt the ambient trace (propagated across the pool submit by
+        # tracing.propagate) so an operator-triggered repair correlates
+        # with the triggering request; background ticks mint fresh ids
+        tid = tracing.current_trace_id() or tracing.new_trace_id()
         try:
-            with tracing.trace_scope(tid):
+            # keep the propagated parent span too — resetting it would
+            # orphan the repair's downstream hops out of the tree
+            with tracing.trace_scope(tid, tracing.current_span_id()):
                 # deposed while queued: executing would mutate cluster
                 # state this master no longer owns
                 if not self.master.is_leader:

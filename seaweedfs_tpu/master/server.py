@@ -77,8 +77,10 @@ class MasterServer:
         self.jwt_signing_key = jwt_signing_key
         self.jwt_expires_seconds = jwt_expires_seconds
         from ..stats import ServerMetrics
+        from ..util import profiling
         self.metrics = ServerMetrics()
         self.tracer = tracing.Tracer("master")
+        profiling.sampler()  # always-on process sampler (WEED_PROFILE)
         # `follow` makes this a read-only follower of an EXISTING cluster
         # (weed master.follower, command/master_follower.go): it serves
         # lookups from a KeepConnected-fed vid cache and proxies writes —
@@ -123,6 +125,10 @@ class MasterServer:
         self.rpc = RpcServer(host, grpc_port)
         self.http.tracer = self.tracer
         self.rpc.tracer = self.tracer
+        # cluster-wide observability federation (master/observe.py):
+        # /cluster/metrics + SLO burn + the ClusterTrace span feeder
+        from .observe import ClusterObserver
+        self.observer = ClusterObserver(self)
         self._register_http()
         self._register_rpc()
 
@@ -177,6 +183,7 @@ class MasterServer:
 
     def stop(self) -> None:
         self._stop_vacuum.set()
+        self.observer.close()
         if self.repair is not None:
             self.repair.stop()
         if self._follower_client is not None:
@@ -241,6 +248,21 @@ class MasterServer:
             preferred_data_node=req.get("data_node", ""))
 
     def assign(self, req: dict) -> dict:
+        t0 = time.time()
+        try:
+            out = self._assign_routed(req)
+        except Exception:
+            self.metrics.master_op_errors.inc("assign")
+            raise
+        # success-only latency: the SLO math derives ok-counts from
+        # <op>_seconds_count, so failures must live ONLY in the errors
+        # counter (availability = count / (count + errors))
+        self.metrics.master_op_latency.observe(
+            "assign", value=time.time() - t0,
+            trace_id=tracing.current_trace_id())
+        return out
+
+    def _assign_routed(self, req: dict) -> dict:
         self._check_partition()
         if not self.is_leader:
             # transparent follower proxy (proxyToLeader master_server.go:180)
@@ -496,6 +518,7 @@ class MasterServer:
 
     # -- service registration -----------------------------------------------
     def _register_rpc(self) -> None:
+        from . import observe
         self.rpc.add_service(
             "Seaweed",
             unary={
@@ -519,6 +542,13 @@ class MasterServer:
                 # address; HTTP /debug/traces serves the same spans)
                 "DebugTraces": tracing.traces_rpc_handler(self.tracer),
                 "Metrics": lambda req: {"text": self.metrics.render()},
+                # cluster-wide federation (master/observe.py): every
+                # server's spans / metrics through ONE master RPC —
+                # what cluster.trace <id> and cluster.top ride
+                "ClusterTrace": observe.cluster_trace_rpc_handler(
+                    self.observer),
+                "ClusterMetrics": observe.cluster_metrics_rpc_handler(
+                    self.observer),
             },
             stream={
                 "SendHeartbeat": self._handle_heartbeat_stream,
@@ -578,6 +608,19 @@ class MasterServer:
                                               tracer=self.tracer)}
 
     def _rpc_lookup_volume(self, req: dict) -> dict:
+        t0 = time.time()
+        try:
+            out = self._lookup_volume_inner(req)
+        except Exception:
+            self.metrics.master_op_errors.inc("lookup")
+            raise
+        # success-only latency (see assign): ok-count = _seconds_count
+        self.metrics.master_op_latency.observe(
+            "lookup", value=time.time() - t0,
+            trace_id=tracing.current_trace_id())
+        return out
+
+    def _lookup_volume_inner(self, req: dict) -> dict:
         self._check_partition()
         if self._follower_client is None \
                 and not self.is_leader \
@@ -623,8 +666,13 @@ class MasterServer:
         self.http.route("GET", "/vol/status", self._http_vol_status)
         self.http.route("*", "/vol/vacuum", self._http_vol_vacuum)
         self.http.route("GET", "/metrics", self._http_metrics)
+        self.http.route("GET", "/cluster/metrics",
+                        self._http_cluster_metrics, exact=True)
         self.http.route("GET", "/debug/traces",
                         tracing.traces_http_handler(self.tracer))
+        from ..util import profiling
+        self.http.route("GET", "/debug/profile",
+                        profiling.profile_http_handler(), exact=True)
         self.http.route("GET", "/ui", self._http_ui)
 
     def _http_assign(self, req: Request) -> Response:
@@ -663,7 +711,14 @@ class MasterServer:
         return Response.json({"Topology": self.topo.to_dict()})
 
     def _http_metrics(self, req: Request) -> Response:
-        return Response(200, self.metrics.render().encode(),
+        from ..stats import metrics_response
+        return metrics_response(req, self.metrics.render)
+
+    def _http_cluster_metrics(self, req: Request) -> Response:
+        """Every registered server's /metrics federated into one page
+        with per-server labels + seaweedfs_slo_* burn families
+        (master/observe.py)."""
+        return Response(200, self.observer.federate_metrics().encode(),
                         content_type="text/plain; version=0.0.4")
 
     def _http_ui(self, req: Request) -> Response:

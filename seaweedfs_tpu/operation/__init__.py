@@ -162,15 +162,26 @@ def upload_data_tcp(tcp_addr: str, fid: str, data, jwt: str = "",
                     ttl: str = "", compressed: bool = False,
                     replicate: bool = False) -> dict:
     """Frame write.  Plain payloads use the original 'W' frame; any
-    extension (ttl, the compressed needle flag, the replicate marker)
-    upgrades to the 'X' frame whose body carries a 2-byte header + ttl
-    prefix (volume_server/tcp.py) — wire-compatible with old peers for
-    the common case."""
-    if ttl or compressed or replicate:
-        from ..volume_server.tcp import pack_ext_body
+    extension (ttl, the compressed needle flag, the replicate marker,
+    or an ambient trace id) upgrades to the 'X' frame whose body
+    carries a small prefix (volume_server/tcp.py) — wire-compatible
+    with old peers for the common case.  The trace slot is what lets a
+    frame-path write appear as a child span in the cross-server tree
+    instead of vanishing into the old documented gap."""
+    from ..util import tracing
+    from ..volume_server.tcp import pack_ext_body, trace_slot_enabled
+    # trace_slot_enabled: the slot is mis-parsed by pre-slot RECEIVERS,
+    # so mixed-version volume tiers switch emission off fleet-wide
+    # (WEED_TRACE_TCP_SLOT=0) for the duration of a rolling upgrade
+    trace_id = tracing.current_trace_id() \
+        if tracing.enabled() and trace_slot_enabled() else ""
+    if ttl or compressed or replicate or trace_id:
         reply = _tcp_call(tcp_addr, "X", fid, jwt,
-                          pack_ext_body(data, replicate=replicate,
-                                        compressed=compressed, ttl=ttl))
+                          pack_ext_body(
+                              data, replicate=replicate,
+                              compressed=compressed, ttl=ttl,
+                              trace_id=trace_id,
+                              parent_span_id=tracing.current_span_id()))
     else:
         reply = _tcp_call(tcp_addr, "W", fid, jwt, data)
     # the write reply has ONE producer shape

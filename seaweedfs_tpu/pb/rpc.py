@@ -88,20 +88,34 @@ def _de(b: bytes) -> dict:
 
 
 def _trace_metadata() -> "list[tuple[str, str]] | None":
+    if not tracing.enabled():
+        return None
     tid = tracing.current_trace_id()
-    return [(tracing.TRACE_METADATA_KEY, tid)] if tid else None
+    if not tid:
+        return None
+    md = [(tracing.TRACE_METADATA_KEY, tid)]
+    sid = tracing.current_span_id()
+    if sid:
+        # the calling span becomes the server-side span's parent
+        md.append((tracing.SPAN_METADATA_KEY, sid))
+    return md
 
 
-def _incoming_trace_id(context) -> str:
+def _incoming_trace_ids(context) -> tuple[str, str]:
+    """-> (trace_id, parent_span_id) from the invocation metadata."""
+    tid = parent = ""
     try:
         for key, value in context.invocation_metadata() or ():
             if key == tracing.TRACE_METADATA_KEY:
-                return value
+                tid = value
+            elif key == tracing.SPAN_METADATA_KEY:
+                parent = value
     except Exception as e:
         # fakes/in-process contexts may not implement metadata at all;
         # a request without a trace id is fine, a crashed handler is not
         LOG.debug("invocation metadata unreadable: %s", e)
-    return ""
+    # metadata is client-controlled: bound it like the HTTP headers
+    return tracing.clamp_id(tid), tracing.clamp_id(parent)
 
 
 class RpcServer:
@@ -136,16 +150,24 @@ class RpcServer:
             [grpc.method_handlers_generic_handler(service, handlers)])
 
     def _record(self, label: str, tid: str, t0: float, status: str,
-                slow_log: bool = True) -> None:
+                slow_log: bool = True, span_id: str = "",
+                parent_id: str = "") -> None:
         tracer = self.tracer  # attached after construction; read late
         if tracer is not None:
             tracer.record(label, tid, t0, time.time() - t0,
-                          status=status, slow_log=slow_log)
+                          status=status, slow_log=slow_log,
+                          span_id=span_id, parent_id=parent_id)
 
     def _wrap_unary(self, fn, label: str):
         def h(request: dict, context) -> dict:
-            tid = _incoming_trace_id(context) or tracing.new_trace_id()
-            t0 = time.time()
+            # WEED_TRACE=0: no id minting, no scope, no span — the same
+            # zero-cost branch the HTTP dispatch takes
+            traced = tracing.enabled()
+            if traced:
+                tid, parent = _incoming_trace_ids(context)
+                tid = tid or tracing.new_trace_id()
+                sid = tracing.new_span_id()
+                t0 = time.time()
             status = "ok"
             try:
                 if faults.ACTIVE:
@@ -158,7 +180,9 @@ class RpcServer:
                         raise RpcError(
                             f"injected fault #{p.rule_id}: {p.mode} "
                             f"{label}")
-                with tracing.trace_scope(tid):
+                if not traced:
+                    return fn(request) or {}
+                with tracing.trace_scope(tid, sid):
                     return fn(request) or {}
             except RpcError as e:
                 status = "error"
@@ -168,16 +192,25 @@ class RpcServer:
                 context.abort(grpc.StatusCode.INTERNAL,
                               f"{type(e).__name__}: {e}")
             finally:
-                self._record(label, tid, t0, status)
+                if traced:
+                    self._record(label, tid, t0, status, span_id=sid,
+                                 parent_id=parent)
         return h
 
     def _wrap_stream(self, fn, label: str):
         def h(request_iterator, context):
-            tid = _incoming_trace_id(context) or tracing.new_trace_id()
-            t0 = time.time()
+            traced = tracing.enabled()
+            if traced:
+                tid, parent = _incoming_trace_ids(context)
+                tid = tid or tracing.new_trace_id()
+                sid = tracing.new_span_id()
+                t0 = time.time()
             status = "ok"
             try:
-                with tracing.trace_scope(tid):
+                if not traced:
+                    yield from fn(request_iterator)
+                    return
+                with tracing.trace_scope(tid, sid):
                     yield from fn(request_iterator)
             except RpcError as e:
                 status = "error"
@@ -191,7 +224,9 @@ class RpcServer:
                 # metadata subscriptions live for hours) — its duration
                 # is lifetime, not latency, so keep it out of the slow
                 # log
-                self._record(label, tid, t0, status, slow_log=False)
+                if traced:
+                    self._record(label, tid, t0, status, slow_log=False,
+                                 span_id=sid, parent_id=parent)
         return h
 
     def start(self) -> int:

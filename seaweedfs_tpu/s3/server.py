@@ -208,8 +208,10 @@ class S3ApiServer:
             resp = self._dispatch_inner(req)
             return resp
         finally:
-            self.metrics.s3_requests.inc(
-                getattr(req, "_s3_action", req.method.lower()))
+            # bounded label: the router stamps _s3_action from its fixed
+            # verb table; the fallback is the (closed) HTTP method set
+            action = getattr(req, "_s3_action", "") or req.method.lower()
+            self.metrics.s3_requests.inc(action)
             if self.audit is not None:
                 status = resp.status if resp is not None else 500
                 # bytes: request size for uploads, response size for
@@ -557,7 +559,10 @@ class S3ApiServer:
                 try:
                     self._authz(req, ident, "s3:ListBucket", bucket,
                                 record=False)
-                    self.metrics.s3_authz.inc(*req._audit_authz)
+                    # bounded labels: (result, source) are enum-like
+                    # strings stamped by _authz, never request data
+                    result, source = req._audit_authz
+                    self.metrics.s3_authz.inc(result, source)
                     return self._head_bucket(bucket)
                 except S3AuthError:
                     self._authz(req, ident, "s3:GetBucketLocation",

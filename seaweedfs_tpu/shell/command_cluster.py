@@ -1,5 +1,8 @@
-"""Cluster-wide observability verbs: `cluster.trace` gathers span ring
-buffers and `metrics.dump` gathers prometheus snapshots from every node.
+"""Cluster-wide observability verbs: `cluster.trace` renders
+cross-server span trees (and lists the slowest recent traces),
+`cluster.top` renders per-server rps/p99/error-rate from the master's
+federated scrape, and `metrics.dump` gathers prometheus snapshots from
+every node.
 
 Discovery matches each plane's own surface: volume servers come from the
 master topology and answer over their HTTP data port (/debug/traces,
@@ -13,6 +16,7 @@ beats none during an incident."""
 from __future__ import annotations
 
 import json
+import time
 import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 
@@ -67,23 +71,181 @@ def _sweep(env: CommandEnv, master_call, filer_call, volume_fetch) -> dict:
 
 
 @command("cluster.trace",
-         "fetch /debug/traces spans from every node: "
-         "[-traceId X] [-limit N]")
+         "cross-server span tree: `cluster.trace <id>` renders the "
+         "waterfall for one trace; no args lists the slowest recent "
+         "traces cluster-wide; [-traceId X] [-limit N] dumps raw "
+         "per-node spans as JSON")
 def cmd_cluster_trace(env: CommandEnv, args: list[str]) -> str:
     flags = parse_flags(args)
-    tid = flags.get("traceId", "")
     try:
         limit = int(flags.get("limit", "100"))
     except ValueError:
         raise ShellError(f"-limit must be an integer, "
                          f"got {flags['limit']!r}")
-    req = {"trace_id": tid, "limit": limit}
-    qs = "?" + urllib.parse.urlencode({"trace_id": tid, "limit": limit})
-    return json.dumps(_sweep(
-        env,
-        lambda m: m.call("DebugTraces", req),
-        lambda f: f.call("DebugTraces", req),
-        lambda url: _fetch_http_json(f"http://{url}/debug/traces{qs}")))
+    # `cluster.trace <id>`: positional id (the common incident flow)
+    pos_id = args[0] if args and not args[0].startswith("-") else ""
+    if pos_id:
+        out = env.master().call("ClusterTrace",
+                                {"trace_id": pos_id, "limit": 0})
+        from ..util.tracing import assemble_tree, render_tree
+        spans = out.get("spans", [])
+        if not spans:
+            return f"trace {pos_id}: no spans found " \
+                   f"(rotated out of every ring buffer?)"
+        tree = render_tree(assemble_tree(spans))
+        notes = "".join(f"\n! {srv}: {err}"
+                        for srv, err in out.get("errors", {}).items())
+        return f"trace {pos_id} ({len(spans)} spans across " \
+               f"{len(out.get('servers', []))} servers)\n{tree}{notes}"
+    if "traceId" in flags:
+        # legacy raw sweep: per-node JSON, errors inline
+        tid = flags.get("traceId", "")
+        req = {"trace_id": tid, "limit": limit}
+        qs = "?" + urllib.parse.urlencode({"trace_id": tid,
+                                           "limit": limit})
+        return json.dumps(_sweep(
+            env,
+            lambda m: m.call("DebugTraces", req),
+            lambda f: f.call("DebugTraces", req),
+            lambda url: _fetch_http_json(f"http://{url}/debug/traces{qs}")))
+    # no args: the N slowest recent traces cluster-wide — where an
+    # operator starts when "it feels slow" has no request id yet
+    try:
+        top_n = int(flags.get("n", "10"))
+    except ValueError:
+        raise ShellError(f"-n must be an integer, got {flags['n']!r}")
+    try:
+        min_ms = float(flags.get("minMs", "0") or 0)
+    except ValueError:
+        raise ShellError(f"-minMs must be a number, got {flags['minMs']!r}")
+    out = env.master().call("ClusterTrace",
+                            {"trace_id": "", "limit": limit,
+                             "min_ms": min_ms})
+    roots: dict[str, dict] = {}
+    span_counts: dict[str, int] = {}
+    for s in out.get("spans", []):
+        tid = s.get("trace_id", "")
+        if not tid:
+            continue
+        span_counts[tid] = span_counts.get(tid, 0) + 1
+        best = roots.get(tid)
+        # the trace's headline duration = its longest span (the root
+        # hop dominates its children by construction)
+        if best is None or s.get("duration_ms", 0) \
+                > best.get("duration_ms", 0):
+            roots[tid] = s
+    slowest = sorted(roots.values(),
+                     key=lambda s: -s.get("duration_ms", 0))[:top_n]
+    lines = [f"{len(roots)} recent traces across "
+             f"{len(out.get('servers', []))} servers; slowest {top_n}:",
+             "%-18s %10s %6s  %-8s %s"
+             % ("TRACE", "MS", "SPANS", "SERVICE", "ROOT")]
+    for s in slowest:
+        lines.append("%-18s %10.2f %6d  %-8s %s" % (
+            s.get("trace_id", "?"), s.get("duration_ms", 0.0),
+            span_counts.get(s.get("trace_id", ""), 0),
+            s.get("service", "?"), s.get("name", "?")))
+    lines.append("drill in with: cluster.trace <id>")
+    return "\n".join(lines)
+
+
+def _top_snapshot(env: CommandEnv) -> "tuple[float, dict]":
+    """One federated scrape -> (timestamp, {server: parsed samples}).
+    Rides the master's ClusterMetrics RPC so the shell needs nothing
+    but its existing gRPC address."""
+    from ..stats import parse_exposition
+    text = env.master().call("ClusterMetrics", {})["text"]
+    per_server: dict[str, list] = {}
+    for name, labels, value in parse_exposition(text):
+        server = labels.get("server", "")
+        per_server.setdefault(server, []).append((name, labels, value))
+    return time.time(), per_server
+
+
+def _top_rates(before: "tuple[float, dict]", after: "tuple[float, dict]",
+               server: str) -> dict:
+    """Per-server deltas between two scrapes -> rps / p99 / error rate
+    / repair queue."""
+    from ..stats import quantile_from_buckets
+    dt = max(1e-6, after[0] - before[0])
+
+    def total(samples, names, label_filter=None) -> float:
+        got = 0.0
+        for name, labels, value in samples:
+            if name in names and (label_filter is None
+                                  or label_filter(labels)):
+                got += value
+        return got
+
+    b = before[1].get(server, [])
+    a = after[1].get(server, [])
+    count_names = {"seaweedfs_volume_request_total",
+                   "seaweedfs_filer_request_total",
+                   "seaweedfs_master_assign_total",
+                   "seaweedfs_master_lookup_total"}
+    err_names = {"seaweedfs_volume_request_errors_total",
+                 "seaweedfs_master_op_errors_total"}
+    ops = total(a, count_names) - total(b, count_names)
+    errs = total(a, err_names) - total(b, err_names)
+    # per-server p99 over the WINDOW: bucket deltas, not lifetime sums
+    deltas: dict[float, float] = {}
+    hist_names = {"seaweedfs_volume_request_seconds_bucket",
+                  "seaweedfs_filer_request_seconds_bucket",
+                  "seaweedfs_master_op_seconds_bucket"}
+    before_buckets: dict[tuple, float] = {}
+    for name, labels, value in b:
+        if name in hist_names:
+            key = (name, labels.get("type") or labels.get("op", ""),
+                   labels.get("le", ""))
+            before_buckets[key] = before_buckets.get(key, 0.0) + value
+    for name, labels, value in a:
+        if name in hist_names:
+            le_s = labels.get("le", "")
+            le = float("inf") if le_s == "+Inf" else float(le_s or "inf")
+            key = (name, labels.get("type") or labels.get("op", ""),
+                   le_s)
+            d = value - before_buckets.get(key, 0.0)
+            if d > 0:
+                deltas[le] = deltas.get(le, 0.0) + d
+    p99 = quantile_from_buckets(sorted(deltas.items()), 0.99)
+    queue_depth = total(a, {"seaweedfs_master_repair_queue_depth"})
+    return {"rps": ops / dt,
+            "err_pct": 100.0 * errs / ops if ops > 0 else 0.0,
+            "p99_ms": None if p99 is None else p99 * 1000.0,
+            "repair_queue": queue_depth}
+
+
+@command("cluster.top",
+         "live per-server rps/p99/error-rate/repair-queue: "
+         "[-interval SECONDS] [-count FRAMES]")
+def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    try:
+        interval = float(flags.get("interval", "1"))
+        count = int(flags.get("count", "1"))
+    except ValueError:
+        raise ShellError("-interval/-count must be numbers")
+    frame = ""
+    before = _top_snapshot(env)
+    for i in range(max(1, count)):
+        time.sleep(max(0.1, interval))
+        after = _top_snapshot(env)
+        servers = sorted(set(before[1]) | set(after[1]) - {""})
+        lines = ["%-22s %9s %9s %7s %7s"
+                 % ("SERVER", "RPS", "P99_MS", "ERR%", "REPAIRQ")]
+        for server in servers:
+            if not server:
+                continue
+            r = _top_rates(before, after, server)
+            lines.append("%-22s %9.1f %9s %7.2f %7d" % (
+                server, r["rps"],
+                "-" if r["p99_ms"] is None else f"{r['p99_ms']:.1f}",
+                r["err_pct"], int(r["repair_queue"])))
+        frame = "\n".join(lines)
+        if count > 1 and i < count - 1:
+            print(frame + "\n")   # live refresh: intermediate frames
+        before = after
+    return frame
 
 
 @command("metrics.dump",

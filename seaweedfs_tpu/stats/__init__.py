@@ -44,15 +44,25 @@ class Counter:
     def value(self, *labels) -> float:
         return self._values.get(labels, 0.0)
 
-    def render(self, label_names: list[str]) -> str:
-        out = [f"# HELP {self.name} {self.help}",
-               f"# TYPE {self.name} {self.kind}"]
+    def render(self, label_names: list[str],
+               exemplars: bool = False) -> str:
+        """`exemplars=True` selects the OpenMetrics representation,
+        where a counter FAMILY must be named without the `_total`
+        suffix while its samples keep it — a Prometheus that negotiated
+        openmetrics-text rejects the whole scrape otherwise.  The
+        default 0.0.4 page keeps the legacy flat naming."""
+        fam = sample = self.name
+        if exemplars and self.kind == "counter":
+            fam = fam[:-len("_total")] if fam.endswith("_total") else fam
+            sample = fam + "_total"
+        out = [f"# HELP {fam} {self.help}",
+               f"# TYPE {fam} {self.kind}"]
         with self._lock:
             items = sorted(self._values.items())
         for labels, v in items:
             sel = _fmt_labels(label_names, labels)
-            out.append(f"{self.name}{{{sel}}} {v}" if sel
-                       else f"{self.name} {v}")
+            out.append(f"{sample}{{{sel}}} {v}" if sel
+                       else f"{sample} {v}")
         return "\n".join(out)
 
 
@@ -73,36 +83,68 @@ class Histogram:
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = defaultdict(float)
         self._totals: dict[tuple, int] = defaultdict(int)
+        # (labels) -> {bucket_index: (trace_id, value)} — the last
+        # exemplar landing in each bucket; index len(buckets) is +Inf.
+        # Bounded by construction: one entry per existing bucket.
+        self._exemplars: dict[tuple, dict[int, tuple[str, float]]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, *labels, value: float) -> None:
+    def observe(self, *labels, value: float, trace_id: str = "") -> None:
+        """Record one observation; a non-empty `trace_id` becomes the
+        bucket's exemplar so a p99 outlier on the exposition page links
+        straight to its trace in /debug/traces."""
         with self._lock:
             counts = self._counts.setdefault(
                 labels, [0] * len(self.buckets))
+            bucket_idx = len(self.buckets)   # +Inf unless a bucket fits
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     counts[i] += 1
+                    if i < bucket_idx:
+                        bucket_idx = i
             self._sums[labels] += value
             self._totals[labels] += 1
+            if trace_id:
+                self._exemplars.setdefault(labels, {})[bucket_idx] = \
+                    (trace_id, value)
 
-    def render(self, label_names: list[str]) -> str:
+    def render(self, label_names: list[str],
+               exemplars: bool = False) -> str:
+        """`exemplars=True` appends the OpenMetrics exemplar suffix to
+        bucket lines.  Callers must only enable it for clients that
+        negotiated application/openmetrics-text (or explicitly asked) —
+        the legacy 0.0.4 text parser rejects anything after the value,
+        so exemplars on the default page would fail the whole scrape."""
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
         with self._lock:
             items = [(labels, list(counts), self._sums[labels],
-                      self._totals[labels])
+                      self._totals[labels],
+                      dict(self._exemplars.get(labels, {}))
+                      if exemplars else {})
                      for labels, counts in sorted(self._counts.items())]
-        for labels, counts, label_sum, label_total in items:
+        for labels, counts, label_sum, label_total, exes in items:
             base = _fmt_labels(label_names, labels)
-            for b, c in zip(self.buckets, counts):
+            for i, (b, c) in enumerate(zip(self.buckets, counts)):
                 sel = (base + "," if base else "") + f'le="{b}"'
-                out.append(f"{self.name}_bucket{{{sel}}} {c}")
+                out.append(f"{self.name}_bucket{{{sel}}} {c}"
+                           + _fmt_exemplar(exes.get(i)))
             sel_inf = (base + "," if base else "") + 'le="+Inf"'
-            out.append(f"{self.name}_bucket{{{sel_inf}}} {label_total}")
+            out.append(f"{self.name}_bucket{{{sel_inf}}} {label_total}"
+                       + _fmt_exemplar(exes.get(len(self.buckets))))
             sfx = f"{{{base}}}" if base else ""
             out.append(f"{self.name}_sum{sfx} {label_sum}")
             out.append(f"{self.name}_count{sfx} {label_total}")
         return "\n".join(out)
+
+
+def _fmt_exemplar(ex: "tuple[str, float] | None") -> str:
+    """OpenMetrics exemplar suffix for a bucket sample line:
+    ` # {trace_id="..."} <value>`."""
+    if ex is None:
+        return ""
+    tid, value = ex
+    return f' # {{trace_id="{escape_label_value(tid)}"}} {value}'
 
 
 class Registry:
@@ -132,9 +174,9 @@ class Registry:
             self._metrics.append((h, label_names or []))
         return h
 
-    def render(self) -> str:
+    def render(self, exemplars: bool = False) -> str:
         with self._lock:
-            return "\n".join(m.render(names)
+            return "\n".join(m.render(names, exemplars=exemplars)
                              for m, names in self._metrics) + "\n"
 
 
@@ -150,12 +192,25 @@ class ServerMetrics:
             "seaweedfs_master_assign_total", "master assign requests")
         self.master_lookup = r.counter(
             "seaweedfs_master_lookup_total", "master lookup requests")
+        # control-plane latency + failures by op (assign | lookup): the
+        # inputs the cluster SLO burn (master/observe.py) needs — the
+        # control-plane scale harness reads assign p99 from here
+        self.master_op_latency = r.histogram(
+            "seaweedfs_master_op_seconds", "master op latency", ["op"])
+        self.master_op_errors = r.counter(
+            "seaweedfs_master_op_errors_total",
+            "master ops that failed", ["op"])
         self.volume_requests = r.counter(
             "seaweedfs_volume_request_total", "volume server requests",
             ["type"])
         self.volume_latency = r.histogram(
             "seaweedfs_volume_request_seconds", "volume request latency",
             ["type"])
+        # server-fault (5xx-class) outcomes per op; 404s/cookie
+        # mismatches are user errors and do NOT burn the SLO budget
+        self.volume_errors = r.counter(
+            "seaweedfs_volume_request_errors_total",
+            "volume requests that failed server-side", ["type"])
         self.filer_requests = r.counter(
             "seaweedfs_filer_request_total", "filer requests", ["type"])
         self.filer_latency = r.histogram(
@@ -226,5 +281,95 @@ class ServerMetrics:
             "seaweedfs_master_liveness_unregister_total",
             "nodes unregistered by the liveness sweep")
 
-    def render(self) -> str:
-        return self.registry.render()
+    def render(self, exemplars: bool = False) -> str:
+        return self.registry.render(exemplars=exemplars)
+
+
+EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4"
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+
+def metrics_response(req, render):
+    """Build a /metrics Response from `render(exemplars=...)`.
+
+    Exemplar suffixes are only legal under the OpenMetrics content
+    type — the legacy 0.0.4 parser rejects anything after the sample
+    value, failing the WHOLE scrape — so they're emitted only when the
+    client negotiated them (Accept: ...openmetrics... or an explicit
+    ?exemplars=1)."""
+    from ..util.http import Response
+    want = "openmetrics" in (req.headers.get("Accept", "") or "").lower() \
+        or req.qs("exemplars") in ("1", "true")
+    text = render(exemplars=want)
+    if want:
+        return Response(200, (text.rstrip("\n") + "\n# EOF\n").encode(),
+                        content_type=OPENMETRICS_CONTENT_TYPE)
+    return Response(200, text.encode(),
+                    content_type=EXPOSITION_CONTENT_TYPE)
+
+
+# -- exposition parsing (federation / cluster.top / SLO math) ---------------
+
+import re as _re
+
+_SAMPLE_RE = _re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>.*?)\})?'   # non-greedy: stop before an exemplar
+    r'\s+(?P<value>[^ #]+)'
+    r'(?P<exemplar>\s+#\s+\{.*)?$')
+_LABEL_RE = _re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label_value(v: str) -> str:
+    return (v.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def parse_exposition(text: str) -> "list[tuple[str, dict, float]]":
+    """Prometheus text format -> [(name, labels, value)].  Tolerates
+    OpenMetrics exemplar suffixes on bucket lines and skips comments and
+    unparseable lines — a federated page must survive one odd sample."""
+    out: list[tuple[str, dict, float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        labels = {k: _unescape_label_value(v)
+                  for k, v in _LABEL_RE.findall(m.group("labels") or "")}
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        out.append((m.group("name"), labels, value))
+    return out
+
+
+def quantile_from_buckets(buckets: "list[tuple[float, float]]",
+                          q: float) -> "float | None":
+    """Estimate quantile `q` from cumulative histogram buckets
+    [(le, cumulative_count), ...] (le may be float('inf')).  Linear
+    interpolation inside the winning bucket, the standard
+    histogram_quantile() approach; None when the histogram is empty."""
+    buckets = sorted(buckets, key=lambda b: b[0])
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_le, prev_cum = 0.0, 0.0
+    for le, cum in buckets:
+        if cum >= rank:
+            if le == float("inf"):
+                # beyond the last finite bucket: report its bound (the
+                # honest "at least this much" answer)
+                return prev_le if prev_le > 0 else None
+            span = cum - prev_cum
+            frac = (rank - prev_cum) / span if span > 0 else 1.0
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_cum = le, cum
+    return buckets[-1][0] if buckets[-1][0] != float("inf") else prev_le

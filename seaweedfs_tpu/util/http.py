@@ -419,10 +419,29 @@ class HttpServer:
 
     def _dispatch(self, req: Request) -> Response:
         handler = self._match(req.method, req.path)
+        if not tracing.enabled():
+            # WEED_TRACE=0: no minting, no scope, no span — the
+            # uninstrumented baseline the bench prices tracing against
+            if handler is None:
+                return Response.error("not found", 404)
+            try:
+                return handler(req)
+            except Exception as e:
+                return Response.error(f"{type(e).__name__}: {e}")
         t0 = time.time()
-        tid = req.headers.get(tracing.TRACE_HEADER, "") \
+        # clamp both ids: they are client-controlled and ride internal
+        # protocols with bounded slots (the TCP frame trace slot is a
+        # u8 length)
+        tid = tracing.clamp_id(req.headers.get(tracing.TRACE_HEADER,
+                                               "")) \
             or tracing.new_trace_id()
-        with tracing.trace_scope(tid):
+        # the caller's span id arrives as X-Span-Id and becomes this
+        # request span's parent; our own span id is the ambient parent
+        # for every downstream hop made while serving it
+        parent = tracing.clamp_id(req.headers.get(tracing.SPAN_HEADER,
+                                                  ""))
+        sid = tracing.new_span_id()
+        with tracing.trace_scope(tid, sid):
             if handler is None:
                 resp = Response.error("not found", 404)
             else:
@@ -436,7 +455,8 @@ class HttpServer:
             tracer.record(f"{req.method} {req.path}", tid,
                           t0, time.time() - t0,
                           status=("ok" if resp.status < 400
-                                  else f"http {resp.status}"))
+                                  else f"http {resp.status}"),
+                          span_id=sid, parent_id=parent)
         return resp
 
     def _serve_fault(self, conn, req: Request, resp: Response) -> bool:
@@ -730,9 +750,15 @@ def http_request(url: str, method: str = "GET", body: bytes | None = None,
     if not url.startswith("http"):
         url = "http://" + url
     headers = dict(headers or {})
-    tid = tracing.current_trace_id()
-    if tid:
-        headers.setdefault(tracing.TRACE_HEADER, tid)
+    if tracing.enabled():
+        tid = tracing.current_trace_id()
+        if tid:
+            headers.setdefault(tracing.TRACE_HEADER, tid)
+            sid = tracing.current_span_id()
+            if sid:
+                # name the calling span as the remote span's parent —
+                # how the cross-server tree links up
+                headers.setdefault(tracing.SPAN_HEADER, sid)
     return _POOL.request(url, method, body, headers, timeout)
 
 

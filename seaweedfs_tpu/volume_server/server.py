@@ -95,8 +95,10 @@ class VolumeServer:
         self.rack = rack
         self.jwt_signing_key = jwt_signing_key
         from ..stats import ServerMetrics
+        from ..util import profiling
         self.metrics = ServerMetrics()
         self.tracer = tracing.Tracer("volume")
+        profiling.sampler()  # always-on process sampler (WEED_PROFILE)
         # hot-needle LRU in front of the read paths (HTTP + TCP frames);
         # writes/deletes of a needle evict its entry, populates are
         # offset-guarded (volume_server/needle_cache.py)
@@ -267,6 +269,9 @@ class VolumeServer:
         self.http.route("GET", "/metrics", self._http_metrics)
         self.http.route("GET", "/debug/traces",
                         tracing.traces_http_handler(self.tracer))
+        from ..util import profiling
+        self.http.route("GET", "/debug/profile",
+                        profiling.profile_http_handler())
         self.http.route("*", "/", self._http_data)
 
     def _http_metrics(self, req: Request) -> Response:
@@ -277,9 +282,11 @@ class VolumeServer:
         # the process-global codec families ride along: per-backend EC
         # encode/decode latency + bytes (ops/codec.py codec_metrics)
         from ..ops.codec import codec_metrics
-        text = self.metrics.render() + codec_metrics().registry.render()
-        return Response(200, text.encode(),
-                        content_type="text/plain; version=0.0.4")
+        from ..stats import metrics_response
+        return metrics_response(
+            req, lambda exemplars=False:
+            self.metrics.render(exemplars=exemplars)
+            + codec_metrics().registry.render(exemplars=exemplars))
 
     def _check_jwt(self, req: Request, fid: FileId) -> "Response | None":
         """Write gate (volume_server_handlers_write.go:41): when a signing
@@ -313,18 +320,36 @@ class VolumeServer:
             part = part.split(".", 1)[0]
         return FileId.parse(part)
 
+    _HTTP_KINDS = {"GET": "read", "HEAD": "read", "POST": "write",
+                   "PUT": "write", "DELETE": "delete"}
+
     def _http_data(self, req: Request) -> Response:
         try:
             fid = self._parse_fid_path(req.path)
         except Exception:
             return Response.error("invalid fid path", 400)
-        if req.method in ("GET", "HEAD"):
-            return self._read_needle(fid, req)
-        if req.method in ("POST", "PUT"):
-            return self._write_needle(fid, req)
-        if req.method == "DELETE":
-            return self._delete_needle(fid, req)
-        return Response.error("method not allowed", 405)
+        kind = self._HTTP_KINDS.get(req.method)
+        if kind is None:
+            return Response.error("method not allowed", 405)
+        try:
+            if kind == "read":
+                resp = self._read_needle(fid, req)
+            elif kind == "write":
+                resp = self._write_needle(fid, req)
+            else:
+                resp = self._delete_needle(fid, req)
+        except Exception:
+            # a raised handler exception becomes a 500 one layer up
+            # (HttpServer._dispatch) — it must burn the error budget
+            # like any other server fault
+            self.metrics.volume_errors.inc(kind)
+            raise
+        if resp.status >= 500:
+            # server-fault accounting for the SLO availability burn;
+            # 4xx (not-found, cookie mismatch, bad jwt) is the user's
+            # problem and must not eat the error budget
+            self.metrics.volume_errors.inc(kind)
+        return resp
 
     def _read_needle(self, fid: FileId, req: Request) -> Response:
         t0 = time.time()
@@ -407,7 +432,9 @@ class VolumeServer:
             data, mime = _maybe_resize_image(
                 data, mime, req.qs("width"), req.qs("height"),
                 req.qs("mode"))
-        self.metrics.volume_latency.observe("read", value=time.time() - t0)
+        self.metrics.volume_latency.observe(
+            "read", value=time.time() - t0,
+            trace_id=tracing.current_trace_id())
         return Response(200, data, content_type=mime, headers=headers)
 
     def _redirect_or_404(self, fid: FileId) -> Response:
@@ -458,7 +485,9 @@ class VolumeServer:
             if err:
                 return Response.error(f"replication failed: {err}", 500)
         self.metrics.volume_requests.inc("write")
-        self.metrics.volume_latency.observe("write", value=time.time() - t0)
+        self.metrics.volume_latency.observe(
+            "write", value=time.time() - t0,
+            trace_id=tracing.current_trace_id())
         return Response.json({"name": req.qs("name"), "size": size,
                               "eTag": n.etag()}, status=201)
 
@@ -534,6 +563,12 @@ class VolumeServer:
             size = self.store.write_volume_needle(fid.volume_id, n)
         except NotFoundError:
             raise ValueError(f"volume {fid.volume_id} not local") from None
+        except Exception:
+            # server-fault accounting mirrors _http_data: a disk/storage
+            # failure on the frame path must burn the SLO error budget
+            # like its HTTP twin would (not-local/jwt are client-class)
+            self.metrics.volume_errors.inc("write")
+            raise
         self.needle_cache.invalidate(fid.volume_id, fid.key)
         if not replicate:
             err = self._fan_out(
@@ -546,10 +581,13 @@ class VolumeServer:
                 + ("&compressed=1" if compressed else ""),
                 jwt=jwt, ttl=ttl, compressed=compressed, tcp_ok=True)
             if err:
+                # the HTTP handler answers this with a 500 — same burn
+                self.metrics.volume_errors.inc("write")
                 raise ValueError(f"replication failed: {err}")
         self.metrics.volume_requests.inc("write")
-        self.metrics.volume_latency.observe("write",
-                                            value=time.time() - t0)
+        self.metrics.volume_latency.observe(
+            "write", value=time.time() - t0,
+            trace_id=tracing.current_trace_id())
         return size, n.etag()
 
     def tcp_read(self, fid_str: str) -> bytes:
@@ -565,7 +603,8 @@ class VolumeServer:
             if ce is not None:
                 self.metrics.needle_cache_ops.inc("hit")
                 self.metrics.volume_latency.observe(
-                    "read", value=time.time() - t0)
+                    "read", value=time.time() - t0,
+                    trace_id=tracing.current_trace_id())
                 return ce.data
             self.metrics.needle_cache_ops.inc("miss")
             offset = v.needle_offset(fid.key)
@@ -574,6 +613,11 @@ class VolumeServer:
                 data = v.read_needle_data(fid.key, fid.cookie, meta=meta)
             except NotFoundError:
                 raise ValueError("not found") from None
+            except Exception:
+                # disk/CRC faults on the frame read path burn the SLO
+                # error budget like a 500 from _http_data (404 doesn't)
+                self.metrics.volume_errors.inc("read")
+                raise
             if offset is not None and not meta.get("ttl") \
                     and self.needle_cache.admissible(len(data)):
                 # data_only entry: the frame path never parses metadata;
@@ -586,13 +630,16 @@ class VolumeServer:
                     CachedNeedle(cookie=fid.cookie, data=data,
                                  offset=offset),
                     lambda: v.needle_offset(fid.key))
-            self.metrics.volume_latency.observe("read",
-                                                value=time.time() - t0)
+            self.metrics.volume_latency.observe(
+                "read", value=time.time() - t0,
+                trace_id=tracing.current_trace_id())
             return data
         from ..util.http import CIDict
         req = Request(method="GET", path="", query={},
                       headers=CIDict(), body=b"")
         resp = self._read_needle(fid, req)  # EC / redirect cases
+        if resp.status >= 500:
+            self.metrics.volume_errors.inc("read")
         if resp.status >= 300:
             raise ValueError(bytes(resp.body).decode(errors="replace"))
         # the frame writers concat the payload into the reply: a
@@ -699,7 +746,12 @@ class VolumeServer:
             err = self._send_replica(locs[0], fid, method, body, qs,
                                      jwt, ttl, compressed, tcp_ok)
             return err or ""
-        futs = [self._fanout.submit(self._send_replica, loc, fid, method,
+        # the persistent executor's workers have no thread-local context:
+        # wrap the task so each replica send runs under THIS request's
+        # ambient trace (regression: fan-out spans must share the root's
+        # trace id instead of minting unrelated ones)
+        send = tracing.propagate(self._send_replica)
+        futs = [self._fanout.submit(send, loc, fid, method,
                                     body, qs, jwt, ttl, compressed,
                                     tcp_ok)
                 for loc in locs]

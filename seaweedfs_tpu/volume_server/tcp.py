@@ -18,9 +18,26 @@ The extended write ('X') keeps this exact layout — the generic parsers
 (Python and native C) stay oblivious — and carries its extensions as a
 prefix INSIDE the body slot:
     flags:u8 (1 = replicate: do not fan out; 2 = compressed: set the
-              needle's gzip flag), ttl_len:u8, ttl bytes, payload...
+              needle's gzip flag; 4 = trace slot present),
+    ttl_len:u8, ttl bytes,
+    [trace slot when flag 4: tid_len:u8, trace id bytes,
+                             parent_len:u8, parent span id bytes],
+    payload...
 This is what lets replication fan-out and filer ttl'd/compressed chunk
-uploads ride the frame path instead of falling back to HTTP.
+uploads ride the frame path instead of falling back to HTTP, and — via
+the optional trace slot — what closes the old "deliberate gap": frame
+hops now carry the caller's trace/parent ids and appear as real child
+spans in the cross-server tree.  Wire compat (pinned by test): a frame
+WITHOUT flag 4 parses exactly as before, so old clients keep working
+against new servers, and the slot costs nothing when tracing is off.
+The reverse direction is NOT safe — a pre-trace-slot server would read
+a flag-4 frame's trace bytes as payload and store them as needle data —
+and "server-first" ordering cannot cover it alone, because replica
+fan-out makes an upgraded PRIMARY a client of not-yet-upgraded
+replicas mid-rollout.  For mixed-version volume tiers set
+WEED_TRACE_TCP_SLOT=0 (checked at emission, `trace_slot_enabled()`)
+until every volume server runs the new parser; same-version processes
+(SimCluster, the single-deploy unit) are unaffected.
 Reply (server -> client):
     status:u8 (0 ok, 1 error)
     payload_len:u32, payload bytes      (R: needle data; W/D: json ack;
@@ -34,13 +51,24 @@ as tcp locations — same discovery path as public_url.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import struct
 import threading
+import time
 
 from ..util.weedlog import logger
 
 LOG = logger(__name__)
+
+
+def trace_slot_enabled() -> bool:
+    """Emission gate for the 'X' frame trace slot (flag 4).  A
+    pre-trace-slot RECEIVER mis-parses the slot bytes as payload, so a
+    mixed-version volume tier must disable emission fleet-wide
+    (WEED_TRACE_TCP_SLOT=0) until the rollout completes — see the
+    module docstring's wire-compat note."""
+    return os.environ.get("WEED_TRACE_TCP_SLOT", "1") != "0"
 
 _HDR = struct.Struct("<BH")
 
@@ -55,32 +83,70 @@ MAX_FRAME_BODY = 64 << 20
 # extended-write body-prefix flags
 XFLAG_REPLICATE = 1     # this IS a replica copy: do not fan out again
 XFLAG_COMPRESSED = 2    # payload is pre-gzipped: set the needle flag
+XFLAG_TRACE = 4         # optional trace slot follows the ttl bytes
 
 _EXT_HDR = struct.Struct("<BB")  # flags, ttl_len
 
 
 def pack_ext_body(payload: bytes, replicate: bool = False,
-                  compressed: bool = False, ttl: str = "") -> bytes:
-    """Prefix `payload` with the extended-write header ('X' frames)."""
+                  compressed: bool = False, ttl: str = "",
+                  trace_id: str = "", parent_span_id: str = "") -> bytes:
+    """Prefix `payload` with the extended-write header ('X' frames).
+    A non-empty `trace_id` adds the optional trace slot (flag 4) so the
+    receiving server's span links under `parent_span_id`."""
     flags = (XFLAG_REPLICATE if replicate else 0) \
         | (XFLAG_COMPRESSED if compressed else 0)
     ttl_b = ttl.encode()
+    parts = [_EXT_HDR.pack(flags, len(ttl_b)), ttl_b]
+    if trace_id:
+        # the slot lengths are u8; ids are clamped where they're
+        # adopted (tracing.clamp_id), but an oversized one reaching
+        # here must degrade to truncation, never a struct.error that
+        # fails the write with no HTTP fallback
+        tid_b = trace_id.encode()[:255]
+        parent_b = parent_span_id.encode()[:255]
+        parts[0] = _EXT_HDR.pack(flags | XFLAG_TRACE, len(ttl_b))
+        parts.append(struct.pack("<B", len(tid_b)) + tid_b
+                     + struct.pack("<B", len(parent_b)) + parent_b)
+    parts.append(payload)
     # join, not +: payload may be a memoryview (replica fan-out forwards
     # the received frame's body without copying it first)
-    return b"".join((_EXT_HDR.pack(flags, len(ttl_b)), ttl_b, payload))
+    return b"".join(parts)
 
 
-def unpack_ext_body(body: bytes) -> tuple[bool, bool, str, bytes]:
-    """-> (replicate, compressed, ttl, payload).  The payload is
-    materialized as bytes: the needle CRC path hands it to a ctypes
-    c_char_p, which only accepts bytes (the strip copy is 2+ttl bytes
-    of overhead on a payload the HTTP path would copy anyway)."""
+def unpack_ext_body(body: bytes
+                    ) -> tuple[bool, bool, str, str, str, bytes]:
+    """-> (replicate, compressed, ttl, trace_id, parent_span_id,
+    payload).  Frames without flag 4 parse exactly as the pre-trace
+    layout (wire compat with old clients).  The payload is materialized
+    as bytes: the needle CRC path hands it to a ctypes c_char_p, which
+    only accepts bytes (the strip copy is a few bytes of overhead on a
+    payload the HTTP path would copy anyway)."""
     if len(body) < 2:
         raise ValueError("extended write frame too short")
     flags, ttl_len = _EXT_HDR.unpack_from(body)
-    ttl = bytes(body[2:2 + ttl_len]).decode()
+    at = 2
+    ttl = bytes(body[at:at + ttl_len]).decode()
+    at += ttl_len
+    trace_id = parent = ""
+    if flags & XFLAG_TRACE:
+        if len(body) < at + 1:
+            raise ValueError("extended write frame trace slot truncated")
+        tid_len = body[at]
+        at += 1
+        # errors="replace": ids are observability garnish — a clamped
+        # multi-byte codepoint (client sliced at the 255-byte cap) must
+        # degrade to a mangled id, never fail the WRITE
+        trace_id = bytes(body[at:at + tid_len]).decode(errors="replace")
+        at += tid_len
+        if len(body) < at + 1:
+            raise ValueError("extended write frame trace slot truncated")
+        parent_len = body[at]
+        at += 1
+        parent = bytes(body[at:at + parent_len]).decode(errors="replace")
+        at += parent_len
     return (bool(flags & XFLAG_REPLICATE), bool(flags & XFLAG_COMPRESSED),
-            ttl, bytes(body[2 + ttl_len:]))
+            ttl, trace_id, parent, bytes(body[at:]))
 
 
 class FrameTooLarge(ValueError):
@@ -288,10 +354,40 @@ class TcpDataServer:
             return b'{"name":"","size":%d,"eTag":"%s"}' \
                 % (size, etag.encode())
         if op == "X":
-            replicate, compressed, ttl, payload = unpack_ext_body(body)
-            size, etag = self.vs.tcp_write(fid, payload, jwt,
-                                           replicate=replicate,
-                                           compressed=compressed, ttl=ttl)
+            from ..util import tracing
+            (replicate, compressed, ttl, trace_id, parent,
+             payload) = unpack_ext_body(body)
+            # tracing.enabled() gates recording here like it does on the
+            # HTTP and gRPC paths: WEED_TRACE=0 on this server must win
+            # even when a tracing-enabled peer sends flagged frames
+            if trace_id and tracing.enabled():
+                # the frame's trace slot: serve this write as a real
+                # child span of the sender's hop — the raw-TCP leg of
+                # the cross-server tree
+                sid = tracing.new_span_id()
+                t0 = time.time()
+                status = "ok"
+                with tracing.trace_scope(trace_id, sid):
+                    try:
+                        size, etag = self.vs.tcp_write(
+                            fid, payload, jwt, replicate=replicate,
+                            compressed=compressed, ttl=ttl)
+                    except BaseException:
+                        status = "error"
+                        raise
+                    finally:
+                        tracer = self.vs.tracer
+                        if tracer is not None:
+                            tracer.record(
+                                f"TCP X {'replica ' if replicate else ''}"
+                                f"write", trace_id, t0,
+                                time.time() - t0, status=status,
+                                span_id=sid, parent_id=parent)
+            else:
+                size, etag = self.vs.tcp_write(fid, payload, jwt,
+                                               replicate=replicate,
+                                               compressed=compressed,
+                                               ttl=ttl)
             return b'{"name":"","size":%d,"eTag":"%s"}' \
                 % (size, etag.encode())
         if op == "R":

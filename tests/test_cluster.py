@@ -268,7 +268,7 @@ def test_cluster_registry_tracks_filers(cluster):
     f2 = FilerServer(master.grpc_address)
     f2.start()
     c = POOL.client(master.grpc_address, "Seaweed")
-    deadline = _time.time() + 5
+    deadline = _time.time() + 15
     nodes = {}
     while _time.time() < deadline:
         nodes = c.call("ListClusterNodes")
@@ -279,7 +279,7 @@ def test_cluster_registry_tracks_filers(cluster):
         [f1.grpc_address, f2.grpc_address])
     assert nodes["leaders"]["filer"] == f1.grpc_address  # first = leader
     f1.stop()
-    deadline = _time.time() + 5
+    deadline = _time.time() + 15
     while _time.time() < deadline:
         nodes = c.call("ListClusterNodes")
         if nodes["nodes"].get("filer") == [f2.grpc_address]:

@@ -1,0 +1,586 @@
+"""Observability plane v2: cross-server span trees (span_id/parent_id
+over HTTP, gRPC and the raw-TCP frame trace slot), trace propagation
+across persistent executors, the continuous sampling profiler at
+GET /debug/profile, the master's federated /cluster/metrics page with
+seaweedfs_slo_* burn families, histogram exemplars, and the
+cluster.trace / cluster.top shell verbs."""
+
+import json
+import os
+import struct
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from seaweedfs_tpu import operation, shell
+from seaweedfs_tpu.stats import (Histogram, parse_exposition,
+                                 quantile_from_buckets)
+from seaweedfs_tpu.testing import SimCluster
+from seaweedfs_tpu.util import profiling, tracing
+from seaweedfs_tpu.util.http import http_request
+from seaweedfs_tpu.volume_server import tcp as tcp_mod
+
+
+# -- unit: span ids, parenting, executor propagation ------------------------
+
+def test_tracer_span_mints_ids_and_parents_under_ambient():
+    t = tracing.Tracer("test", slow_seconds=0)
+    with t.span("outer"):
+        outer_sid = tracing.current_span_id()
+        assert outer_sid
+        with t.span("inner"):
+            assert tracing.current_span_id() != outer_sid
+    outer, inner = t.snapshot()[-2], t.snapshot()[-1]
+    # deque order is record order: inner finishes first
+    outer, inner = ((outer, inner) if outer["name"] == "inner"
+                    else (inner, outer))
+    assert outer["name"] == "inner"
+    assert outer["parent_id"] == inner["span_id"]
+    assert inner["parent_id"] == ""
+    assert outer["trace_id"] == inner["trace_id"]
+
+
+def test_propagate_carries_trace_across_executor():
+    # regression (PR 5 fan-out executor / repair pool): thread-locals do
+    # not cross submit() — propagate() must carry both ids over
+    seen = {}
+
+    def task():
+        seen["tid"] = tracing.current_trace_id()
+        seen["sid"] = tracing.current_span_id()
+
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        with tracing.trace_scope("trace-x", "span-y"):
+            pool.submit(tracing.propagate(task)).result()
+        assert seen == {"tid": "trace-x", "sid": "span-y"}
+        # outside any trace, propagate is a no-op passthrough
+        pool.submit(tracing.propagate(task)).result()
+        assert seen == {"tid": "", "sid": ""}
+
+
+def test_assemble_tree_links_children_and_self_time():
+    spans = [
+        {"trace_id": "t", "span_id": "a", "parent_id": "",
+         "name": "root", "service": "filer", "start": 1.0,
+         "duration_ms": 10.0, "status": "ok"},
+        {"trace_id": "t", "span_id": "b", "parent_id": "a",
+         "name": "child1", "service": "master", "start": 1.001,
+         "duration_ms": 4.0, "status": "ok"},
+        {"trace_id": "t", "span_id": "c", "parent_id": "a",
+         "name": "child2", "service": "volume", "start": 1.005,
+         "duration_ms": 3.0, "status": "ok"},
+        {"trace_id": "t", "span_id": "d", "parent_id": "c",
+         "name": "leaf", "service": "volume", "start": 1.006,
+         "duration_ms": 1.0, "status": "ok"},
+    ]
+    roots = tracing.assemble_tree(spans)
+    assert len(roots) == 1 and roots[0]["span_id"] == "a"
+    assert [c["span_id"] for c in roots[0]["children"]] == ["b", "c"]
+    assert roots[0]["self_ms"] == pytest.approx(3.0)   # 10 - (4+3)
+    child2 = roots[0]["children"][1]
+    assert child2["self_ms"] == pytest.approx(2.0)     # 3 - 1
+    text = tracing.render_tree(roots)
+    assert "root" in text and "  master" in text and "self" in text
+
+
+def test_assemble_tree_orphans_surface_as_roots():
+    spans = [{"trace_id": "t", "span_id": "x", "parent_id": "rotated",
+              "name": "orphan", "service": "volume", "start": 1.0,
+              "duration_ms": 2.0, "status": "ok"}]
+    roots = tracing.assemble_tree(spans)
+    assert len(roots) == 1 and roots[0]["name"] == "orphan"
+
+
+# -- unit: TCP extended-frame trace slot ------------------------------------
+
+def test_ext_frame_trace_slot_round_trip():
+    body = tcp_mod.pack_ext_body(b"payload", replicate=True,
+                                 compressed=True, ttl="3m",
+                                 trace_id="aabbccdd00112233",
+                                 parent_span_id="deadbeefdeadbeef")
+    out = tcp_mod.unpack_ext_body(body)
+    assert out == (True, True, "3m", "aabbccdd00112233",
+                   "deadbeefdeadbeef", b"payload")
+    # no trace: ids come back empty
+    plain = tcp_mod.pack_ext_body(b"p", ttl="5m")
+    assert tcp_mod.unpack_ext_body(plain) == (False, False, "5m", "",
+                                              "", b"p")
+
+
+def test_ext_frame_wire_compat_pinned():
+    # a frame in the PRE-trace layout (flags without bit 4) must parse
+    # byte-identically — old clients keep working against new servers
+    old_bytes = struct.pack("<BB", tcp_mod.XFLAG_REPLICATE, 2) \
+        + b"3m" + b"needle-bytes"
+    assert tcp_mod.unpack_ext_body(old_bytes) == (
+        True, False, "3m", "", "", b"needle-bytes")
+    # and the packer emits EXACTLY that layout when no trace rides along
+    assert tcp_mod.pack_ext_body(b"needle-bytes", replicate=True,
+                                 ttl="3m") == old_bytes
+    # truncated trace slot fails loudly instead of mis-slicing payload
+    bad = struct.pack("<BB", tcp_mod.XFLAG_TRACE, 0) + b"\x10"
+    with pytest.raises(ValueError):
+        tcp_mod.unpack_ext_body(bad)
+    # the slot lengths are u8: oversize ids degrade to truncation,
+    # never a struct.error that fails the write
+    huge = "t" * 600
+    body = tcp_mod.pack_ext_body(b"p", trace_id=huge,
+                                 parent_span_id=huge)
+    assert tcp_mod.unpack_ext_body(body)[3] == huge[:255]
+    assert tracing.clamp_id(huge) == huge[:tracing.MAX_ID_LEN]
+    # a multi-byte id sliced at the 255-BYTE cap mid-codepoint must
+    # degrade to a mangled id, never fail the unpack (and the write)
+    body = tcp_mod.pack_ext_body(b"p", trace_id="é" * 128)
+    rep, comp, ttl, tid, parent, payload = tcp_mod.unpack_ext_body(body)
+    assert payload == b"p" and tid.startswith("é")
+
+
+def test_trace_slot_emission_gate(monkeypatch):
+    """WEED_TRACE_TCP_SLOT=0 stops SENDING the slot even with a trace
+    ambient — a pre-slot receiver stores the slot bytes as needle data,
+    the mixed-version rolling-upgrade hazard — without disabling
+    tracing anywhere else."""
+    sent = []
+    monkeypatch.setattr(
+        operation, "_tcp_call",
+        lambda addr, op, fid, jwt, body: (
+            sent.append((op, bytes(body))),
+            b'{"name":"","size":1,"eTag":"00"}')[1])
+    with tracing.trace_scope(tracing.new_trace_id()):
+        operation.upload_data_tcp("x:1", "3,01abc", b"needle")
+        assert sent[-1][0] == "X"            # slot rides by default
+        assert tcp_mod.unpack_ext_body(sent[-1][1])[3] != ""
+        monkeypatch.setenv("WEED_TRACE_TCP_SLOT", "0")
+        operation.upload_data_tcp("x:1", "3,01abc", b"needle")
+        assert sent[-1] == ("W", b"needle")  # plain frame, no slot
+        # extensions still ride the 'X' frame — just without the slot
+        operation.upload_data_tcp("x:1", "3,01abc", b"needle", ttl="3m")
+        assert sent[-1][0] == "X"
+        assert tcp_mod.unpack_ext_body(sent[-1][1]) == (
+            False, False, "3m", "", "", b"needle")
+
+
+# -- unit: exemplars + SLO math ---------------------------------------------
+
+def test_histogram_exemplar_rendered_per_bucket():
+    h = Histogram("t_seconds", "latency")
+    h.observe(value=0.003, trace_id="fast-trace")
+    h.observe(value=0.004)                      # no trace: keeps last
+    h.observe(value=99.0, trace_id="slow-trace")
+    text = h.render([], exemplars=True)
+    assert 't_seconds_bucket{le="0.005"} 2 # {trace_id="fast-trace"} ' \
+           "0.003" in text
+    assert 't_seconds_bucket{le="+Inf"} 3 # {trace_id="slow-trace"} ' \
+           "99.0" in text
+    # exemplars are opt-in: the default (0.0.4) rendering stays clean
+    assert "# {trace_id=" not in h.render([])
+    # exemplar suffixes must not break the parser
+    parsed = {(n, tuple(sorted(l.items()))): v
+              for n, l, v in parse_exposition(text)}
+    assert parsed[("t_seconds_bucket", (("le", "0.005"),))] == 2.0
+
+
+def test_quantile_from_buckets_interpolates():
+    buckets = [(0.1, 90.0), (0.5, 99.0), (1.0, 100.0),
+               (float("inf"), 100.0)]
+    p99 = quantile_from_buckets(buckets, 0.99)
+    assert p99 == pytest.approx(0.5)
+    p50 = quantile_from_buckets(buckets, 0.50)
+    assert 0.0 < p50 <= 0.1
+    assert quantile_from_buckets([], 0.99) is None
+    assert quantile_from_buckets([(0.1, 0.0)], 0.99) is None
+
+
+def test_slo_targets_env_knobs(monkeypatch):
+    from seaweedfs_tpu.master.observe import slo_targets
+    monkeypatch.setenv("WEED_SLO_READ_P99_MS", "7")
+    monkeypatch.setenv("WEED_SLO_AVAILABILITY", "0.99")
+    monkeypatch.setenv("WEED_SLO_WRITE_AVAILABILITY", "0.9999")
+    t = slo_targets()
+    assert t["read"]["p99_ms"] == 7.0
+    assert t["read"]["availability"] == 0.99
+    assert t["write"]["availability"] == 0.9999
+    assert t["assign"]["p99_ms"] == 20.0       # default
+
+
+def test_openmetrics_counter_family_drops_total_suffix():
+    """OpenMetrics requires counter FAMILIES named without `_total`
+    while the samples keep it — a negotiating Prometheus rejects the
+    whole scrape otherwise.  The 0.0.4 page keeps the legacy naming."""
+    from seaweedfs_tpu.stats import Registry
+    r = Registry()
+    c = r.counter("seaweedfs_x_total", "h", ["op"])
+    c.inc("read")
+    om = r.render(exemplars=True)
+    assert "# TYPE seaweedfs_x counter" in om
+    assert 'seaweedfs_x_total{op="read"}' in om
+    assert "# TYPE seaweedfs_x_total counter" not in om
+    legacy = r.render()
+    assert "# TYPE seaweedfs_x_total counter" in legacy
+
+
+# -- unit: sampling profiler ------------------------------------------------
+
+def _busy(deadline: float) -> None:
+    import zlib
+    blob = b"x" * 4096
+    while time.monotonic() < deadline:
+        zlib.crc32(blob)
+
+
+def test_sampler_captures_busy_thread_collapsed_format():
+    p = profiling.SamplingProfiler(hz=200)
+    p.start()
+    try:
+        t = threading.Thread(target=_busy,
+                             args=(time.monotonic() + 0.5,),
+                             name="busy-worker")
+        t.start()
+        before = p.snapshot()
+        t.join()
+        after = p.snapshot()
+    finally:
+        p.stop()
+    assert after["samples"] > before["samples"]
+    text = p.collapsed(after["counts"])
+    assert text
+    for line in text.splitlines():
+        stack, _, count = line.rpartition(" ")
+        assert stack and count.isdigit()       # collapsed format
+    assert any(line.startswith("busy-worker;") and "_busy" in line
+               for line in text.splitlines()), text[:800]
+
+
+def test_sampler_overhead_under_budget():
+    """The 5% overhead budget, asserted on the sampler's deterministic
+    per-tick cost (wall-clock A/B deltas on this shared 2-core box have
+    a ±5% noise floor — a null thread waking at 100Hz and doing NOTHING
+    measures anywhere in ±5%, so a delta assertion would gate on
+    weather).  tick_cost * hz is the fraction of one core the sampler
+    consumes; the rotating per-tick thread cap must keep it bounded
+    even in a process that has accumulated hundreds of threads."""
+    evt = threading.Event()
+    threads = [threading.Thread(target=evt.wait, daemon=True)
+               for _ in range(150)]
+    for t in threads:
+        t.start()
+    p = profiling.SamplingProfiler(hz=100)
+    try:
+        # not started: drive ticks synchronously for a noise-free cost.
+        # thread_time (CPU seconds of THIS thread) instead of wall
+        # clock: under full-suite load the measuring thread gets
+        # descheduled mid-tick and wall time would gate on box load,
+        # not on the sampler's actual work.  Even thread_time inflates
+        # when a preemption burst restarts the loop on cold caches, so
+        # measure several batches and assert on the MINIMUM batch
+        # average — the sampler's intrinsic cost is the floor; noise
+        # only ever adds
+        batch, batches = 50, 6
+        p._sample()   # warm label/name caches
+        per_tick = float("inf")
+        for _ in range(batches):
+            t0 = time.thread_time()
+            for _ in range(batch):
+                p._sample()
+            per_tick = min(per_tick, (time.thread_time() - t0) / batch)
+        core_fraction = per_tick * p.hz
+        assert core_fraction < 0.05, \
+            f"sampler consumes {core_fraction:.1%} of a core " \
+            f"({per_tick * 1e6:.0f}us/tick at {p.hz}Hz)"
+        # the cap really bounded the walk: far fewer distinct parked
+        # stacks than threads would imply is fine, but samples counted
+        assert p.samples == batch * batches + 1
+    finally:
+        evt.set()
+
+
+# -- cluster: the end-to-end plane ------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    with SimCluster(volume_servers=3, filers=1,
+                    base_dir=str(tmp_path_factory.mktemp("obs"))) as c:
+        deadline = time.time() + 10
+        while time.time() < deadline \
+                and not c.masters[0].cluster_nodes.get("filer", {}):
+            time.sleep(0.05)
+        # replicated writes need a second holder on another rack
+        c.filers[0].replication = "010"
+        yield c
+
+
+def _traced_filer_write(c, path: str, body: bytes) -> str:
+    tid = tracing.new_trace_id()
+    status, _, headers = http_request(
+        f"http://{c.filers[0].address}{path}", method="POST", body=body,
+        headers={"Content-Type": "application/octet-stream",
+                 "X-Trace-Id": tid})
+    assert status == 201, status
+    assert headers.get("X-Trace-Id") == tid
+    return tid
+
+
+def test_e2e_span_tree_replicated_write(cluster):
+    """Acceptance: one filer write with replication -> ONE tree holding
+    filer, master-assign, volume-write and replica-fan-out spans with
+    correct parent links, the volume hops riding the raw-TCP frame."""
+    c = cluster
+    tid = _traced_filer_write(c, "/obs/tree.bin", os.urandom(700))
+    time.sleep(0.3)   # replica span lands on the peer's ring buffer
+    out = c.masters[0].observer.cluster_trace(trace_id=tid)
+    spans = out["spans"]
+    assert all(s["trace_id"] == tid for s in spans)
+    roots = tracing.assemble_tree(spans)
+    assert len(roots) == 1, [s["name"] for s in spans]
+    root = roots[0]
+    assert root["service"] == "filer" \
+        and root["name"].startswith("POST /obs/")
+    child_names = [(ch["service"], ch["name"]) for ch in root["children"]]
+    assert ("master", "Seaweed/Assign") in child_names
+    tcp_write = [ch for ch in root["children"]
+                 if ch["name"] == "TCP X write"]
+    assert tcp_write, f"no raw-TCP write hop under the root: " \
+                      f"{child_names}"
+    fanout = [g for g in tcp_write[0]["children"]
+              if g["name"] == "TCP X replica write"]
+    assert fanout, "replica fan-out span missing / mis-parented"
+    # satellite regression: the fan-out hop (submitted through the
+    # persistent executor) kept the root's trace id
+    assert fanout[0]["trace_id"] == tid
+    assert fanout[0]["parent_id"] == tcp_write[0]["span_id"]
+    # every span reports its ids
+    assert all("span_id" in s and "parent_id" in s for s in spans)
+
+
+def test_cluster_trace_shell_renders_tree_and_lists_slowest(cluster):
+    c = cluster
+    tid = _traced_filer_write(c, "/obs/shell.bin", os.urandom(600))
+    time.sleep(0.3)
+    env = shell.CommandEnv(c.master_grpc)
+    rendered = shell.run_command(env, f"cluster.trace {tid}")
+    assert f"trace {tid}" in rendered
+    # (no Seaweed/Assign hop here: this write consumed a LEASED fid —
+    # exactly the amortization PR 5 built; the e2e test covers the
+    # assign hop on the cluster's first write)
+    assert "POST /obs/" in rendered
+    assert "TCP X write" in rendered
+    assert "self" in rendered          # per-hop self-time
+    # indentation: the volume hop nests under the filer root
+    assert any(line.startswith("  volume")
+               for line in rendered.splitlines())
+    # no args: cluster-wide slowest-traces listing
+    listing = shell.run_command(env, "cluster.trace")
+    assert "slowest" in listing and "drill in" in listing
+    assert tid in listing or "TRACE" in listing
+    # legacy raw sweep stays available
+    raw = json.loads(shell.run_command(env,
+                                       f"cluster.trace -traceId {tid}"))
+    assert raw["master"]["service"] == "master"
+
+
+def test_debug_traces_id_and_min_ms_filters(cluster):
+    c = cluster
+    tid = _traced_filer_write(c, "/obs/filter.bin", os.urandom(500))
+    f = c.filers[0]
+    out = json.loads(http_request(
+        f"http://{f.address}/debug/traces?id={tid}")[1])
+    assert out["span_count"] >= 1
+    assert all(s["trace_id"] == tid for s in out["spans"])
+    assert all("span_id" in s and "parent_id" in s
+               for s in out["spans"])
+    # an absurd min_ms filters everything out
+    out = json.loads(http_request(
+        f"http://{f.address}/debug/traces?id={tid}&min_ms=60000")[1])
+    assert out["span_count"] == 0
+
+
+def test_oversize_client_trace_id_is_clamped_e2e(cluster):
+    # X-Trace-Id is client-controlled: a 600-char id must be clamped at
+    # adoption and the write (whose chunk upload rides the TCP frame
+    # path with its u8 trace-slot lengths) must still succeed
+    huge = "t" * 600
+    c = cluster
+    status, _, headers = http_request(
+        f"http://{c.filers[0].address}/obs/hugeid.bin", method="POST",
+        body=os.urandom(400), headers={"X-Trace-Id": huge})
+    assert status == 201
+    assert headers.get("X-Trace-Id") == huge[:tracing.MAX_ID_LEN]
+
+
+def test_cluster_metrics_federation_and_slo(cluster):
+    """Acceptance: /cluster/metrics federates >= 3 servers with
+    per-server labels and exports seaweedfs_slo_* burn families."""
+    c = cluster
+    fid = c.upload(b"slo" * 300)
+    for _ in range(5):
+        c.read(fid)
+    m = c.masters[0]
+    status, body, _ = http_request(f"http://{m.address}/cluster/metrics")
+    assert status == 200
+    text = body.decode()
+    samples = parse_exposition(text)
+    servers = {l["server"] for _, l, _ in samples if "server" in l}
+    assert len(servers) >= 5           # master + 3 volume + filer
+    up = {(l["server"], l["role"]): v for n, l, v in samples
+          if n == "seaweedfs_federation_up"}
+    assert sum(v for v in up.values()) >= 5
+    assert {"master", "volume", "filer"} <= {r for _, r in up}
+    # per-server labels on a real family
+    vol_reqs = [l["server"] for n, l, _ in samples
+                if n == "seaweedfs_volume_request_total"]
+    assert len(set(vol_reqs)) >= 1
+    # SLO families present for all four ops, driven by default targets
+    by_name: dict = {}
+    for n, l, v in samples:
+        by_name.setdefault(n, {})[l.get("op", "")] = v
+    for op in ("read", "write", "assign", "lookup"):
+        assert by_name["seaweedfs_slo_p99_target_ms"][op] > 0
+        assert 0.0 <= by_name["seaweedfs_slo_availability"][op] <= 1.0
+        assert by_name["seaweedfs_slo_availability_target"][op] == 0.999
+        assert op in by_name["seaweedfs_slo_error_budget_burn"]
+    assert by_name["seaweedfs_slo_p99_ms"]["read"] > 0
+
+
+def test_cluster_metrics_exposition_conformance(cluster):
+    """Every line of the federated page is a comment or a parseable
+    sample, and every sample's family carries exactly one TYPE line —
+    the conformance contract scrapers depend on."""
+    c = cluster
+    text = http_request(
+        f"http://{c.masters[0].address}/cluster/metrics")[1].decode()
+    typed: dict[str, int] = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            fam = line.split(" ")[2]
+            typed[fam] = typed.get(fam, 0) + 1
+    assert typed and all(n == 1 for n in typed.values()), \
+        {f: n for f, n in typed.items() if n != 1}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        parsed = parse_exposition(line)
+        assert parsed, f"unparseable sample line: {line!r}"
+        name = parsed[0][0]
+        base = name
+        for sfx in ("_bucket", "_sum", "_count"):
+            if name.endswith(sfx) and name[:-len(sfx)] in typed:
+                base = name[:-len(sfx)]
+        assert base in typed, f"sample {name} has no TYPE metadata"
+
+
+def test_volume_metrics_page_carries_exemplars(cluster):
+    c = cluster
+    tid = tracing.new_trace_id()
+    r = operation.assign(c.master_grpc)
+    operation.upload_data(r.url, r.fid, b"exemplar me " * 40, jwt=r.auth)
+    status, _, _ = http_request(f"http://{r.url}/{r.fid}",
+                                headers={"X-Trace-Id": tid})
+    assert status == 200
+    # exemplars only under the negotiated OpenMetrics representation —
+    # the legacy 0.0.4 parser would reject them and fail the scrape
+    status, body, headers = http_request(
+        f"http://{r.url}/metrics",
+        headers={"Accept": "application/openmetrics-text"})
+    assert "openmetrics-text" in headers.get("Content-Type", "")
+    text = body.decode()
+    assert text.rstrip().endswith("# EOF")
+    read_buckets = [l for l in text.splitlines()
+                    if l.startswith("seaweedfs_volume_request_seconds_"
+                                    "bucket") and 'type="read"' in l]
+    assert any("# {trace_id=" in l for l in read_buckets), \
+        read_buckets[:4]
+    assert any(f'trace_id="{tid}"' in l for l in read_buckets)
+    # ?exemplars=1 is the curl-friendly spelling of the same opt-in
+    text = http_request(f"http://{r.url}/metrics?exemplars=1")[1].decode()
+    assert "# {trace_id=" in text
+    # and the DEFAULT page stays strict 0.0.4: no exemplar suffixes
+    status, body, headers = http_request(f"http://{r.url}/metrics")
+    assert headers.get("Content-Type", "").startswith("text/plain")
+    assert "# {trace_id=" not in body.decode()
+
+
+def test_debug_profile_captures_volume_serving_loop(cluster):
+    """Acceptance: GET /debug/profile?seconds=N during a read loop
+    returns non-empty collapsed stacks including the volume serving
+    loop."""
+    c = cluster
+    fid = c.upload(b"p" * 1024)
+    stop = threading.Event()
+
+    def read_loop():
+        while not stop.is_set():
+            try:
+                operation.read_file(c.master_grpc, fid)
+            except RuntimeError:
+                pass
+
+    t = threading.Thread(target=read_loop, daemon=True)
+    t.start()
+    try:
+        vs = next(v for v in c.volume_servers if v is not None)
+        status, body, headers = http_request(
+            f"http://{vs.url}/debug/profile?seconds=1.2", timeout=30)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert status == 200
+    assert int(headers["X-Profile-Samples"]) > 0
+    assert "X-Profile-Overrun-Pct" in headers
+    text = body.decode()
+    assert text.strip(), "empty collapsed profile"
+    stacks = text.splitlines()
+    serving = [l for l in stacks
+               if "_serve_conn" in l or "tcp._accept_loop" in l]
+    assert serving, stacks[:10]
+    for line in stacks:
+        stack, _, count = line.rpartition(" ")
+        assert stack and count.isdigit()
+
+
+def test_cluster_top_renders_per_server_rates(cluster):
+    c = cluster
+    fid = c.upload(b"t" * 512)
+    stop = threading.Event()
+
+    def read_loop():
+        while not stop.is_set():
+            try:
+                operation.read_file(c.master_grpc, fid)
+            except RuntimeError:
+                pass
+
+    t = threading.Thread(target=read_loop, daemon=True)
+    t.start()
+    try:
+        env = shell.CommandEnv(c.master_grpc)
+        frame = shell.run_command(env, "cluster.top -interval 0.6")
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    lines = frame.splitlines()
+    assert lines[0].split() == ["SERVER", "RPS", "P99_MS", "ERR%",
+                                "REPAIRQ"]
+    assert len(lines) >= 6             # header + 5 servers
+    # at least one server saw traffic during the window
+    assert any(float(line.split()[1]) > 0 for line in lines[1:])
+
+
+def test_federation_tombstones_dead_server(cluster):
+    # LAST test on the shared cluster: kills a volume server.  The next
+    # scrape must report it up=0 (tombstone) instead of silently
+    # shrinking the page.
+    c = cluster
+    dead_url = c.volume_servers[2].url
+    c.kill_volume_server(2)
+    deadline = time.time() + 10
+    m = c.masters[0]
+    while time.time() < deadline:
+        text = m.observer.federate_metrics()
+        up = {l["server"]: v for n, l, v in parse_exposition(text)
+              if n == "seaweedfs_federation_up"}
+        if up.get(dead_url) == 0.0:
+            break
+        time.sleep(0.3)
+    assert up.get(dead_url) == 0.0, up

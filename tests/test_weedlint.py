@@ -77,6 +77,12 @@ def test_bad_s3authz_fixture():
     assert got == [("WL080", 8), ("WL080", 10)]
 
 
+def test_bad_metrics_fixture():
+    got = _ids_lines(_findings(os.path.join(FIXTURES, "bad_metrics.py")))
+    assert got == [("WL090", 8), ("WL090", 10), ("WL090", 11),
+                   ("WL090", 12), ("WL090", 17), ("WL090", 18)]
+
+
 def test_good_fixture_is_clean():
     assert _findings(os.path.join(FIXTURES, "good.py")) == []
 
@@ -174,5 +180,5 @@ def test_cli_list_checkers():
     assert r.returncode == 0
     for cid in ("WL001", "WL002", "WL010", "WL011", "WL012",
                 "WL020", "WL021", "WL022", "WL030", "WL040",
-                "WL050", "WL060", "WL080"):
+                "WL050", "WL060", "WL080", "WL090"):
         assert cid in r.stdout

@@ -146,9 +146,13 @@ def test_ext_frame_roundtrip():
                                                  unpack_ext_body)
     body = pack_ext_body(b"payload", replicate=True, compressed=True,
                          ttl="5m")
-    assert unpack_ext_body(body) == (True, True, "5m", b"payload")
+    assert unpack_ext_body(body) == (True, True, "5m", "", "",
+                                     b"payload")
     body = pack_ext_body(b"", replicate=False, compressed=False, ttl="")
-    assert unpack_ext_body(body) == (False, False, "", b"")
+    assert unpack_ext_body(body) == (False, False, "", "", "", b"")
+    # the optional trace slot (ISSUE 9) rides behind flag bit 4
+    body = pack_ext_body(b"p", trace_id="t1", parent_span_id="s1")
+    assert unpack_ext_body(body) == (False, False, "", "t1", "s1", b"p")
 
 
 # -- replica fan-out --------------------------------------------------------

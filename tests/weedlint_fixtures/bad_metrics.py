@@ -1,0 +1,25 @@
+"""WL090 fixture: family construction in handlers + unbounded labels.
+Line numbers are pinned by tests/test_weedlint.py."""
+registry = None
+metrics = None
+
+
+def handler(req):
+    c = registry.counter("boom_total", "constructed per request")
+    c.inc("x")
+    h = registry.histogram("boom_seconds", "same problem")
+    metrics.requests.inc(req.path)
+    metrics.volume_latency.observe(req.qs("op"), value=0.1)
+    return h
+
+
+def not_a_handler(path, fid):
+    metrics.requests.inc(path)
+    metrics.errors.inc(f"op-{fid}")
+
+
+def clean(req):
+    kind = "read"
+    metrics.requests.inc(kind)
+    metrics.volume_latency.observe("write", value=0.1)
+    metrics.ops.inc("tcp", "ok")

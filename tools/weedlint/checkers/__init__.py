@@ -9,3 +9,4 @@ from . import dataplane    # noqa: F401
 from . import retryhygiene  # noqa: F401
 from . import leadership   # noqa: F401
 from . import s3authz      # noqa: F401
+from . import metricshygiene  # noqa: F401

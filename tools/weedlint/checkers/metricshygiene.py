@@ -1,0 +1,114 @@
+"""WL090 metrics-hygiene — family construction in handlers and
+unbounded label cardinality.
+
+Two ways a prometheus surface rots:
+
+- **Registry-time only**: `registry.counter/gauge/histogram(...)` (or
+  `ServerMetrics()`) called inside a REQUEST HANDLER builds a fresh
+  family per request — the registry grows without bound and the
+  exposition page double-reports the family.  Families must be
+  constructed once, at server construction.
+- **Bounded label sets**: feeding request-derived data (the path, a
+  fid/key, anything off ``req``/``request``) into a label value makes
+  per-label-set storage grow with the keyspace — the classic
+  cardinality explosion.  Label values must come from small closed
+  vocabularies (op names, transports, results).
+
+Handler detection matches WL050: any function with a parameter named
+``req`` or ``request`` (the repo's Handler/RPC-handler signatures).
+Label-argument scanning covers positional args to ``.inc()`` /
+``.observe()`` / ``.set()`` on an attribute chain that runs through a
+metrics-looking owner (``metrics``/``self.metrics``/a family attr) —
+flagged when the argument's expression mentions ``req``/``request`` or
+a name in the known-unbounded set (``path``, ``fid``, ``file_id``,
+``needle_id``, ``key``)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .. import Finding, ModuleContext, register
+from ..astutil import dotted_name
+
+_FAMILY_CTORS = {"counter", "gauge", "histogram"}
+_RECORD_METHODS = {"inc", "observe", "set"}
+_UNBOUNDED_NAMES = {"path", "fid", "file_id", "needle_id", "key"}
+_REQUEST_NAMES = {"req", "request"}
+
+
+def _is_handler(fn: ast.AST) -> bool:
+    args = fn.args
+    names = [a.arg for a in (args.posonlyargs + args.args
+                             + args.kwonlyargs)]
+    return "req" in names or "request" in names
+
+
+def _mentions_request_data(node: ast.AST) -> "str | None":
+    """Why this expression is an unbounded label value, or None."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            if sub.id in _REQUEST_NAMES:
+                return f"value derived from `{sub.id}`"
+            if sub.id in _UNBOUNDED_NAMES:
+                return f"`{sub.id}` is an unbounded identifier space"
+        elif isinstance(sub, ast.Attribute) \
+                and sub.attr in _UNBOUNDED_NAMES:
+            return f"`.{sub.attr}` is an unbounded identifier space"
+    return None
+
+
+def _metrics_owner(call: ast.Call) -> bool:
+    """Does `x.y.inc(...)` look like a metric-family record call?  The
+    owner chain must mention a metrics-ish name so `d.set(...)` on some
+    random object stays clean."""
+    owner = call.func.value
+    text = dotted_name(owner) or ""
+    if "metrics" in text or "stats" in text:
+        return True
+    # family held directly: self.volume_latency.observe(...) — accept
+    # attr names that look like metric families
+    if isinstance(owner, ast.Attribute):
+        leaf = owner.attr
+        return any(tok in leaf for tok in
+                   ("_total", "_seconds", "_latency", "_count",
+                    "counter", "gauge", "histogram", "requests",
+                    "errors", "ops", "bytes"))
+    return False
+
+
+@register("WL090", "metrics-hygiene")
+def check_metrics_hygiene(ctx: ModuleContext) -> Iterator[Finding]:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        handler = _is_handler(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute):
+                continue
+            attr = node.func.attr
+            if handler and attr in _FAMILY_CTORS and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                yield Finding(
+                    "WL090", "metrics-hygiene", ctx.path, node.lineno,
+                    f"metric family constructed inside a request "
+                    f"handler (.{attr}(...))",
+                    "construct families once at registry time (server "
+                    "__init__ / ServerMetrics) and record through the "
+                    "held handle")
+                continue
+            if attr in _RECORD_METHODS and _metrics_owner(node):
+                for arg in node.args:     # positional args = label values
+                    why = _mentions_request_data(arg)
+                    if why:
+                        yield Finding(
+                            "WL090", "metrics-hygiene", ctx.path,
+                            arg.lineno,
+                            f"unbounded label value fed to .{attr}() "
+                            f"({why})",
+                            "label values must be a small closed "
+                            "vocabulary (op/transport/result); put "
+                            "per-request detail in traces, not labels")
+                        break
