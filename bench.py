@@ -678,6 +678,107 @@ def bench_replicated_write(concurrency: int, quick: bool = False,
     return out
 
 
+def bench_replication(quick: bool = False) -> dict:
+    """Cross-cluster replication extras (ISSUE 11): steady-state
+    replicated events/s through the journal-offset sync path, the
+    replication lag p99 (source event ts -> applied on the target), and
+    post-partition catch-up seconds — the backlog drain rate after a
+    heal, which is the number an operator's staleness budget hangs on.
+    Two complete SimClusters, sync running continuously, the partition
+    injected through the seeded fault plane like test_georeplication."""
+    import shutil
+    import tempfile
+
+    from seaweedfs_tpu.replication.filer_sync import SyncDirection
+    from seaweedfs_tpu.testing import SimCluster
+    from seaweedfs_tpu.util import faults
+    from seaweedfs_tpu.util.http import http_request
+
+    n_steady = 80 if quick else 400
+    n_part = 40 if quick else 150
+    payload = b"r" * 1024
+    out: dict = {}
+    base = tempfile.mkdtemp(prefix="georep-bench")
+    try:
+        a = SimCluster(volume_servers=1, filers=1, max_volumes=60,
+                       base_dir=os.path.join(base, "A"), seed=71,
+                       filer_store="sqlite").start()
+        b = SimCluster(volume_servers=1, filers=1, max_volumes=60,
+                       base_dir=os.path.join(base, "B"), seed=72,
+                       filer_store="sqlite").start()
+        d = SyncDirection(
+            a.filers[0].grpc_address, a.master_grpc,
+            b.filers[0].grpc_address, b.master_grpc,
+            "benchA", "benchB", path_prefix="/bench",
+            offset_path=os.path.join(base, "offset"))
+        try:
+            d.start()
+            addr = a.filers[0].address
+
+            def write(tag, i):
+                status, body, _ = http_request(
+                    f"http://{addr}/bench/{tag}/f{i:04d}",
+                    method="POST", body=payload)
+                assert status == 201, body
+
+            def wait_applied(target, timeout=120.0) -> float:
+                t0 = time.perf_counter()
+                deadline = time.time() + timeout
+                while time.time() < deadline:
+                    if d.applied >= target:
+                        return time.perf_counter() - t0
+                    time.sleep(0.02)
+                raise TimeoutError(
+                    f"applied {d.applied} < {target}")
+
+            # steady state: PACED writes while the sync tails live, so
+            # the lag samples measure per-event replication latency
+            # (write -> applied on the target), not backlog drain
+            t0 = time.perf_counter()
+            for i in range(n_steady):
+                write("steady", i)
+                time.sleep(0.02)
+            wait_applied(n_steady)
+            dt = time.perf_counter() - t0
+            out["replication_steady_events_per_s"] = round(
+                d.applied / dt, 1)
+            if d.lag_samples:
+                lags_ms = sorted(s * 1e3 for s in d.lag_samples)
+                out["replication_lag_p99_ms"] = round(
+                    lags_ms[min(len(lags_ms) - 1,
+                                int(0.99 * len(lags_ms)))], 1)
+            # post-partition catch-up: events accumulate behind a
+            # seeded partition, then drain on heal
+            rules = [
+                faults.inject("rpc.call", mode="drop",
+                              match=a.filers[0].grpc_address),
+                faults.inject("rpc.call", mode="drop",
+                              match=(a.master_grpc, "/LookupVolume")),
+            ]
+            for i in range(n_part):
+                write("backlog", i)
+            applied0 = d.applied
+            for r in rules:
+                faults.remove(r)
+            catchup = wait_applied(applied0 + n_part)
+            out["replication_catchup_s"] = round(catchup, 2)
+            # backlog drain rate = the sustained apply throughput
+            out["replication_drain_events_per_s"] = round(
+                n_part / catchup, 1) if catchup > 0 else 0.0
+            out["replication_chunks_deduped"] = \
+                d.sink.stats["chunks_deduped"]
+        finally:
+            # the fault plane is process-global: a failure mid-partition
+            # must not leave drop rules armed for the NEXT bench
+            faults.clear()
+            d.stop()
+            a.stop()
+            b.stop()
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -1050,6 +1151,10 @@ def main():
                 smallfile.update(bench_observability(quick=args.quick))
             except Exception as e:
                 smallfile["observability_error"] = str(e)[:200]
+            try:
+                smallfile.update(bench_replication(quick=args.quick))
+            except Exception as e:
+                smallfile["replication_error"] = str(e)[:200]
         except Exception as e:   # never fail the headline metric
             smallfile = {"smallfile_error": str(e)[:200]}
     # end-to-end disk path (VERDICT r3 missing #1)

@@ -4,58 +4,203 @@ Capability-equivalent to weed/filer/filer.go:33-240 + filer_notify.go +
 filer_delete_entry.go:
 - create_entry auto-creates parent directories (filer.go:154)
 - recursive delete feeds every dead chunk to the deletion pipeline
-- every mutation emits a metadata event (old_entry, new_entry) into an
-  in-memory log with monotonically increasing ts; subscribers replay from
-  any ts and then tail live events (the LogBuffer + SubscribeMetadata
-  mechanism, util/log_buffer/log_buffer.go + filer_grpc_server_sub_meta.go)
+- every mutation emits a metadata event (old_entry, new_entry) with a
+  monotonically increasing ts AND a journal offset; subscribers replay
+  from any ts or offset and then tail live events (the LogBuffer +
+  SubscribeMetadata mechanism, util/log_buffer/log_buffer.go +
+  filer_grpc_server_sub_meta.go).  With a MetaJournal attached the
+  event log is durable: offsets are resume tokens that survive a filer
+  restart (meta_journal.py), which is what cross-cluster sync resumes
+  from.
+- subscriber delivery is backpressure-safe: each subscriber owns a
+  bounded pending queue; a slow/hung consumer parks events there and is
+  DISCONNECTED on overflow (counted) instead of blocking _notify
+  writers.
 - rename = move entry + children (filer_rename.go), emitted as
   delete+create events like the reference
 """
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Callable
 
+from ..util.weedlog import logger
 from .entry import Attr, Entry, FileChunk, new_directory_entry
 from .filechunk_manifest import resolve_chunk_manifest
 from .filerstore import FilerStore, NotFound
+from .meta_journal import MetaJournal
+
+LOG = logger(__name__)
 
 META_LOG_CAPACITY = 10000
+# events a slow subscriber may have parked before it is disconnected
+SUBSCRIBER_MAX_PENDING = 10000
 
 
 class MetaEvent:
-    __slots__ = ("ts_ns", "directory", "old_entry", "new_entry")
+    __slots__ = ("ts_ns", "directory", "old_entry", "new_entry", "offset")
 
     def __init__(self, ts_ns: int, directory: str,
-                 old_entry: Entry | None, new_entry: Entry | None):
+                 old_entry: Entry | None, new_entry: Entry | None,
+                 offset: int = 0):
         self.ts_ns = ts_ns
         self.directory = directory
         self.old_entry = old_entry
         self.new_entry = new_entry
+        self.offset = offset
 
     def to_dict(self) -> dict:
         return {"ts_ns": self.ts_ns, "directory": self.directory,
+                "offset": self.offset,
                 "old_entry": self.old_entry.to_dict()
                 if self.old_entry else None,
                 "new_entry": self.new_entry.to_dict()
                 if self.new_entry else None}
 
+    @classmethod
+    def from_dict(cls, d: dict) -> "MetaEvent":
+        return cls(d.get("ts_ns", 0), d.get("directory", "/"),
+                   Entry.from_dict(d["old_entry"])
+                   if d.get("old_entry") else None,
+                   Entry.from_dict(d["new_entry"])
+                   if d.get("new_entry") else None,
+                   offset=d.get("offset", 0))
+
+
+class _Subscriber:
+    """One subscriber = callback + bounded pending queue + delivery
+    lock.  Writers only ever ENQUEUE (non-blocking, under the filer's
+    log lock so queue order == journal order) and then offer to drain;
+    the drain runs fn() outside every filer lock, serialized by
+    ``_dlock``.  A consumer that stalls leaves events parking in
+    pending; past ``max_pending`` the subscriber is disconnected and
+    counted — _notify writers never wait on it again."""
+
+    __slots__ = ("fn", "max_pending", "_pending", "_plock", "_dlock",
+                 "dead", "overflowed")
+
+    def __init__(self, fn: Callable[[MetaEvent], None],
+                 max_pending: int = SUBSCRIBER_MAX_PENDING):
+        self.fn = fn
+        self.max_pending = max_pending
+        self._pending: list[MetaEvent] = []
+        self._plock = threading.Lock()
+        self._dlock = threading.Lock()
+        self.dead = False
+        self.overflowed = False
+
+    def enqueue(self, ev: MetaEvent) -> bool:
+        """Park one event; returns True when this enqueue OVERFLOWED the
+        queue (caller must disconnect + count)."""
+        with self._plock:
+            if self.dead:
+                return False
+            if len(self._pending) >= self.max_pending:
+                self.dead = True
+                self.overflowed = True
+                self._pending.clear()
+                return True
+            self._pending.append(ev)
+        return False
+
+    def drain(self) -> None:
+        """Deliver parked events in order.  Non-blocking when another
+        thread is already delivering (it will pick our events up);
+        re-checks after releasing the lock so no event is stranded."""
+        while True:
+            if not self._dlock.acquire(blocking=False):
+                return
+            try:
+                while True:
+                    with self._plock:
+                        batch, self._pending = self._pending, []
+                    if not batch:
+                        break
+                    for ev in batch:
+                        if self.dead:
+                            return
+                        self.fn(ev)
+            finally:
+                self._dlock.release()
+            with self._plock:
+                if not self._pending or self.dead:
+                    return
+            # refilled between the inner break and the release: go again
+
 
 class Filer:
     def __init__(self, store: FilerStore,
                  delete_chunks_fn: Callable[[list[FileChunk]], None]
-                 | None = None):
+                 | None = None,
+                 journal: "MetaJournal | None" = None):
         self.store = store
         self.delete_chunks_fn = delete_chunks_fn or (lambda chunks: None)
+        self.journal = journal
         self._log: list[MetaEvent] = []
         self._log_lock = threading.Lock()
         # serializes hardlink KV read-modify-write (counters must not
         # lose increments/decrements across RPC threads)
         self._hardlink_lock = threading.Lock()
         self._last_ts = 0
-        self._subscribers: list[Callable[[MetaEvent], None]] = []
+        self._seq = 0            # next offset - 1 (mirrors the journal)
+        self._subscribers: list[_Subscriber] = []
+        # slow consumers disconnected on bounded-queue overflow;
+        # surfaced as seaweedfs_filer_subscriber_overflow_total
+        self.subscriber_overflows = 0
+        self.on_subscriber_overflow: "Callable[[], None] | None" = None
+        if journal is not None:
+            self._seq = journal.last_offset
+            # ts monotonicity must survive restart: recover the tail ts
+            for _off, payload in journal.read(journal.last_offset):
+                try:
+                    self._last_ts = json.loads(payload).get("ts_ns", 0)
+                except ValueError:
+                    pass
+
+    def last_offset(self) -> int:
+        with self._log_lock:
+            return self._seq
+
+    def first_available_offset(self) -> int:
+        """Oldest offset still servable (journal retention floor, or
+        the ring's head without a journal).  A resume token below
+        this - 1 has a GAP the subscriber must be told about."""
+        if self.journal is not None:
+            return self.journal.first_offset
+        with self._log_lock:
+            if self._log:
+                return self._log[0].offset
+            return self._seq + 1
+
+    def read_events(self, since_offset: int,
+                    limit: int = 1024) -> list[MetaEvent]:
+        """Historical events (offset > since_offset), oldest first, up
+        to `limit` — no subscription.  Served from the ring when it
+        reaches back far enough, else from the journal.  Stream
+        handlers page deep backlogs through this instead of flooding a
+        live subscription's bounded queue."""
+        with self._log_lock:
+            ring = list(self._log)
+            tail = self._seq
+        if since_offset >= tail:
+            return []
+        if (ring and ring[0].offset <= since_offset + 1) \
+                or self.journal is None:
+            return [ev for ev in ring
+                    if ev.offset > since_offset][:limit]
+        out: list[MetaEvent] = []
+        for _off, payload in self.journal.read(since_offset + 1,
+                                               upto=tail):
+            try:
+                out.append(MetaEvent.from_dict(json.loads(payload)))
+            except ValueError:
+                continue
+            if len(out) >= limit:
+                break
+        return out
 
     # -- meta event log ----------------------------------------------------
     def _notify(self, old: Entry | None, new: Entry | None) -> None:
@@ -67,53 +212,114 @@ class Filer:
         if new is not None and new.hard_link_id:
             new = self._resolve_hardlink(new)
         directory = (new or old).parent_dir if (new or old) else "/"
+        overflowed: list[_Subscriber] = []
         with self._log_lock:
             ts = max(time.time_ns(), self._last_ts + 1)
             self._last_ts = ts
-            ev = MetaEvent(ts, directory, old, new)
+            ev = MetaEvent(ts, directory, old, new, offset=self._seq + 1)
+            if self.journal is not None:
+                # journal BEFORE ack: an append failure fails the
+                # mutation loudly (the store may hold the entry, but
+                # nothing unjournaled was ever acked — retrying re-emits)
+                self.journal.append(
+                    json.dumps(ev.to_dict()).encode())
+            self._seq += 1
             self._log.append(ev)
             if len(self._log) > META_LOG_CAPACITY:
                 self._log = self._log[-META_LOG_CAPACITY:]
             subs = list(self._subscribers)
-        for fn in subs:
-            fn(ev)
+            # enqueue under the log lock: every subscriber's queue order
+            # is exactly journal order, with no gap against the backlog
+            # snapshot taken at subscribe time
+            for sub in subs:
+                if sub.enqueue(ev):
+                    overflowed.append(sub)
+            for sub in overflowed:
+                self._subscribers.remove(sub)
+                self.subscriber_overflows += 1
+        for sub in overflowed:
+            LOG.warning("subscriber disconnected: bounded queue "
+                        "overflowed at %d pending events",
+                        sub.max_pending)
+            if self.on_subscriber_overflow:
+                self.on_subscriber_overflow()
+        for sub in subs:
+            if not sub.dead:
+                sub.drain()
 
     def subscribe(self, fn: Callable[[MetaEvent], None],
-                  since_ts_ns: int = 0) -> Callable[[], None]:
-        """Replay events after since_ts_ns, then tail live, with backlog
-        guaranteed to be delivered before any concurrent live event.
-        Returns an unsubscribe function."""
-        state = {"live": False, "buffer": []}
-        deliver_lock = threading.Lock()  # serializes delivery to fn
-
-        def proxy(ev: MetaEvent) -> None:
-            with self._log_lock:
-                if not state["live"]:
-                    state["buffer"].append(ev)
-                    return
-            with deliver_lock:
+                  since_ts_ns: int = 0,
+                  since_offset: "int | None" = None,
+                  max_pending: int = SUBSCRIBER_MAX_PENDING
+                  ) -> Callable[[], None]:
+        """Replay events after since_ts_ns (or, when ``since_offset`` is
+        given, after that journal offset — the durable resume token),
+        then tail live.  The backlog is guaranteed to be delivered
+        before any concurrent live event, with no gap and no duplicate:
+        backlog snapshot and registration are atomic under the log
+        lock, and live events park in the subscriber's queue until the
+        backlog has drained.  Returns an unsubscribe function."""
+        sub = _Subscriber(fn, max_pending=max_pending)
+        if since_offset is not None:
+            pred = lambda ev: ev.offset > since_offset      # noqa: E731
+            delivered = since_offset
+        else:
+            pred = lambda ev: ev.ts_ns > since_ts_ns        # noqa: E731
+            delivered = 0
+        # live events park in pending until the backlog is done: hold
+        # the delivery lock across registration + backlog replay
+        sub._dlock.acquire()
+        try:
+            while True:
+                with self._log_lock:
+                    ring_first = self._log[0].offset if self._log \
+                        else None
+                    # the ring covers the request when it reaches back
+                    # to the resume offset — or, for ts-mode, when its
+                    # oldest event predates since_ts_ns (ts is
+                    # monotonic, so everything newer is ring-resident;
+                    # no full-journal rescan for a recent-tail replay)
+                    ring_covers = ring_first is not None \
+                        and ring_first <= delivered + 1
+                    if since_offset is None and self._log \
+                            and self._log[0].ts_ns <= since_ts_ns:
+                        ring_covers = True
+                    if self.journal is None or self._seq <= delivered \
+                            or ring_covers:
+                        # ring (or nothing) covers the rest: snapshot +
+                        # register atomically, then replay outside
+                        backlog = [ev for ev in self._log
+                                   if ev.offset > delivered
+                                   and pred(ev)]
+                        self._subscribers.append(sub)
+                        break
+                    tail = self._seq
+                # journal-backed history: bulk-read OUTSIDE the lock
+                # (immutable once written), then re-check coverage
+                for off, payload in self.journal.read(delivered + 1,
+                                                      upto=tail):
+                    delivered = off
+                    try:
+                        ev = MetaEvent.from_dict(json.loads(payload))
+                    except ValueError:
+                        continue
+                    if pred(ev):
+                        fn(ev)
+                if delivered < tail:
+                    # raced retention mid-read: the gap is unreadable —
+                    # resume from the snapshot tail instead of spinning
+                    delivered = tail
+            for ev in backlog:
                 fn(ev)
-
-        with self._log_lock:
-            backlog = [ev for ev in self._log if ev.ts_ns > since_ts_ns]
-            self._subscribers.append(proxy)
-        for ev in backlog:
-            fn(ev)
-        # flush the buffer and flip live while HOLDING deliver_lock: a
-        # concurrent _notify that sees live=True must wait here, so it can
-        # never deliver ahead of the buffered (older) events
-        with deliver_lock:
-            with self._log_lock:
-                buffered = state["buffer"]
-                state["buffer"] = []
-                state["live"] = True
-            for ev in buffered:
-                fn(ev)
+        finally:
+            sub._dlock.release()
+        sub.drain()   # anything parked while the backlog replayed
 
         def unsubscribe():
             with self._log_lock:
-                if proxy in self._subscribers:
-                    self._subscribers.remove(proxy)
+                sub.dead = True
+                if sub in self._subscribers:
+                    self._subscribers.remove(sub)
         return unsubscribe
 
     # -- CRUD --------------------------------------------------------------
@@ -242,7 +448,15 @@ class Filer:
         else:
             dead = list(entry.chunks)
         self.store.delete_entry(full_path)
-        self._notify(entry, None)
+        try:
+            self._notify(entry, None)
+        except Exception:
+            # the journal refused the delete event: un-delete so the
+            # failed (unacked) operation can retry and re-emit — a
+            # store-applied delete with NO event would be invisible to
+            # replicas forever (a retry would see NotFound and no-op)
+            self.store.insert_entry(entry)  # weedlint: disable=WL100
+            raise
         if dead:
             self.delete_chunks_fn(dead)
 

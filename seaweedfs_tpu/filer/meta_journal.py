@@ -1,0 +1,412 @@
+"""Durable, offset-addressed metadata journal — the event log under the
+filer that makes SubscribeMetadata resume tokens survive a restart.
+
+The in-memory event ring (filer.py) answers "what happened recently";
+this journal answers "what happened since offset N" across process
+death, which is the contract cross-cluster replication needs: a sync
+daemon persists the last offset it fully applied and a crashed/restarted
+filer can still serve everything after it.  Capability-equivalent to the
+reference's filer log-buffer flush files (weed/util/log_buffer +
+filer/filer_notify.go writes dated log segments under /topics/.system/)
+with the offset addressing made first-class.
+
+Layout: a directory of append-only segment files
+
+    j-<first_offset as 16 digits>.wlog
+
+Each record is CRC-framed:
+
+    magic (1B, 0xA7) | payload_len (u32 LE) | crc32c(payload) (u32 LE) | payload
+
+Offsets are 1-based logical record numbers, contiguous across segments.
+Durability follows the volume plane's discipline (PR 6): appends are a
+single pwrite (visible to same-host readers immediately), fsync is
+BATCHED — a background flusher syncs the active segment every
+``fsync_interval`` seconds so one disk flush covers every append in the
+window — and crash recovery heals torn tails: on open, every segment is
+walked frame by frame; the first incomplete/corrupt frame truncates its
+file there and any later segment files are set aside as ``.orphan``
+(offsets past a tear are unreachable by contract).  The same
+``disk.pwrite`` fault-plane hook the volume backend uses covers the
+append path, so chaos suites can tear journal writes at any byte.
+
+Retention is by size and age over SEALED segments only; the active
+segment is never collected, so ``first_offset`` advances in segment
+steps.  A subscriber resuming below ``first_offset`` is served from the
+earliest retained record (callers see the gap via ``first_offset``).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+
+from ..storage.crc import crc32c
+from ..util import faults
+from ..util.weedlog import logger
+
+LOG = logger(__name__)
+
+_MAGIC = 0xA7
+_HEADER = struct.Struct("<BII")    # magic, payload_len, crc32c(payload)
+_SEG_PREFIX = "j-"
+_SEG_SUFFIX = ".wlog"
+
+# knobs (env-overridable like the volume plane's)
+DEFAULT_SEGMENT_BYTES = int(os.environ.get("WEED_JOURNAL_SEGMENT_MB",
+                                           "8")) << 20
+DEFAULT_RETAIN_BYTES = int(os.environ.get("WEED_JOURNAL_RETAIN_MB",
+                                          "256")) << 20
+DEFAULT_RETAIN_AGE_S = float(os.environ.get("WEED_JOURNAL_RETAIN_HOURS",
+                                            "168")) * 3600.0
+DEFAULT_FSYNC_INTERVAL = float(os.environ.get("WEED_JOURNAL_FSYNC_MS",
+                                              "20")) / 1000.0
+
+MAX_RECORD_BYTES = 64 << 20   # sanity bound; a larger len field = corrupt
+
+
+class JournalError(Exception):
+    pass
+
+
+def _segment_name(first_offset: int) -> str:
+    return f"{_SEG_PREFIX}{first_offset:016d}{_SEG_SUFFIX}"
+
+
+def _parse_segment_name(name: str) -> "int | None":
+    if not (name.startswith(_SEG_PREFIX) and name.endswith(_SEG_SUFFIX)):
+        return None
+    try:
+        return int(name[len(_SEG_PREFIX):-len(_SEG_SUFFIX)])
+    except ValueError:
+        return None
+
+
+class _Segment:
+    __slots__ = ("path", "first_offset", "records", "size", "mtime")
+
+    def __init__(self, path: str, first_offset: int, records: int = 0,
+                 size: int = 0, mtime: float = 0.0):
+        self.path = path
+        self.first_offset = first_offset
+        self.records = records
+        self.size = size
+        self.mtime = mtime
+
+    @property
+    def next_offset(self) -> int:
+        return self.first_offset + self.records
+
+
+def _scan_segment(path: str):
+    """Walk one segment file; yields (offset_in_segment, payload,
+    end_pos).  Stops at the first incomplete or corrupt frame and
+    returns its start position via StopIteration semantics — callers use
+    :func:`_scan_records` below which also reports the clean length."""
+    with open(path, "rb") as f:
+        data = f.read()
+    pos = 0
+    idx = 0
+    n = len(data)
+    while pos + _HEADER.size <= n:
+        magic, length, crc = _HEADER.unpack_from(data, pos)
+        if magic != _MAGIC or length > MAX_RECORD_BYTES:
+            break
+        end = pos + _HEADER.size + length
+        if end > n:
+            break
+        payload = data[pos + _HEADER.size:end]
+        if crc32c(payload) != crc:
+            break
+        yield idx, payload, end
+        idx += 1
+        pos = end
+
+
+def _scan_records(path: str) -> tuple[int, int]:
+    """(record_count, clean_byte_length) of a segment file."""
+    records, clean = 0, 0
+    for _idx, _payload, end in _scan_segment(path):
+        records += 1
+        clean = end
+    return records, clean
+
+
+class MetaJournal:
+    def __init__(self, directory: str,
+                 segment_max_bytes: int = DEFAULT_SEGMENT_BYTES,
+                 retain_bytes: int = DEFAULT_RETAIN_BYTES,
+                 retain_age_s: float = DEFAULT_RETAIN_AGE_S,
+                 fsync_interval: float = DEFAULT_FSYNC_INTERVAL):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.segment_max_bytes = max(1 << 12, segment_max_bytes)
+        self.retain_bytes = retain_bytes
+        self.retain_age_s = retain_age_s
+        self.fsync_interval = fsync_interval
+        self._lock = threading.Lock()
+        self._segments: list[_Segment] = []   # sorted; last is active
+        self._fd = -1
+        self._dirty = False
+        self._closed = False
+        self._poisoned = False
+        self._recover()
+        self._flusher: "threading.Thread | None" = None
+        if fsync_interval > 0:
+            self._stop_flush = threading.Event()
+            self._flusher = threading.Thread(target=self._flush_loop,
+                                             daemon=True,
+                                             name="journal-fsync")
+            self._flusher.start()
+
+    # -- recovery ----------------------------------------------------------
+    def _recover(self) -> None:
+        names = sorted(n for n in os.listdir(self.directory)
+                       if _parse_segment_name(n) is not None)
+        segs: list[_Segment] = []
+        torn_at: "str | None" = None
+        for name in names:
+            path = os.path.join(self.directory, name)
+            if torn_at is not None:
+                # offsets past a tear are unreachable by contract: set
+                # the file aside loudly instead of serving a gap
+                LOG.warning("journal %s: segment %s follows torn %s; "
+                            "set aside as .orphan", self.directory, name,
+                            torn_at)
+                os.replace(path, path + ".orphan")
+                continue
+            first = _parse_segment_name(name)
+            records, clean = _scan_records(path)
+            size = os.path.getsize(path)
+            if clean < size:
+                LOG.warning("journal %s: torn tail in %s healed "
+                            "(%d -> %d bytes, %d records)",
+                            self.directory, name, size, clean, records)
+                with open(path, "r+b") as f:
+                    f.truncate(clean)
+                    f.flush()
+                    os.fsync(f.fileno())
+                size = clean
+                torn_at = name
+            segs.append(_Segment(path, first, records, size,
+                                 os.path.getmtime(path)))
+        # contiguity check: a deleted-from-the-middle segment would make
+        # offsets lie — refuse to silently bridge the gap
+        for a, b in zip(segs, segs[1:]):
+            if b.first_offset != a.next_offset:
+                raise JournalError(
+                    f"journal {self.directory}: segment {b.path} starts "
+                    f"at {b.first_offset}, expected {a.next_offset}")
+        if not segs:
+            segs = [self._new_segment(1)]
+        self._segments = segs
+        self._open_active()
+
+    def _new_segment(self, first_offset: int) -> _Segment:
+        path = os.path.join(self.directory, _segment_name(first_offset))
+        with open(path, "ab"):
+            pass
+        return _Segment(path, first_offset, 0, 0, time.time())
+
+    def _open_active(self) -> None:
+        if self._fd >= 0:
+            os.close(self._fd)
+        self._fd = os.open(self._segments[-1].path, os.O_RDWR)
+
+    # -- properties --------------------------------------------------------
+    @property
+    def first_offset(self) -> int:
+        """Offset of the earliest retained record (== next_offset when
+        the journal is empty)."""
+        with self._lock:
+            return self._segments[0].first_offset
+
+    @property
+    def last_offset(self) -> int:
+        """Offset of the newest record (0 when empty)."""
+        with self._lock:
+            return self._segments[-1].next_offset - 1
+
+    @property
+    def next_offset(self) -> int:
+        with self._lock:
+            return self._segments[-1].next_offset
+
+    # -- append ------------------------------------------------------------
+    def append(self, payload: bytes, sync: bool = False) -> int:
+        """Write one record; returns its offset.  The frame reaches the
+        OS before return (single pwrite); fsync is batched unless
+        ``sync=True``."""
+        if not isinstance(payload, (bytes, bytearray, memoryview)):
+            raise TypeError("journal payload must be bytes")
+        payload = bytes(payload)
+        frame = _HEADER.pack(_MAGIC, len(payload),
+                             crc32c(payload)) + payload
+        with self._lock:
+            if self._closed:
+                raise JournalError("journal is closed")
+            if self._poisoned:
+                # a failed append could not be rolled back: anything
+                # written after the torn bytes would be unreachable by
+                # every scan (and truncated away on reopen) — refuse
+                # loudly instead of acking ghost records
+                raise JournalError(
+                    "journal has an unrolled torn tail; reopen to heal")
+            active = self._segments[-1]
+            if active.size + len(frame) > self.segment_max_bytes \
+                    and active.records > 0:
+                self._roll_locked()
+                active = self._segments[-1]
+            if faults.ACTIVE:
+                plan = faults.hit("disk.pwrite", active.path)
+                if plan is not None:
+                    if plan.mode == "torn":
+                        torn = plan.torn_bytes if plan.torn_bytes >= 0 \
+                            else len(frame) // 2
+                        os.pwrite(self._fd, frame[:torn], active.size)
+                        self._rollback_locked(active)
+                    raise plan.error(active.path)
+            try:
+                wrote = os.pwrite(self._fd, frame, active.size)
+                if wrote != len(frame):          # genuine short write
+                    raise OSError(f"short journal write: {wrote} of "
+                                  f"{len(frame)} bytes")
+            except OSError:
+                self._rollback_locked(active)
+                raise
+            active.size += len(frame)
+            active.records += 1
+            active.mtime = time.time()
+            offset = active.next_offset - 1
+            self._dirty = True
+            if sync:
+                os.fsync(self._fd)
+                self._dirty = False
+        return offset
+
+    def _rollback_locked(self, active: "_Segment") -> None:
+        """A failed/torn append left partial bytes at the tail: truncate
+        back to the last clean record boundary so LATER appends never
+        land unreachable behind garbage.  If the rollback itself fails
+        the journal is poisoned — appends refuse until a reopen heals
+        the tail (the volume plane's degrade-on-failed-rollback
+        discipline, PR 6)."""
+        try:
+            if faults.ACTIVE:
+                faults.raise_if_planned("disk.truncate", active.path)
+            os.ftruncate(self._fd, active.size)
+        except OSError as e:
+            self._poisoned = True
+            LOG.warning("journal %s: rollback truncate failed (%s); "
+                        "journal poisoned until reopen", active.path, e)
+
+    def _roll_locked(self) -> None:
+        os.fsync(self._fd)     # seal: a rolled segment is fully durable
+        self._dirty = False
+        nxt = self._segments[-1].next_offset
+        self._segments.append(self._new_segment(nxt))
+        self._open_active()
+        self._retain_locked()
+
+    def _retain_locked(self) -> None:
+        """Drop the oldest SEALED segments past the size/age budget."""
+        now = time.time()
+        while len(self._segments) > 1:
+            sealed = self._segments[:-1]
+            total = sum(s.size for s in sealed)
+            oldest = sealed[0]
+            over_size = self.retain_bytes and total > self.retain_bytes
+            over_age = self.retain_age_s \
+                and now - oldest.mtime > self.retain_age_s
+            if not (over_size or over_age):
+                break
+            try:
+                os.remove(oldest.path)
+            except OSError as e:
+                LOG.warning("journal retention: cannot remove %s: %s",
+                            oldest.path, e)
+                break
+            self._segments.pop(0)
+
+    # -- sync --------------------------------------------------------------
+    def sync(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            if self._fd >= 0 and self._dirty:
+                if faults.ACTIVE:
+                    faults.raise_if_planned("disk.fsync",
+                                            self._segments[-1].path)
+                os.fsync(self._fd)
+                self._dirty = False
+            # retention rides the flusher cadence too: age budgets must
+            # reclaim sealed segments even when the journal never rolls
+            # again (the check is O(segments) and usually a no-op)
+            self._retain_locked()
+
+    def _flush_loop(self) -> None:
+        while not self._stop_flush.wait(self.fsync_interval):
+            try:
+                self.sync()
+            except OSError as e:
+                LOG.warning("journal fsync failed: %s", e)
+
+    # -- read --------------------------------------------------------------
+    def read(self, from_offset: int, upto: "int | None" = None):
+        """Yield (offset, payload) for records in [from_offset, upto]
+        (upto defaults to last_offset at call time — records appended
+        during iteration are not yielded, so a reader holding no lock
+        never races a half-written tail: every record at or below the
+        snapshot tail was fully written before the snapshot)."""
+        with self._lock:
+            limit = self._segments[-1].next_offset - 1
+            segs = [(s.path, s.first_offset, s.next_offset)
+                    for s in self._segments]
+        if upto is not None:
+            limit = min(limit, upto)
+        for path, first, nxt in segs:
+            if nxt <= from_offset or first > limit:
+                continue
+            try:
+                for idx, payload, _end in _scan_segment(path):
+                    off = first + idx
+                    if off > limit:
+                        return
+                    if off >= from_offset:
+                        yield off, payload
+            except FileNotFoundError:
+                # collected by retention mid-read: the reader sees the
+                # same gap as a resume below first_offset (served from
+                # the earliest retained record) — loud, not silent
+                LOG.warning("journal read raced retention: segment "
+                            "%s gone; resuming from the next retained "
+                            "segment", path)
+                continue
+
+    # -- admin -------------------------------------------------------------
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "dir": self.directory,
+                "first_offset": self._segments[0].first_offset,
+                "last_offset": self._segments[-1].next_offset - 1,
+                "segments": len(self._segments),
+                "bytes": sum(s.size for s in self._segments),
+            }
+
+    def close(self) -> None:
+        if self._flusher is not None:
+            self._stop_flush.set()
+            self._flusher.join(timeout=2.0)
+            self._flusher = None
+        with self._lock:
+            if self._fd >= 0 and not self._closed:
+                try:
+                    os.fsync(self._fd)
+                except OSError:
+                    pass
+                os.close(self._fd)
+                self._fd = -1
+            self._closed = True

@@ -39,6 +39,7 @@ from .filechunk_manifest import MANIFEST_BATCH, maybe_manifestize
 from .filechunks import read_views, total_size
 from .filer import Filer
 from .filerstore import NotFound, new_filer_store
+from .meta_journal import MetaJournal
 
 LOG = logger(__name__)
 
@@ -122,7 +123,8 @@ class FilerServer:
                  chunk_cache_mem_mb: int = 64,
                  chunk_cache_dir: "str | None" = None,
                  chunk_cache_disk_mb: int = 1024,
-                 encrypt_data: bool = False):
+                 encrypt_data: bool = False,
+                 journal_dir: "str | None" = None):
         # may be a comma-separated HA master list; resolved to the leader
         # at start (and re-resolved when calls start failing)
         self._master_spec = master_grpc
@@ -153,7 +155,14 @@ class FilerServer:
                 port=int(conf.get("redis.port", 6379) or 6379))
         else:
             store = new_filer_store(store_kind)
-        self.filer = Filer(store, delete_chunks_fn=self._enqueue_deletion)
+        # durable metadata journal (meta_journal.py): offset resume
+        # tokens for SubscribeMetadata that survive a filer restart —
+        # what cross-cluster sync resumes from.  Without a journal_dir
+        # the event log is the in-memory ring only (resume tokens still
+        # work in-process, but die with the process).
+        self.journal = MetaJournal(journal_dir) if journal_dir else None
+        self.filer = Filer(store, delete_chunks_fn=self._enqueue_deletion,
+                           journal=self.journal)
         # read-path chunk cache tiers (util/chunk_cache + reader_at.go);
         # fids are immutable so entries only ever age out by capacity
         from ..util.chunk_cache import TieredChunkCache
@@ -173,6 +182,13 @@ class FilerServer:
         # families in stats/__init__.py, served at GET /metrics) and the
         # span ring behind GET /debug/traces
         self.metrics = ServerMetrics()
+        self.filer.on_subscriber_overflow = \
+            self.metrics.filer_sub_overflow.inc
+        # per-client subscription progress (offset of the last event
+        # streamed), behind the seaweedfs_sync_subscriber_lag_events
+        # gauge and the JournalStatus RPC / filer.sync.status verb
+        self._sub_progress: "dict[str, int]" = {}
+        self._sub_lock = threading.Lock()
         self.tracer = Tracer("filer")
         from ..util import profiling
         profiling.sampler()  # always-on process sampler (WEED_PROFILE)
@@ -187,7 +203,9 @@ class FilerServer:
         # aggregate feed = local events + peer filers' events
         # (meta_aggregator.go); peers follow our LOCAL stream only, so
         # re-published peer events can never loop back
-        self._agg_subs: "dict[int, queue.Queue]" = {}
+        # sid -> bounded-put callable of an aggregate stream (never
+        # blocks; the stream disconnects itself on overflow)
+        self._agg_subs: "dict[int, object]" = {}
         self._agg_seq = 0
         self._agg_lock = threading.Lock()
         self._aggregator = None
@@ -228,6 +246,8 @@ class FilerServer:
         self.http.stop()
         self.rpc.stop()
         self.filer.store.close()
+        if self.journal is not None:
+            self.journal.close()
 
     @property
     def address(self) -> str:
@@ -372,7 +392,29 @@ class FilerServer:
 
     def _http_metrics(self, req: Request) -> Response:
         from ..stats import metrics_response
+        self._refresh_sync_gauges()
         return metrics_response(req, self.metrics.render)
+
+    def _refresh_sync_gauges(self) -> None:
+        """seaweedfs_sync_* gauges are point-in-time: journal head/tail
+        plus per-subscriber lag, recomputed at scrape so the federated
+        /cluster/metrics page (master/observe.py) and the SLO math see
+        live values."""
+        last = self.filer.last_offset()
+        if self.journal is not None:
+            st = self.journal.status()
+            self.metrics.sync_journal_offset.set(
+                "first", value=st["first_offset"])
+            self.metrics.sync_journal_offset.set(
+                "last", value=st["last_offset"])
+            self.metrics.sync_journal_bytes.set(value=st["bytes"])
+        else:
+            self.metrics.sync_journal_offset.set("last", value=last)
+        with self._sub_lock:
+            progress = dict(self._sub_progress)
+        for client, off in progress.items():
+            self.metrics.sync_subscriber_lag.set(
+                client, value=max(0, last - off))
 
     def _http_status(self, req: Request) -> Response:
         return Response.json({
@@ -601,7 +643,8 @@ class FilerServer:
                 # cluster.trace / metrics.dump fetch through these
                 # instead of guessing the HTTP port
                 "DebugTraces": tracing.traces_rpc_handler(self.tracer),
-                "Metrics": lambda req: {"text": self.metrics.render()},
+                "Metrics": self._rpc_metrics,
+                "JournalStatus": self._rpc_journal_status,
             },
             stream={
                 "ListEntries": self._rpc_list_entries,
@@ -632,38 +675,132 @@ class FilerServer:
         except Exception as e:
             LOG.debug("peer event apply failed: %s", e)
         with self._agg_lock:
-            for q in self._agg_subs.values():
-                q.put(event)
+            sinks = list(self._agg_subs.values())
+        for fn in sinks:
+            fn(event)   # bounded put_nowait wrappers — never block
 
-    def _rpc_subscribe_aggregate(self, requests):
-        """Aggregate stream: the local backlog+live feed (via
-        Filer.subscribe, which guarantees backlog-before-live with no
-        gap/duplication) merged with peer events (SubscribeMetadata in the
-        reference; peer history replays through the aggregator)."""
+    # events a subscription stream may buffer before the slow client is
+    # disconnected (it resumes from its offset token on reconnect)
+    STREAM_QUEUE_MAX = 8192
+
+    def _track_progress(self, client_name: str, offset: int) -> None:
+        if not client_name:
+            return
+        with self._sub_lock:
+            self._sub_progress[client_name] = offset
+            while len(self._sub_progress) > 64:    # bounded by clients
+                self._sub_progress.pop(next(iter(self._sub_progress)))
+
+    def _stream_events(self, requests, subscribe):
+        """Shared body of both subscription streams: bounded buffering
+        with disconnect-on-overflow (a hung client must not park
+        unbounded memory here — it reconnects and resumes from its
+        offset token), per-client progress tracking, and pings carrying
+        the journal tail so subscribers can compute their own lag."""
         req = next(iter(requests), {}) or {}
         since = req.get("since_ns", 0)
+        since_offset = req.get("since_offset")
+        client = req.get("client_name", "")
         prefix = (req.get("path_prefix", "/") or "/").rstrip("/")
         from ..util import path_matches_prefix
-        q: "queue.Queue[dict]" = queue.Queue()
-        with self._agg_lock:
-            self._agg_seq += 1
-            sid = self._agg_seq
-            self._agg_subs[sid] = q
-        unsubscribe = self.filer.subscribe(
-            lambda ev: q.put(ev.to_dict()), since_ts_ns=since)
+        offset_mode = since_offset is not None
+        cursor = since_offset if offset_mode else 0
+        if offset_mode:
+            # retention gap disclosure: a resume token older than the
+            # journal's retention floor CANNOT be served loss-free —
+            # say so explicitly (the client logs/counts it and decides
+            # on a resync) instead of silently skipping the gap
+            first = self.filer.first_available_offset()
+            if 0 < cursor + 1 < first:
+                yield {"gap": {"requested": cursor,
+                               "resumed_at": first - 1}}
+                cursor = first - 1
+        # deep-backlog phase, BOTH resume modes: page history straight
+        # off the journal/ring and yield as we go — a replay from far
+        # behind (an offset resume, or an aggregator peer's since_ns=0
+        # first contact) must not flood the live subscription's bounded
+        # queue (overflow there means a HUNG consumer, not a healthy
+        # catch-up).  ts-mode filters by event ts while the cursor
+        # advances by offset; the subscription below closes the gap
+        # from wherever paging caught up to.
+        page = self.STREAM_QUEUE_MAX // 4
+        while True:
+            batch = self.filer.read_events(cursor, limit=page)
+            if not batch:
+                break
+            for ev in batch:
+                cursor = max(cursor, ev.offset)
+                if (offset_mode or ev.ts_ns > since) \
+                        and path_matches_prefix(ev.directory, prefix):
+                    yield ev.to_dict()
+            self._track_progress(client, cursor)
+            if len(batch) < page:
+                break         # near the tail: hand off to live mode
+        q: "queue.Queue[dict]" = queue.Queue(
+            maxsize=self.STREAM_QUEUE_MAX)
+        dead = threading.Event()
+
+        def on_event(ev_dict: dict) -> None:
+            if dead.is_set():
+                return
+            try:
+                q.put_nowait(ev_dict)
+            except queue.Full:
+                # disconnect-on-overflow: end the stream; the client
+                # resumes from its last persisted offset
+                dead.set()
+                self.metrics.filer_sub_overflow.inc()
+
+        # live tailing resumes from the paging cursor by OFFSET in both
+        # modes: everything <= cursor was already considered above
+        unsubscribe = subscribe(on_event, since, cursor)
         try:
             while True:
                 try:
                     ev = q.get(timeout=0.5)
                 except queue.Empty:
-                    yield {"ping": 1}
+                    if dead.is_set():
+                        return
+                    yield {"ping": 1,
+                           "last_offset": self.filer.last_offset()}
                     continue
                 if path_matches_prefix(ev.get("directory", "/"), prefix):
                     yield ev
+                self._track_progress(client, ev.get("offset", 0))
         finally:
             unsubscribe()
+            if client:
+                # the stream is over: stop exporting a forever-growing
+                # lag for a departed subscriber (the verb/gauges report
+                # ACTIVE streams; a reconnect re-registers)
+                self.metrics.sync_subscriber_lag.set(client, value=0)
+                with self._sub_lock:
+                    self._sub_progress.pop(client, None)
+
+    def _rpc_subscribe_aggregate(self, requests):
+        """Aggregate stream: the local backlog+live feed (via
+        Filer.subscribe, which guarantees backlog-before-live with no
+        gap/duplication) merged with peer events (SubscribeMetadata in the
+        reference; peer history replays through the aggregator).  Offsets
+        on peer events are PEER journal offsets — resume tokens are only
+        meaningful against the local stream (SubscribeLocalMetadata)."""
+
+        def subscribe(on_event, since, since_offset):
             with self._agg_lock:
-                self._agg_subs.pop(sid, None)
+                self._agg_seq += 1
+                sid = self._agg_seq
+                self._agg_subs[sid] = on_event
+            unsub = self.filer.subscribe(
+                lambda ev: on_event(ev.to_dict()), since_ts_ns=since,
+                since_offset=since_offset)
+
+            def unsubscribe():
+                unsub()
+                with self._agg_lock:
+                    self._agg_subs.pop(sid, None)
+            return unsubscribe
+
+        yield from self._stream_events(requests, subscribe)
 
     def _rpc_lookup(self, req: dict) -> dict:
         directory = req.get("directory", "/").rstrip("/") or "/"
@@ -738,28 +875,42 @@ class FilerServer:
                 yield {"entry": e.to_dict()}
 
     def _rpc_subscribe_metadata(self, requests):
-        """Replay from since_ns then tail live events
-        (filer_grpc_server_sub_meta.go)."""
-        req = next(iter(requests), {}) or {}
-        since = req.get("since_ns", 0)
-        path_prefix = req.get("path_prefix", "/")
-        q: "queue.Queue[dict]" = queue.Queue()
+        """LOCAL stream: replay from since_ns — or from since_offset,
+        the durable journal resume token — then tail live events
+        (filer_grpc_server_sub_meta.go).  Offsets in these events are
+        positions in THIS filer's journal: a subscriber that persists
+        the last offset it applied resumes exactly there across both
+        its own restarts and this filer's."""
 
-        from ..util import path_matches_prefix
+        def subscribe(on_event, since, since_offset):
+            return self.filer.subscribe(
+                lambda ev: on_event(ev.to_dict()), since_ts_ns=since,
+                since_offset=since_offset)
 
-        def on_event(ev):
-            if path_matches_prefix(ev.directory, path_prefix):
-                q.put(ev.to_dict())
+        yield from self._stream_events(requests, subscribe)
 
-        unsubscribe = self.filer.subscribe(on_event, since_ts_ns=since)
-        try:
-            while True:
-                try:
-                    yield q.get(timeout=0.5)
-                except queue.Empty:
-                    yield {"ping": 1}
-        finally:
-            unsubscribe()
+    def _rpc_metrics(self, req: dict) -> dict:
+        self._refresh_sync_gauges()
+        return {"text": self.metrics.render()}
+
+    def _rpc_journal_status(self, req: dict) -> dict:
+        """Journal head/tail + per-subscriber progress — what
+        `filer.sync.status` renders per filer."""
+        last = self.filer.last_offset()
+        with self._sub_lock:
+            subs = {name: {"offset": off, "lag": max(0, last - off)}
+                    for name, off in self._sub_progress.items()}
+        out = {"last_offset": last,
+               "first_offset": self.journal.first_offset
+               if self.journal else max(1, last + 1 - len(
+                   self.filer._log)),
+               "durable": self.journal is not None,
+               "subscribers": subs,
+               "subscriber_overflows":
+                   self.filer.subscriber_overflows}
+        if self.journal is not None:
+            out["journal"] = self.journal.status()
+        return out
 
     def _rpc_kv_get(self, req: dict) -> dict:
         from ..pb.rpc import to_b64, from_b64

@@ -4,6 +4,19 @@ The reference generates Go stubs from 6 .proto files (weed/pb/*.proto) and
 keeps a global connection cache (pb/grpc_client_server.go).  Here the same
 service/method shapes run over grpc generic handlers with JSON bodies
 (bytes fields base64) — no codegen step, same RPC surface.
+
+Wire contract extensions over the reference's filer.proto:13-72:
+
+- SubscribeMetadata / SubscribeLocalMetadata requests carry optional
+  ``since_offset`` (a metadata-journal offset, the DURABLE resume token
+  that survives a filer restart — preferred over ``since_ns``) and
+  ``client_name`` (a stable subscriber id the filer tracks lag for);
+  streamed events carry ``offset``; keepalive pings carry
+  ``last_offset`` (the journal tail, for lag accounting only — never a
+  consumable resume token).  Offsets are positions in the SERVING
+  filer's local journal: only the local stream's offsets are resumable.
+- SeaweedFiler.JournalStatus (unary) reports journal head/tail,
+  per-subscriber progress and overflow counts (filer.sync.status).
 """
 
 from .rpc import (GrpcConnectionPool, RpcClient, RpcError, RpcServer,

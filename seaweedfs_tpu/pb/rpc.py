@@ -206,12 +206,33 @@ class RpcServer:
                 sid = tracing.new_span_id()
                 t0 = time.time()
             status = "ok"
+
+            def faulted():
+                # server-side stream chaos (rpc.handle): refuse at
+                # dispatch AND cut established streams per message —
+                # the shape a partitioned/crashed peer presents to a
+                # long-lived metadata subscription
+                key = f"{self.host}:{self.port}/{label}"
+                if faults.ACTIVE:
+                    p = faults.hit("rpc.handle", key)
+                    if p is not None:
+                        raise RpcError(f"injected fault #{p.rule_id}: "
+                                       f"{p.mode} {label}")
+                for item in fn(request_iterator):
+                    if faults.ACTIVE:
+                        p = faults.hit("rpc.handle", key)
+                        if p is not None:
+                            raise RpcError(
+                                f"injected fault #{p.rule_id}: "
+                                f"{p.mode} {label}")
+                    yield item
+
             try:
                 if not traced:
-                    yield from fn(request_iterator)
+                    yield from faulted()
                     return
                 with tracing.trace_scope(tid, sid):
-                    yield from fn(request_iterator)
+                    yield from faulted()
             except RpcError as e:
                 status = "error"
                 context.abort(grpc.StatusCode.UNKNOWN, str(e))
@@ -294,12 +315,22 @@ class RpcClient:
 
     def stream(self, method: str, requests: Iterator[dict],
                timeout: float | None = None) -> Iterator[dict]:
+        # streams honor the same rpc.call chaos rules as unary calls:
+        # a partitioned peer refuses NEW subscriptions (checked at open)
+        # and cuts ESTABLISHED ones (checked per received message) —
+        # both halves matter for partition-tolerance tests, where a
+        # long-lived SubscribeMetadata stream must actually die
+        if faults.ACTIVE:
+            self._maybe_fault(method)
         fn = self._channel.stream_stream(
             f"/{self.service}/{method}",
             request_serializer=_ser, response_deserializer=_de)
         try:
-            yield from fn(requests, timeout=timeout,
-                          metadata=_trace_metadata())
+            for msg in fn(requests, timeout=timeout,
+                          metadata=_trace_metadata()):
+                if faults.ACTIVE:
+                    self._maybe_fault(method)
+                yield msg
         except grpc.RpcError as e:
             raise RpcError(e.details() or str(e.code())) from None
 

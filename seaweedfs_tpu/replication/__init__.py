@@ -23,28 +23,59 @@ REPLICATION_SOURCE_KEY = "replication.source"  # loop-prevention signature
 
 
 class ReplicationSink(Protocol):
-    def create_entry(self, entry: Entry, signature: str) -> None: ...
+    def create_entry(self, entry: Entry, signature: str,
+                     ts_ns: int = 0) -> None: ...
 
     def update_entry(self, old: Entry, new: Entry,
-                     signature: str) -> None: ...
+                     signature: str, ts_ns: int = 0) -> None: ...
 
-    def delete_entry(self, path: str, is_directory: bool) -> None: ...
+    def delete_entry(self, path: str, is_directory: bool,
+                     ts_ns: int = 0) -> None: ...
+
+
+# tombstone KV namespace on the TARGET filer: a replicated delete leaves
+# `sync.tomb.<path> -> ts_ns` so a stale create arriving later (out of
+# order, or replayed from an old offset) cannot resurrect the entry —
+# the same missed-DELETE-must-propagate rule the PR 7 scrub authority
+# clock enforces between replicas, generalized across clusters
+_TOMB_PREFIX = b"sync.tomb."
+
+
+def _mtime_ns(entry_dict_or_entry) -> int:
+    attr = entry_dict_or_entry.get("attr", {}) \
+        if isinstance(entry_dict_or_entry, dict) \
+        else vars(entry_dict_or_entry.attr)
+    return int(float(attr.get("mtime", 0.0)) * 1e9)
 
 
 class FilerSink:
     """Replays events into another filer over its gRPC API, stamping each
     entry with the source signature so the target's own sync loop skips
-    events that originated here (filer_sync.go signature loop prevention)."""
+    events that originated here (filer_sync.go signature loop prevention).
+
+    With ``lww=True`` (the cross-cluster sync default) every apply runs
+    the conflict rules: last-writer-wins on entry mtime (a target entry
+    newer than the incoming one is kept) and delete tombstones (a
+    replicated delete records its event ts; creates older than the
+    tombstone are dropped instead of resurrecting).  ``fid_cache`` is
+    the chunk-level dedup map {source_fid: target_fid}: a chunk already
+    materialized on the target crosses the wire zero more times."""
 
     def __init__(self, filer_grpc: str, path_translation: tuple[str, str]
                  = ("/", "/"), read_chunk: "callable | None" = None,
-                 write_chunk: "callable | None" = None):
+                 write_chunk: "callable | None" = None,
+                 lww: bool = False,
+                 fid_cache: "dict | None" = None):
         self.filer_grpc = filer_grpc
         self.src_prefix, self.dst_prefix = path_translation
         # chunk re-materialization hooks: read from source cluster, write
         # into the target cluster (repl_util.CopyFromChunkViews)
         self.read_chunk = read_chunk
         self.write_chunk = write_chunk
+        self.lww = lww
+        self.fid_cache = fid_cache
+        self.stats = {"applied": 0, "lww_skipped": 0, "tomb_skipped": 0,
+                      "chunks_copied": 0, "chunks_deduped": 0}
 
     def _client(self):
         return POOL.client(self.filer_grpc, "SeaweedFiler")
@@ -60,36 +91,123 @@ class FilerSink:
         """Copy chunk data into the target cluster (the sink's cluster has
         its own volume servers; fids don't transfer).  Sealed chunks copy
         as-is — raw ciphertext travels, cipher_key rides in the entry, so
-        the target cluster is exactly as encrypted as the source."""
+        the target cluster is exactly as encrypted as the source.  Fids
+        already copied this stream's lifetime are reused (chunk-level
+        dedup): an entry update that keeps 9 of 10 chunks ships one."""
         out = []
         for c in entry.chunks:
             d = c.to_dict()
             if self.read_chunk and self.write_chunk:
-                data = self.read_chunk(c.file_id)
-                d["file_id"] = self.write_chunk(data)
+                cached = None if self.fid_cache is None \
+                    else self.fid_cache.get(c.file_id)
+                if cached is not None:
+                    d["file_id"] = cached
+                    self.stats["chunks_deduped"] += 1
+                else:
+                    data = self.read_chunk(c.file_id)
+                    d["file_id"] = self.write_chunk(data)
+                    self.stats["chunks_copied"] += 1
+                    if self.fid_cache is not None:
+                        if len(self.fid_cache) > 100_000:
+                            self.fid_cache.clear()   # bounded, coarse
+                        self.fid_cache[c.file_id] = d["file_id"]
             out.append(d)
         return out
 
-    def create_entry(self, entry: Entry, signature: str) -> None:
+    # -- conflict rules (lww mode) ----------------------------------------
+    def _lookup_target(self, path: str) -> "dict | None":
+        # fails CLOSED on transport errors (the stream retries the
+        # event): treating a dropped call as "no entry" would bypass
+        # the LWW guard and let an older create clobber a newer target
+        # entry.  The filer signals plain not-found as an RpcError with
+        # a stable "<path> not found" message (server.py _rpc_lookup) —
+        # only that maps to None.
+        directory, _, name = path.rstrip("/").rpartition("/")
+        try:
+            out = self._client().call("LookupDirectoryEntry", {
+                "directory": directory or "/", "name": name})
+            return out.get("entry")
+        except RpcError as e:
+            if "not found" in str(e):
+                return None
+            raise
+
+    def _tomb_ts(self, path: str) -> int:
+        # transport errors PROPAGATE (the stream retries the event):
+        # returning 0 on a dropped call would bypass the resurrection
+        # guard exactly when the target is flaky.  A missing tombstone
+        # is a clean {"error": ...} response, not an exception.
+        from ..pb.rpc import from_b64, to_b64
+        out = self._client().call("KvGet", {
+            "key": to_b64(_TOMB_PREFIX + path.encode())})
+        if out.get("value"):
+            try:
+                return int(from_b64(out["value"]).decode())
+            except ValueError:
+                return 0
+        return 0
+
+    def _record_tomb(self, path: str, ts_ns: int) -> None:
+        # propagates transport errors: a delete applied WITHOUT its
+        # tombstone would let a later stale create resurrect the entry;
+        # failing here makes the stream retry the whole (idempotent)
+        # delete event instead
+        from ..pb.rpc import to_b64
+        self._client().call("KvPut", {
+            "key": to_b64(_TOMB_PREFIX + path.encode()),
+            "value": to_b64(str(ts_ns).encode())})
+
+    def create_entry(self, entry: Entry, signature: str,
+                     ts_ns: int = 0) -> None:
+        path = self._translate(entry.full_path)
+        if self.lww and not entry.is_directory():
+            incoming = _mtime_ns(entry) or ts_ns
+            if incoming <= self._tomb_ts(path):
+                self.stats["tomb_skipped"] += 1
+                return
+            existing = self._lookup_target(path)
+            if existing is not None and _mtime_ns(existing) > incoming:
+                self.stats["lww_skipped"] += 1
+                return
         e = entry.to_dict()
-        e["full_path"] = self._translate(entry.full_path)
+        e["full_path"] = path
         e["chunks"] = self._rewrite_chunks(entry)
         e.setdefault("extended", {})[REPLICATION_SOURCE_KEY] = signature
         self._client().call("CreateEntry", {"entry": e})
+        self.stats["applied"] += 1
 
-    def update_entry(self, old: Entry, new: Entry, signature: str) -> None:
-        self.create_entry(new, signature)
+    def update_entry(self, old: Entry, new: Entry, signature: str,
+                     ts_ns: int = 0) -> None:
+        self.create_entry(new, signature, ts_ns=ts_ns)
 
-    def delete_entry(self, path: str, is_directory: bool) -> None:
+    def delete_entry(self, path: str, is_directory: bool,
+                     ts_ns: int = 0) -> None:
         path = self._translate(path)
+        if self.lww:
+            if not is_directory:
+                existing = self._lookup_target(path)
+                if existing is not None and ts_ns \
+                        and _mtime_ns(existing) > ts_ns:
+                    # a write NEWER than this delete exists on the
+                    # target: the delete lost — keep the newer content
+                    self.stats["lww_skipped"] += 1
+                    return
+            # tombstone the path either way (a dir tombstone blocks the
+            # DIR entry's stale re-create; per-child LWW for recursive
+            # deletes racing child creates is a documented active-active
+            # caveat — see README)
+            self._record_tomb(path, ts_ns)
         directory, _, name = path.rstrip("/").rpartition("/")
-        try:
-            self._client().call("DeleteEntry", {
-                "directory": directory or "/", "name": name,
-                "is_recursive": is_directory,
-                "ignore_recursive_error": True})
-        except RpcError:
-            pass  # already gone
+        # ignore_recursive_error=True makes a missing entry a no-op on
+        # the server, so any RpcError here is a TRANSPORT failure and
+        # must propagate: swallowing it would let the consumed offset
+        # advance past a delete that never happened — permanent
+        # divergence the offset-replay contract exists to prevent
+        self._client().call("DeleteEntry", {
+            "directory": directory or "/", "name": name,
+            "is_recursive": is_directory,
+            "ignore_recursive_error": True})
+        self.stats["applied"] += 1
 
 
 class LocalSink:
@@ -104,7 +222,8 @@ class LocalSink:
     def _path(self, entry_path: str) -> str:
         return os.path.join(self.directory, entry_path.lstrip("/"))
 
-    def create_entry(self, entry: Entry, signature: str) -> None:
+    def create_entry(self, entry: Entry, signature: str,
+                     ts_ns: int = 0) -> None:
         p = self._path(entry.full_path)
         if entry.is_directory():
             os.makedirs(p, exist_ok=True)
@@ -120,10 +239,12 @@ class LocalSink:
                     f.write(decode_chunk_record(
                         self.read_chunk(c.file_id), c))
 
-    def update_entry(self, old: Entry, new: Entry, signature: str) -> None:
+    def update_entry(self, old: Entry, new: Entry, signature: str,
+                     ts_ns: int = 0) -> None:
         self.create_entry(new, signature)
 
-    def delete_entry(self, path: str, is_directory: bool) -> None:
+    def delete_entry(self, path: str, is_directory: bool,
+                     ts_ns: int = 0) -> None:
         p = self._path(path)
         if os.path.isdir(p):
             import shutil
@@ -205,7 +326,8 @@ class S3Sink:
         key = path.lstrip("/")
         return f"{self.prefix}/{key}" if self.prefix else key
 
-    def create_entry(self, entry: Entry, signature: str) -> None:
+    def create_entry(self, entry: Entry, signature: str,
+                     ts_ns: int = 0) -> None:
         if entry.is_directory():
             return              # S3 has no directories
         stream, data = stitch_chunks(entry, self.read_chunk)
@@ -219,10 +341,12 @@ class S3Sink:
             self.client.put_object(self.bucket,
                                    self._key(entry.full_path), data)
 
-    def update_entry(self, old: Entry, new: Entry, signature: str) -> None:
+    def update_entry(self, old: Entry, new: Entry, signature: str,
+                     ts_ns: int = 0) -> None:
         self.create_entry(new, signature)
 
-    def delete_entry(self, path: str, is_directory: bool) -> None:
+    def delete_entry(self, path: str, is_directory: bool,
+                     ts_ns: int = 0) -> None:
         if is_directory:
             for obj in self.client.list_objects(
                     self.bucket, self._key(path) + "/"):
@@ -248,6 +372,7 @@ class Replicator:
         self.signature = signature
         self.skip_sources = skip_sources or set()
         self.path_prefix = path_prefix.rstrip("/") or ""
+        self.echo_suppressed = 0   # events dropped by signature
 
     def _in_scope(self, path: str) -> bool:
         from ..util import path_matches_prefix
@@ -256,12 +381,16 @@ class Replicator:
     def replicate(self, event: dict) -> bool:
         """event = MetaEvent.to_dict(); returns True when applied."""
         old, new = event.get("old_entry"), event.get("new_entry")
+        ts_ns = event.get("ts_ns", 0)
         # loop prevention: never forward an entry that originated from a
-        # cluster in skip_sources (normally: the sync target itself)
+        # cluster in skip_sources (normally: the sync target itself) —
+        # run active-active, each direction suppresses the echo of the
+        # other's applies, so an event crosses the wire exactly once
         for side in (new, old):
             src = side and side.get("extended", {}).get(
                 REPLICATION_SOURCE_KEY)
             if src and src in self.skip_sources:
+                self.echo_suppressed += 1
                 return False
         if new is not None:
             entry = Entry.from_dict(new)
@@ -269,16 +398,18 @@ class Replicator:
                 return False
             if old is not None:
                 self.sink.update_entry(Entry.from_dict(old), entry,
-                                       self.signature)
+                                       self.signature, ts_ns=ts_ns)
             else:
-                self.sink.create_entry(entry, self.signature)
+                self.sink.create_entry(entry, self.signature,
+                                       ts_ns=ts_ns)
             return True
         if old is not None:
             path = old["full_path"]
             if not self._in_scope(path):
                 return False
             self.sink.delete_entry(
-                path, bool(old.get("attr", {}).get("mode", 0) & 0o40000))
+                path, bool(old.get("attr", {}).get("mode", 0) & 0o40000),
+                ts_ns=ts_ns)
             return True
         return False
 

@@ -34,7 +34,8 @@ class _CloudSinkBase:
         key = path.lstrip("/")
         return f"{self.prefix}/{key}" if self.prefix else key
 
-    def update_entry(self, old, new, signature: str) -> None:
+    def update_entry(self, old, new, signature: str,
+                     ts_ns: int = 0) -> None:
         self.create_entry(new, signature)
 
 
@@ -59,7 +60,8 @@ class GcsSink(_CloudSinkBase):
             client = storage.Client().bucket(bucket)
         self.client = client
 
-    def create_entry(self, entry, signature: str) -> None:
+    def create_entry(self, entry, signature: str,
+                     ts_ns: int = 0) -> None:
         if entry.is_directory():
             return
         stream, data = _stitch(entry, self.read_chunk)
@@ -69,7 +71,8 @@ class GcsSink(_CloudSinkBase):
         else:
             blob.upload_from_string(data)
 
-    def delete_entry(self, path: str, is_directory: bool) -> None:
+    def delete_entry(self, path: str, is_directory: bool,
+                     ts_ns: int = 0) -> None:
         if is_directory:
             for b in self.client.list_blobs(prefix=self._key(path) + "/"):
                 self.client.blob(b.name).delete()
@@ -104,7 +107,8 @@ class AzureSink(_CloudSinkBase):
                 connection_string, container)
         self.client = client
 
-    def create_entry(self, entry, signature: str) -> None:
+    def create_entry(self, entry, signature: str,
+                     ts_ns: int = 0) -> None:
         if entry.is_directory():
             return
         stream, data = _stitch(entry, self.read_chunk)
@@ -112,7 +116,8 @@ class AzureSink(_CloudSinkBase):
                                 stream if stream is not None else data,
                                 overwrite=True)
 
-    def delete_entry(self, path: str, is_directory: bool) -> None:
+    def delete_entry(self, path: str, is_directory: bool,
+                     ts_ns: int = 0) -> None:
         if is_directory:
             for b in self.client.list_blobs(
                     name_starts_with=self._key(path) + "/"):
@@ -150,7 +155,8 @@ class B2Sink(_CloudSinkBase):
             client = api.get_bucket_by_name(bucket)
         self.client = client
 
-    def create_entry(self, entry, signature: str) -> None:
+    def create_entry(self, entry, signature: str,
+                     ts_ns: int = 0) -> None:
         if entry.is_directory():
             return
         stream, data = _stitch(entry, self.read_chunk)
@@ -158,7 +164,8 @@ class B2Sink(_CloudSinkBase):
             data = stream.read()  # b2 upload_bytes takes bytes
         self.client.upload_bytes(data, self._key(entry.full_path))
 
-    def delete_entry(self, path: str, is_directory: bool) -> None:
+    def delete_entry(self, path: str, is_directory: bool,
+                     ts_ns: int = 0) -> None:
         if is_directory:
             # recursive=True: b2sdk's default yields only immediate
             # children + one representative per subfolder, which would

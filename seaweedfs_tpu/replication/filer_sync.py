@@ -1,16 +1,33 @@
 """`filer.sync` — continuous (bi)directional sync between two filer
-clusters.
+clusters, resumable by journal offset with provable no-acked-loss.
 
-Capability-equivalent to weed/command/filer_sync.go:91-333: each direction
-subscribes to the source filer's metadata stream from its last persisted
-offset, replicates events through a FilerSink on the target, excludes the
-target's own signature (loop prevention), and persists the consumed offset
-in the TARGET filer's KV store so restarts resume where they left off.
+Capability-equivalent to weed/command/filer_sync.go:91-333, rebuilt on
+the durable metadata journal (filer/meta_journal.py):
+
+- each direction subscribes to the source filer's LOCAL metadata stream
+  (SubscribeLocalMetadata) from its last persisted JOURNAL OFFSET — not
+  a timestamp — so a restart of either the sync daemon or the source
+  filer resumes exactly where it left off with no rescan and no skip;
+- events are applied through a FilerSink running the last-writer-wins +
+  tombstone conflict rules, with chunk-level dedup (a fid already
+  materialized on the target never crosses the wire again);
+- per-stream signatures echo-suppress: entries applied by a direction
+  are stamped with the source cluster's signature, and the reverse
+  direction skips them, so active-active runs without replication
+  loops;
+- the consumed offset is persisted AFTER the events it covers are
+  applied.  A crash between apply and save replays the unsaved window
+  (applies are idempotent and LWW-guarded) — events can repeat but can
+  never be skipped.  With ``offset_path`` the offset lives in a local
+  file written atomically (tmp + fsync + rename); otherwise it rides
+  the TARGET filer's KV store as before.
 """
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 
 from .. import operation
 from ..pb.rpc import POOL, RpcError, from_b64, to_b64
@@ -20,10 +37,46 @@ from . import FilerSink, Replicator
 
 LOG = logger(__name__)
 
+OFFSET_SAVE_EVERY = 64   # events applied between offset persists
+
 
 def _offset_key(source_signature: str, path_prefix: str) -> bytes:
-    # filer_sync.go persists per-direction offsets under a source-keyed KV
-    return f"sync.offset.{source_signature}.{path_prefix}".encode()
+    # filer_sync.go persists per-direction offsets under a source-keyed
+    # KV.  The key is VERSIONED: pre-journal daemons stored a ts_ns
+    # under "sync.offset." — reading one of those as a journal offset
+    # would sail past the entire backlog, so offset-semantics live
+    # under a fresh namespace and an old checkpoint triggers a full
+    # (idempotent, LWW-guarded) replay instead of a silent skip.
+    return f"sync.offset2.{source_signature}.{path_prefix}".encode()
+
+
+def save_offset_file(path: str, offset: int) -> None:
+    """Atomic offset persistence: write a tmp file, fsync it, rename
+    over the target.  A crash at ANY point leaves either the old offset
+    or the new one — never a torn/empty file — so a restart can replay
+    the unsaved window but can never skip past unapplied events."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="ascii") as f:
+        f.write(str(offset))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    try:
+        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass   # directory fsync is best-effort (not all FSes allow it)
+
+
+def load_offset_file(path: str) -> int:
+    try:
+        with open(path, "r", encoding="ascii") as f:
+            return int(f.read().strip() or "0")
+    except (OSError, ValueError):
+        return 0
 
 
 class SyncDirection:
@@ -32,28 +85,49 @@ class SyncDirection:
     def __init__(self, source_filer_grpc: str, source_master_grpc: str,
                  target_filer_grpc: str, target_master_grpc: str,
                  signature: str, target_signature: str,
-                 path_prefix: str = "/"):
+                 path_prefix: str = "/",
+                 offset_path: "str | None" = None):
         self.source_filer = source_filer_grpc
         self.target_filer = target_filer_grpc
         self.signature = signature
+        self.target_signature = target_signature
         self.path_prefix = path_prefix
+        self.offset_path = offset_path
         # chunk re-materialization: read blobs from the source cluster,
-        # write them into the target cluster
+        # write them into the target cluster; the fid cache is the
+        # chunk-level dedup map shared across this direction's lifetime
         read_chunk = lambda fid: operation.read_file(source_master_grpc,
                                                      fid)
         write_chunk = lambda data: operation.assign_and_upload(
             target_master_grpc, data)
-        sink = FilerSink(target_filer_grpc, read_chunk=read_chunk,
-                         write_chunk=write_chunk)
-        self.replicator = Replicator(sink, signature,
+        self.sink = FilerSink(target_filer_grpc, read_chunk=read_chunk,
+                              write_chunk=write_chunk, lww=True,
+                              fid_cache={})
+        self.replicator = Replicator(self.sink, signature,
                                      path_prefix=path_prefix,
                                      skip_sources={target_signature})
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.applied = 0
+        # observability for filer.sync.status / bench_replication:
+        # resume offsets actually used (proves offset resume, not
+        # timestamp rescan), source journal tail seen on the last ping,
+        # and per-event replication lag samples (apply time - event ts)
+        self.resumes: list[int] = []
+        self.source_tail = 0
+        self.last_offset = 0
+        self.lag_samples: list[float] = []
+        # resume tokens that fell behind the source's retention floor
+        # (events lost to the gap need a full resync; see status())
+        self.retention_gaps = 0
 
-    # -- offset persistence (filer_sync.go:189-242) -------------------------
+    # -- offset persistence -------------------------------------------------
+    # Local file mode (offset_path): atomic tmp+fsync+rename.  KV mode:
+    # the TARGET filer's store, like filer_sync.go:189-242 — same
+    # replay-never-skip ordering, durability is the target store's.
     def _load_offset(self) -> int:
+        if self.offset_path is not None:
+            return load_offset_file(self.offset_path)
         try:
             out = POOL.client(self.target_filer, "SeaweedFiler").call(
                 "KvGet",
@@ -65,63 +139,123 @@ class SyncDirection:
             pass
         return 0
 
-    def _save_offset(self, ts_ns: int) -> None:
+    def _save_offset(self, offset: int) -> None:
+        if self.offset_path is not None:
+            save_offset_file(self.offset_path, offset)
+            return
         try:
             POOL.client(self.target_filer, "SeaweedFiler").call(
                 "KvPut",
                 {"key": to_b64(_offset_key(self.signature,
                                            self.path_prefix)),
-                 "value": to_b64(str(ts_ns).encode())})
+                 "value": to_b64(str(offset).encode())})
         except RpcError:
             pass
 
     # -- run ----------------------------------------------------------------
     def run_once(self, max_events: int = 0) -> int:
-        """Drain currently-available events once (tests / cron mode).
-        Returns events applied."""
+        """Drain currently-available events once (tests / cron mode):
+        returns at the first keepalive ping.  Returns events applied."""
+        return self._consume(until_ping=True, max_events=max_events)
+
+    def run_stream(self) -> int:
+        """Live-tailing mode: stay on the subscription stream across
+        pings (pings flush the offset and update lag accounting) until
+        stop() or a stream error.  This is what start() runs — events
+        replicate with subscription latency, not poll cadence."""
+        return self._consume(until_ping=False)
+
+    def _consume(self, until_ping: bool, max_events: int = 0) -> int:
         since = self._load_offset()
+        if len(self.resumes) >= 64:
+            del self.resumes[:32]
+        self.resumes.append(since)
         client = POOL.client(self.source_filer, "SeaweedFiler")
         applied = 0
-        last_ts = 0
+        last_off = since
         unsaved = 0
-        for msg in client.stream("SubscribeMetadata",
-                                 iter([{"since_ns": since,
-                                        "path_prefix": self.path_prefix}])):
-            if "ping" in msg:
-                break  # caught up with the live tail
-            if self.replicator.replicate(msg):
-                applied += 1
-            last_ts = msg["ts_ns"]
-            unsaved += 1
-            # persist periodically, not per event (filer_sync.go saves on
-            # a ~3s timer); a crash replays at most the unsaved window
-            if unsaved >= 100:
-                self._save_offset(last_ts)
-                unsaved = 0
-            if max_events and applied >= max_events:
-                break
-        if unsaved and last_ts:
-            self._save_offset(last_ts)
-        self.applied += applied
+        try:
+            for msg in client.stream(
+                    "SubscribeLocalMetadata",
+                    iter([{"since_offset": since,
+                           "path_prefix": self.path_prefix,
+                           "client_name":
+                               f"sync:{self.signature}->"
+                               f"{self.target_signature}"}])):
+                if self._stop.is_set():
+                    break
+                if "gap" in msg:
+                    # the source's retention floor passed our resume
+                    # token: events in the gap are unrecoverable from
+                    # the journal — count + log LOUDLY (the operator
+                    # decides on a full resync); never silent
+                    g = msg["gap"]
+                    self.retention_gaps += 1
+                    LOG.warning(
+                        "sync %s -> %s: retention gap — resume offset "
+                        "%s predates the source's retained history; "
+                        "resuming at %s (events in between need a full "
+                        "resync)", self.source_filer, self.target_filer,
+                        g.get("requested"), g.get("resumed_at"))
+                    continue
+                if "ping" in msg:
+                    # caught up with the live tail; the ping carries
+                    # the journal tail for lag accounting (never saved
+                    # as a consumed offset — only applied events
+                    # advance that)
+                    self.source_tail = max(self.source_tail,
+                                           msg.get("last_offset", 0))
+                    if until_ping:
+                        break
+                    if unsaved and last_off > since:
+                        self._save_offset(last_off)
+                        unsaved = 0
+                    self.last_offset = last_off
+                    continue
+                if self.replicator.replicate(msg):
+                    applied += 1
+                    self.applied += 1
+                    if msg.get("ts_ns"):
+                        if len(self.lag_samples) >= 4096:
+                            del self.lag_samples[:2048]
+                        self.lag_samples.append(
+                            time.time() - msg["ts_ns"] / 1e9)
+                off = msg.get("offset", 0)
+                if off:
+                    last_off = off
+                    self.last_offset = off
+                    unsaved += 1
+                    # persist periodically, not per event; a crash
+                    # replays at most the unsaved window (applies are
+                    # idempotent and LWW/tombstone-guarded)
+                    if unsaved >= OFFSET_SAVE_EVERY:
+                        self._save_offset(last_off)
+                        unsaved = 0
+                if max_events and applied >= max_events:
+                    break
+        finally:
+            if unsaved and last_off > since:
+                self._save_offset(last_off)
+            self.last_offset = last_off
         return applied
 
     def start(self) -> None:
         def loop():
-            # healthy polls keep the old 0.5s cadence; failures back off
-            # (jittered) so a down source filer isn't re-dialed on a
-            # fixed beat by every sync direction at once
+            # a healthy stream lives until stop()/error; failures back
+            # off (jittered) so a down source filer isn't re-dialed on
+            # a fixed beat by every sync direction at once
             policy = background_reconnect()
             failures = 0
             while not self._stop.is_set():
                 try:
-                    self.run_once()
+                    self.run_stream()
                     failures = 0
                 except RpcError as e:
                     failures += 1
                     LOG.debug("sync %s -> %s failed (%d consecutive): "
                               "%s", self.source_filer, self.target_filer,
                               failures, e)
-                self._stop.wait(0.5 if not failures
+                self._stop.wait(0.05 if not failures
                                 else policy.backoff(failures))
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
@@ -129,18 +263,47 @@ class SyncDirection:
     def stop(self) -> None:
         self._stop.set()
 
+    def status(self) -> dict:
+        """One direction's health — rendered by `filer.sync.status` and
+        sampled by bench_replication."""
+        lag_events = max(0, self.source_tail - self.last_offset)
+        st = dict(self.sink.stats)
+        st.update({
+            "source": self.source_filer,
+            "target": self.target_filer,
+            "signature": self.signature,
+            "applied": self.applied,
+            "echo_suppressed": self.replicator.echo_suppressed,
+            "consumed_offset": self.last_offset,
+            "source_tail": self.source_tail,
+            "backlog_events": lag_events,
+            "retention_gaps": self.retention_gaps,
+            "resumes": list(self.resumes[-8:]),
+        })
+        return st
+
 
 class FilerSync:
     """Bidirectional sync = two directions with crossed signatures
-    (filer_sync.go runs two goroutine loops)."""
+    (filer_sync.go runs two goroutine loops).  Echo suppression makes
+    this safe to run active-active: each direction skips entries
+    stamped with its target's signature, so nothing ping-pongs."""
 
     def __init__(self, a_filer: str, a_master: str, b_filer: str,
                  b_master: str, sig_a: str = "filerA",
-                 sig_b: str = "filerB", path_prefix: str = "/"):
+                 sig_b: str = "filerB", path_prefix: str = "/",
+                 offset_dir: "str | None" = None):
+        def opath(tag: str) -> "str | None":
+            if offset_dir is None:
+                return None
+            os.makedirs(offset_dir, exist_ok=True)
+            return os.path.join(offset_dir, f"offset.{tag}")
         self.a_to_b = SyncDirection(a_filer, a_master, b_filer, b_master,
-                                    sig_a, sig_b, path_prefix)
+                                    sig_a, sig_b, path_prefix,
+                                    offset_path=opath(f"{sig_a}-{sig_b}"))
         self.b_to_a = SyncDirection(b_filer, b_master, a_filer, a_master,
-                                    sig_b, sig_a, path_prefix)
+                                    sig_b, sig_a, path_prefix,
+                                    offset_path=opath(f"{sig_b}-{sig_a}"))
 
     def run_once(self) -> tuple[int, int]:
         return self.a_to_b.run_once(), self.b_to_a.run_once()
@@ -152,3 +315,7 @@ class FilerSync:
     def stop(self) -> None:
         self.a_to_b.stop()
         self.b_to_a.stop()
+
+    def status(self) -> dict:
+        return {"a_to_b": self.a_to_b.status(),
+                "b_to_a": self.b_to_a.status()}

@@ -4,5 +4,5 @@ into the COMMANDS map; CommandEnv holds the master connection + admin lock."""
 from . import (command_cluster, command_collection,  # noqa: F401
                command_ec, command_fs, command_fs_extra,
                command_maintenance, command_remote, command_s3_extra,
-               command_volume, command_volume_extra)
+               command_sync, command_volume, command_volume_extra)
 from .commands import COMMANDS, CommandEnv, ShellError, run_command

@@ -1,0 +1,64 @@
+"""`filer.sync.status` — cross-cluster replication health at a glance.
+
+Sweeps every filer in the master's cluster registry and renders its
+metadata-journal head/tail (the offset space resume tokens live in),
+each active subscription stream's consumed offset and lag, and the
+bounded-queue overflow count (subscribers disconnected for falling too
+far behind).  The numbers come from the filer's JournalStatus RPC — the
+same state behind the seaweedfs_sync_* metric families, so what this
+verb prints is what the SLO scrape alarms on."""
+
+from __future__ import annotations
+
+import json
+
+from ..pb.rpc import POOL, RpcError
+from .commands import CommandEnv, command
+
+
+def _filer_grpc_addresses(env: CommandEnv) -> list[str]:
+    try:
+        out = env.master().call("ListClusterNodes", {})
+    except RpcError:
+        return []
+    return list(out.get("nodes", {}).get("filer", []))
+
+
+@command("filer.sync.status",
+         "per-filer metadata journal offsets + subscriber lag "
+         "(resume-token health for cross-cluster sync); -json dumps raw")
+def cmd_filer_sync_status(env: CommandEnv, args: list[str]) -> str:
+    per_filer: dict[str, dict] = {}
+    for addr in _filer_grpc_addresses(env):
+        try:
+            per_filer[addr] = POOL.client(addr, "SeaweedFiler").call(
+                "JournalStatus", {})
+        except RpcError as e:
+            per_filer[addr] = {"error": str(e)}
+    if "-json" in args:
+        return json.dumps(per_filer, indent=1, sort_keys=True)
+    if not per_filer:
+        return "no filers registered"
+    lines = []
+    for addr, st in sorted(per_filer.items()):
+        if "error" in st:
+            lines.append(f"filer {addr}: ERROR {st['error']}")
+            continue
+        dur = "durable journal" if st.get("durable") \
+            else "in-memory ring only"
+        lines.append(
+            f"filer {addr}: offsets [{st.get('first_offset', 0)}, "
+            f"{st.get('last_offset', 0)}] ({dur}), "
+            f"subscriber overflows {st.get('subscriber_overflows', 0)}")
+        if st.get("journal"):
+            j = st["journal"]
+            lines.append(f"  journal: {j.get('segments', 0)} segments, "
+                         f"{j.get('bytes', 0)} bytes @ {j.get('dir', '')}")
+        subs = st.get("subscribers", {})
+        if not subs:
+            lines.append("  no tracked subscribers")
+        for name, s in sorted(subs.items()):
+            lines.append(f"  subscriber {name}: offset "
+                         f"{s.get('offset', 0)}, lag {s.get('lag', 0)} "
+                         f"events")
+    return "\n".join(lines)

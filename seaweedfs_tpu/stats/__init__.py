@@ -280,6 +280,24 @@ class ServerMetrics:
         self.liveness_unregister_total = r.counter(
             "seaweedfs_master_liveness_unregister_total",
             "nodes unregistered by the liveness sweep")
+        # cross-cluster sync observability (filer meta journal +
+        # SubscribeMetadata streams): journal head/tail offsets, bytes
+        # retained, and per-subscriber lag in events — the backlog a
+        # geo-replica is behind, fed into the PR 9 federated scrape
+        self.sync_journal_offset = r.gauge(
+            "seaweedfs_sync_journal_offset",
+            "metadata journal offsets (end = first | last)", ["end"])
+        self.sync_journal_bytes = r.gauge(
+            "seaweedfs_sync_journal_bytes",
+            "bytes retained by the metadata journal")
+        self.sync_subscriber_lag = r.gauge(
+            "seaweedfs_sync_subscriber_lag_events",
+            "events between the journal tail and a subscriber's last "
+            "streamed offset", ["client"])
+        self.filer_sub_overflow = r.counter(
+            "seaweedfs_filer_subscriber_overflow_total",
+            "metadata subscribers disconnected on bounded-queue "
+            "overflow")
 
     def render(self, exemplars: bool = False) -> str:
         return self.registry.render(exemplars=exemplars)

@@ -55,7 +55,9 @@ class SimCluster:
                  base_dir: "str | None" = None, seed: int = 0,
                  encrypt_data: bool = False,
                  repair_interval: float = 0.0,
-                 repair: "dict | None" = None):
+                 repair: "dict | None" = None,
+                 filer_store: str = "memory",
+                 filer_journal: bool = True):
         # self-healing loop (master/repair.py): off by default so kill/
         # partition tests observe raw degradation; chaos-convergence
         # tests turn it on with tight knobs via `repair={...}`
@@ -93,7 +95,15 @@ class SimCluster:
             d = os.path.join(self.base_dir, f"vol{i}")
             os.makedirs(d, exist_ok=True)
             self._vs_dirs.append(d)
-        self.filers: list[FilerServer] = []
+        # filer persistence: each filer gets its own dir under base_dir
+        # holding the durable metadata journal (and, with
+        # filer_store="sqlite", the namespace itself) so
+        # kill_filer/restart_filer simulates a real crash+reboot with
+        # resume tokens surviving
+        self._filer_store = filer_store
+        self._filer_journal = filer_journal
+        self._filer_ports: list[tuple[int, int]] = []
+        self.filers: "list[FilerServer | None]" = []
         self.s3_server: "S3ApiServer | None" = None
 
     def _make_master(self, i: int, port: int) -> MasterServer:
@@ -110,6 +120,21 @@ class SimCluster:
             rack=f"rack{i % self.racks}", pulse_seconds=self.pulse,
             max_volume_counts=[self.max_volumes],
             jwt_signing_key=self.jwt_key)
+
+    def _make_filer(self, i: int, port: int = 0,
+                    grpc_port: int = 0) -> FilerServer:
+        fdir = os.path.join(self.base_dir, f"filer{i}")
+        os.makedirs(fdir, exist_ok=True)
+        store_kind, store_path = self._filer_store, ":memory:"
+        if store_kind == "sqlite":
+            store_path = os.path.join(fdir, "meta.db")
+        journal_dir = os.path.join(fdir, "journal") \
+            if self._filer_journal else None
+        return FilerServer(self._master_list(), port=port,
+                           grpc_port=grpc_port,
+                           store_kind=store_kind, store_path=store_path,
+                           journal_dir=journal_dir,
+                           encrypt_data=self.encrypt_data)
 
     def _master_list(self) -> str:
         if self.peers:
@@ -144,11 +169,11 @@ class SimCluster:
             vs.start()
             self.volume_servers.append(vs)
         self.wait_for_nodes(len(self.volume_servers), timeout)
-        for _ in range(self._n_filers):
-            f = FilerServer(self._master_list(),
-                            encrypt_data=self.encrypt_data)
+        for i in range(self._n_filers):
+            f = self._make_filer(i)
             f.start()
             self.filers.append(f)
+            self._filer_ports.append((f.http.port, f.rpc.port))
         if self._want_s3:
             assert self.filers, "s3 needs a filer"
             self.s3_server = S3ApiServer(self.filers[0].address,
@@ -169,10 +194,11 @@ class SimCluster:
             except Exception as e:
                 LOG.debug("s3 server stop failed: %s", e)
         for f in self.filers:
-            try:
-                f.stop()
-            except Exception as e:
-                LOG.debug("filer stop failed: %s", e)
+            if f is not None:
+                try:
+                    f.stop()
+                except Exception as e:
+                    LOG.debug("filer stop failed: %s", e)
         for vs in self.volume_servers:
             if vs is not None:
                 try:
@@ -399,6 +425,25 @@ class SimCluster:
                     return i
             time.sleep(0.05)
         raise TimeoutError("no leader elected")
+
+    def kill_filer(self, i: int) -> None:
+        """Hard-stop a filer; its journal and (sqlite) store stay on
+        disk for restart_filer — the crash+reboot resume-token drill."""
+        f = self.filers[i]
+        if f is not None:
+            f.stop()
+            self.filers[i] = None
+
+    def restart_filer(self, i: int) -> FilerServer:
+        """Re-launch on the SAME ports over the same filer dir: the
+        journal heals any torn tail, offsets continue, and subscribers
+        resume against an unchanged address."""
+        assert self.filers[i] is None, "kill it first"
+        port, grpc_port = self._filer_ports[i]
+        f = self._make_filer(i, port=port, grpc_port=grpc_port)
+        f.start()
+        self.filers[i] = f
+        return f
 
     def kill_volume_server(self, i: int) -> None:
         """Hard-stop; its volumes become unavailable until restart."""

@@ -1,0 +1,421 @@
+"""Geo-replication chaos acceptance (ISSUE 11): two complete SimClusters
+with continuous cross-cluster sync, partitioned through the seeded fault
+plane, the SOURCE filer killed and restarted mid-stream — on heal both
+clusters must converge (entry + content digests equal) with ZERO acked
+writes lost, and resume must ride journal offsets, not timestamp
+rescans.  Plus the conflict rules (last-writer-wins, delete tombstones,
+echo suppression), chunk-level dedup, and the atomic offset-persistence
+satellite (crash between apply and save replays, never skips)."""
+
+import hashlib
+import json
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.replication.filer_sync import (FilerSync,
+                                                  SyncDirection,
+                                                  load_offset_file,
+                                                  save_offset_file)
+from seaweedfs_tpu.testing import SimCluster
+from seaweedfs_tpu.util import faults
+from seaweedfs_tpu.util.http import http_request
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def put(cluster, path, data):
+    status, body, _ = http_request(
+        f"http://{cluster.filers[0].address}{path}", method="POST",
+        body=data)
+    assert status == 201, body
+    return data
+
+
+def get(cluster, path):
+    return http_request(f"http://{cluster.filers[0].address}{path}")
+
+
+def tree_digest(cluster, root="/docs") -> dict:
+    """{relative_path: md5(content)} of every FILE under root — the
+    convergence fingerprint (covers entries AND chunk bytes)."""
+    out = {}
+    addr = cluster.filers[0].address
+
+    def walk(d):
+        status, body, _ = http_request(f"http://{addr}{d}?limit=10000")
+        if status != 200:
+            return
+        for e in json.loads(body).get("Entries", []):
+            p = e["full_path"]
+            if e.get("attr", {}).get("mode", 0) & 0o40000:
+                walk(p)
+            else:
+                s, content, _ = http_request(f"http://{addr}{p}")
+                if s == 200:
+                    out[p] = hashlib.md5(content).hexdigest()
+    walk(root)
+    return out
+
+
+def wait_converged(a, b, root="/docs", timeout=45.0) -> dict:
+    deadline = time.time() + timeout
+    da = db = None
+    while time.time() < deadline:
+        da, db = tree_digest(a, root), tree_digest(b, root)
+        if da and da == db:
+            return da
+        time.sleep(0.25)
+    raise AssertionError(
+        f"clusters never converged:\n  A={sorted(da or {})}\n"
+        f"  B={sorted(db or {})}\n  only_a="
+        f"{set(da or {}) - set(db or {})} only_b="
+        f"{set(db or {}) - set(da or {})}")
+
+
+@pytest.fixture()
+def two_clusters(tmp_path):
+    a = SimCluster(volume_servers=1, filers=1, max_volumes=30,
+                   base_dir=str(tmp_path / "A"), seed=31,
+                   filer_store="sqlite").start()
+    b = SimCluster(volume_servers=1, filers=1, max_volumes=30,
+                   base_dir=str(tmp_path / "B"), seed=32,
+                   filer_store="sqlite").start()
+    yield a, b
+    a.stop()
+    b.stop()
+
+
+def _direction(a, b, tmp_path, tag="A-B") -> SyncDirection:
+    return SyncDirection(
+        a.filers[0].grpc_address, a.master_grpc,
+        b.filers[0].grpc_address, b.master_grpc,
+        "geoA", "geoB", path_prefix="/docs",
+        offset_path=str(tmp_path / f"offset.{tag}"))
+
+
+def _partition(src: SimCluster) -> list[int]:
+    """Cut the cross-cluster paths through the seeded fault plane: the
+    source filer's gRPC surface (subscription stream — established
+    streams die on the next message, new ones refuse) and the source
+    master's chunk-location lookups (what the sink's chunk copies
+    need).  The source cluster's OWN write path — HTTP ingest, Assign,
+    heartbeats — stays up: writes during the partition are acked."""
+    rules = [
+        faults.inject("rpc.call", mode="drop",
+                      match=src.filers[0].grpc_address),
+        faults.inject("rpc.call", mode="drop",
+                      match=(src.master_grpc, "/LookupVolume")),
+    ]
+    return rules
+
+
+# -- THE acceptance test ----------------------------------------------------
+
+def test_partition_kill_restart_converges_zero_acked_loss(
+        two_clusters, tmp_path):
+    a, b = two_clusters
+    d = _direction(a, b, tmp_path)
+    d.start()
+    try:
+        acked = {}
+        for i in range(12):
+            p = f"/docs/steady/f{i:02d}.bin"
+            acked[p] = put(a, p, os.urandom(1500) + b"steady-%d" % i)
+        wait_converged(a, b)
+        # last_offset is stamped when a poll round completes — wait for
+        # the in-flight round to finish before sampling it
+        deadline = time.time() + 10.0
+        while time.time() < deadline and d.last_offset == 0:
+            time.sleep(0.1)
+        events_after_steady = d.last_offset
+        assert events_after_steady > 0
+
+        # PARTITION (seeded fault plane) — then keep writing: every one
+        # of these is acked to the client and must survive
+        rules = _partition(a)
+        for i in range(10):
+            p = f"/docs/during/f{i:02d}.bin"
+            acked[p] = put(a, p, os.urandom(900) + b"partition-%d" % i)
+
+        # kill + restart the SOURCE filer mid-stream: journal heals,
+        # sqlite store reopens, same ports — resume tokens stay valid
+        a.kill_filer(0)
+        time.sleep(0.3)
+        a.restart_filer(0)
+        for i in range(8):
+            p = f"/docs/after/f{i:02d}.bin"
+            acked[p] = put(a, p, os.urandom(700) + b"restarted-%d" % i)
+
+        # HEAL: remove exactly the partition rules
+        for r in rules:
+            faults.remove(r)
+        final = wait_converged(a, b)
+
+        # zero acked loss: every acked write is on BOTH sides, intact
+        for path, data in acked.items():
+            want = hashlib.md5(data).hexdigest()
+            assert final.get(path) == want, f"lost acked write {path}"
+
+        # resume rode journal offsets (no timestamp rescan): the last
+        # resume token is deep into the offset space, and the total
+        # applied events stayed bounded (no full re-replication)
+        assert d.resumes[-1] > 0
+        assert max(d.resumes) >= events_after_steady
+        assert d.applied < 3 * (len(acked) + 8), \
+            f"replayed far too much: applied={d.applied}"
+        st = d.status()
+        assert st["backlog_events"] == 0
+    finally:
+        d.stop()
+
+
+def test_source_filer_restart_resumes_by_offset(two_clusters, tmp_path):
+    """Restart WITHOUT a partition: the live subscription stream dies,
+    the sync loop re-dials, and the resume token picks up exactly where
+    the applied offset left off."""
+    a, b = two_clusters
+    d = _direction(a, b, tmp_path, tag="restart")
+    acked = {}
+    for i in range(6):
+        p = f"/docs/one/f{i}.bin"
+        acked[p] = put(a, p, b"round-one-%d" % i)
+    d.run_once()
+    wait_converged(a, b)
+    first_offset = load_offset_file(d.offset_path)
+    assert first_offset > 0
+
+    a.kill_filer(0)
+    time.sleep(0.2)
+    a.restart_filer(0)
+    for i in range(5):
+        p = f"/docs/two/f{i}.bin"
+        acked[p] = put(a, p, b"round-two-%d" % i)
+    applied = d.run_once()
+    # only the new events crossed: resume started at the saved offset
+    assert d.resumes[-1] == first_offset
+    assert 0 < applied <= 8, f"timestamp-rescan smell: {applied}"
+    final = wait_converged(a, b)
+    for path, data in acked.items():
+        assert final.get(path) == hashlib.md5(data).hexdigest()
+
+
+# -- conflict rules ---------------------------------------------------------
+
+def test_lww_keeps_newer_target_entry(two_clusters, tmp_path):
+    a, b = two_clusters
+    put(a, "/docs/shared.txt", b"older from A")
+    time.sleep(0.02)
+    put(b, "/docs/shared.txt", b"NEWER from B")
+    d = _direction(a, b, tmp_path, tag="lww")
+    d.run_once()
+    # A's older write must not clobber B's newer one
+    assert get(b, "/docs/shared.txt")[1] == b"NEWER from B"
+    assert d.sink.stats["lww_skipped"] >= 1
+
+
+def test_tombstone_blocks_replayed_create(two_clusters, tmp_path):
+    a, b = two_clusters
+    put(a, "/docs/ghost.txt", b"soon deleted")
+    d = _direction(a, b, tmp_path, tag="tomb")
+    d.run_once()
+    assert get(b, "/docs/ghost.txt")[0] == 200
+    http_request(f"http://{a.filers[0].address}/docs/ghost.txt",
+                 method="DELETE")
+    d.run_once()
+    assert get(b, "/docs/ghost.txt")[0] == 404
+    # stale replay from offset 0 (lost offset file): the tombstone on B
+    # blocks the old create from resurrecting the entry
+    save_offset_file(d.offset_path, 0)
+    d.run_once()
+    assert get(b, "/docs/ghost.txt")[0] == 404
+    assert d.sink.stats["tomb_skipped"] >= 1
+
+
+def test_chunk_dedup_on_replay(two_clusters, tmp_path):
+    a, b = two_clusters
+    put(a, "/docs/dedup.bin", os.urandom(4000))
+    d = _direction(a, b, tmp_path, tag="dedup")
+    d.run_once()
+    copied = d.sink.stats["chunks_copied"]
+    assert copied >= 1 and d.sink.stats["chunks_deduped"] == 0
+    # replay the same events: fids already materialized on the target
+    # must not cross the wire again
+    save_offset_file(d.offset_path, 0)
+    d.run_once()
+    assert d.sink.stats["chunks_copied"] == copied
+    assert d.sink.stats["chunks_deduped"] >= 1
+
+
+def test_active_active_echo_suppression(two_clusters, tmp_path):
+    """Bidirectional sync with journal offsets: each side's writes reach
+    the other exactly once; repeated rounds go quiet (no ping-pong)."""
+    a, b = two_clusters
+    sync = FilerSync(a.filers[0].grpc_address, a.master_grpc,
+                     b.filers[0].grpc_address, b.master_grpc,
+                     sig_a="geoA", sig_b="geoB", path_prefix="/docs",
+                     offset_dir=str(tmp_path / "offsets"))
+    put(a, "/docs/x/from-a.txt", b"made in A")
+    put(b, "/docs/x/from-b.txt", b"made in B")
+    sync.run_once()
+    sync.run_once()          # second round carries the applied echoes
+    assert get(a, "/docs/x/from-b.txt")[1] == b"made in B"
+    assert get(b, "/docs/x/from-a.txt")[1] == b"made in A"
+    for _ in range(3):
+        applied = sync.run_once()
+    assert applied == (0, 0)
+    assert sync.a_to_b.replicator.echo_suppressed \
+        + sync.b_to_a.replicator.echo_suppressed >= 2
+
+
+# -- offset persistence satellite -------------------------------------------
+
+def test_offset_file_save_is_atomic(tmp_path, monkeypatch):
+    path = str(tmp_path / "offset")
+    save_offset_file(path, 41)
+    assert load_offset_file(path) == 41
+    # crash BEFORE the rename: tmp written, target untouched
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("crash before rename")
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError):
+        save_offset_file(path, 42)
+    monkeypatch.setattr(os, "replace", real_replace)
+    assert load_offset_file(path) == 41      # old offset intact, no tear
+    # a stray torn tmp from a dead process never shadows the real file
+    with open(path + ".tmp", "w") as f:
+        f.write("9")
+    assert load_offset_file(path) == 41
+
+
+def test_crash_between_apply_and_save_replays_never_skips(
+        two_clusters, tmp_path):
+    """Satellite 1: the consumed offset is persisted AFTER the events it
+    covers are applied.  A kill between apply and save replays the
+    window on restart — it can duplicate work (idempotent, LWW-guarded)
+    but can NEVER skip an acked event."""
+    a, b = two_clusters
+    acked = {}
+    for i in range(6):
+        p = f"/docs/k/f{i}.bin"
+        acked[p] = put(a, p, b"killed-sync-%d" % i)
+    d = _direction(a, b, tmp_path, tag="crash")
+
+    # invariant probe: every offset save must cover only APPLIED events
+    applied_offsets = []
+    real_replicate = d.replicator.replicate
+    real_save = d._save_offset
+
+    def tracking_replicate(msg):
+        ok = real_replicate(msg)
+        if ok:
+            applied_offsets.append(msg.get("offset", 0))
+        if len(applied_offsets) == 3:
+            raise KeyboardInterrupt("kill between apply and save")
+        return ok
+
+    def checked_save(off):
+        assert applied_offsets and off <= max(applied_offsets), \
+            "offset saved AHEAD of applied events (would skip on crash)"
+        real_save(off)
+
+    d.replicator.replicate = tracking_replicate
+    d._save_offset = checked_save
+    with pytest.raises(KeyboardInterrupt):
+        d.run_once()
+    # killed before any save: the offset file still says 0 → replay
+    assert load_offset_file(d.offset_path) <= max(applied_offsets)
+
+    # "restart" of the sync daemon: fresh direction, same offset file
+    d2 = _direction(a, b, tmp_path, tag="crash")
+    d2.run_once()
+    final = tree_digest(b)
+    for path, data in acked.items():
+        assert final.get(path) == hashlib.md5(data).hexdigest(), \
+            f"skipped after crash: {path}"
+
+
+def test_deep_backlog_resume_pages_without_overflow(two_clusters):
+    """A resume whose backlog exceeds the live stream queue must be
+    paged straight off the journal — delivered completely, in order,
+    with ZERO spurious overflow disconnects (that counter means 'hung
+    consumer', and a healthy catch-up must not pollute it)."""
+    a, _ = two_clusters
+    fs = a.filers[0]
+    for i in range(60):
+        put(a, f"/docs/deep/f{i:03d}", b"x")
+    fs.STREAM_QUEUE_MAX = 8          # instance override: force paging
+    from seaweedfs_tpu.pb.rpc import POOL
+    got = []
+    for msg in POOL.client(fs.grpc_address, "SeaweedFiler").stream(
+            "SubscribeLocalMetadata",
+            iter([{"since_offset": 0, "client_name": "deep"}])):
+        if "ping" in msg:
+            break
+        got.append(msg["offset"])
+    assert got == sorted(got) and len(got) >= 60
+    assert got == list(range(got[0], got[-1] + 1))   # gap/dup-free
+    assert fs.filer.subscriber_overflows == 0
+    assert fs.metrics.filer_sub_overflow.value() == 0
+
+
+def test_retention_gap_is_disclosed_not_skipped(two_clusters, tmp_path):
+    """A resume token older than the source's retention floor cannot be
+    served loss-free — the stream must SAY so (gap message; counted by
+    the sync direction) instead of silently skipping the gap."""
+    a, b = two_clusters
+    fs = a.filers[0]
+    # shrink the live journal's budgets so retention actually collects
+    fs.journal.segment_max_bytes = 2048
+    fs.journal.retain_bytes = 2048
+    for i in range(120):
+        put(a, f"/docs/gap/f{i:03d}", b"g")
+    first = fs.journal.first_offset
+    assert first > 1, "retention never collected (test setup)"
+    from seaweedfs_tpu.pb.rpc import POOL
+    msgs = []
+    for msg in POOL.client(fs.grpc_address, "SeaweedFiler").stream(
+            "SubscribeLocalMetadata", iter([{"since_offset": 0}])):
+        if "ping" in msg:
+            break
+        msgs.append(msg)
+    assert msgs and "gap" in msgs[0], msgs[:2]
+    assert msgs[0]["gap"]["resumed_at"] == first - 1
+    offsets = [m["offset"] for m in msgs[1:]]
+    assert offsets and offsets[0] == first and offsets == sorted(offsets)
+    # the sync daemon counts it loudly
+    d = _direction(a, b, tmp_path, tag="gap")
+    d.run_once()
+    assert d.retention_gaps >= 1
+    assert d.status()["retention_gaps"] >= 1
+
+
+def test_ts_mode_deep_backlog_pages_without_overflow(two_clusters):
+    """Aggregator peers resume by since_ns: a full-history ts replay
+    bigger than the live queue must page off the journal exactly like
+    an offset resume — complete, ordered, zero overflow disconnects."""
+    a, _ = two_clusters
+    fs = a.filers[0]
+    for i in range(60):
+        put(a, f"/docs/tsdeep/f{i:03d}", b"x")
+    fs.STREAM_QUEUE_MAX = 8          # instance override: force paging
+    from seaweedfs_tpu.pb.rpc import POOL
+    got = []
+    for msg in POOL.client(fs.grpc_address, "SeaweedFiler").stream(
+            "SubscribeLocalMetadata",
+            iter([{"since_ns": 0, "client_name": "tsdeep"}])):
+        if "ping" in msg:
+            break
+        got.append(msg["offset"])
+    assert len(got) >= 60 and got == sorted(got)
+    assert got == list(range(got[0], got[-1] + 1))
+    assert fs.filer.subscriber_overflows == 0
+    assert fs.metrics.filer_sub_overflow.value() == 0

@@ -321,3 +321,26 @@ def test_remote_mount_buckets(cluster, tmp_path):
     meta = json.loads(shell.run_command(
         env, "fs.meta.cat /buckets/logs/app.log"))
     assert meta["chunks"]
+
+
+def test_filer_sync_status_verb(cluster):
+    c, env = cluster
+    put(c, "/sync-status/a.txt", b"hello")
+    # a tracked subscriber: tail the local stream under a client name
+    from seaweedfs_tpu.pb.rpc import POOL
+    stream = POOL.client(c.filers[0].grpc_address, "SeaweedFiler").stream(
+        "SubscribeLocalMetadata",
+        iter([{"since_offset": 0, "client_name": "verbtest"}]))
+    events = 0
+    for msg in stream:
+        if "ping" in msg:
+            break
+        events += 1
+    assert events > 0
+    out = shell.run_command(env, "filer.sync.status")
+    assert "durable journal" in out
+    assert "verbtest" in out and "lag 0" in out
+    raw = json.loads(shell.run_command(env, "filer.sync.status -json"))
+    (st,) = raw.values()
+    assert st["durable"] and st["last_offset"] >= events
+    assert st["subscribers"]["verbtest"]["lag"] == 0

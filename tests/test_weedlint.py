@@ -83,6 +83,20 @@ def test_bad_metrics_fixture():
                    ("WL090", 12), ("WL090", 17), ("WL090", 18)]
 
 
+def test_bad_journal_fixture():
+    got = _ids_lines(_findings(os.path.join(FIXTURES, "bad_journal.py")))
+    assert got == [("WL100", 8), ("WL100", 12), ("WL100", 17)]
+
+
+def test_filer_module_journal_discipline_is_clean():
+    """The live Filer holds the WL100 contract with ZERO baselined
+    exceptions: every store mutation emits its metadata event."""
+    from tools.weedlint import analyze_file
+    target = os.path.join(PACKAGE, "filer", "filer.py")
+    got = [f for f in analyze_file(target, select={"WL100"})]
+    assert got == [], "\n".join(f.render() for f in got)
+
+
 def test_good_fixture_is_clean():
     assert _findings(os.path.join(FIXTURES, "good.py")) == []
 
@@ -180,5 +194,5 @@ def test_cli_list_checkers():
     assert r.returncode == 0
     for cid in ("WL001", "WL002", "WL010", "WL011", "WL012",
                 "WL020", "WL021", "WL022", "WL030", "WL040",
-                "WL050", "WL060", "WL080", "WL090"):
+                "WL050", "WL060", "WL080", "WL090", "WL100"):
         assert cid in r.stdout

@@ -1,0 +1,46 @@
+"""WL100 fixture: Filer store writes that never emit a metadata event."""
+
+
+class Filer:                                         # noqa
+    def create_entry(self, entry):
+        # BAD: store mutated, no _notify -> invisible to the journal,
+        # subscribers and cross-cluster sync silently diverge
+        self.store.insert_entry(entry)               # line 8: WL100
+
+    def delete_quietly(self, path):
+        entry = self.store.find_entry(path)          # read: fine
+        self.store.delete_entry(path)                # line 12: WL100
+        return entry
+
+    def branch_leak(self, entry, fancy):
+        if fancy:
+            self.store.update_entry(entry)           # line 17: WL100
+            return
+        self.store.insert_entry(entry)
+        self._notify(None, entry)                    # gates line 19 only
+
+    def good_create(self, entry):
+        self.store.insert_entry(entry)
+        self._notify(None, entry)
+
+    def good_txn(self, entry, old_path):
+        with self.store.atomic():
+            self.store.insert_entry(entry)
+            self.store.delete_entry(old_path)
+        self._notify(None, entry)                    # enclosing suite gates
+        self._notify(entry, None)
+
+    def good_rollback(self, entry, path):
+        # the sanctioned journal-failure discipline: write, notify in a
+        # try, roll the write back (pragma'd) when the event is refused
+        self.store.delete_entry(path)
+        try:
+            self._notify(entry, None)
+        except Exception:
+            self.store.insert_entry(entry)  # weedlint: disable=WL100
+            raise
+
+
+class NotAFiler:
+    def create_entry(self, entry):
+        self.store.insert_entry(entry)               # out of scope

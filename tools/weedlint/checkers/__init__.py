@@ -10,3 +10,4 @@ from . import retryhygiene  # noqa: F401
 from . import leadership   # noqa: F401
 from . import s3authz      # noqa: F401
 from . import metricshygiene  # noqa: F401
+from . import journal      # noqa: F401

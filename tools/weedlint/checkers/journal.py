@@ -1,0 +1,160 @@
+"""WL100 journal-discipline — every Filer mutation that writes store
+state must emit its metadata event.
+
+The durable metadata journal (ISSUE 11) is only loss-free if every
+namespace mutation flows through ``self._notify``: a store write with
+no event is INVISIBLE to subscribers, peer filers and cross-cluster
+sync — the replica silently diverges and no scrub ever reconciles it,
+which is exactly the acked-loss class PRs 6-7 eliminated from the data
+plane.  The historical failure shape is a new mutation helper wired
+straight to ``self.store.insert_entry(...)`` without the event emit.
+
+The rule: inside any method of a class named ``Filer``, a call to
+``self.store.insert_entry / update_entry / delete_entry /
+delete_folder_children`` must be FOLLOWED by a ``self._notify(...)``
+call — later in the same statement suite, or later in an enclosing
+suite (the rename txn writes inside a ``with`` and notifies after it).
+Suite-walked like WL080: a notify inside one branch does not excuse a
+write in a sibling branch.  Scoped to filer/filer.py (the only module
+with this contract — FilerServer._on_peer_event's bypass is the
+DELIBERATE no-echo path and lives outside it) and the fixture corpus.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .. import Finding, ModuleContext, register
+
+_SCOPE_PARTS = ("seaweedfs_tpu/filer/filer.py",)
+_STORE_WRITES = {"insert_entry", "update_entry", "delete_entry",
+                 "delete_folder_children"}
+_NOTIFY = "_notify"
+
+
+def _in_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(part in p for part in _SCOPE_PARTS) \
+        or "weedlint_fixtures" in p
+
+
+def _store_write_calls(node: ast.AST) -> "Iterator[ast.Call]":
+    """Calls of the shape ``self.store.<write>(...)`` under node."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) \
+                and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _STORE_WRITES \
+                and isinstance(n.func.value, ast.Attribute) \
+                and n.func.value.attr == "store" \
+                and isinstance(n.func.value.value, ast.Name) \
+                and n.func.value.value.id == "self":
+            yield n
+
+
+def _calls_notify(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) \
+                and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == _NOTIFY \
+                and isinstance(n.func.value, ast.Name) \
+                and n.func.value.id == "self":
+            return True
+    return False
+
+
+@register("WL100", "journal-discipline")
+def check_journal_discipline(ctx: ModuleContext) -> Iterator[Finding]:
+    if not _in_scope(ctx.path):
+        return
+    for cls in ast.walk(ctx.tree):
+        if isinstance(cls, ast.ClassDef) and cls.name == "Filer":
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) \
+                        and fn.name != _NOTIFY:
+                    yield from _check_suite(ctx, fn.body,
+                                            notified_after=False)
+
+
+def _check_suite(ctx: ModuleContext, stmts: list,
+                 notified_after: bool) -> Iterator[Finding]:
+    """Walk a suite BACKWARDS: a store write is satisfied by a
+    ``self._notify`` in any LATER statement of this suite or of an
+    enclosing one (``notified_after``).  Compound statements recurse
+    with the state as of their position; sibling branches never excuse
+    each other."""
+    for i in range(len(stmts) - 1, -1, -1):
+        stmt = stmts[i]
+        if isinstance(stmt, (ast.If, ast.For, ast.While, ast.With,
+                             ast.Try)):
+            for suite in _stmt_suites(stmt):
+                yield from _check_suite(ctx, suite, notified_after)
+            for expr in _stmt_head_exprs(stmt):
+                yield from _check_exprs(ctx, expr, notified_after)
+            if _unconditional_notify(stmt):
+                # a notify inside a With/Try BODY runs on every
+                # non-raising path (and a raising path never acks), so
+                # it gates earlier statements — the rollback shape
+                # `write; try: _notify() except: undo; raise` is the
+                # sanctioned discipline, not a violation
+                notified_after = True
+        elif isinstance(stmt, (ast.Return, ast.Raise, ast.Break,
+                               ast.Continue)):
+            # control exits here: statements BEFORE this never reach
+            # the enclosing suite's later notify — drop the gate
+            yield from _check_exprs(ctx, stmt, notified_after)
+            notified_after = False
+        else:
+            yield from _check_exprs(ctx, stmt, notified_after)
+            if _calls_notify(stmt):
+                notified_after = True
+
+
+def _stmt_head_exprs(stmt: ast.AST) -> list:
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, ast.For):
+        return [stmt.iter]
+    if isinstance(stmt, ast.With):
+        return [item.context_expr for item in stmt.items]
+    return []
+
+
+def _unconditional_notify(stmt: ast.AST) -> bool:
+    """True when stmt is a With/Try whose unconditionally-executed
+    suites (body / finalbody) contain a ``self._notify`` call.  If/For/
+    While bodies are conditional and never gate earlier statements."""
+    if isinstance(stmt, ast.With):
+        suites = [stmt.body]
+    elif isinstance(stmt, ast.Try):
+        suites = [stmt.body, stmt.finalbody]
+    else:
+        return False
+    return any(_calls_notify(s) for suite in suites for s in suite)
+
+
+def _stmt_suites(stmt: ast.AST) -> list:
+    if isinstance(stmt, (ast.If, ast.For, ast.While)):
+        return [stmt.body, stmt.orelse]
+    if isinstance(stmt, ast.With):
+        return [stmt.body]
+    if isinstance(stmt, ast.Try):
+        return [stmt.body, stmt.orelse, stmt.finalbody] \
+            + [h.body for h in stmt.handlers]
+    return []
+
+
+def _check_exprs(ctx: ModuleContext, node: ast.AST,
+                 notified_after: bool) -> Iterator[Finding]:
+    if notified_after:
+        return
+    for call in _store_write_calls(node):
+        yield Finding(
+            "WL100", "journal-discipline", ctx.path, call.lineno,
+            f"self.store.{call.func.attr}() with no self._notify() "
+            "after it on this path — the mutation never reaches the "
+            "metadata journal",
+            "emit the event: call self._notify(old, new) after the "
+            "store write (subscribers, peer filers and cross-cluster "
+            "sync all replicate from the event log)")
