@@ -56,6 +56,26 @@ def cmd_master(args) -> int:
 
 
 def cmd_volume(args) -> int:
+    from ..volume_server.workers import resolve_worker_count
+    workers = resolve_worker_count(getattr(args, "workers", None))
+    if workers > 1:
+        # process-sharded data plane: N workers share the data port
+        # behind one logical server (volume_server/workers.py)
+        from ..volume_server.workers import ShardedVolumeServer
+        vs = ShardedVolumeServer(
+            args.mserver, args.dir.split(","), host=args.ip,
+            port=args.port, grpc_port=args.grpc_port,
+            data_center=args.data_center, rack=args.rack,
+            max_volume_counts=[int(c) for c in args.max.split(",")],
+            jwt_signing_key=resolve_jwt_key(args.jwt_key),
+            workers=workers)
+        vs.start()
+        print(f"volume server http {vs.url} grpc {vs.grpc_address} "
+              f"({workers} workers, "
+              f"{'reuseport' if vs.reuseport else 'accept-and-pass'})")
+        _wait_forever()
+        vs.stop()
+        return 0
     from ..volume_server import VolumeServer
     vs = VolumeServer(args.mserver, args.dir.split(","),
                       host=args.ip, port=args.port,
@@ -574,6 +594,10 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("-rack", dest="rack", default="")
     v.add_argument("-jwtKey", dest="jwt_key", default="",
                    help="HS256 signing key (must match the master's)")
+    v.add_argument("-workers", default=None,
+                   help="worker processes sharing the data port "
+                        "(default WEED_VOLUME_WORKERS; 1 = single "
+                        "process, 0/auto = one per core)")
     v.set_defaults(fn=cmd_volume)
 
     f = sub.add_parser("filer", help="start a filer server")

@@ -732,7 +732,12 @@ class FilerServer:
                 cursor = max(cursor, ev.offset)
                 if (offset_mode or ev.ts_ns > since) \
                         and path_matches_prefix(ev.directory, prefix):
-                    yield ev.to_dict()
+                    d = ev.to_dict()
+                    # paged history, not live tail: consumers that
+                    # batch their applies (filer_sync backlog drain)
+                    # key off this; old clients ignore the extra key
+                    d["backlog"] = 1
+                    yield d
             self._track_progress(client, cursor)
             if len(batch) < page:
                 break         # near the tail: hand off to live mode

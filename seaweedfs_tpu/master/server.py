@@ -39,6 +39,14 @@ from .sequencer import MemorySequencer
 LOG = logger(__name__)
 
 
+def _dn_tcp_port(dn, vid: int) -> int:
+    """The frame port to advertise for `vid` on `dn`: the per-volume
+    worker port when the node is process-sharded, the node-level port
+    otherwise."""
+    return getattr(dn, "volume_tcp_ports", {}).get(
+        vid, getattr(dn, "tcp_port", 0))
+
+
 def _volume_info_from_dict(d: dict) -> VolumeInfo:
     return VolumeInfo(
         id=d["id"], size=d.get("size", 0),
@@ -304,8 +312,8 @@ class MasterServer:
             "replicas": [{"url": dn.url, "public_url": dn.public_url}
                          for dn in nodes[1:]],
         }
-        if getattr(main, "tcp_port", 0):
-            out["tcp_url"] = f"{main.ip}:{main.tcp_port}"
+        if _dn_tcp_port(main, vid):
+            out["tcp_url"] = f"{main.ip}:{_dn_tcp_port(main, vid)}"
         if self.jwt_signing_key:
             # sign the write authorization (master_server_handlers.go:146);
             # a count>1 batch gets a token scoped to the assigned
@@ -359,8 +367,8 @@ class MasterServer:
                     seen[dn.url] = entry
             return list(seen.values())
         return [dict({"url": dn.url, "public_url": dn.public_url},
-                     **({"tcp_url": f"{dn.ip}:{dn.tcp_port}"}
-                        if getattr(dn, "tcp_port", 0) else {}))
+                     **({"tcp_url": f"{dn.ip}:{_dn_tcp_port(dn, vid)}"}
+                        if _dn_tcp_port(dn, vid) else {}))
                 for dn in locs]
 
     # -- heartbeat (master_grpc_server.go:21-183) ---------------------------
@@ -410,10 +418,19 @@ class MasterServer:
             infos = [_volume_info_from_dict(v) for v in hb["volumes"]]
             self.topo.sync_data_node(dn, infos)
             self.sequencer.set_max(hb.get("max_file_key", 0))
+            # per-volume frame-port map (process-sharded nodes): full
+            # sync replaces it wholesale so worker reassignments and
+            # deleted volumes never leave a stale route behind
+            dn.volume_tcp_ports = {
+                int(v["id"]): int(v["tcp_port"]) for v in hb["volumes"]
+                if v.get("tcp_port")}
         for v in hb.get("new_volumes", []):
             self.topo.register_volume(_volume_info_from_dict(v), dn)
+            if v.get("tcp_port"):
+                dn.volume_tcp_ports[int(v["id"])] = int(v["tcp_port"])
         for v in hb.get("deleted_volumes", []):
             self.topo.unregister_volume(_volume_info_from_dict(v), dn)
+            dn.volume_tcp_ports.pop(int(v["id"]), None)
         if "ec_shards" in hb:  # full EC sync
             bits = {int(e["id"]): ShardBits(e["ec_index_bits"])
                     for e in hb["ec_shards"]}
@@ -467,13 +484,20 @@ class MasterServer:
                 q.put(msg)
 
     def _node_location_msg(self, dn: DataNode, is_add: bool) -> dict:
-        return {"volume_location": {
+        msg = {"volume_location": {
             "url": dn.url, "public_url": dn.public_url,
             "grpc_port": dn.grpc_port,
             "tcp_port": getattr(dn, "tcp_port", 0),
             "new_vids" if is_add else "deleted_vids":
                 sorted(dn.volumes.keys()) + sorted(dn.ec_shards.keys()),
         }}
+        vid_ports = getattr(dn, "volume_tcp_ports", {})
+        if is_add and vid_ports:
+            # worker-accurate frame routes for sharded nodes: keys are
+            # strings (the map crosses the JSON-RPC boundary)
+            msg["volume_location"]["vid_tcp_ports"] = {
+                str(vid): port for vid, port in vid_ports.items()}
+        return msg
 
     def _publish_node_change(self, dn: DataNode, is_add: bool) -> None:
         self._publish(self._node_location_msg(dn, is_add))
@@ -487,7 +511,7 @@ class MasterServer:
             self._publish({"volume_location": {
                 "url": dn.url, "public_url": dn.public_url,
                 "grpc_port": dn.grpc_port,
-                "tcp_port": getattr(dn, "tcp_port", 0),
+                "tcp_port": _dn_tcp_port(dn, vid),
                 "new_vids": [vid]}})
 
     # -- admin lock (LeaseAdminToken, master_grpc_server_admin.go) ----------

@@ -463,6 +463,13 @@ def lookup_volume(master_grpc: str, vid: int,
     locs = out["volume_id_locations"][str(vid)]["locations"]
     if locs:
         _LOOKUP_CACHE[key] = (now + _LOOKUP_TTL, locs)
+        # piggyback the vid -> frame-port route: on process-sharded
+        # nodes the master stamps each volume with its OWNING worker's
+        # tcp port, so the first frame read already hits the right
+        # worker instead of paying a forward hop
+        tcp = locs[0].get("tcp_url", "")
+        if tcp and _TCP_DEAD.get(tcp, 0) < now:
+            _TCP_ROUTE[(master_grpc, vid)] = (now + _LOOKUP_TTL, tcp)
     return locs
 
 

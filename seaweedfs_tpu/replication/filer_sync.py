@@ -38,6 +38,118 @@ from . import FilerSink, Replicator
 LOG = logger(__name__)
 
 OFFSET_SAVE_EVERY = 64   # events applied between offset persists
+BATCH_APPLY = 32         # backlog events buffered per apply pass
+
+
+class KvFidCache:
+    """Chunk-dedup map {source_fid: target_fid} PERSISTED in the target
+    filer's KV store (ROADMAP PR 10 follow-up: the per-daemon-lifetime
+    dict forgot everything on restart, so a bounced sync daemon
+    re-copied every chunk byte it had already shipped).
+
+    Dict-shaped for FilerSink, with the persistence shaped for the hot
+    path: the whole recent map rides ONE KV blob per direction —
+    loaded once when the stream (re)connects, saved on the offset-save
+    cadence — so lookups and populates are plain dict ops and the
+    apply path pays ZERO extra RPCs per chunk.  Only the most recent
+    PERSIST_MAX pairs persist: a restart's re-copy exposure is the
+    unsaved-offset window (<= OFFSET_SAVE_EVERY events), not all of
+    history.  Transport errors degrade to a cold map — re-copying a
+    chunk is correct, skipping one is not."""
+
+    PERSIST_MAX = 4096
+
+    def __init__(self, target_filer_grpc: str, key: str,
+                 verify: "callable | None" = None):
+        self.target_filer = target_filer_grpc
+        self._key = f"sync.fidmap.{key}".encode()
+        self._local: dict[str, str] = {}
+        # persisted entries outlive the target's chunk lifecycle: a dst
+        # fid may have been deleted/vacuumed since the blob was saved,
+        # and trusting it would create entries pointing at reclaimed
+        # chunks.  Loaded entries are verified ONCE on first reuse via
+        # `verify(dst_fid)` (a target-side read); failures fall back to
+        # a plain re-copy.  Session-fresh entries (we just copied them)
+        # skip the check.
+        self._verify = verify
+        self._unverified: set[str] = set()
+        self._dirty = False
+        self._loaded = False
+        self.kv_hits = 0
+
+    def _client(self):
+        return POOL.client(self.target_filer, "SeaweedFiler")
+
+    def load(self) -> None:
+        """Seed the overlay from the persisted blob (once per cache;
+        stream reconnects reuse the warm overlay)."""
+        if self._loaded:
+            return
+        self._loaded = True
+        try:
+            out = self._client().call("KvGet",
+                                      {"key": to_b64(self._key)})
+        except RpcError as e:
+            LOG.debug("dedup map load failed (starting cold): %s", e)
+            return
+        if out.get("value"):
+            try:
+                import json as _json
+                persisted = _json.loads(from_b64(out["value"]))
+                self.kv_hits = len(persisted)
+                self._unverified = set(persisted) - set(self._local)
+                persisted.update(self._local)   # fresh copies win
+                self._local = persisted
+            except (ValueError, TypeError) as e:
+                LOG.warning("dedup map blob unreadable (starting "
+                            "cold): %s", e)
+
+    def save(self) -> None:
+        """Persist the most recent PERSIST_MAX pairs (insertion order =
+        recency) — called on the offset-save cadence, so it costs one
+        RPC per OFFSET_SAVE_EVERY events, not one per chunk."""
+        if not self._dirty:
+            return
+        import json as _json
+        items = list(self._local.items())[-self.PERSIST_MAX:]
+        try:
+            self._client().call("KvPut", {
+                "key": to_b64(self._key),
+                "value": to_b64(_json.dumps(dict(items)).encode())})
+            self._dirty = False
+        except RpcError as e:
+            LOG.debug("dedup map save failed (retrying next "
+                      "cadence): %s", e)
+
+    def get(self, src_fid: str) -> "str | None":
+        dst = self._local.get(src_fid)
+        if dst is None:
+            return None
+        if src_fid in self._unverified:
+            self._unverified.discard(src_fid)
+            if self._verify is not None and not self._verify(dst):
+                # the target reclaimed the chunk since the blob was
+                # saved: drop the entry; the caller re-copies
+                LOG.info("dedup entry %s -> %s no longer readable on "
+                         "the target; re-copying", src_fid, dst)
+                del self._local[src_fid]
+                self._dirty = True
+                return None
+        return dst
+
+    def __setitem__(self, src_fid: str, dst_fid: str) -> None:
+        self._local[src_fid] = dst_fid
+        self._dirty = True
+
+    def __len__(self) -> int:
+        return len(self._local)
+
+    def clear(self) -> None:
+        # FilerSink's size bound: drop the oldest half instead of
+        # forgetting everything (insertion order = recency)
+        items = list(self._local.items())
+        self._local = dict(items[len(items) // 2:])
+        self._dirty = True
 
 
 def _offset_key(source_signature: str, path_prefix: str) -> bytes:
@@ -100,9 +212,25 @@ class SyncDirection:
                                                      fid)
         write_chunk = lambda data: operation.assign_and_upload(
             target_master_grpc, data)
-        self.sink = FilerSink(target_filer_grpc, read_chunk=read_chunk,
-                              write_chunk=write_chunk, lww=True,
-                              fid_cache={})
+        # dedup map persisted in the TARGET KV: daemon restarts stop
+        # re-copying chunk bytes the target already holds.  A loaded
+        # entry is trusted only after one target-side read proves the
+        # dst fid still exists (vacuum/delete may have reclaimed it
+        # since the blob was saved).
+        def target_fid_readable(dst_fid: str) -> bool:
+            try:
+                operation.read_file(target_master_grpc, dst_fid)
+                return True
+            except Exception as e:
+                LOG.debug("dedup verify read %s failed: %s", dst_fid,
+                          e)
+                return False
+        self.sink = FilerSink(
+            target_filer_grpc, read_chunk=read_chunk,
+            write_chunk=write_chunk, lww=True,
+            fid_cache=KvFidCache(target_filer_grpc,
+                                 key=f"{signature}.{path_prefix}",
+                                 verify=target_fid_readable))
         self.replicator = Replicator(self.sink, signature,
                                      path_prefix=path_prefix,
                                      skip_sources={target_signature})
@@ -174,6 +302,58 @@ class SyncDirection:
         applied = 0
         last_off = since
         unsaved = 0
+        # Batched applies for BACKLOG REPLAY: the source marks events
+        # it pages from journal history (``backlog: 1`` — a resume /
+        # post-partition catch-up, exactly where the ~20/s serial
+        # apply floor hurt).  Those buffer and flush as one
+        # replicate_batch pass: grouped per directory, coalesced per
+        # path (a replayed create superseded by a later delete in the
+        # same window never applies at all), bounded concurrency.
+        # Live-tail events apply IMMEDIATELY, one at a time — zero
+        # added replication latency; the first live event (or a ping)
+        # flushes any backlog tail.  The offset advances ONLY after a
+        # buffered event's batch applied, so a crash mid-batch replays
+        # it, never skips it.
+        cache = self.sink.fid_cache
+        if hasattr(cache, "load"):
+            # warm the persisted dedup map (one RPC, first connect
+            # only): what stops a restarted daemon re-copying chunk
+            # bytes for events it already applied
+            cache.load()
+        pending: list[dict] = []
+
+        def flush() -> None:
+            nonlocal applied, last_off, unsaved
+            if not pending:
+                return
+            batch, offs = list(pending), [m.get("offset", 0)
+                                          for m in pending]
+            pending.clear()
+            flags = self.replicator.replicate_batch(batch)
+            now = time.time()
+            for msg, ok in zip(batch, flags):
+                if ok:
+                    applied += 1
+                    self.applied += 1
+                    if msg.get("ts_ns"):
+                        if len(self.lag_samples) >= 4096:
+                            del self.lag_samples[:2048]
+                        self.lag_samples.append(
+                            now - msg["ts_ns"] / 1e9)
+            real = [o for o in offs if o]
+            if real:
+                last_off = real[-1]
+                self.last_offset = last_off
+                unsaved += len(real)
+                # persist periodically, not per event; a crash replays
+                # at most the unsaved window (applies are idempotent
+                # and LWW/tombstone-guarded)
+                if unsaved >= OFFSET_SAVE_EVERY:
+                    self._save_offset(last_off)
+                    if hasattr(cache, "save"):
+                        cache.save()
+                    unsaved = 0
+
         try:
             for msg in client.stream(
                     "SubscribeLocalMetadata",
@@ -203,40 +383,38 @@ class SyncDirection:
                     # the journal tail for lag accounting (never saved
                     # as a consumed offset — only applied events
                     # advance that)
+                    flush()
                     self.source_tail = max(self.source_tail,
                                            msg.get("last_offset", 0))
                     if until_ping:
                         break
                     if unsaved and last_off > since:
                         self._save_offset(last_off)
+                        if hasattr(cache, "save"):
+                            cache.save()
                         unsaved = 0
                     self.last_offset = last_off
                     continue
-                if self.replicator.replicate(msg):
-                    applied += 1
-                    self.applied += 1
-                    if msg.get("ts_ns"):
-                        if len(self.lag_samples) >= 4096:
-                            del self.lag_samples[:2048]
-                        self.lag_samples.append(
-                            time.time() - msg["ts_ns"] / 1e9)
-                off = msg.get("offset", 0)
-                if off:
-                    last_off = off
-                    self.last_offset = off
-                    unsaved += 1
-                    # persist periodically, not per event; a crash
-                    # replays at most the unsaved window (applies are
-                    # idempotent and LWW/tombstone-guarded)
-                    if unsaved >= OFFSET_SAVE_EVERY:
-                        self._save_offset(last_off)
-                        unsaved = 0
+                pending.append(msg)
+                # backlog-marked events buffer up to BATCH_APPLY; live
+                # events apply NOW (flushing any backlog tail ahead of
+                # them, order preserved).  max_events callers (tests)
+                # need exact counts, so the cap forces event-boundary
+                # applies.
+                if not msg.get("backlog") \
+                        or len(pending) >= BATCH_APPLY or max_events:
+                    flush()
                 if max_events and applied >= max_events:
                     break
         finally:
-            if unsaved and last_off > since:
-                self._save_offset(last_off)
-            self.last_offset = last_off
+            try:
+                flush()
+            finally:
+                if unsaved and last_off > since:
+                    self._save_offset(last_off)
+                if hasattr(cache, "save"):
+                    cache.save()
+                self.last_offset = last_off
         return applied
 
     def start(self) -> None:

@@ -57,7 +57,8 @@ class SimCluster:
                  repair_interval: float = 0.0,
                  repair: "dict | None" = None,
                  filer_store: str = "memory",
-                 filer_journal: bool = True):
+                 filer_journal: bool = True,
+                 volume_workers: int = 1):
         # self-healing loop (master/repair.py): off by default so kill/
         # partition tests observe raw degradation; chaos-convergence
         # tests turn it on with tight knobs via `repair={...}`
@@ -102,6 +103,9 @@ class SimCluster:
         # resume tokens surviving
         self._filer_store = filer_store
         self._filer_journal = filer_journal
+        # >1: each volume server becomes a supervisor over that many
+        # worker subprocesses sharing its data port (ISSUE 12)
+        self.volume_workers = max(1, int(volume_workers))
         self._filer_ports: list[tuple[int, int]] = []
         self.filers: "list[FilerServer | None]" = []
         self.s3_server: "S3ApiServer | None" = None
@@ -115,6 +119,17 @@ class SimCluster:
             repair_interval=self._repair_interval, repair=self._repair)
 
     def _make_vs(self, i: int) -> VolumeServer:
+        if self.volume_workers > 1:
+            # process-sharded data plane: REAL worker subprocesses
+            # behind one logical volume server (volume_server/workers)
+            from ..volume_server.workers import ShardedVolumeServer
+            return ShardedVolumeServer(
+                self._master_list(), [self._vs_dirs[i]],
+                rack=f"rack{i % self.racks}",
+                pulse_seconds=self.pulse,
+                max_volume_counts=[self.max_volumes],
+                jwt_signing_key=self.jwt_key,
+                workers=self.volume_workers)
         return VolumeServer(
             self._master_list(), [self._vs_dirs[i]],
             rack=f"rack{i % self.racks}", pulse_seconds=self.pulse,
@@ -460,6 +475,20 @@ class SimCluster:
         vs.start()
         self.volume_servers[i] = vs
         return vs
+
+    def kill_volume_worker(self, i: int, worker: int) -> int:
+        """SIGKILL one worker subprocess of sharded volume server i —
+        the supervisor's monitor loop respawns it on the same ports.
+        Returns the killed pid (pass to wait_volume_worker)."""
+        vs = self.volume_servers[i]
+        assert vs is not None and hasattr(vs, "kill_worker"), \
+            "needs volume_workers > 1"
+        return vs.kill_worker(worker)
+
+    def wait_volume_worker(self, i: int, worker: int, old_pid: int,
+                           timeout: float = 30.0) -> None:
+        vs = self.volume_servers[i]
+        vs.wait_worker_restarted(worker, old_pid, timeout=timeout)
 
     def partition_volume_server(self, i: int) -> None:
         """Cut the server's gRPC surface (admin/EC/replication partner

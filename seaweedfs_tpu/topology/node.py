@@ -88,6 +88,11 @@ class DataNode(Node):
         self.port = port
         self.grpc_port = grpc_port
         self.tcp_port = tcp_port    # raw-TCP data fast path (0 = off)
+        # process-sharded nodes advertise a PER-VOLUME frame port (the
+        # owning worker's) in their heartbeat volume entries; lookups
+        # and assigns prefer it over the node-level tcp_port so clients
+        # hit the right worker without a forward hop
+        self.volume_tcp_ports: dict[int, int] = {}
         self.public_url = public_url or f"{ip}:{port}"
         self.max_volumes = max_volumes
         self.volumes: dict[int, VolumeInfo] = {}

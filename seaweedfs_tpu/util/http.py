@@ -197,6 +197,10 @@ class HttpServer:
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # additional listening sockets sharing this route table — the
+        # process-sharded volume workers serve the SAME handlers on the
+        # cluster-shared SO_REUSEPORT socket and their private port
+        self._extra_socks: list[socket.socket] = []
         # live connections, closed on stop() so clients holding pooled
         # keep-alive sockets see a real FIN instead of a dead peer
         self._conns: set[socket.socket] = set()
@@ -221,13 +225,37 @@ class HttpServer:
         self._thread.start()
         return self.port
 
+    def add_listener(self, sock: socket.socket) -> None:
+        """Serve this route table on an ALREADY bound+listening socket
+        too (a second accept loop).  The caller owns binding policy —
+        this is how a volume worker joins the cluster-shared
+        SO_REUSEPORT data port next to its private one."""
+        self._extra_socks.append(sock)
+        threading.Thread(target=self._accept_loop, args=(sock,),
+                         daemon=True, name="http-accept-extra").start()
+
+    def serve_socket(self, conn: socket.socket, addr=None) -> None:
+        """Adopt an externally-accepted connection into the serving loop
+        (the accept-and-pass worker fallback: the supervisor accepts on
+        the shared port and hands connected fds to workers over
+        socket.send_fds)."""
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError as e:
+            LOG.debug("nodelay on adopted socket failed: %s", e)
+        with self._conns_lock:
+            self._conns.add(conn)
+        threading.Thread(target=self._serve_conn,
+                         args=(conn, addr or ("", 0)),
+                         daemon=True).start()
+
     def stop(self) -> None:
         self._stop.set()
         # shutdown() BEFORE close(): a thread blocked in accept()/recv()
         # holds a reference to the open file description, so close()
         # alone neither wakes it nor releases the port — shutdown wakes
         # the blocked syscall and flushes a FIN to keep-alive peers
-        for s in [self._sock]:
+        for s in [self._sock] + self._extra_socks:
             try:
                 s.shutdown(socket.SHUT_RDWR)
             except OSError:
@@ -254,13 +282,14 @@ class HttpServer:
         return f"{self.host}:{self.port}"
 
     # -- accept / serve loops ----------------------------------------------
-    def _accept_loop(self) -> None:
+    def _accept_loop(self, sock: "socket.socket | None" = None) -> None:
         from .retry import RetryPolicy
+        listener = sock if sock is not None else self._sock
         backoff = RetryPolicy(base_delay=0.05, max_delay=1.0)
         failures = 0
         while not self._stop.is_set():
             try:
-                conn, addr = self._sock.accept()
+                conn, addr = listener.accept()
                 failures = 0
             except OSError as e:
                 if self._stop.is_set():

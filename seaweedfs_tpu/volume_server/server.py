@@ -85,7 +85,13 @@ class VolumeServer:
                  public_url: str = "", data_center: str = "", rack: str = "",
                  max_volume_counts: list[int] | None = None,
                  pulse_seconds: float = PULSE_SECONDS,
-                 jwt_signing_key: str = ""):
+                 jwt_signing_key: str = "", tcp_port: int = 0,
+                 worker=None):
+        # worker: a WorkerContext (volume_server/workers.py) when this
+        # server is one partition of a process-sharded logical node —
+        # requests for vids outside the partition forward to the owning
+        # sibling, and /status+/metrics proxy to the supervisor's merge
+        self._worker = worker
         # master_grpc may be a comma-separated list; heartbeats rotate
         # through it and re-home to whatever leader the replies announce
         self._masters = [m.strip() for m in master_grpc.split(",")
@@ -132,7 +138,7 @@ class VolumeServer:
         self._register_rpc()
         self._public_url = public_url
         from .tcp import TcpDataServer
-        self.tcp = TcpDataServer(self, host=host)
+        self.tcp = TcpDataServer(self, host=host, port=tcp_port)
         # persistent replica fan-out pool: the previous design spawned
         # one thread PER WRITE PER REPLICA — thread creation cost on
         # every replicated write, and each thread's fresh TCP connection
@@ -272,9 +278,73 @@ class VolumeServer:
         from ..util import profiling
         self.http.route("GET", "/debug/profile",
                         profiling.profile_http_handler())
+        if self._worker is not None:
+            # the supervisor's heartbeat_now pulls a fresh partition
+            # snapshot through this before pushing the merged payload
+            self.http.route("POST", "/heartbeat_now",
+                            self._http_heartbeat_now, exact=True)
         self.http.route("*", "/", self._http_data)
 
+    def _http_heartbeat_now(self, req: Request) -> Response:
+        self.heartbeat_now(timeout=3.0)
+        return Response.json({"ok": True})
+
+    # -- worker-partition plumbing (volume_server/workers.py) -------------
+    def _owns_vid(self, vid: int) -> bool:
+        return self._worker is None or self._worker.owns(vid)
+
+    def _forward_to_owner(self, req: Request, fid: FileId) -> Response:
+        """Wrong-worker HTTP request: proxy it to the owning sibling's
+        private port, marked so it can never bounce twice.  The shared
+        SO_REUSEPORT socket load-balances CONNECTIONS, not vids — this
+        is the correctness backstop for clients without the per-vid
+        routing map."""
+        target = self._worker.peer_http_addr(fid.volume_id)
+        qs = urllib.parse.urlencode(
+            [(k, v) for k, vals in req.query.items() for v in vals])
+        url = f"http://{target}{req.path}" + (f"?{qs}" if qs else "")
+        headers = {"X-Weed-Worker-Forward": "1"}
+        for h in ("Content-Encoding", "Authorization",
+                  "Accept-Encoding", "If-None-Match"):
+            if h in req.headers:
+                headers[h] = req.headers[h]
+        try:
+            status, body, rhdrs = http_request(
+                url, method=req.method, body=req.body or None,
+                headers=headers)
+        except (OSError, ConnectionError) as e:
+            self.metrics.volume_errors.inc("forward")
+            return Response.error(f"worker forward failed: {e}", 502)
+        drop = {"content-length", "date", "server", "connection",
+                "transfer-encoding", "content-type"}
+        return Response(
+            status, body,
+            content_type=rhdrs.get("Content-Type",
+                                   "application/octet-stream"),
+            headers={k: v for k, v in rhdrs.items()
+                     if k.lower() not in drop})
+
+    def _proxy_supervisor(self, req: Request, path: str) -> Response:
+        """/status and /metrics on a worker answer for the whole logical
+        node (the supervisor merges every partition); ?worker_local=1
+        asks for just this partition."""
+        try:
+            status, body, rhdrs = http_request(
+                f"http://{self._worker.supervisor_admin}{path}",
+                timeout=10.0)
+        except (OSError, ConnectionError) as e:
+            LOG.warning("supervisor merge proxy failed, serving "
+                        "partition-local %s: %s", path, e)
+            return None  # caller serves its local view
+        return Response(status, body,
+                        content_type=rhdrs.get("Content-Type",
+                                               "text/plain"))
+
     def _http_metrics(self, req: Request) -> Response:
+        if self._worker is not None and not req.qs("worker_local"):
+            merged = self._proxy_supervisor(req, "/metrics")
+            if merged is not None:
+                return merged
         total = sum(len(loc.volumes) for loc in self.store.locations)
         self.metrics.volume_count.set(value=total)
         self.metrics.needle_cache_bytes.set(
@@ -307,6 +377,10 @@ class VolumeServer:
         return None
 
     def _http_status(self, req: Request) -> Response:
+        if self._worker is not None and not req.qs("worker_local"):
+            merged = self._proxy_supervisor(req, "/status")
+            if merged is not None:
+                return merged
         hb = self.store.collect_heartbeat()
         return Response.json({"Version": "seaweedfs-tpu",
                               "Volumes": [vars(v) for v in hb.volumes],
@@ -331,6 +405,10 @@ class VolumeServer:
         kind = self._HTTP_KINDS.get(req.method)
         if kind is None:
             return Response.error("method not allowed", 405)
+        if self._worker is not None \
+                and not self._worker.owns(fid.volume_id) \
+                and not req.headers.get("X-Weed-Worker-Forward"):
+            return self._forward_to_owner(req, fid)
         try:
             if kind == "read":
                 resp = self._read_needle(fid, req)
@@ -538,6 +616,18 @@ class VolumeServer:
         fan-out work is built only when replicas actually exist."""
         t0 = time.time()
         fid = FileId.parse(fid_str)
+        if self._worker is not None \
+                and not self._worker.owns(fid.volume_id):
+            # wrong-worker frame: hand the WHOLE op to the owner (it
+            # runs the jwt gate and, when replicate is unset, the
+            # replica fan-out).  Ownership is vid%N-deterministic, so
+            # this can never bounce twice.
+            from .. import operation
+            out = operation.upload_data_tcp(
+                self._worker.peer_tcp_addr(fid.volume_id), fid_str,
+                body, jwt=jwt, replicate=replicate,
+                compressed=compressed, ttl=ttl)
+            return out["size"], out["eTag"]
         if self.jwt_signing_key:
             from ..security import JwtError, verify_fid_jwt
             try:
@@ -592,6 +682,11 @@ class VolumeServer:
 
     def tcp_read(self, fid_str: str) -> bytes:
         fid = FileId.parse(fid_str)
+        if self._worker is not None \
+                and not self._worker.owns(fid.volume_id):
+            from .. import operation
+            return operation.read_file_tcp(
+                self._worker.peer_tcp_addr(fid.volume_id), fid_str)
         # hot path: plain volume read with no Request/Response wrapping —
         # 1KB reads are dispatch-bound, and the TCP frame protocol has no
         # use for headers/mime/resize anyway
@@ -650,6 +745,12 @@ class VolumeServer:
     def tcp_delete(self, fid_str: str, jwt: str) -> dict:
         from ..util.http import CIDict
         fid = FileId.parse(fid_str)
+        if self._worker is not None \
+                and not self._worker.owns(fid.volume_id):
+            from .. import operation
+            return operation.delete_file_tcp(
+                self._worker.peer_tcp_addr(fid.volume_id), fid_str,
+                jwt=jwt)
         req = Request(method="DELETE", path="",
                       query={"jwt": [jwt]} if jwt else {},
                       headers=CIDict(), body=b"")
@@ -1037,6 +1138,14 @@ class VolumeServer:
 
     # volume lifecycle
     def _rpc_allocate_volume(self, req: dict) -> dict:
+        if not self._owns_vid(int(req["volume_id"])):
+            # defense in depth: the supervisor routes by vid%N, so a
+            # misrouted allocate means a partition-count mismatch —
+            # creating the volume HERE would strand it invisibly
+            raise RpcError(
+                f"volume {req['volume_id']} belongs to worker "
+                f"{self._worker.owner_of(int(req['volume_id']))}, "
+                f"not {self._worker.index}")
         self.store.add_volume(
             int(req["volume_id"]), req.get("collection", ""),
             replica_placement=req.get("replication") or "000",

@@ -244,10 +244,12 @@ class TcpDataServer:
     """Accept loop + per-connection worker threads over the volume
     server's existing write/read/delete internals."""
 
-    def __init__(self, volume_server, host: str = "127.0.0.1"):
+    def __init__(self, volume_server, host: str = "127.0.0.1",
+                 port: int = 0):
         self.vs = volume_server
         self.host = host
         self.port = 0
+        self._requested_port = port  # 0 = ephemeral; workers pin theirs
         self._sock: socket.socket | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -258,7 +260,7 @@ class TcpDataServer:
         must not squat a listening socket)."""
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((self.host, 0))
+        self._sock.bind((self.host, self._requested_port))
         self._sock.listen(128)
         self.port = self._sock.getsockname()[1]
         self._thread = threading.Thread(target=self._accept_loop,

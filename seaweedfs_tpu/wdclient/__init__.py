@@ -90,14 +90,22 @@ class MasterClient:
             return
         entry = {"url": loc["url"], "public_url": loc.get("public_url", ""),
                  "grpc_port": loc.get("grpc_port", 0)}
+        host = loc["url"].rsplit(":", 1)[0]
         if loc.get("tcp_port"):
-            host = loc["url"].rsplit(":", 1)[0]
             entry["tcp_url"] = f"{host}:{loc['tcp_port']}"
+        # process-sharded nodes carry per-volume frame ports: the
+        # owning worker's port beats the node-level fallback, so frame
+        # reads hit the right worker without a forward hop
+        vid_ports = loc.get("vid_tcp_ports") or {}
         with self._lock:
             for vid in loc.get("new_vids", []):
+                e = entry
+                if str(vid) in vid_ports:
+                    e = dict(entry,
+                             tcp_url=f"{host}:{vid_ports[str(vid)]}")
                 lst = self._vid_map.setdefault(int(vid), [])
-                if entry not in lst:
-                    lst.append(entry)
+                if e not in lst:
+                    lst.append(e)
                 # a fresh stream-fed location supersedes any RPC-cached
                 # answer — ESPECIALLY a negative one: a repaired volume
                 # must serve immediately, not after the negative TTL

@@ -231,9 +231,14 @@ def test_tombstone_blocks_replayed_create(two_clusters, tmp_path):
     d.run_once()
     assert get(b, "/docs/ghost.txt")[0] == 404
     # stale replay from offset 0 (lost offset file): the tombstone on B
-    # blocks the old create from resurrecting the entry
+    # blocks the old create from resurrecting the entry.  max_events=1
+    # delivers the create ALONE — in a full-batch replay the
+    # per-path coalescer would collapse create+delete to just the
+    # delete (same final state, but the tombstone guard is what this
+    # test pins, for the window where the stale create arrives without
+    # its delete)
     save_offset_file(d.offset_path, 0)
-    d.run_once()
+    d.run_once(max_events=1)
     assert get(b, "/docs/ghost.txt")[0] == 404
     assert d.sink.stats["tomb_skipped"] >= 1
 
@@ -251,6 +256,87 @@ def test_chunk_dedup_on_replay(two_clusters, tmp_path):
     d.run_once()
     assert d.sink.stats["chunks_copied"] == copied
     assert d.sink.stats["chunks_deduped"] >= 1
+
+
+def test_chunk_dedup_survives_daemon_restart(two_clusters, tmp_path):
+    """ISSUE 12 satellite: the {src_fid: dst_fid} dedup map persists in
+    the TARGET KV, so a brand-new sync daemon (fresh process, empty
+    in-memory cache) replaying already-shipped events copies ZERO chunk
+    bytes."""
+    a, b = two_clusters
+    put(a, "/docs/restart.bin", os.urandom(4000))
+    d = _direction(a, b, tmp_path, tag="restart")
+    d.run_once()
+    copied = d.sink.stats["chunks_copied"]
+    assert copied >= 1
+    # "restart": a NEW SyncDirection — its FilerSink starts with an
+    # empty overlay; only the KV-persisted map can remember the fids
+    d2 = _direction(a, b, tmp_path, tag="restart")
+    save_offset_file(d2.offset_path, 0)   # full idempotent replay
+    d2.run_once()
+    assert d2.sink.stats["chunks_copied"] == 0
+    assert d2.sink.stats["chunks_deduped"] >= 1
+    assert d2.sink.fid_cache.kv_hits >= 1
+    # convergence sanity: the replayed entry still reads back whole
+    assert wait_converged(a, b)
+
+
+def test_stale_persisted_dedup_entry_recopy_not_resurrect(
+        two_clusters, tmp_path):
+    """A persisted dedup entry can outlive its target chunk (vacuum /
+    delete reclaimed the fid after the map blob was saved).  A fresh
+    daemon must VERIFY a loaded entry on first reuse and fall back to
+    re-copying — never create an entry pointing at a reclaimed fid."""
+    import json as _json
+
+    from seaweedfs_tpu.pb.rpc import POOL, to_b64
+    a, b = two_clusters
+    put(a, "/docs/stale.bin", os.urandom(3000))
+    d = _direction(a, b, tmp_path, tag="stale")
+    d.run_once()
+    assert d.sink.stats["chunks_copied"] >= 1
+    # corrupt the persisted map: point every src fid at a fid the
+    # target never stored (the reclaimed-chunk shape)
+    cache = d.sink.fid_cache
+    bogus = {src: "9999,deadbeef00" for src in cache._local}
+    POOL.client(b.filers[0].grpc_address, "SeaweedFiler").call(
+        "KvPut", {"key": to_b64(cache._key),
+                  "value": to_b64(_json.dumps(bogus).encode())})
+    d2 = _direction(a, b, tmp_path, tag="stale")
+    save_offset_file(d2.offset_path, 0)
+    d2.run_once()
+    # the bogus entries failed verification and were re-copied
+    assert d2.sink.stats["chunks_copied"] >= 1
+    assert d2.sink.stats["chunks_deduped"] == 0
+    assert wait_converged(a, b)
+
+
+def test_batched_apply_preserves_order_and_state(two_clusters,
+                                                 tmp_path):
+    """ISSUE 12 satellite: per-directory batched applies (coalesce per
+    path, bounded concurrency) must land the same final state as the
+    serial path — including a rewrite burst and a delete-then-recreate
+    in one batch window."""
+    a, b = two_clusters
+    for i in range(8):
+        put(a, f"/docs/batch/f{i}.txt", b"v1-%d" % i)
+    for i in range(8):
+        put(a, f"/docs/batch/f{i}.txt", b"v2-%d" % i)   # rewrite burst
+    put(a, "/docs/batch/gone.txt", b"temp")
+    st, _, _ = http_request(
+        f"http://{a.filers[0].address}/docs/batch/gone.txt",
+        method="DELETE")
+    assert st in (200, 202, 204)
+    put(a, "/docs/batch/gone.txt", b"reborn")  # delete then recreate
+    d = _direction(a, b, tmp_path, tag="batch")
+    d.run_once()
+    digest = wait_converged(a, b)
+    assert any(p.endswith("gone.txt") for p in digest)
+    s, body, _ = get(b, "/docs/batch/gone.txt")
+    assert s == 200 and body == b"reborn"
+    for i in range(8):
+        s, body, _ = get(b, f"/docs/batch/f{i}.txt")
+        assert s == 200 and body == b"v2-%d" % i
 
 
 def test_active_active_echo_suppression(two_clusters, tmp_path):

@@ -88,6 +88,23 @@ def test_bad_journal_fixture():
     assert got == [("WL100", 8), ("WL100", 12), ("WL100", 17)]
 
 
+def test_bad_forksafety_fixture():
+    got = _ids_lines(_findings(os.path.join(FIXTURES,
+                                            "bad_forksafety.py")))
+    assert got == [("WL110", 6), ("WL110", 10), ("WL110", 16),
+                   ("WL110", 23), ("WL110", 29), ("WL110", 31)]
+
+
+def test_volume_server_fork_safety_is_clean():
+    """The process-sharded worker plane (ISSUE 12) holds the WL110
+    contract with ZERO baselined exceptions: no forks, no fork-default
+    multiprocessing, no supervisor/worker-shared module mutables."""
+    from tools.weedlint import analyze_paths as _ap
+    target = os.path.join(PACKAGE, "volume_server")
+    got = [f for f in _ap([target]) if f.checker == "WL110"]
+    assert got == [], "\n".join(f.render() for f in got)
+
+
 def test_filer_module_journal_discipline_is_clean():
     """The live Filer holds the WL100 contract with ZERO baselined
     exceptions: every store mutation emits its metadata event."""
@@ -194,5 +211,6 @@ def test_cli_list_checkers():
     assert r.returncode == 0
     for cid in ("WL001", "WL002", "WL010", "WL011", "WL012",
                 "WL020", "WL021", "WL022", "WL030", "WL040",
-                "WL050", "WL060", "WL080", "WL090", "WL100"):
+                "WL050", "WL060", "WL080", "WL090", "WL100",
+                "WL110"):
         assert cid in r.stdout

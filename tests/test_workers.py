@@ -1,0 +1,177 @@
+"""Worker-partition coherence matrix (ISSUE 12): the process-sharded
+volume data plane must be indistinguishable from a single-process
+server to every client.
+
+- write lands on its vid's owner; a read through the WRONG worker's
+  private HTTP or TCP port forwards to the owner and returns the bytes;
+- the master sees ONE logical DataNode whose volume list is the union
+  of the partitions, with per-volume tcp routing to the owning worker;
+- a SIGKILL'd worker respawns on the same ports with ZERO acked loss;
+- the SO_REUSEPORT-unavailable fallback (supervisor accept-and-pass
+  over socket.send_fds) serves the same traffic;
+- volume_workers=1 keeps the plain in-process VolumeServer —
+  byte-identical behavior to today.
+"""
+
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.testing import SimCluster
+from seaweedfs_tpu.util.http import http_request
+from seaweedfs_tpu.volume_server import VolumeServer
+from seaweedfs_tpu.volume_server.workers import ShardedVolumeServer
+
+
+@pytest.fixture(scope="module")
+def sharded():
+    """One 2-worker sharded cluster shared by the read-path tests
+    (worker subprocess boots are the expensive part)."""
+    c = SimCluster(masters=1, volume_servers=1, volume_workers=2,
+                   pulse_seconds=0.4).start()
+    yield c
+    c.stop()
+
+
+def _upload_some(c, n, tag=b"blob"):
+    fids = []
+    for i in range(n):
+        fids.append(c.upload(tag + b"-%d" % i))
+    return fids
+
+
+def test_partition_write_read_any_worker(sharded):
+    """Write through the normal flow, then read every fid through BOTH
+    workers' private ports AND the shared port — wrong-worker requests
+    must forward, not 404."""
+    c = sharded
+    vs = c.volume_servers[0]
+    assert isinstance(vs, ShardedVolumeServer)
+    fids = _upload_some(c, 12, b"coh")
+    vids = {int(f.split(",")[0]) for f in fids}
+    assert len(vids) > 1, "need volumes in both partitions"
+    for i, fid in enumerate(fids):
+        want = b"coh-%d" % i
+        for addr in (vs.worker_http_addr(0), vs.worker_http_addr(1),
+                     vs.url):
+            status, body, _ = http_request(f"http://{addr}/{fid}")
+            assert status == 200, (addr, fid, status, body)
+            assert body == want
+
+
+def test_wrong_worker_tcp_forward(sharded):
+    """The frame path forwards too: a read sent to the non-owner's tcp
+    port returns the needle via the owner."""
+    c = sharded
+    vs = c.volume_servers[0]
+    fids = _upload_some(c, 6, b"tcp")
+    for i, fid in enumerate(fids):
+        vid = int(fid.split(",", 1)[0])
+        wrong = (vid + 1) % vs.workers
+        got = operation.read_file_tcp(vs.worker_tcp_addr(wrong), fid)
+        assert got == b"tcp-%d" % i
+
+
+def test_heartbeat_aggregation_single_logical_node(sharded):
+    """The master must see ONE DataNode: union volume list, summed
+    capacity, and per-volume tcp routing to the owning worker."""
+    c = sharded
+    vs = c.volume_servers[0]
+    c.sync_heartbeats()
+    m = c.masters[0]
+    nodes = m.topo.data_nodes()
+    assert len(nodes) == 1
+    dn = nodes[0]
+    assert dn.id == vs.url          # the SHARED data address
+    assert dn.grpc_port == vs.rpc.port
+    assert dn.max_volumes == c.max_volumes  # summed worker capacity
+    assert dn.volumes, "no volumes registered"
+    for vid in dn.volumes:
+        owner = vid % vs.workers
+        assert dn.volume_tcp_ports[vid] == \
+            vs.status()["ports"][owner]["tcp"], \
+            f"vid {vid} routed to the wrong worker"
+    # lookups hand clients the OWNER's frame port
+    for vid in list(dn.volumes)[:4]:
+        locs = operation.lookup_volume(c.master_grpc, vid)
+        assert locs and locs[0]["tcp_url"] == vs.worker_tcp_addr(
+            vid % vs.workers)
+        assert locs[0]["url"] == vs.url
+
+
+def test_merged_status_and_metrics(sharded):
+    """/status and /metrics on the shared port answer for the WHOLE
+    logical node (supervisor merge), per-partition views stay reachable
+    with ?worker_local=1."""
+    c = sharded
+    vs = c.volume_servers[0]
+    status, body, _ = http_request(f"http://{vs.url}/status")
+    assert status == 200
+    merged = json.loads(body)
+    assert merged["Workers"]["workers"] == 2
+    status, body, _ = http_request(
+        f"http://{vs.worker_http_addr(0)}/status?worker_local=1")
+    local = json.loads(body)
+    assert len(local["Volumes"]) < len(merged["Volumes"])
+    # every vid in the merged view belongs to exactly one partition
+    merged_vids = sorted(v["id"] for v in merged["Volumes"])
+    assert len(merged_vids) == len(set(merged_vids))
+    status, body, _ = http_request(f"http://{vs.url}/metrics")
+    assert status == 200
+    text = body.decode()
+    assert 'seaweedfs_volume_worker_up{worker="0"} 1' in text
+    assert 'seaweedfs_volume_worker_up{worker="1"} 1' in text
+
+
+def test_worker_crash_respawn_zero_acked_loss(tmp_path):
+    """SIGKILL one worker mid-life: the supervisor respawns it on the
+    same ports and every previously-acked write reads back."""
+    with SimCluster(masters=1, volume_servers=1, volume_workers=2,
+                    pulse_seconds=0.4,
+                    base_dir=str(tmp_path / "crash")) as c:
+        vs = c.volume_servers[0]
+        fids = _upload_some(c, 30, b"acked")
+        pid = c.kill_volume_worker(0, 1)
+        c.wait_volume_worker(0, 1, pid)
+        assert vs.restarts.get(1) == 1
+        for i, fid in enumerate(fids):
+            assert c.read(fid) == b"acked-%d" % i, f"lost {fid}"
+        # the respawned partition still takes NEW writes
+        fid = c.upload(b"post-crash")
+        assert c.read(fid) == b"post-crash"
+
+
+def test_reuseport_unavailable_fallback(tmp_path, monkeypatch):
+    """WEED_VOLUME_REUSEPORT=0 forces the accept-and-pass path: the
+    supervisor accepts on the shared port and passes fds to workers
+    over socket.send_fds — same traffic, same answers."""
+    monkeypatch.setenv("WEED_VOLUME_REUSEPORT", "0")
+    with SimCluster(masters=1, volume_servers=1, volume_workers=2,
+                    pulse_seconds=0.4,
+                    base_dir=str(tmp_path / "fb")) as c:
+        vs = c.volume_servers[0]
+        assert vs.status()["fallback"] == "send_fds"
+        fids = _upload_some(c, 8, b"fb")
+        for i, fid in enumerate(fids):
+            assert c.read(fid) == b"fb-%d" % i
+        # shared-port requests flow through the fd pass
+        status, _, _ = http_request(f"http://{vs.url}/status")
+        assert status == 200
+        status, body, _ = http_request(f"http://{vs.url}/{fids[0]}")
+        assert status == 200 and body == b"fb-0"
+
+
+def test_workers_one_is_plain_volume_server():
+    """volume_workers=1 (the default) must construct the unchanged
+    in-process VolumeServer — byte-identical single-process behavior."""
+    c = SimCluster(masters=1, volume_servers=1)
+    try:
+        vs = c._make_vs(0)
+        assert type(vs) is VolumeServer
+    finally:
+        # never started; nothing to stop beyond constructed servers
+        vs.store.close()
+        for m in c.masters:
+            m.stop()

@@ -1,0 +1,41 @@
+"""WL110 fixture: fork-safety violations in the worker plane."""
+import multiprocessing
+import os
+import threading
+
+_SHARED_ROUTES = {}
+
+
+def plain_fork():
+    return os.fork()
+
+
+def thread_then_fork():
+    t = threading.Thread(target=print)
+    t.start()
+    if os.fork() == 0:
+        os._exit(0)
+
+
+def lock_then_fork(lock):
+    lock.acquire()
+    try:
+        return os.fork()
+    finally:
+        lock.release()
+
+
+def mp_default_context():
+    p = multiprocessing.Process(target=print)
+    p.start()
+    return multiprocessing.get_context("fork")
+
+
+class WorkerSupervisor:
+    def route(self):
+        return _SHARED_ROUTES
+
+
+def worker_main():
+    _SHARED_ROUTES["x"] = 1
+    return _SHARED_ROUTES

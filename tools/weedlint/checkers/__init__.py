@@ -11,3 +11,4 @@ from . import leadership   # noqa: F401
 from . import s3authz      # noqa: F401
 from . import metricshygiene  # noqa: F401
 from . import journal      # noqa: F401
+from . import forksafety   # noqa: F401

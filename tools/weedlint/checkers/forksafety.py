@@ -1,0 +1,171 @@
+"""WL110 fork-safety — the process-sharded volume plane must spawn
+fresh interpreters, never fork a threaded server.
+
+ISSUE 12 sharded the volume data plane across worker PROCESSES
+(volume_server/workers.py).  ``os.fork`` of a server that already runs
+threads is the classic deadlock factory: the child inherits every held
+lock with no thread left to release it, and module-level mutable state
+silently diverges between supervisor and worker (each process mutates
+its own copy while the code reads as if they shared one).  The
+discipline the supervisor follows — and this checker enforces over
+``volume_server/`` — is:
+
+- no ``os.fork``/``os.forkpty`` at all (spawn via subprocess/exec);
+  forking AFTER creating threads or while holding a lock gets the
+  sharper message, but a bare fork in the serving plane is flagged too;
+- no fork-default ``multiprocessing`` primitives
+  (``multiprocessing.Process``/``Pool`` or ``get_context("fork")``) —
+  on Linux they fork;
+- no module-level mutable container reached from BOTH a supervisor
+  scope and a worker scope (name-based: a class/function whose name
+  mentions supervisor vs one that mentions worker): after the spawn
+  each process has a private copy, so "shared" state there is a lie.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .. import Finding, ModuleContext, register
+from ..astutil import dotted_name
+
+_SCOPE_PARTS = ("seaweedfs_tpu/volume_server/",)
+_FORKS = {"os.fork", "os.forkpty"}
+_MP_FORKERS = {"multiprocessing.Process", "multiprocessing.Pool"}
+
+
+def _in_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(part in p for part in _SCOPE_PARTS) \
+        or "weedlint_fixtures" in p
+
+
+def _is_fork(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and dotted_name(node.func) in _FORKS
+
+
+def _is_thread_create(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) \
+        and dotted_name(node.func).endswith("Thread")
+
+
+def _is_lock_acquire(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) \
+        and isinstance(node.func, ast.Attribute) \
+        and node.func.attr == "acquire"
+
+
+def _mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set)):
+        return True
+    return isinstance(node, ast.Call) \
+        and dotted_name(node.func) in ("dict", "list", "set")
+
+
+@register("WL110", "fork-safety")
+def check_fork_safety(ctx: ModuleContext) -> Iterator[Finding]:
+    if not _in_scope(ctx.path):
+        return
+    yield from _check_forks(ctx)
+    yield from _check_multiprocessing(ctx)
+    yield from _check_shared_module_state(ctx)
+
+
+def _check_forks(ctx: ModuleContext) -> Iterator[Finding]:
+    seen: set[tuple[int, int]] = set()
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        pre = [n.lineno for n in ast.walk(fn)
+               if _is_thread_create(n) or _is_lock_acquire(n)]
+        for call in ast.walk(fn):
+            if not _is_fork(call):
+                continue
+            key = (call.lineno, call.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            if any(line <= call.lineno for line in pre):
+                msg = ("thread created or lock acquired before "
+                       f"{dotted_name(call.func)}() — the child "
+                       "inherits held locks with no thread to release "
+                       "them")
+            else:
+                msg = (f"{dotted_name(call.func)}() in the volume "
+                       "serving plane — a forked copy of a threaded "
+                       "server deadlocks on inherited lock state")
+            yield Finding(
+                "WL110", "fork-safety", ctx.path, call.lineno, msg,
+                "spawn a fresh interpreter instead (subprocess / the "
+                "ShardedVolumeServer worker spawn path)")
+    # a fork at module scope (outside any function) is just as wrong
+    for call in ast.walk(ctx.tree):
+        if _is_fork(call) \
+                and (call.lineno, call.col_offset) not in seen:
+            yield Finding(
+                "WL110", "fork-safety", ctx.path, call.lineno,
+                f"{dotted_name(call.func)}() at module scope in the "
+                "volume serving plane",
+                "spawn a fresh interpreter instead (subprocess)")
+
+
+def _check_multiprocessing(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        fork_ctx = name.endswith("get_context") and any(
+            isinstance(a, ast.Constant) and a.value == "fork"
+            for a in node.args)
+        if name in _MP_FORKERS or fork_ctx:
+            yield Finding(
+                "WL110", "fork-safety", ctx.path, node.lineno,
+                f"{name}(...) uses the fork start method on Linux — "
+                "same inherited-lock hazard as os.fork in a threaded "
+                "server",
+                "use subprocess (exec) or an explicit "
+                "get_context('spawn')")
+
+
+def _scope_side(name: str) -> "str | None":
+    low = name.lower()
+    if "supervisor" in low:
+        return "supervisor"
+    if "worker" in low:
+        return "worker"
+    return None
+
+
+def _check_shared_module_state(ctx: ModuleContext) -> Iterator[Finding]:
+    """Module-level mutable containers referenced from both a
+    supervisor-named scope and a worker-named scope: post-spawn each
+    process mutates a PRIVATE copy, so the sharing is illusory."""
+    candidates: dict[str, int] = {}
+    for stmt in ctx.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and _mutable_literal(stmt.value):
+            candidates[stmt.targets[0].id] = stmt.lineno
+    if not candidates:
+        return
+    sides: dict[str, set[str]] = {"supervisor": set(), "worker": set()}
+    for stmt in ctx.tree.body:
+        if not isinstance(stmt, (ast.ClassDef, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        side = _scope_side(stmt.name)
+        if side is None:
+            continue
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Name) and n.id in candidates:
+                sides[side].add(n.id)
+    for name in sorted(sides["supervisor"] & sides["worker"],
+                       key=lambda n: candidates[n]):
+        yield Finding(
+            "WL110", "fork-safety", ctx.path, candidates[name],
+            f"module-level mutable {name!r} is reached from both a "
+            "supervisor scope and a worker scope — across the process "
+            "spawn each side mutates a private copy",
+            "move the state into the supervisor object and ship it to "
+            "workers through the spawn config (or an RPC)")
