@@ -570,6 +570,28 @@ def bench_observability(quick: bool = False, n_files: int = 1500,
             100.0 * (base - max(rates["traced"])) / base, 2)
         out["profiler_overhead_pct"] = round(
             100.0 * (base - max(rates["profiled"])) / base, 2)
+
+        # v3 plane cost (ISSUE 14): a tick = one federated scrape +
+        # history record + alert evaluation.  Overhead is reported the
+        # way the PR 9 sampler budget is — deterministic per-tick cost
+        # times the cadence — because a wall-clock A/B at any cadence
+        # worth running gates on box weather (the true cost here is
+        # single-digit ms per 10s tick; the A/B noise floor on this box
+        # is +-5%).  min-over-ticks: noise only ever adds.
+        plane = cluster.masters[0].plane
+        tick_ms = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            plane.tick()
+            tick_ms.append((time.perf_counter() - t0) * 1000.0)
+        out["history_tick_ms"] = round(min(tick_ms), 2)
+        # alert evaluation alone, straight from the engine's self-gauge
+        out["alert_eval_ms"] = round(
+            plane.alerts.m_eval.value() * 1000.0, 3)
+        interval_ms = plane.interval * 1000.0 if plane.interval > 0 \
+            else 10_000.0                    # production default cadence
+        out["history_scrape_overhead_pct"] = round(
+            100.0 * min(tick_ms) / interval_ms, 3)
     return out
 
 

@@ -47,7 +47,8 @@ def cmd_master(args) -> int:
                      volume_size_limit_mb=args.volume_size_limit_mb,
                      default_replication=args.default_replication,
                      jwt_signing_key=resolve_jwt_key(args.jwt_key),
-                     peers=peers)
+                     peers=peers,
+                     event_dir=getattr(args, "event_dir", "") or None)
     m.start()
     print(f"master http {m.address} grpc {m.grpc_address}")
     _wait_forever()
@@ -581,6 +582,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="HS256 signing key gating volume writes")
     m.add_argument("-peers", default="",
                    help="comma-separated master gRPC addresses for HA")
+    m.add_argument("-eventDir", dest="event_dir", default="",
+                   help="directory for the durable cluster event "
+                        "timeline journal (default: WEED_EVENT_DIR "
+                        "env, else ring-only)")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume", help="start a volume server")

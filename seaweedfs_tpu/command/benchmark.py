@@ -84,10 +84,10 @@ def _mp_write_worker(args) -> tuple[list[float], list[str], int]:
             failed += take
             continue
         for fid in operation.derive_fids(r):
-            t0 = time.time()
+            t0 = time.perf_counter()
             try:
                 operation.upload_to(r, fid, payload)
-                lats.append(time.time() - t0)
+                lats.append(time.perf_counter() - t0)
                 fids.append(fid)
             except Exception:
                 failed += 1
@@ -102,10 +102,10 @@ def _mp_read_worker(args) -> tuple[list[float], int, int]:
     failed = 0
     for _ in range(len(fids)):
         fid = rng.choice(fids)
-        t0 = time.time()
+        t0 = time.perf_counter()
         try:
             data = operation.read_file(master_grpc, fid)
-            lats.append(time.time() - t0)
+            lats.append(time.perf_counter() - t0)
             nbytes += len(data)
         except Exception:
             failed += 1
@@ -125,12 +125,12 @@ def run_benchmark_mp(master_grpc: str, n_files: int = 10000,
     results: dict = {}
     share = [n_files // processes + (1 if i < n_files % processes else 0)
              for i in range(processes)]
-    t0 = time.time()
+    t0 = time.perf_counter()
     with ctx.Pool(processes) as pool:
         outs = pool.map(_mp_write_worker,
                         [(master_grpc, s, file_size, collection,
                           assign_batch) for s in share])
-    wall = time.time() - t0
+    wall = time.perf_counter() - t0
     stats = _Stats()
     fids: list[str] = []
     for lats, worker_fids, failed in outs:
@@ -147,12 +147,12 @@ def run_benchmark_mp(master_grpc: str, n_files: int = 10000,
         chunks = [fids[i * per:(i + 1) * per]
                   for i in range(processes)]
         chunks = [c for c in chunks if c]
-        t0 = time.time()
+        t0 = time.perf_counter()
         with ctx.Pool(len(chunks)) as pool:
             outs = pool.map(_mp_read_worker,
                             [(master_grpc, c, i)
                              for i, c in enumerate(chunks)])
-        wall = time.time() - t0
+        wall = time.perf_counter() - t0
         stats = _Stats()
         for lats, nbytes, failed in outs:
             stats.latencies.extend(lats)
@@ -210,18 +210,18 @@ def run_benchmark(master_grpc: str, n_files: int = 10000,
             # latency percentiles (batch wall / n ≈ avg for every item)
             # and measured no extra throughput (the bound is CPU)
             for fid in operation.derive_fids(r):
-                t0 = time.time()
+                t0 = time.perf_counter()
                 try:
                     operation.upload_to(r, fid, payload)
-                    lats.append(time.time() - t0)
+                    lats.append(time.perf_counter() - t0)
                     my_fids.append(fid)
                 except Exception:
                     stats.fail()
             flush()
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     _run_workers(concurrency, writer)
-    results["write"] = stats.report("write", time.time() - t0)
+    results["write"] = stats.report("write", time.perf_counter() - t0)
     if not quiet:
         _print_report(results["write"], file_size, concurrency)
 
@@ -246,17 +246,17 @@ def run_benchmark(master_grpc: str, n_files: int = 10000,
                 # keeps the latency percentiles real
                 for _ in range(take):
                     fid = r.choice(fids)
-                    t0 = time.time()
+                    t0 = time.perf_counter()
                     try:
                         data = operation.read_file(master_grpc, fid)
-                        lats.append(time.time() - t0)
+                        lats.append(time.perf_counter() - t0)
                         nbytes[0] += len(data)
                     except Exception:
                         stats.fail()
 
-        t0 = time.time()
+        t0 = time.perf_counter()
         _run_workers(concurrency, reader)
-        results["read"] = stats.report("read", time.time() - t0)
+        results["read"] = stats.report("read", time.perf_counter() - t0)
         if not quiet:
             _print_report(results["read"], file_size, concurrency)
     return results

@@ -429,7 +429,7 @@ class FilerServer:
               "HEAD": "read", "DELETE": "delete"}
 
     def _http_dispatch(self, req: Request) -> Response:
-        t0 = time.time()
+        t0 = time.perf_counter()   # monotonic: latency, not timestamp
         path = urllib.parse.unquote(req.path) or "/"
         kind = self._KINDS.get(req.method, "other")
         try:  # finally: handler exceptions (-> 500 upstream) must count
@@ -443,7 +443,7 @@ class FilerServer:
         finally:
             self.metrics.filer_requests.inc(kind)
             self.metrics.filer_latency.observe(
-                kind, value=time.time() - t0,
+                kind, value=time.perf_counter() - t0,
                 trace_id=tracing.current_trace_id())
 
     def _http_write(self, path: str, req: Request) -> Response:

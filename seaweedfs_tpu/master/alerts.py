@@ -1,0 +1,383 @@
+"""Alerting engine — evaluates the observability plane's curated series
+into pending -> firing -> resolved alerts, the voice PR 9's gauges
+never had.
+
+Rules are small and declarative: a rule names one SNAPSHOT SERIES (the
+curated set master/history.py derives from the federated scrape each
+tick — ``slo_error_budget_burn``, ``federation_up``, ...), a comparison,
+a threshold and an optional for-duration.  Every distinct labelset of
+the series is an independent alert INSTANCE with its own state machine
+and dedup key (``rule{label=value,...}``), so a burn on `op=write` and
+one on `op=read` page separately but a flapping instance never
+re-enqueues while already firing.
+
+State machine per instance:
+
+    ok --breach--> pending --for_s elapsed--> firing --clear--> resolved
+         (for_s == 0 goes straight to firing: one evaluation interval
+          is the detection latency ceiling the acceptance test pins)
+
+Every transition is recorded in the durable event timeline
+(master/events.py, ``alert.pending`` / ``alert.firing`` /
+``alert.resolved``, journal-synced) and counted in the
+``seaweedfs_alerts_*`` self-metric families.
+
+Silences mute an alert's contribution to the health rollup (red ->
+yellow) without stopping evaluation: a silenced rule keeps tracking
+state so un-silencing shows the truth immediately.  Patterns are
+substring matches against the dedup key, with a TTL.
+
+Builtin thresholds are env-tunable (WEED_ALERT_*); extra rules load
+from a JSON file named by WEED_ALERT_RULES.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+from ..util.weedlog import logger
+
+LOG = logger(__name__)
+
+_OPS = {
+    ">": lambda v, t: v > t,
+    ">=": lambda v, t: v >= t,
+    "<": lambda v, t: v < t,
+    "<=": lambda v, t: v <= t,
+    "==": lambda v, t: v == t,
+    "!=": lambda v, t: v != t,
+}
+
+# how long a resolved instance stays visible in cluster.alerts before
+# it is forgotten entirely
+RESOLVED_LINGER_S = 600.0
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+@dataclass
+class AlertRule:
+    name: str
+    series: str            # snapshot series name (master/history.py)
+    op: str = ">"
+    threshold: float = 0.0
+    for_s: float = 0.0     # breach must hold this long before firing
+    severity: str = "warning"
+    help: str = ""
+
+    def breached(self, value: float) -> bool:
+        return _OPS.get(self.op, _OPS[">"])(value, self.threshold)
+
+
+def builtin_rules() -> "list[AlertRule]":
+    """The page-worthy defaults, thresholds env-tunable."""
+    return [
+        # the SLO rules read the WINDOWED burn series (per-tick bucket/
+        # counter deltas, master/history.py _windowed_slo) — the
+        # lifetime seaweedfs_slo_* gauges never forget a slow boot or a
+        # long-past incident, so an alert on them could neither stay
+        # quiet on a healthy cluster nor resolve after one
+        AlertRule("slo-error-budget-burn",
+                  "slo_error_budget_burn_window", ">",
+                  _env_f("WEED_ALERT_BURN", 2.0),
+                  _env_f("WEED_ALERT_BURN_FOR_S", 0.0), "critical",
+                  "error-budget burn rate over the SLO availability "
+                  "target (WEED_SLO_AVAILABILITY), this interval"),
+        AlertRule("slo-latency-burn", "slo_p99_burn_window", ">",
+                  _env_f("WEED_ALERT_P99_BURN", 3.0),
+                  _env_f("WEED_ALERT_P99_FOR_S", 0.0), "warning",
+                  "windowed p99 over the per-op latency target "
+                  "(WEED_SLO_<OP>_P99_MS)"),
+        AlertRule("federation-down", "federation_up", "<", 0.5, 0.0,
+                  "critical",
+                  "a registered server stopped answering the federated "
+                  "scrape (tombstoned)"),
+        AlertRule("volumes-readonly", "volumes_readonly", ">",
+                  _env_f("WEED_ALERT_READONLY", 0.0), 0.0, "warning",
+                  "degraded / read-only volume replicas in topology"),
+        AlertRule("repair-queue-deep", "repair_queue_depth", ">",
+                  _env_f("WEED_ALERT_REPAIRQ", 10.0),
+                  _env_f("WEED_ALERT_REPAIRQ_FOR_S", 0.0), "warning",
+                  "repair jobs waiting behind throttle/backoff — the "
+                  "thundering-herd signature under mass churn"),
+        AlertRule("subscriber-overflow", "subscriber_overflow_delta",
+                  ">", 0.0, 0.0, "warning",
+                  "a filer disconnected a metadata subscriber on "
+                  "bounded-queue overflow this interval"),
+        AlertRule("volume-disk-full", "volume_fullness_pct", ">",
+                  _env_f("WEED_ALERT_DISK_PCT", 90.0), 0.0, "critical",
+                  "fullest volume as % of the volume size limit"),
+        AlertRule("node-capacity-full", "node_fullness_pct", ">",
+                  _env_f("WEED_ALERT_NODE_PCT", 95.0), 0.0, "warning",
+                  "fullest node's volume slots as % of max_volumes"),
+    ]
+
+
+def load_rules_file(path: str) -> "list[AlertRule]":
+    """Optional operator rules: a JSON list of AlertRule field dicts.
+    Bad entries are skipped loudly — one typo must not disarm the
+    builtin set."""
+    if not path:
+        return []
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as e:
+        LOG.warning("alert rules file %s unreadable: %s", path, e)
+        return []
+    out = []
+    for i, entry in enumerate(raw if isinstance(raw, list) else []):
+        try:
+            rule = AlertRule(
+                name=str(entry["name"]), series=str(entry["series"]),
+                op=str(entry.get("op", ">")),
+                threshold=float(entry.get("threshold", 0.0)),
+                for_s=float(entry.get("for_s", 0.0)),
+                severity=str(entry.get("severity", "warning")),
+                help=str(entry.get("help", "")))
+            if rule.op not in _OPS:
+                raise ValueError(f"unknown op {rule.op!r}")
+        except (KeyError, TypeError, ValueError) as e:
+            LOG.warning("alert rules file %s entry %d skipped: %s",
+                        path, i, e)
+            continue
+        out.append(rule)
+    return out
+
+
+def _label_str(labels: tuple) -> str:
+    return ",".join(f"{k}={v}" for k, v in labels)
+
+
+def _dedup_key(rule_name: str, labels: tuple) -> str:
+    ls = _label_str(labels)
+    return f"{rule_name}{{{ls}}}" if ls else rule_name
+
+
+class AlertEngine:
+    def __init__(self, registry=None, emit_event=None,
+                 rules: "list[AlertRule] | None" = None,
+                 rules_path: "str | None" = None):
+        self.rules = list(rules) if rules is not None else builtin_rules()
+        self.rules += load_rules_file(
+            rules_path if rules_path is not None
+            else os.environ.get("WEED_ALERT_RULES", ""))
+        self._by_name = {r.name: r for r in self.rules}
+        self._lock = threading.Lock()
+        # (rule_name, labels) -> {"state", "since", "fired_at",
+        #                         "resolved_at", "value"}
+        self._states: dict[tuple, dict] = {}
+        self._silences: dict[str, float] = {}   # pattern -> until ts
+        self.last_eval_ts: float = 0.0
+        self.emit_event = emit_event or (lambda *a, **k: None)
+        if registry is not None:
+            self.m_transitions = registry.counter(
+                "seaweedfs_alerts_transitions_total",
+                "alert state transitions", ["rule", "to"])
+            self.m_firing = registry.gauge(
+                "seaweedfs_alerts_firing",
+                "alert instances currently firing", ["severity"])
+            self.m_pending = registry.gauge(
+                "seaweedfs_alerts_pending",
+                "alert instances waiting out their for-duration")
+            self.m_silences = registry.gauge(
+                "seaweedfs_alerts_silences_active",
+                "unexpired silence patterns")
+            self.m_eval = registry.gauge(
+                "seaweedfs_alerts_eval_seconds",
+                "duration of the last alert evaluation pass")
+        else:
+            self.m_transitions = self.m_firing = self.m_pending = None
+            self.m_silences = self.m_eval = None
+
+    # -- silences ------------------------------------------------------------
+    def silence(self, pattern: str, duration_s: float = 3600.0) -> dict:
+        until = time.time() + max(1.0, duration_s)
+        with self._lock:
+            self._silences[pattern] = until
+        return {"pattern": pattern, "until": until}
+
+    def unsilence(self, pattern: str) -> bool:
+        with self._lock:
+            return self._silences.pop(pattern, None) is not None
+
+    def _silenced_locked(self, key: str, now: float) -> bool:
+        return any(pat in key and until > now
+                   for pat, until in self._silences.items())
+
+    # -- evaluation ----------------------------------------------------------
+    def evaluate(self, snap: "dict[tuple, float]",
+                 now: "float | None" = None) -> list[dict]:
+        """One pass over the snapshot ``{(series, labels): value}``;
+        returns the transitions it caused.  Instances whose series
+        vanished from the snapshot (server tombstone expired, volume
+        trimmed) resolve rather than firing forever on stale data."""
+        now = time.time() if now is None else now
+        p0 = time.perf_counter()
+        transitions: list[dict] = []
+        by_series: dict[str, dict[tuple, float]] = {}
+        for (name, labels), value in snap.items():
+            by_series.setdefault(name, {})[labels] = value
+        with self._lock:
+            for pat in [p for p, until in self._silences.items()
+                        if until <= now]:
+                self._silences.pop(pat, None)
+            for rule in self.rules:
+                instances = by_series.get(rule.series, {})
+                for labels, value in instances.items():
+                    self._eval_instance(rule, labels, value, now,
+                                        transitions)
+                # instances we track but the snapshot no longer carries
+                for key in list(self._states):
+                    if key[0] != rule.name or key[1] in instances:
+                        continue
+                    st = self._states[key]
+                    if st["state"] in ("pending", "firing"):
+                        self._transition(rule, key, st, "resolved", now,
+                                         st.get("value", 0.0),
+                                         transitions, reason="no data")
+            # forget long-resolved instances so the table stays readable
+            for key in [k for k, st in self._states.items()
+                        if st["state"] == "resolved"
+                        and now - st.get("resolved_at", now)
+                        > RESOLVED_LINGER_S]:
+                self._states.pop(key, None)
+            firing = {"critical": 0, "warning": 0, "info": 0}
+            pending = 0
+            for (rname, _labels), st in self._states.items():
+                rule = self._by_name.get(rname)
+                if st["state"] == "firing" and rule is not None:
+                    firing[rule.severity] = \
+                        firing.get(rule.severity, 0) + 1
+                elif st["state"] == "pending":
+                    pending += 1
+            n_silences = len(self._silences)
+            self.last_eval_ts = now
+        if self.m_firing is not None:
+            for sev, n in firing.items():
+                self.m_firing.set(sev, value=float(n))
+            self.m_pending.set(value=float(pending))
+            self.m_silences.set(value=float(n_silences))
+            self.m_eval.set(value=time.perf_counter() - p0)
+        for t in transitions:
+            sev = "info" if t["to"] == "resolved" else t["severity"]
+            self.emit_event(
+                "alert." + t["to"], severity=sev, sync=True,
+                message=f"{t['key']}: value {t['value']:.4g} "
+                        f"{t['op']} {t['threshold']:.4g}"
+                        + (f" ({t['reason']})" if t.get("reason")
+                           else ""),
+                rule=t["rule"], alert_state=t["to"],
+                value=float(t["value"]))
+        return transitions
+
+    def _eval_instance(self, rule: AlertRule, labels: tuple,
+                       value: float, now: float,
+                       transitions: list) -> None:
+        key = (rule.name, labels)
+        st = self._states.get(key)
+        if rule.breached(value):
+            if st is None or st["state"] == "resolved":
+                to = "pending" if rule.for_s > 0 else "firing"
+                st = {"state": "ok", "since": now}
+                self._states[key] = st
+                self._transition(rule, key, st, to, now, value,
+                                 transitions)
+            elif st["state"] == "pending" \
+                    and now - st["since"] >= rule.for_s:
+                self._transition(rule, key, st, "firing", now, value,
+                                 transitions)
+            st["value"] = value
+        elif st is not None and st["state"] in ("pending", "firing"):
+            self._transition(rule, key, st, "resolved", now, value,
+                             transitions)
+
+    def _transition(self, rule: AlertRule, key: tuple, st: dict,
+                    to: str, now: float, value: float,
+                    transitions: list, reason: str = "") -> None:
+        st["state"] = to
+        st["value"] = value
+        if to in ("pending", "firing"):
+            st.setdefault("since", now)
+            if to == "firing":
+                st["fired_at"] = now
+        else:
+            st["resolved_at"] = now
+            st.pop("fired_at", None)
+        if self.m_transitions is not None:
+            self.m_transitions.inc(rule.name, to)
+        transitions.append({
+            "rule": rule.name, "labels": dict(key[1]),
+            "key": _dedup_key(rule.name, key[1]), "to": to,
+            "value": value, "op": rule.op,
+            "threshold": rule.threshold, "severity": rule.severity,
+            "reason": reason,
+        })
+
+    # -- reporting -----------------------------------------------------------
+    def status(self, now: "float | None" = None) -> dict:
+        now = time.time() if now is None else now
+        with self._lock:
+            alerts = []
+            for (rname, labels), st in sorted(self._states.items()):
+                rule = self._by_name.get(rname)
+                key = _dedup_key(rname, labels)
+                alerts.append({
+                    "rule": rname, "labels": dict(labels), "key": key,
+                    "state": st["state"],
+                    "severity": rule.severity if rule else "warning",
+                    "value": st.get("value"),
+                    "since_s": round(now - st.get("since", now), 1),
+                    "silenced": self._silenced_locked(key, now),
+                })
+            return {
+                "alerts": alerts,
+                "silences": {p: round(u - now, 1)
+                             for p, u in self._silences.items()
+                             if u > now},
+                "rules": [{"name": r.name, "series": r.series,
+                           "op": r.op, "threshold": r.threshold,
+                           "for_s": r.for_s, "severity": r.severity}
+                          for r in self.rules],
+                "last_eval_ts": self.last_eval_ts,
+            }
+
+    def health_rollup(self, now: "float | None" = None) \
+            -> "tuple[str, list[str]]":
+        """(status, reasons): red when an unsilenced critical alert is
+        firing, yellow for firing warnings / pending anything /
+        silenced criticals, green otherwise."""
+        now = time.time() if now is None else now
+        status = "green"
+        reasons: list[str] = []
+        with self._lock:
+            for (rname, labels), st in sorted(self._states.items()):
+                if st["state"] not in ("pending", "firing"):
+                    continue
+                rule = self._by_name.get(rname)
+                sev = rule.severity if rule else "warning"
+                key = _dedup_key(rname, labels)
+                silenced = self._silenced_locked(key, now)
+                if st["state"] == "firing" and sev == "critical" \
+                        and not silenced:
+                    status = "red"
+                elif status != "red":
+                    status = "yellow"
+                note = "silenced " if silenced else ""
+                val = st.get("value")
+                val_s = f"{val:.4g}" if isinstance(val, (int, float)) \
+                    else "?"
+                reasons.append(
+                    f"[{sev}] {key}: {st['state']} {note}"
+                    f"(value {val_s}, "
+                    f"{round(now - st.get('since', now), 1)}s)")
+        return status, reasons

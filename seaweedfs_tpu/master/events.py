@@ -1,0 +1,160 @@
+"""Durable cluster event timeline — the master's memory of what
+happened to the fleet.
+
+PR 9 gave the cluster eyes (span trees, federated metrics); load-bearing
+state changes still only existed as log lines that die with the process.
+This module records them as structured events in BOTH an in-memory ring
+(fast queries) and a durable journal — reusing the segmented CRC-framed
+machinery the filer metadata journal built (filer/meta_journal.py), so
+torn-tail healing, batched fsync and size/age retention come for free.
+
+Event shape (one JSON object per journal record):
+
+    {"ts": <epoch s>, "type": "volume.degraded", "severity": "warning",
+     "message": "...", <free-form fields>, "offset": <journal offset>}
+
+Types are dotted and queried by PREFIX ("repair" matches "repair.ok" and
+"repair.failed").  Recorded types:
+
+    master.start        leader.elect / leader.stepdown
+    topology.join / topology.leave          volume.degraded / volume.healed
+    repair.planned / repair.ok / repair.failed
+    worker.respawn      alert.pending / alert.firing / alert.resolved
+
+Emission is append-then-ack: ``emit`` returns only after the journal
+append (single pwrite) succeeded, so every event a caller saw
+acknowledged replays after a master kill+restart.  ``sync=True`` forces
+the fsync too (alert transitions use it; they are rare and paging-
+grade).  A master constructed without a directory keeps the ring only —
+verbs still work, durability is just off.
+
+HA semantics: events are emitted by whichever master observes them —
+in practice the leader, since heartbeats, repair and alert evaluation
+are leader-only.  Each master's journal is local; ``ClusterEvents``
+queries proxy to the current leader, so a failover starts a fresh
+authoritative timeline (the old leader's history survives on its disk
+and returns with it).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+from ..util.weedlog import logger
+
+LOG = logger(__name__)
+
+SEVERITIES = ("info", "warning", "critical")
+
+# master events are tiny and rare next to filer metadata traffic: keep
+# segments small so retention has grain to work with
+DEFAULT_SEGMENT_BYTES = 1 << 20
+
+
+class EventLog:
+    def __init__(self, directory: "str | None" = None,
+                 ring_size: int = 2048):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=ring_size)
+        self._journal = None
+        self.counters = {"emitted": 0, "recovered": 0,
+                         "journal_errors": 0}
+        if directory:
+            from ..filer.meta_journal import MetaJournal
+            try:
+                self._journal = MetaJournal(
+                    directory, segment_max_bytes=DEFAULT_SEGMENT_BYTES)
+                self._recover()
+            except Exception as e:
+                # a master must come up even with a broken event disk;
+                # the timeline degrades to ring-only, loudly
+                LOG.warning("event journal %s unavailable (%s); "
+                            "timeline is ring-only", directory, e)
+                self._journal = None
+
+    def _recover(self) -> None:
+        """Replay the newest ring-full of journaled events into memory
+        so queries answer across a restart without touching disk."""
+        j = self._journal
+        last = j.last_offset
+        if last <= 0:
+            return
+        first = max(j.first_offset, last - (self._ring.maxlen or 1) + 1)
+        for off, payload in j.read(first):
+            try:
+                ev = json.loads(payload)
+            except ValueError:
+                continue   # CRC passed but payload is not ours; skip
+            ev["offset"] = off
+            self._ring.append(ev)
+            self.counters["recovered"] += 1
+
+    # -- write ---------------------------------------------------------------
+    def emit(self, type: str, message: str = "", severity: str = "info",
+             sync: bool = False, **fields) -> dict:
+        """Record one event; returns it (with ``offset`` when durable).
+        The journal append happens before return — an emitted event is a
+        pre-ack'd event."""
+        if severity not in SEVERITIES:
+            severity = "info"
+        ev = {"ts": round(time.time(), 3), "type": str(type),
+              "severity": severity, "message": str(message)}
+        for k, v in fields.items():
+            if k not in ev and isinstance(v, (str, int, float, bool)):
+                ev[k] = v
+        with self._lock:
+            if self._journal is not None:
+                try:
+                    ev["offset"] = self._journal.append(
+                        json.dumps(ev, sort_keys=True).encode(),
+                        sync=sync)
+                except Exception as e:
+                    self.counters["journal_errors"] += 1
+                    # teardown races (a heartbeat stream unwinding
+                    # after master.stop closed the journal) are
+                    # expected; anything else is worth an operator's
+                    # attention
+                    log = LOG.debug if "closed" in str(e) \
+                        else LOG.warning
+                    log("event journal append failed (%s); event kept "
+                        "in ring only: %s", e, ev)
+            self._ring.append(ev)
+            self.counters["emitted"] += 1
+        LOG.info("cluster event %s [%s] %s", ev["type"], severity,
+                 message)
+        return ev
+
+    # -- read ----------------------------------------------------------------
+    def query(self, since: float = 0.0,
+              types: "list[str] | None" = None,
+              limit: int = 200) -> list[dict]:
+        """Newest-last events, filtered by timestamp and type prefix."""
+        with self._lock:
+            events = list(self._ring)
+        if since > 0:
+            events = [e for e in events if e.get("ts", 0) >= since]
+        if types:
+            prefixes = tuple(t for t in types if t)
+            if prefixes:
+                events = [e for e in events
+                          if str(e.get("type", "")).startswith(prefixes)]
+        if limit > 0:
+            events = events[-limit:]
+        return events
+
+    def status(self) -> dict:
+        with self._lock:
+            out = {"ring": len(self._ring),
+                   "ring_capacity": self._ring.maxlen,
+                   "counters": dict(self.counters),
+                   "durable": self._journal is not None}
+        if self._journal is not None:
+            out["journal"] = self._journal.status()
+        return out
+
+    def close(self) -> None:
+        if self._journal is not None:
+            self._journal.close()

@@ -30,7 +30,10 @@ from __future__ import annotations
 
 import threading
 
+from ..util.weedlog import logger
 from .raft import RaftNode, NotLeaderError  # noqa: F401 (re-export)
+
+LOG = logger(__name__)
 
 SEQ_BLOCK = 4096
 
@@ -102,7 +105,15 @@ class HaCoordinator:
             topo.max_volume_id = max(topo.max_volume_id, self.max_vid)
 
     def _on_role_change(self, is_leader: bool) -> None:
+        was = self.master.is_leader
         self.master.is_leader = is_leader
+        if is_leader != was:
+            try:
+                # durable timeline (master/events.py): leadership flips
+                # are the first thing an incident review looks for
+                self.master._on_leadership(is_leader)
+            except Exception as e:
+                LOG.warning("leadership event emit failed: %s", e)
 
     # -- replicated allocators ---------------------------------------------
     def reserve_vid(self) -> int:

@@ -1,0 +1,503 @@
+"""In-master metrics history — a lightweight ring TSDB over a curated
+set of federated series, plus the ObservabilityPlane that fuses it with
+the alerting engine on one leader-driven tick.
+
+PR 9's /cluster/metrics is instantaneous: every scrape forgets the last
+one, `cluster.top` reconstructs rates from two ad-hoc deltas, and
+nothing can answer "what did write p99 look like over the last hour" —
+the question every incident starts with.  This module retains exactly
+the series a master operator reads first, in memory, with STEP-DOWN
+retention: a fine ring for the recent window and coarser rings behind
+it (default 10s x 1h, then 1m x 24h; WEED_HISTORY_LEVELS overrides as
+"step:span,step:span").  A point falling out of a fine ring has already
+been averaged into its coarser bucket on insert, so queries far back in
+time cost the same as queries now and memory is bounded by
+construction: levels * (span/step) points per series.
+
+Curated series (names are the /cluster/history query vocabulary):
+
+    slo_p99_ms{op} slo_p99_burn{op} slo_availability{op}
+    slo_error_budget_burn{op}            (master/observe.py SLO math,
+                                          lifetime — for charts)
+    slo_p99_window_ms{op} slo_p99_burn_window{op}
+    slo_availability_window{op} slo_error_budget_burn_window{op}
+                                         (per-tick deltas — what the
+                                          builtin alert rules read)
+    server_rps{server} server_err_pct{server}   (per-tick counter deltas)
+    federation_up{server}  repair_queue_depth  sync_lag_events
+    volumes_readonly  volume_fullness_pct  node_fullness_pct
+    subscriber_overflow_delta
+
+One ObservabilityPlane tick = ONE federated scrape feeding BOTH
+subsystems: the parsed samples become a history record and the same
+snapshot drives AlertEngine.evaluate — the fused design the alerting
+rules rely on (their series vocabulary IS the snapshot vocabulary).
+The background loop is leader-only (re-checked every iteration, weedlint
+WL070 discipline) on a WEED_HISTORY_INTERVAL_S cadence; followers proxy
+via the ClusterHealth/ClusterHistory RPCs.  ``tick()`` is callable
+synchronously (tests, cluster.health on a loop-less master, bench).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from ..stats import parse_exposition, quantile_from_buckets
+from ..util.weedlog import logger
+from .alerts import AlertEngine
+from .observe import SLO_OPS, slo_targets
+
+LOG = logger(__name__)
+
+DEFAULT_LEVELS = "10:3600,60:86400"
+
+
+def _parse_levels(spec: str) -> "list[tuple[float, float]]":
+    out = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            step_s, span_s = part.split(":")
+            step, span = float(step_s), float(span_s)
+        except ValueError:
+            LOG.warning("bad WEED_HISTORY_LEVELS entry %r; skipped",
+                        part)
+            continue
+        if step > 0 and span >= step:
+            out.append((step, span))
+    out.sort()
+    return out or _parse_levels(DEFAULT_LEVELS)
+
+
+class _Level:
+    __slots__ = ("step", "span", "points", "acc")
+
+    def __init__(self, step: float, span: float):
+        self.step = step
+        self.span = span
+        self.points: deque = deque()   # (bucket_ts, value) sorted
+        self.acc: "list | None" = None  # [bucket_ts, sum, count]
+
+    def add(self, ts: float, value: float) -> None:
+        bucket = ts - (ts % self.step)
+        if self.acc is not None and self.acc[0] != bucket:
+            self._flush()
+        if self.acc is None:
+            self.acc = [bucket, 0.0, 0]
+        self.acc[1] += value
+        self.acc[2] += 1
+        # evict by age against the newest time we have seen
+        floor = bucket - self.span
+        while self.points and self.points[0][0] < floor:
+            self.points.popleft()
+
+    def _flush(self) -> None:
+        bucket, total, count = self.acc
+        self.points.append((bucket, total / max(1, count)))
+        self.acc = None
+
+    def snapshot(self) -> "list[tuple[float, float]]":
+        """Sealed buckets plus the live accumulating one — a range
+        query must see the current partial bucket or the most recent
+        step of history is invisible exactly when it matters."""
+        out = list(self.points)
+        if self.acc is not None:
+            out.append((self.acc[0], self.acc[1] / max(1, self.acc[2])))
+        return out
+
+
+class MetricsHistory:
+    """{series_name: {labels_tuple: [_Level, ...]}} with one lock; all
+    appends come from the plane tick, all reads from query RPCs."""
+
+    def __init__(self, levels: "list[tuple[float, float]] | None" = None):
+        self.levels = levels if levels is not None else _parse_levels(
+            os.environ.get("WEED_HISTORY_LEVELS", DEFAULT_LEVELS))
+        self._series: dict[str, dict[tuple, list]] = {}
+        self._lock = threading.Lock()
+
+    def record(self, ts: float,
+               values: "dict[tuple[str, tuple], float]") -> None:
+        with self._lock:
+            for (name, labels), value in values.items():
+                by_labels = self._series.setdefault(name, {})
+                lvls = by_labels.get(labels)
+                if lvls is None:
+                    lvls = [_Level(s, sp) for s, sp in self.levels]
+                    by_labels[labels] = lvls
+                for lvl in lvls:
+                    lvl.add(ts, float(value))
+
+    @staticmethod
+    def _pick_points(lvls: list, since: float) -> list:
+        """Points from the finest level whose oldest RETAINED point
+        still reaches back to `since` (coarser rings hold the step-down
+        averages of what the fine rings evicted).  When NO level
+        reaches — a cluster younger than the window — every level spans
+        the same range, so answer with whichever holds the most points
+        (the fine ring), not unconditionally the coarsest."""
+        best: "list | None" = None
+        for lvl in lvls:
+            snap = lvl.snapshot()
+            if snap and snap[0][0] <= since:
+                return snap
+            if best is None or len(snap) > len(best):
+                best = snap
+        return best or []
+
+    def query(self, name: str, since: float,
+              until: "float | None" = None,
+              step: float = 0.0) -> "dict[str, list]":
+        """{label_key: [[ts, value], ...]} for one series over
+        [since, until].  `step` >= the chosen level's step re-buckets by
+        averaging (the step-down math, applied once more at read time)."""
+        until = time.time() if until is None else until
+        out: dict[str, list] = {}
+        with self._lock:
+            by_labels = self._series.get(name, {})
+            snap = {labels: self._pick_points(lvls, since)
+                    for labels, lvls in by_labels.items()}
+        for labels, points in snap.items():
+            pts = [(ts, v) for ts, v in points if since <= ts <= until]
+            if step > 0:
+                buckets: dict[float, list] = {}
+                for ts, v in pts:
+                    b = ts - (ts % step)
+                    acc = buckets.setdefault(b, [0.0, 0])
+                    acc[0] += v
+                    acc[1] += 1
+                pts = [(b, acc[0] / acc[1])
+                       for b, acc in sorted(buckets.items())]
+            key = ",".join(f"{k}={v}" for k, v in labels)
+            out[key] = [[round(ts, 3), round(v, 6)] for ts, v in pts]
+        return out
+
+    def names(self) -> list:
+        with self._lock:
+            return sorted(self._series)
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "levels": [{"step": s, "span": sp}
+                           for s, sp in self.levels],
+                "series": {
+                    name: len(by_labels)
+                    for name, by_labels in sorted(self._series.items())},
+                "points": sum(
+                    len(lvl.points) + (1 if lvl.acc else 0)
+                    for by_labels in self._series.values()
+                    for lvls in by_labels.values() for lvl in lvls),
+            }
+
+
+# -- the fused leader tick ---------------------------------------------------
+
+_COUNT_NAMES = {"seaweedfs_volume_request_total",
+                "seaweedfs_filer_request_total",
+                "seaweedfs_master_assign_total",
+                "seaweedfs_master_lookup_total"}
+_ERR_NAMES = {"seaweedfs_volume_request_errors_total",
+              "seaweedfs_master_op_errors_total"}
+_SLO_BUCKETS = {"seaweedfs_volume_request_seconds_bucket",
+                "seaweedfs_master_op_seconds_bucket"}
+_SLO_COUNTS = {"seaweedfs_volume_request_seconds_count",
+               "seaweedfs_master_op_seconds_count"}
+_SLO_ERRORS = {"seaweedfs_volume_request_errors_total",
+               "seaweedfs_master_op_errors_total"}
+_SLO_DIRECT = {
+    "seaweedfs_slo_p99_ms": "slo_p99_ms",
+    "seaweedfs_slo_p99_burn": "slo_p99_burn",
+    "seaweedfs_slo_availability": "slo_availability",
+    "seaweedfs_slo_error_budget_burn": "slo_error_budget_burn",
+}
+
+
+def _default_interval() -> float:
+    try:
+        return float(os.environ.get("WEED_HISTORY_INTERVAL_S", "10"))
+    except ValueError:
+        return 10.0
+
+
+class ObservabilityPlane:
+    """History sampler + alert evaluator behind one federated scrape.
+
+    Construction is cheap and always happens (verbs work with the loop
+    off); the background thread only starts when ``interval > 0`` —
+    production masters default it on via WEED_HISTORY_INTERVAL_S,
+    SimCluster defaults it off so chaos tests' fault budgets are never
+    consumed by a background scrape."""
+
+    def __init__(self, master, interval: "float | None" = None):
+        self.master = master
+        self.interval = _default_interval() if interval is None \
+            else float(interval)
+        self.history = MetricsHistory()
+        self.alerts = AlertEngine(
+            registry=master.metrics.registry,
+            emit_event=getattr(master, "events",
+                               None) and master.events.emit)
+        self._prev_counters: "tuple[float, dict] | None" = None
+        self._prev_slo: "dict | None" = None
+        self._last_tick: float = 0.0
+        self._last_snapshot: "dict[tuple, float]" = {}
+        self._tick_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self.m_tick = master.metrics.registry.gauge(
+            "seaweedfs_history_tick_seconds",
+            "duration of the last history+alert evaluation tick")
+        self.m_points = master.metrics.registry.gauge(
+            "seaweedfs_history_points",
+            "points retained across every history ring")
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        if self.interval <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="observability-plane")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            # leadership re-checked EVERY iteration (WL070): followers
+            # idle — their history comes from the leader over RPC
+            if not self.master.is_leader:
+                continue
+            try:
+                self.tick()
+            except Exception as e:
+                LOG.warning("observability tick failed: %s", e)
+
+    # -- the tick ------------------------------------------------------------
+    def tick(self) -> dict:
+        """One synchronous pass: federated scrape -> curated snapshot ->
+        history record + alert evaluation.  Serialized: a shell-driven
+        health refresh racing the background loop must not double-count
+        counter deltas."""
+        with self._tick_lock:
+            p0 = time.perf_counter()
+            now = time.time()
+            text = self.master.observer.federate_metrics()
+            snap = self._snapshot(parse_exposition(text), now)
+            self.history.record(now, snap)
+            transitions = self.alerts.evaluate(snap, now=now)
+            self._last_tick = now
+            self._last_snapshot = snap
+            self.m_tick.set(value=time.perf_counter() - p0)
+            self.m_points.set(
+                value=float(self.history.status()["points"]))
+            return {"at": now, "series": len(snap),
+                    "transitions": [t["key"] + "->" + t["to"]
+                                    for t in transitions]}
+
+    def _snapshot(self, samples: list, now: float) \
+            -> "dict[tuple, float]":
+        """Parsed federated samples -> the curated series dict the
+        history rings store and the alert rules read."""
+        snap: dict[tuple, float] = {}
+        counters: dict[str, dict[str, float]] = {}
+        # per-(server, op) SLO counters: deltas MUST be taken per
+        # server before aggregation — a server missing one scrape (or
+        # restarting) would otherwise make the cross-server sum go
+        # backwards, clamp to zero ok-count, and false-fire the
+        # critical burn rule on a healthy cluster
+        slo_now: dict = {"buckets": {}, "ok": {}, "err": {},
+                         "servers": set()}
+        overflow = 0.0
+        lag = 0.0
+        repairq = 0.0
+        for name, labels, value in samples:
+            mapped = _SLO_DIRECT.get(name)
+            if mapped is not None:
+                snap[(mapped, (("op", labels.get("op", "")),))] = value
+                continue
+            if name == "seaweedfs_federation_up":
+                if value:
+                    # the set of servers that ANSWERED this scrape — the
+                    # discriminator between "server missed the scrape"
+                    # (skip its window) and "counter was simply zero
+                    # before" (a lazily-created errors counter)
+                    slo_now["servers"].add(labels.get("server", ""))
+                snap[("federation_up",
+                      (("server", labels.get("server", "")),))] = value
+                continue
+            server = labels.get("server", "")
+            op = labels.get("type") or labels.get("op") or ""
+            if op in SLO_OPS:
+                key = (server, op)
+                if name in _SLO_BUCKETS:
+                    le = float("inf") if labels.get("le") == "+Inf" \
+                        else float(labels.get("le", "inf"))
+                    b = slo_now["buckets"].setdefault(key, {})
+                    b[le] = b.get(le, 0.0) + value
+                elif name in _SLO_COUNTS:
+                    slo_now["ok"][key] = \
+                        slo_now["ok"].get(key, 0.0) + value
+                if name in _SLO_ERRORS:
+                    slo_now["err"][key] = \
+                        slo_now["err"].get(key, 0.0) + value
+            if name == "seaweedfs_master_repair_queue_depth":
+                repairq += value
+            elif name == "seaweedfs_sync_subscriber_lag_events":
+                lag = max(lag, value)
+            elif name == "seaweedfs_filer_subscriber_overflow_total":
+                overflow += value
+            elif name in _COUNT_NAMES:
+                counters.setdefault(server, {"ops": 0.0, "errs": 0.0})
+                counters[server]["ops"] += value
+            elif name in _ERR_NAMES:
+                counters.setdefault(server, {"ops": 0.0, "errs": 0.0})
+                counters[server]["errs"] += value
+        snap[("repair_queue_depth", ())] = repairq
+        snap[("sync_lag_events", ())] = lag
+        snap.update(self._windowed_slo(slo_now))
+        prev = self._prev_counters
+        if prev is not None:
+            prev_ts, prev_counters = prev
+            dt = max(1e-6, now - prev_ts)
+            for server, cur in counters.items():
+                if not server:
+                    continue
+                before = prev_counters.get(server,
+                                           {"ops": 0.0, "errs": 0.0})
+                d_ops = max(0.0, cur["ops"] - before["ops"])
+                d_errs = max(0.0, cur["errs"] - before["errs"])
+                key = (("server", server),)
+                snap[("server_rps", key)] = d_ops / dt
+                snap[("server_err_pct", key)] = \
+                    100.0 * d_errs / d_ops if d_ops > 0 else 0.0
+            prev_overflow = prev_counters.get("", {}).get("overflow",
+                                                          0.0)
+            snap[("subscriber_overflow_delta", ())] = \
+                max(0.0, overflow - prev_overflow)
+        counters.setdefault("", {})["overflow"] = overflow
+        self._prev_counters = (now, counters)
+        snap.update(self._topology_series())
+        return snap
+
+    def _windowed_slo(self, slo_now: dict) -> "dict[tuple, float]":
+        """Per-op p99/availability burn over THIS tick's window, the
+        series the builtin SLO alert rules read.  The lifetime
+        seaweedfs_slo_* gauges never forget a slow cluster boot or a
+        long-past incident; an alert must evaluate what is happening
+        NOW and resolve when it stops.  Deltas are taken PER SERVER
+        (clamped at zero, skipped for servers absent from either tick)
+        and only then aggregated per op — see the collection-side
+        comment for why.  Ops with no traffic in the window produce no
+        instance (nothing to judge)."""
+        out: dict[tuple, float] = {}
+        prev, self._prev_slo = self._prev_slo, slo_now
+        if prev is None:
+            return out
+        targets = slo_targets()
+        # a server only contributes to this window if it answered BOTH
+        # scrapes — a counter key absent from prev on an answering
+        # server just means the counter was zero then (counters are
+        # created lazily on first increment)
+        steady = prev.get("servers", set()) & slo_now.get("servers",
+                                                          set())
+        op_deltas: dict[str, dict[float, float]] = {}
+        op_ok: dict[str, float] = {}
+        op_err: dict[str, float] = {}
+        for key, buckets in slo_now["buckets"].items():
+            if key[0] not in steady:
+                continue       # new/rejoined server: window starts next tick
+            before = prev["buckets"].get(key, {})
+            agg = op_deltas.setdefault(key[1], {})
+            for le, cum in buckets.items():
+                d = cum - before.get(le, 0.0)
+                if d > 0:
+                    agg[le] = agg.get(le, 0.0) + d
+        for kind, agg in (("ok", op_ok), ("err", op_err)):
+            for key, cum in slo_now[kind].items():
+                if key[0] not in steady:
+                    continue
+                agg[key[1]] = agg.get(key[1], 0.0) \
+                    + max(0.0, cum - prev[kind].get(key, 0.0))
+        for op in SLO_OPS:
+            key = (("op", op),)
+            p99_s = quantile_from_buckets(
+                sorted(op_deltas.get(op, {}).items()), 0.99)
+            if p99_s is not None:
+                p99_ms = p99_s * 1000.0
+                out[("slo_p99_window_ms", key)] = round(p99_ms, 3)
+                out[("slo_p99_burn_window", key)] = round(
+                    p99_ms / targets[op]["p99_ms"], 4)
+            d_ok = op_ok.get(op, 0.0)
+            d_err = op_err.get(op, 0.0)
+            if d_ok + d_err > 0:
+                avail = d_ok / (d_ok + d_err)
+                out[("slo_availability_window", key)] = round(avail, 6)
+                budget = 1.0 - targets[op]["availability"]
+                out[("slo_error_budget_burn_window", key)] = round(
+                    0.0 if budget <= 0 else (1.0 - avail) / budget, 4)
+        return out
+
+    def _topology_series(self) -> "dict[tuple, float]":
+        """Fullness and degradation straight from the leader's topology
+        tree — state the exposition pages don't carry."""
+        topo = self.master.topo
+        readonly = 0
+        vol_full = 0.0
+        node_full = 0.0
+        limit = float(getattr(topo, "volume_size_limit", 0) or 0)
+        try:
+            for dn in topo.data_nodes():
+                if not dn.is_active:
+                    continue
+                if dn.max_volumes:
+                    node_full = max(node_full, 100.0 * len(dn.volumes)
+                                    / dn.max_volumes)
+                for v in dn.volumes.values():
+                    if v.read_only:
+                        readonly += 1
+                    if limit > 0:
+                        vol_full = max(vol_full, 100.0 * v.size / limit)
+        except Exception as e:
+            LOG.debug("topology walk failed during snapshot: %s", e)
+        return {("volumes_readonly", ()): float(readonly),
+                ("volume_fullness_pct", ()): round(vol_full, 3),
+                ("node_fullness_pct", ()): round(node_full, 3)}
+
+    # -- health rollup -------------------------------------------------------
+    def health(self, refresh: bool = True) -> dict:
+        """Red/yellow/green with the reasons.  ``refresh`` runs a
+        synchronous tick when the last evaluation is stale (loop off, or
+        an operator asking faster than the cadence deserves a live
+        answer)."""
+        now = time.time()
+        stale = now - self._last_tick > max(self.interval, 1.0)
+        if refresh and stale and self.master.is_leader:
+            try:
+                self.tick()
+            except Exception as e:
+                LOG.warning("health refresh tick failed: %s", e)
+        status, reasons = self.alerts.health_rollup()
+        snap = self._last_snapshot
+        up = [v for (name, _labels), v in snap.items()
+              if name == "federation_up"]
+        firing = pending = 0
+        for a in self.alerts.status()["alerts"]:
+            if a["state"] == "firing":
+                firing += 1
+            elif a["state"] == "pending":
+                pending += 1
+        return {
+            "status": status, "reasons": reasons,
+            "alerts_firing": firing, "alerts_pending": pending,
+            "servers_up": int(sum(up)), "servers_total": len(up),
+            "evaluated_at": round(self._last_tick, 3),
+            "interval_s": self.interval,
+            "leader": self.master.grpc_address,
+        }

@@ -174,6 +174,18 @@ class RepairPlanner:
             "scrub_reconciled": 0, "scrub_crc_errors": 0,
         }
 
+    def _emit(self, type: str, message: str, **fields) -> None:
+        """Best-effort record into the master's durable event timeline
+        (observability v3); repair must never fail on a full event
+        disk."""
+        events = getattr(self.master, "events", None)
+        if events is None:
+            return
+        try:
+            events.emit(type, message, **fields)
+        except Exception as e:
+            LOG.debug("event emit %s failed: %s", type, e)
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -239,6 +251,11 @@ class RepairPlanner:
             self.master._publish_node_change(dn, is_add=False)
             self.counters["liveness_unregistered"] += 1
             self.metrics.liveness_unregister_total.inc()
+            self._emit("topology.leave",
+                       f"volume server {dn.id} unregistered by the "
+                       f"liveness sweep ({silent:.1f}s silent)",
+                       severity="warning", server=dn.id,
+                       reason="liveness-sweep")
 
     # -- 2. planning --------------------------------------------------------
     def _plan(self, topo: dict) -> dict[tuple, dict]:
@@ -311,6 +328,14 @@ class RepairPlanner:
         launched, deferred = 0, 0
         for key, job in sorted(jobs.items()):
             first = self._first_seen.setdefault(key, now)
+            if first == now:
+                # first sighting of this degradation: record the PLAN
+                # in the timeline (execution outcome follows later)
+                self._emit("repair.planned",
+                           f"{job['kind']} repair planned for volume "
+                           f"{job.get('volume_id')}",
+                           kind=job["kind"],
+                           volume_id=job.get("volume_id", 0))
             if key in self._inflight:
                 continue
             if now - first < self.cfg.grace:
@@ -374,6 +399,13 @@ class RepairPlanner:
             LOG.warning("repair %s volume %s trace=%s FAILED (attempt "
                         "%d, retry in %.1fs): %s", job["kind"],
                         job.get("volume_id"), tid, fails, delay, e)
+            self._emit("repair.failed",
+                       f"{job['kind']} repair of volume "
+                       f"{job.get('volume_id')} failed (attempt "
+                       f"{fails}): {e}", severity="warning",
+                       kind=job["kind"],
+                       volume_id=job.get("volume_id", 0),
+                       attempt=fails)
         else:
             first = self._first_seen.pop(key, None)
             mttr = time.time() - first if first else 0.0
@@ -388,6 +420,12 @@ class RepairPlanner:
             self._after_heal(job)
             LOG.info("repair %s volume %s trace=%s healed in %.2fs",
                      job["kind"], job.get("volume_id"), tid, mttr)
+            self._emit("repair.ok",
+                       f"{job['kind']} repair of volume "
+                       f"{job.get('volume_id')} healed in {mttr:.2f}s",
+                       kind=job["kind"],
+                       volume_id=job.get("volume_id", 0),
+                       mttr_s=round(mttr, 3))
         finally:
             with self._lock:
                 self._inflight.discard(key)

@@ -19,6 +19,7 @@ raft_server.go:45-62 snapshot).  Single-master mode skips raft entirely.
 from __future__ import annotations
 
 import json
+import os
 import queue
 import random
 import threading
@@ -76,7 +77,9 @@ class MasterServer:
                  follow: str = "",
                  seed: int | None = None,
                  repair_interval: float = 0.0,
-                 repair: dict | None = None):
+                 repair: dict | None = None,
+                 event_dir: "str | None" = None,
+                 history_interval: "float | None" = None):
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024, seed=seed)
         self.sequencer = MemorySequencer()
@@ -137,6 +140,16 @@ class MasterServer:
         # /cluster/metrics + SLO burn + the ClusterTrace span feeder
         from .observe import ClusterObserver
         self.observer = ClusterObserver(self)
+        # observability v3: the durable event timeline (master/events.py)
+        # and the fused history+alerting plane (master/history.py).
+        # event_dir=None degrades to ring-only; history_interval=None
+        # takes WEED_HISTORY_INTERVAL_S (default 10s), <=0 leaves the
+        # background loop off (ticks still run on demand)
+        from .events import EventLog
+        self.events = EventLog(
+            event_dir or os.environ.get("WEED_EVENT_DIR") or None)
+        from .history import ObservabilityPlane
+        self.plane = ObservabilityPlane(self, interval=history_interval)
         self._register_http()
         self._register_rpc()
 
@@ -144,6 +157,16 @@ class MasterServer:
     def start(self) -> None:
         self.http.start()
         self.rpc.start()
+        self.events.emit("master.start",
+                         f"master {self.grpc_address} started",
+                         server=self.grpc_address)
+        if self.is_leader:
+            # single-master mode: leadership is implicit, record it so
+            # the timeline starts with the same shape HA clusters have
+            self.events.emit("leader.elect",
+                             f"{self.grpc_address} is the leader "
+                             "(single-master)",
+                             server=self.grpc_address)
         if self._follow:
             from ..wdclient import MasterClient, resolve_leader
             self._follower_client = MasterClient(
@@ -188,9 +211,11 @@ class MasterServer:
         if repair_cfg.interval > 0:
             self.repair = RepairPlanner(self, repair_cfg)
             self.repair.start()
+        self.plane.start()
 
     def stop(self) -> None:
         self._stop_vacuum.set()
+        self.plane.stop()
         self.observer.close()
         if self.repair is not None:
             self.repair.stop()
@@ -200,6 +225,19 @@ class MasterServer:
             self.ha.stop()
         self.http.stop()
         self.rpc.stop()
+        # last: in-flight handlers emitting after close degrade to
+        # ring-only (EventLog.emit logs and keeps the event in memory)
+        self.events.close()
+
+    def _on_leadership(self, is_leader: bool) -> None:
+        """Raft role change (master/ha.py): record it in the durable
+        timeline — the event an incident review reaches for first."""
+        self.events.emit(
+            "leader.elect" if is_leader else "leader.stepdown",
+            f"{self.grpc_address} "
+            + ("won leadership" if is_leader else "lost leadership"),
+            severity="info" if is_leader else "warning", sync=True,
+            server=self.grpc_address)
 
     @property
     def leader_grpc(self) -> str:
@@ -256,7 +294,7 @@ class MasterServer:
             preferred_data_node=req.get("data_node", ""))
 
     def assign(self, req: dict) -> dict:
-        t0 = time.time()
+        p0 = time.perf_counter()   # monotonic: wall clock can step (WL120)
         try:
             out = self._assign_routed(req)
         except Exception:
@@ -266,7 +304,7 @@ class MasterServer:
         # <op>_seconds_count, so failures must live ONLY in the errors
         # counter (availability = count / (count + errors))
         self.metrics.master_op_latency.observe(
-            "assign", value=time.time() - t0,
+            "assign", value=time.perf_counter() - p0,
             trace_id=tracing.current_trace_id())
         return out
 
@@ -388,6 +426,10 @@ class MasterServer:
                          dn.id)
                 self.topo.unregister_data_node(dn)
                 self._publish_node_change(dn, is_add=False)
+                self.events.emit("topology.leave",
+                                 f"volume server {dn.id} disconnected",
+                                 severity="warning", server=dn.id,
+                                 reason="stream-closed")
 
     def _ingest_heartbeat(self, hb: dict, dn: DataNode | None) -> DataNode:
         if dn is not None and (not dn.is_active or dn.parent is None):
@@ -412,8 +454,21 @@ class MasterServer:
             LOG.info("volume server %s registered (dc=%s rack=%s)",
                      dn.id, hb.get("data_center", ""), hb.get("rack", ""))
             self._publish_node_change(dn, is_add=True)
+            self.events.emit(
+                "topology.join", f"volume server {dn.id} joined",
+                server=dn.id, data_center=hb.get("data_center", ""),
+                rack=hb.get("rack", ""))
         dn.last_seen = time.time()
         dn.max_volumes = hb.get("max_volume_count", dn.max_volumes)
+        # read-only transitions are load-bearing events: a degraded
+        # volume changed what the cluster can serve — diff the flags
+        # across this heartbeat's mutations and record the flips.
+        # Pulse-only heartbeats carry no volume keys and cannot flip
+        # anything; skip the snapshot on the hot ingest path
+        has_volume_keys = any(k in hb for k in ("volumes", "new_volumes",
+                                                "deleted_volumes"))
+        prev_ro = {vid: v.read_only for vid, v in dn.volumes.items()} \
+            if has_volume_keys else {}
         if "volumes" in hb:  # full sync
             infos = [_volume_info_from_dict(v) for v in hb["volumes"]]
             self.topo.sync_data_node(dn, infos)
@@ -437,6 +492,18 @@ class MasterServer:
             colls = {int(e["id"]): e.get("collection", "")
                      for e in hb["ec_shards"]}
             self.topo.sync_ec_shards(dn, bits, colls)
+        for vid, v in (dn.volumes.items() if has_volume_keys else ()):
+            was = prev_ro.get(vid)
+            if was is False and v.read_only:
+                self.events.emit(
+                    "volume.degraded",
+                    f"volume {vid} on {dn.id} went read-only",
+                    severity="warning", volume_id=vid, server=dn.id)
+            elif was is True and not v.read_only:
+                self.events.emit(
+                    "volume.healed",
+                    f"volume {vid} on {dn.id} is writable again",
+                    volume_id=vid, server=dn.id)
         return dn
 
     # -- KeepConnected pub-sub (master_grpc_server.go:185-252) --------------
@@ -573,6 +640,14 @@ class MasterServer:
                     self.observer),
                 "ClusterMetrics": observe.cluster_metrics_rpc_handler(
                     self.observer),
+                # observability v3 (history + alerts + events): history
+                # and alert state live on the LEADER (its plane ticks);
+                # followers proxy so any master answers the shell
+                "ClusterHealth": self._rpc_cluster_health,
+                "ClusterAlerts": self._rpc_cluster_alerts,
+                "ClusterHistory": self._rpc_cluster_history,
+                "ClusterEvents": self._rpc_cluster_events,
+                "ClusterEventAppend": self._rpc_cluster_event_append,
             },
             stream={
                 "SendHeartbeat": self._handle_heartbeat_stream,
@@ -609,6 +684,100 @@ class MasterServer:
             return {"enabled": False}
         return self.repair.status()
 
+    # -- observability v3 RPCs (leader-evaluated, follower-proxied) ----------
+    def _proxy_to_leader(self, method: str, req: dict) -> "dict | None":
+        """None when this master should answer locally (it IS the
+        leader, or no better leader is known — half an answer beats a
+        refusal mid-election)."""
+        if self.is_leader:
+            return None
+        leader = self.leader_grpc
+        if leader == self._self_grpc():
+            return None
+        return POOL.client(leader, "Seaweed").call(method, req)
+
+    def _rpc_cluster_health(self, req: dict) -> dict:
+        out = self._proxy_to_leader("ClusterHealth", req)
+        if out is not None:
+            return out
+        return self.plane.health(
+            refresh=req.get("refresh", True) not in (False, 0, "0"))
+
+    def _rpc_cluster_alerts(self, req: dict) -> dict:
+        out = self._proxy_to_leader("ClusterAlerts", req)
+        if out is not None:
+            return out
+        ack = {}
+        if req.get("silence"):
+            ack["silenced"] = self.plane.alerts.silence(
+                str(req["silence"]),
+                float(req.get("duration") or 3600.0))
+        if req.get("unsilence"):
+            ack["unsilenced"] = self.plane.alerts.unsilence(
+                str(req["unsilence"]))
+        return dict(self.plane.alerts.status(), **ack)
+
+    def _rpc_cluster_history(self, req: dict) -> dict:
+        out = self._proxy_to_leader("ClusterHistory", req)
+        if out is not None:
+            return out
+        now = time.time()
+        since = float(req.get("since") or -3600.0)
+        if since <= 0:
+            since = now + since       # relative: "-600" = last 10 min
+        until_raw = req.get("until")
+        until = float(until_raw) if until_raw else None
+        if until is not None and until <= 0:
+            until = now + until       # same relative semantics as since
+        step = float(req.get("step") or 0.0)
+        names = [s for s in str(req.get("series") or "").split(",") if s]
+        hist = self.plane.history
+        return {
+            "names": hist.names(),
+            "interval_s": self.plane.interval,
+            "series": {name: hist.query(name, since, until=until,
+                                        step=step)
+                       for name in names},
+            "status": hist.status(),
+        }
+
+    def _rpc_cluster_events(self, req: dict) -> dict:
+        out = self._proxy_to_leader("ClusterEvents", req)
+        if out is not None:
+            return out
+        since = float(req.get("since") or 0.0)
+        if since < 0:
+            since = time.time() + since
+        types = req.get("types") or []
+        if isinstance(types, str):
+            types = [t for t in types.split(",") if t]
+        return {"events": self.events.query(
+                    since=since, types=types,
+                    limit=int(req.get("limit") or 200)),
+                "status": self.events.status()}
+
+    def _rpc_cluster_event_append(self, req: dict) -> dict:
+        """Fleet emission hook: volume-server supervisors (worker
+        respawns) and future planes record into the leader's timeline
+        through this; followers forward."""
+        out = self._proxy_to_leader("ClusterEventAppend", req)
+        if out is not None:
+            return out
+        fields = req.get("fields") or {}
+        if not isinstance(fields, dict):
+            fields = {}
+        # reserved keys would collide with emit()'s own kwargs at CALL
+        # time (TypeError before EventLog's guard can run)
+        fields = {str(k): v for k, v in fields.items()
+                  if str(k) not in ("type", "message", "severity",
+                                    "sync")}
+        ev = self.events.emit(
+            str(req.get("type") or "custom"),
+            str(req.get("message") or ""),
+            severity=str(req.get("severity") or "info"),
+            sync=True, **fields)
+        return {"offset": ev.get("offset", 0)}
+
     def _rpc_repair_tick(self, req: dict) -> dict:
         """Run one synchronous planner pass (the `repair.now` verb);
         optionally force a scrub batch (`scrub`, with `deep` selecting
@@ -632,7 +801,7 @@ class MasterServer:
                                               tracer=self.tracer)}
 
     def _rpc_lookup_volume(self, req: dict) -> dict:
-        t0 = time.time()
+        p0 = time.perf_counter()
         try:
             out = self._lookup_volume_inner(req)
         except Exception:
@@ -640,7 +809,7 @@ class MasterServer:
             raise
         # success-only latency (see assign): ok-count = _seconds_count
         self.metrics.master_op_latency.observe(
-            "lookup", value=time.time() - t0,
+            "lookup", value=time.perf_counter() - p0,
             trace_id=tracing.current_trace_id())
         return out
 
@@ -692,6 +861,12 @@ class MasterServer:
         self.http.route("GET", "/metrics", self._http_metrics)
         self.http.route("GET", "/cluster/metrics",
                         self._http_cluster_metrics, exact=True)
+        self.http.route("GET", "/cluster/health",
+                        self._http_cluster_health, exact=True)
+        self.http.route("GET", "/cluster/history",
+                        self._http_cluster_history, exact=True)
+        self.http.route("GET", "/cluster/events",
+                        self._http_cluster_events, exact=True)
         self.http.route("GET", "/debug/traces",
                         tracing.traces_http_handler(self.tracer))
         from ..util import profiling
@@ -744,6 +919,38 @@ class MasterServer:
         (master/observe.py)."""
         return Response(200, self.observer.federate_metrics().encode(),
                         content_type="text/plain; version=0.0.4")
+
+    def _http_cluster_health(self, req: Request) -> Response:
+        """JSON red/yellow/green rollup; rides the same leader-proxied
+        path as the ClusterHealth RPC so any master answers."""
+        try:
+            return Response.json(self._rpc_cluster_health(
+                {"refresh": req.qs("refresh", "1") != "0"}))
+        except RpcError as e:
+            return Response.json({"error": str(e)}, status=503)
+
+    def _http_cluster_history(self, req: Request) -> Response:
+        """JSON range queries over the curated history rings:
+        ?series=a,b&since=-600&step=60 (since<=0 is relative seconds)."""
+        try:
+            return Response.json(self._rpc_cluster_history({
+                "series": req.qs("series"),
+                "since": req.qs("since") or "-3600",
+                "until": req.qs("until"),
+                "step": req.qs("step") or "0"}))
+        except (RpcError, ValueError) as e:
+            return Response.json({"error": str(e)}, status=400)
+
+    def _http_cluster_events(self, req: Request) -> Response:
+        """JSON event timeline with type/time filters:
+        ?type=repair,alert&since=-3600&limit=100."""
+        try:
+            return Response.json(self._rpc_cluster_events({
+                "types": req.qs("type") or req.qs("types"),
+                "since": req.qs("since") or "0",
+                "limit": req.qs("limit") or "200"}))
+        except (RpcError, ValueError) as e:
+            return Response.json({"error": str(e)}, status=400)
 
     def _http_ui(self, req: Request) -> Response:
         """Minimal HTML status page (the reference ships master_ui/)."""

@@ -43,7 +43,8 @@ def vacuum_one_volume(topo: Topology, vid: int, locations,
         LOG.info("vacuum volume %d trace=%s replicas=%s starting", vid,
                  tid, [dn.url for dn in locations])
         import time as _time
-        t0 = _time.time()
+        t0 = _time.time()            # span start: wall
+        p0 = _time.perf_counter()    # duration: monotonic (WL120)
         # phase 2: freeze writes by marking unwritable in every layout
         for layout in topo.layouts.values():
             layout.freeze_writable(vid)
@@ -64,7 +65,7 @@ def vacuum_one_volume(topo: Topology, vid: int, locations,
             layout.refresh_writable(vid)
         if tracer is not None:
             tracer.record(f"vacuum volume {vid}", tid, t0,
-                          _time.time() - t0,
+                          _time.perf_counter() - p0,
                           status="ok" if compacted else "error")
         LOG.info("vacuum volume %d trace=%s done ok=%s", vid, tid,
                  compacted)

@@ -16,6 +16,7 @@ import ctypes
 import ctypes.util
 import errno
 import os
+import signal
 import threading
 from ctypes import (CFUNCTYPE, POINTER, Structure, c_byte, c_char_p,
                     c_int, c_long, c_size_t, c_uint, c_uint64, c_ulong,
@@ -295,6 +296,22 @@ class FuseMount:
                 continue
 
 
+def restore_sigpipe() -> None:
+    """libfuse's ``fuse_remove_signal_handlers`` (run when fuse_main
+    tears down) restores SIGPIPE to SIG_DFL at the C level, clobbering
+    the SIG_IGN CPython installs at startup — the process's NEXT write
+    to a closed socket then dies on signal 13 instead of raising
+    BrokenPipeError.  ``signal.getsignal`` cannot SEE the clobber (it
+    reads Python's shadow table, not the kernel disposition), so the
+    re-install is unconditional.  Only the main thread may set
+    handlers; elsewhere this is a no-op and the main-thread caller
+    owns the restore."""
+    try:
+        signal.signal(signal.SIGPIPE, signal.SIG_IGN)
+    except ValueError:
+        pass  # not the main thread
+
+
 def mount_and_serve(filer_grpc: str, master_grpc: str, mountpoint: str,
                     foreground: bool = True,
                     encrypt_data: bool = False) -> int:
@@ -306,6 +323,7 @@ def mount_and_serve(filer_grpc: str, master_grpc: str, mountpoint: str,
         return FuseMount(fs, mountpoint).serve(foreground=foreground)
     finally:
         fs.stop()
+        restore_sigpipe()
 
 
 class BackgroundMount:
@@ -335,3 +353,4 @@ class BackgroundMount:
         self.mount.unmount()
         if self._thread:
             self._thread.join(timeout=3.0)
+        restore_sigpipe()

@@ -30,7 +30,7 @@ from typing import Callable, Iterator
 import grpc
 
 from ..util import faults, tracing
-from ..util.retry import default_rpc_timeout
+from ..util.retry import default_connect_timeout, default_rpc_timeout
 from ..util.weedlog import logger
 
 LOG = logger(__name__)
@@ -149,12 +149,15 @@ class RpcServer:
         self._server.add_generic_rpc_handlers(
             [grpc.method_handlers_generic_handler(service, handlers)])
 
-    def _record(self, label: str, tid: str, t0: float, status: str,
-                slow_log: bool = True, span_id: str = "",
+    def _record(self, label: str, tid: str, t0: float, p0: float,
+                status: str, slow_log: bool = True, span_id: str = "",
                 parent_id: str = "") -> None:
+        """`t0` is the wall-clock span START (cross-server alignment);
+        `p0` the perf-counter twin the DURATION derives from — wall
+        deltas bend under NTP (weedlint WL120)."""
         tracer = self.tracer  # attached after construction; read late
         if tracer is not None:
-            tracer.record(label, tid, t0, time.time() - t0,
+            tracer.record(label, tid, t0, time.perf_counter() - p0,
                           status=status, slow_log=slow_log,
                           span_id=span_id, parent_id=parent_id)
 
@@ -168,6 +171,7 @@ class RpcServer:
                 tid = tid or tracing.new_trace_id()
                 sid = tracing.new_span_id()
                 t0 = time.time()
+                p0 = time.perf_counter()
             status = "ok"
             try:
                 if faults.ACTIVE:
@@ -193,8 +197,8 @@ class RpcServer:
                               f"{type(e).__name__}: {e}")
             finally:
                 if traced:
-                    self._record(label, tid, t0, status, span_id=sid,
-                                 parent_id=parent)
+                    self._record(label, tid, t0, p0, status,
+                                 span_id=sid, parent_id=parent)
         return h
 
     def _wrap_stream(self, fn, label: str):
@@ -205,6 +209,7 @@ class RpcServer:
                 tid = tid or tracing.new_trace_id()
                 sid = tracing.new_span_id()
                 t0 = time.time()
+                p0 = time.perf_counter()
             status = "ok"
 
             def faulted():
@@ -246,8 +251,9 @@ class RpcServer:
                 # is lifetime, not latency, so keep it out of the slow
                 # log
                 if traced:
-                    self._record(label, tid, t0, status, slow_log=False,
-                                 span_id=sid, parent_id=parent)
+                    self._record(label, tid, t0, p0, status,
+                                 slow_log=False, span_id=sid,
+                                 parent_id=parent)
         return h
 
     def start(self) -> int:
@@ -297,10 +303,33 @@ class RpcClient:
             f"/{self.service}/{method}",
             request_serializer=_ser, response_deserializer=_de)
         try:
-            return fn(payload or {}, timeout=timeout,
-                      metadata=_trace_metadata())
+            out = fn(payload or {}, timeout=timeout,
+                     metadata=_trace_metadata())
         except grpc.RpcError as e:
-            raise RpcError(e.details() or str(e.code())) from None
+            # boot-race grace: a channel that has NEVER connected and
+            # reports UNAVAILABLE most likely dialed a peer that is
+            # still binding its port (an S3 gateway racing its filer at
+            # cluster start) — grpc then parks the subchannel in
+            # reconnect backoff and every call fails fast for seconds.
+            # Wait bounded for readiness and retry ONCE.  A channel
+            # that connected even once skips this, so dead-server
+            # failures keep failing fast everywhere else.
+            if getattr(self._channel, "_weed_connected", False) \
+                    or e.code() != grpc.StatusCode.UNAVAILABLE:
+                raise RpcError(e.details() or str(e.code())) from None
+            try:
+                grpc.channel_ready_future(self._channel).result(
+                    timeout=min(timeout, default_connect_timeout()))
+            except grpc.FutureTimeoutError:
+                raise RpcError(e.details() or str(e.code())) from None
+            try:
+                out = fn(payload or {}, timeout=timeout,
+                         metadata=_trace_metadata())
+            except grpc.RpcError as e2:
+                raise RpcError(e2.details()
+                               or str(e2.code())) from None
+        self._channel._weed_connected = True
+        return out
 
     def _maybe_fault(self, method: str) -> None:
         """Client-side rpc chaos (util/faults.py ``rpc.call``): 'drop'

@@ -202,7 +202,7 @@ class S3ApiServer:
 
     # -- routing (s3api_server.go registerRouter) --------------------------
     def _dispatch(self, req: Request) -> Response:
-        t0 = time.time()
+        t0 = time.perf_counter()   # monotonic: latency, not timestamp
         resp = None
         try:
             resp = self._dispatch_inner(req)
@@ -237,7 +237,7 @@ class S3ApiServer:
                     action=getattr(req, "_s3_action",
                                    req.method.lower()),
                     status=status, nbytes=nbytes,
-                    duration_ms=(time.time() - t0) * 1000,
+                    duration_ms=(time.perf_counter() - t0) * 1000,
                     authz=authz, authz_source=authz_source)
 
     def _dispatch_inner(self, req: Request) -> Response:

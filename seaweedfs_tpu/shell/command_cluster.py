@@ -215,9 +215,46 @@ def _top_rates(before: "tuple[float, dict]", after: "tuple[float, dict]",
             "repair_queue": queue_depth}
 
 
+_SPARK_CHARS = " ▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: list, width: int = 16) -> str:
+    """Unicode sparkline of the last `width` values, scaled to their
+    own max (trend shape, not absolute comparison across rows)."""
+    vals = [v for v in values if isinstance(v, (int, float))][-width:]
+    if not vals:
+        return "-"
+    top = max(vals)
+    if top <= 0:
+        return _SPARK_CHARS[1] * len(vals)
+    return "".join(
+        _SPARK_CHARS[max(1, min(len(_SPARK_CHARS) - 1,
+                                int(round(v / top
+                                          * (len(_SPARK_CHARS) - 1)))))]
+        for v in vals)
+
+
+def _history_rps(env: CommandEnv) -> dict:
+    """{server: [rps values]} from the master's history rings (last
+    10 minutes), empty when the plane has no samples yet."""
+    try:
+        out = env.master().call("ClusterHistory",
+                                {"series": "server_rps",
+                                 "since": -600})
+    except RpcError:
+        return {}
+    by_server: dict[str, list] = {}
+    for key, points in out.get("series", {}).get("server_rps",
+                                                 {}).items():
+        server = key.split("=", 1)[1] if "=" in key else key
+        by_server[server] = [p[1] for p in points]
+    return by_server
+
+
 @command("cluster.top",
          "live per-server rps/p99/error-rate/repair-queue: "
-         "[-interval SECONDS] [-count FRAMES]")
+         "[-interval SECONDS] [-count FRAMES] [-history] (sparkline "
+         "from the master's history rings)")
 def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
     flags = parse_flags(args)
     try:
@@ -225,27 +262,138 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
         count = int(flags.get("count", "1"))
     except ValueError:
         raise ShellError("-interval/-count must be numbers")
+    with_history = "history" in flags
     frame = ""
     before = _top_snapshot(env)
     for i in range(max(1, count)):
         time.sleep(max(0.1, interval))
         after = _top_snapshot(env)
+        history = _history_rps(env) if with_history else {}
         servers = sorted(set(before[1]) | set(after[1]) - {""})
-        lines = ["%-22s %9s %9s %7s %7s"
-                 % ("SERVER", "RPS", "P99_MS", "ERR%", "REPAIRQ")]
+        header = "%-22s %9s %9s %7s %7s" \
+            % ("SERVER", "RPS", "P99_MS", "ERR%", "REPAIRQ")
+        lines = [header + ("  HIST(10m)" if with_history else "")]
         for server in servers:
             if not server:
                 continue
             r = _top_rates(before, after, server)
-            lines.append("%-22s %9.1f %9s %7.2f %7d" % (
+            row = "%-22s %9.1f %9s %7.2f %7d" % (
                 server, r["rps"],
                 "-" if r["p99_ms"] is None else f"{r['p99_ms']:.1f}",
-                r["err_pct"], int(r["repair_queue"])))
+                r["err_pct"], int(r["repair_queue"]))
+            if with_history:
+                row += "  " + _sparkline(history.get(server, []))
+            lines.append(row)
         frame = "\n".join(lines)
         if count > 1 and i < count - 1:
             print(frame + "\n")   # live refresh: intermediate frames
         before = after
     return frame
+
+
+@command("cluster.health",
+         "red/yellow/green cluster rollup with the reasons "
+         "(leader-evaluated alert + federation state): [-json]")
+def cmd_cluster_health(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    out = env.master().call("ClusterHealth", {})
+    if "json" in flags:
+        return json.dumps(out)
+    lines = [
+        f"cluster health: {str(out.get('status', '?')).upper()}  "
+        f"({out.get('servers_up', 0)}/{out.get('servers_total', 0)} "
+        f"servers up, {out.get('alerts_firing', 0)} firing, "
+        f"{out.get('alerts_pending', 0)} pending)"]
+    for reason in out.get("reasons", []):
+        lines.append("  - " + reason)
+    if not out.get("reasons"):
+        lines.append("  all planes quiet")
+    when = out.get("evaluated_at") or 0
+    if when:
+        stamp = time.strftime("%H:%M:%S", time.localtime(when))
+        lines.append(f"evaluated by {out.get('leader', '?')} at {stamp}")
+    else:
+        lines.append("not evaluated yet (plane has not ticked)")
+    return "\n".join(lines)
+
+
+@command("cluster.alerts",
+         "alert instances and their state machine: [-silence PATTERN "
+         "[-for SECONDS]] [-unsilence PATTERN] [-json]")
+def cmd_cluster_alerts(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    req: dict = {}
+    if "silence" in flags:
+        if not flags["silence"]:
+            raise ShellError("-silence needs a rule/key pattern")
+        req["silence"] = flags["silence"]
+        try:
+            req["duration"] = float(flags.get("for", "3600"))
+        except ValueError:
+            raise ShellError(f"-for must be seconds, got {flags['for']!r}")
+    if "unsilence" in flags:
+        req["unsilence"] = flags["unsilence"]
+    out = env.master().call("ClusterAlerts", req)
+    if "json" in flags:
+        return json.dumps(out)
+    lines = []
+    if out.get("silenced"):
+        lines.append(f"silenced {out['silenced']['pattern']} for "
+                     f"{round(out['silenced']['until'] - time.time())}s")
+    if "unsilence" in req:
+        lines.append(f"unsilenced {req['unsilence']}: "
+                     f"{out.get('unsilenced', False)}")
+    alerts = out.get("alerts", [])
+    if not alerts:
+        lines.append(f"no alert instances "
+                     f"({len(out.get('rules', []))} rules armed)")
+    else:
+        lines.append("%-44s %-9s %-9s %12s %8s %s"
+                     % ("ALERT", "STATE", "SEVERITY", "VALUE",
+                        "SINCE_S", "SILENCED"))
+        for a in alerts:
+            val = a.get("value")
+            lines.append("%-44s %-9s %-9s %12s %8.1f %s" % (
+                a.get("key", "?"), a.get("state", "?"),
+                a.get("severity", "?"),
+                "-" if val is None else f"{val:.4g}",
+                a.get("since_s", 0.0),
+                "yes" if a.get("silenced") else ""))
+    silences = out.get("silences", {})
+    if silences:
+        lines.append("silences: " + ", ".join(
+            f"{p} ({int(left)}s left)" for p, left in silences.items()))
+    return "\n".join(lines)
+
+
+@command("cluster.events",
+         "durable cluster event timeline: [-type PREFIX[,PREFIX]] "
+         "[-since SECONDS_AGO] [-limit N] [-json]")
+def cmd_cluster_events(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    try:
+        since_ago = float(flags.get("since", "0"))
+        limit = int(flags.get("limit", "50"))
+    except ValueError:
+        raise ShellError("-since/-limit must be numbers")
+    out = env.master().call("ClusterEvents", {
+        "types": flags.get("type", ""),
+        "since": -abs(since_ago) if since_ago else 0,
+        "limit": limit})
+    if "json" in flags:
+        return json.dumps(out)
+    events = out.get("events", [])
+    status = out.get("status", {})
+    head = (f"{len(events)} events (ring {status.get('ring', '?')}, "
+            f"durable={status.get('durable')})")
+    lines = [head,
+             "%-8s %-8s %-18s %s" % ("TIME", "SEV", "TYPE", "MESSAGE")]
+    for e in events:
+        lines.append("%-8s %-8s %-18s %s" % (
+            time.strftime("%H:%M:%S", time.localtime(e.get("ts", 0))),
+            e.get("severity", "?"), e.get("type", "?"),
+            e.get("message", "")))
+    return "\n".join(lines)
 
 
 @command("metrics.dump",

@@ -58,12 +58,19 @@ class SimCluster:
                  repair: "dict | None" = None,
                  filer_store: str = "memory",
                  filer_journal: bool = True,
-                 volume_workers: int = 1):
+                 volume_workers: int = 1,
+                 history_interval: float = 0.0):
         # self-healing loop (master/repair.py): off by default so kill/
         # partition tests observe raw degradation; chaos-convergence
         # tests turn it on with tight knobs via `repair={...}`
         self._repair_interval = repair_interval
         self._repair = repair
+        # observability v3 plane: 0 keeps the background scrape loop
+        # OFF in tests (a background federation scrape would consume
+        # injected fault budgets); ticks still run on demand
+        # (plane.tick(), cluster.health).  Event journals are always
+        # on — they live under base_dir so kill/restart drills replay.
+        self._history_interval = history_interval
         self.encrypt_data = encrypt_data
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="simcluster-")
         self.pulse = pulse_seconds
@@ -116,7 +123,9 @@ class SimCluster:
         return MasterServer(
             grpc_port=port, peers=self.peers, jwt_signing_key=self.jwt_key,
             raft_dir=raft_dir, election_timeout=0.3, seed=self._seed + i,
-            repair_interval=self._repair_interval, repair=self._repair)
+            repair_interval=self._repair_interval, repair=self._repair,
+            event_dir=os.path.join(self.base_dir, f"master{i}-events"),
+            history_interval=self._history_interval)
 
     def _make_vs(self, i: int) -> VolumeServer:
         if self.volume_workers > 1:
@@ -272,10 +281,10 @@ class SimCluster:
         in the leader's topology (the repair-convergence wait); returns
         the wall time it took.  Raises TimeoutError listing the volumes
         still under-replicated."""
-        t0 = time.time()
+        t0 = time.monotonic()      # duration measurement (WL120)
         deadline = t0 + timeout
         lagging = list(vids)
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             try:
                 m = self.masters[self.leader_index()]
             except RuntimeError:
@@ -284,7 +293,7 @@ class SimCluster:
             lagging = [vid for vid in vids
                        if len(m.topo.lookup("", vid)) < copies]
             if not lagging:
-                return time.time() - t0
+                return time.monotonic() - t0
             time.sleep(0.05)
         raise TimeoutError(
             f"volumes {lagging} still under {copies} copies after "

@@ -457,7 +457,8 @@ class HttpServer:
                 return handler(req)
             except Exception as e:
                 return Response.error(f"{type(e).__name__}: {e}")
-        t0 = time.time()
+        t0 = time.time()            # span start: wall, for alignment
+        p0 = time.perf_counter()    # span duration: monotonic (WL120)
         # clamp both ids: they are client-controlled and ride internal
         # protocols with bounded slots (the TCP frame trace slot is a
         # u8 length)
@@ -482,7 +483,7 @@ class HttpServer:
         tracer = self.tracer
         if tracer is not None and not _trace_skip(req.path):
             tracer.record(f"{req.method} {req.path}", tid,
-                          t0, time.time() - t0,
+                          t0, time.perf_counter() - p0,
                           status=("ok" if resp.status < 400
                                   else f"http {resp.status}"),
                           span_id=sid, parent_id=parent)

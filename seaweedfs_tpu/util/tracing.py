@@ -207,17 +207,20 @@ class Tracer:
         tid = trace_id or current_trace_id() or new_trace_id()
         parent = current_span_id()
         sid = new_span_id()
+        # span START stays wall-clock (cross-server waterfalls align on
+        # it); the DURATION is monotonic — NTP must not bend a span
         t0 = time.time()
+        p0 = time.perf_counter()
         with trace_scope(tid, sid):
             try:
                 yield tid
             except BaseException:
-                self.record(name, tid, t0, time.time() - t0,
+                self.record(name, tid, t0, time.perf_counter() - p0,
                             status="error", span_id=sid,
                             parent_id=parent)
                 raise
-        self.record(name, tid, t0, time.time() - t0, span_id=sid,
-                    parent_id=parent)
+        self.record(name, tid, t0, time.perf_counter() - p0,
+                    span_id=sid, parent_id=parent)
 
     def snapshot(self, trace_id: str = "", limit: int = 0,
                  min_ms: float = 0.0) -> list[dict]:

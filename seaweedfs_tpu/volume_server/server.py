@@ -273,11 +273,12 @@ class VolumeServer:
     def _register_http(self) -> None:
         self.http.route("GET", "/status", self._http_status)
         self.http.route("GET", "/metrics", self._http_metrics)
-        self.http.route("GET", "/debug/traces",
-                        tracing.traces_http_handler(self.tracer))
         from ..util import profiling
+        self._traces_handler = tracing.traces_http_handler(self.tracer)
+        self._profile_handler = profiling.profile_http_handler()
+        self.http.route("GET", "/debug/traces", self._http_debug_traces)
         self.http.route("GET", "/debug/profile",
-                        profiling.profile_http_handler())
+                        self._http_debug_profile)
         if self._worker is not None:
             # the supervisor's heartbeat_now pulls a fresh partition
             # snapshot through this before pushing the merged payload
@@ -339,6 +340,51 @@ class VolumeServer:
         return Response(status, body,
                         content_type=rhdrs.get("Content-Type",
                                                "text/plain"))
+
+    def _proxy_supervisor_debug(self, req: Request, path: str,
+                                timeout: float = 10.0) \
+            -> "Response | None":
+        """Sharded mode: /debug/* on a worker answers for the WHOLE
+        logical node through the supervisor's merge (which re-fetches
+        each partition with worker_local=1), keeping the query string
+        and the X-Profile-* headers intact.  None -> serve the local
+        partition (supervisor unreachable, or worker_local asked)."""
+        qs = urllib.parse.urlencode(
+            [(k, v) for k, vals in req.query.items() for v in vals
+             if k != "worker_local"])
+        url = f"http://{self._worker.supervisor_admin}{path}" \
+            + (f"?{qs}" if qs else "")
+        try:
+            status, body, rhdrs = http_request(url, timeout=timeout)
+        except (OSError, ConnectionError) as e:
+            LOG.warning("supervisor debug proxy failed, serving "
+                        "partition-local %s: %s", path, e)
+            return None
+        keep = {k: v for k, v in rhdrs.items()
+                if k.lower().startswith("x-profile-")}
+        return Response(status, body,
+                        content_type=rhdrs.get("Content-Type",
+                                               "text/plain"),
+                        headers=keep)
+
+    def _http_debug_traces(self, req: Request) -> Response:
+        if self._worker is not None and not req.qs("worker_local"):
+            merged = self._proxy_supervisor_debug(req, "/debug/traces")
+            if merged is not None:
+                return merged
+        return self._traces_handler(req)
+
+    def _http_debug_profile(self, req: Request) -> Response:
+        if self._worker is not None and not req.qs("worker_local"):
+            try:
+                seconds = float(req.qs("seconds", "1") or 1)
+            except ValueError:
+                seconds = 1.0
+            merged = self._proxy_supervisor_debug(
+                req, "/debug/profile", timeout=max(10.0, seconds + 15))
+            if merged is not None:
+                return merged
+        return self._profile_handler(req)
 
     def _http_metrics(self, req: Request) -> Response:
         if self._worker is not None and not req.qs("worker_local"):
@@ -430,7 +476,7 @@ class VolumeServer:
         return resp
 
     def _read_needle(self, fid: FileId, req: Request) -> Response:
-        t0 = time.time()
+        t0 = time.perf_counter()
         self.metrics.volume_requests.inc("read")
         v = self.store.find_volume(fid.volume_id)
         if v is not None:
@@ -511,7 +557,7 @@ class VolumeServer:
                 data, mime, req.qs("width"), req.qs("height"),
                 req.qs("mode"))
         self.metrics.volume_latency.observe(
-            "read", value=time.time() - t0,
+            "read", value=time.perf_counter() - t0,
             trace_id=tracing.current_trace_id())
         return Response(200, data, content_type=mime, headers=headers)
 
@@ -531,7 +577,7 @@ class VolumeServer:
             "Location": f"http://{locs[0]['public_url']}/{fid}"})
 
     def _write_needle(self, fid: FileId, req: Request) -> Response:
-        t0 = time.time()
+        t0 = time.perf_counter()
         denied = self._check_jwt(req, fid)
         if denied is not None:
             return denied
@@ -564,7 +610,7 @@ class VolumeServer:
                 return Response.error(f"replication failed: {err}", 500)
         self.metrics.volume_requests.inc("write")
         self.metrics.volume_latency.observe(
-            "write", value=time.time() - t0,
+            "write", value=time.perf_counter() - t0,
             trace_id=tracing.current_trace_id())
         return Response.json({"name": req.qs("name"), "size": size,
                               "eTag": n.etag()}, status=201)
@@ -614,7 +660,7 @@ class VolumeServer:
         -> (size, etag); every avoidable per-op allocation matters
         here: the jwt check reuses the parsed needle key, and the
         fan-out work is built only when replicas actually exist."""
-        t0 = time.time()
+        t0 = time.perf_counter()
         fid = FileId.parse(fid_str)
         if self._worker is not None \
                 and not self._worker.owns(fid.volume_id):
@@ -676,7 +722,7 @@ class VolumeServer:
                 raise ValueError(f"replication failed: {err}")
         self.metrics.volume_requests.inc("write")
         self.metrics.volume_latency.observe(
-            "write", value=time.time() - t0,
+            "write", value=time.perf_counter() - t0,
             trace_id=tracing.current_trace_id())
         return size, n.etag()
 
@@ -692,13 +738,13 @@ class VolumeServer:
         # use for headers/mime/resize anyway
         v = self.store.find_volume(fid.volume_id)
         if v is not None:
-            t0 = time.time()
+            t0 = time.perf_counter()
             self.metrics.volume_requests.inc("read")
             ce = self.needle_cache.get(fid.volume_id, fid.key, fid.cookie)
             if ce is not None:
                 self.metrics.needle_cache_ops.inc("hit")
                 self.metrics.volume_latency.observe(
-                    "read", value=time.time() - t0,
+                    "read", value=time.perf_counter() - t0,
                     trace_id=tracing.current_trace_id())
                 return ce.data
             self.metrics.needle_cache_ops.inc("miss")
@@ -726,7 +772,7 @@ class VolumeServer:
                                  offset=offset),
                     lambda: v.needle_offset(fid.key))
             self.metrics.volume_latency.observe(
-                "read", value=time.time() - t0,
+                "read", value=time.perf_counter() - t0,
                 trace_id=tracing.current_trace_id())
             return data
         from ..util.http import CIDict
@@ -867,7 +913,7 @@ class VolumeServer:
         through the shared pool otherwise.  A dead TCP port falls back
         to HTTP (and is negative-cached); a server-side rejection is
         real and fails the write."""
-        t0 = time.time()
+        t0 = time.perf_counter()
         from .. import operation
         tcp = loc.get("tcp_url", "")
         if tcp_ok and tcp and not operation.tcp_dead(tcp):
@@ -877,7 +923,7 @@ class VolumeServer:
                                           compressed=compressed)
                 self.metrics.replica_fanout_ops.inc("tcp", "ok")
                 self.metrics.replica_fanout_latency.observe(
-                    "tcp", value=time.time() - t0)
+                    "tcp", value=time.perf_counter() - t0)
                 return None
             except (OSError, ConnectionError):
                 operation.mark_tcp_dead(tcp)   # fall through to HTTP
@@ -898,7 +944,7 @@ class VolumeServer:
             return f"{loc['url']}: HTTP {status}"
         self.metrics.replica_fanout_ops.inc("http", "ok")
         self.metrics.replica_fanout_latency.observe(
-            "http", value=time.time() - t0)
+            "http", value=time.perf_counter() - t0)
         return None
 
     # -- EC remote shard plumbing -----------------------------------------

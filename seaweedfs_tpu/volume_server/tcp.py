@@ -367,7 +367,8 @@ class TcpDataServer:
                 # child span of the sender's hop — the raw-TCP leg of
                 # the cross-server tree
                 sid = tracing.new_span_id()
-                t0 = time.time()
+                t0 = time.time()            # span start: wall
+                p0 = time.perf_counter()    # duration: monotonic
                 status = "ok"
                 with tracing.trace_scope(trace_id, sid):
                     try:
@@ -383,7 +384,7 @@ class TcpDataServer:
                             tracer.record(
                                 f"TCP X {'replica ' if replicate else ''}"
                                 f"write", trace_id, t0,
-                                time.time() - t0, status=status,
+                                time.perf_counter() - p0, status=status,
                                 span_id=sid, parent_id=parent)
             else:
                 size, etag = self.vs.tcp_write(fid, payload, jwt,

@@ -64,6 +64,9 @@ import subprocess
 import sys
 import threading
 import time
+import urllib.parse
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from dataclasses import dataclass, field
 
 from ..pb.rpc import POOL, RpcError, RpcServer
@@ -252,6 +255,16 @@ class ShardedVolumeServer:
         self._threads: list[threading.Thread] = []
         self._monitor_thread: "threading.Thread | None" = None
         self.tcp = _PortShim()
+        # persistent admin fan-out pool: the merged /debug/profile must
+        # sample every worker CONCURRENTLY (N sequential fetches would
+        # multiply the profile window by N), and per-call executors are
+        # the churn PR 5 removed from the data plane
+        # >= one thread per worker: the merged profile's windows must
+        # overlap, and a pool smaller than the worker count would
+        # serialize the tail into a DIFFERENT (later) sampling window
+        self._admin_pool = ThreadPoolExecutor(
+            max_workers=max(8, self.workers),
+            thread_name_prefix="vsup-admin")
 
     # -- addresses ---------------------------------------------------------
     @property
@@ -343,6 +356,7 @@ class ShardedVolumeServer:
                     LOG.warning("worker %d unkillable: %s", i, e)
         self.rpc.stop()
         self.http.stop()
+        self._admin_pool.shutdown(wait=False)
 
     # -- worker processes --------------------------------------------------
     def _worker_config(self, i: int) -> dict:
@@ -483,6 +497,26 @@ class ShardedVolumeServer:
                 # the respawned worker's volumes must re-register with
                 # the master promptly
                 self._hb_wake.set()
+                # record the respawn in the cluster's durable event
+                # timeline (observability v3) — best effort, the
+                # monitor must keep supervising through a dead master
+                try:
+                    POOL.client(self.master_grpc, "Seaweed").call(
+                        "ClusterEventAppend", {
+                            "type": "worker.respawn",
+                            "severity": "warning",
+                            "message": f"volume worker {i} of "
+                                       f"{self.url} respawned "
+                                       f"(restart #{self.restarts[i]}, "
+                                       f"exit {proc.returncode})",
+                            "fields": {"server": self.url, "worker": i,
+                                       "restarts": self.restarts[i],
+                                       "exit_code": proc.returncode
+                                       if proc.returncode is not None
+                                       else -1}},
+                        timeout=5)
+                except RpcError as e:
+                    LOG.debug("worker.respawn event emit failed: %s", e)
 
     def _wait_worker(self, i: int, timeout: float) -> None:
         deadline = time.time() + timeout
@@ -905,12 +939,20 @@ class ShardedVolumeServer:
                         exact=True)
         self.http.route("GET", "/workers", self._http_workers,
                         exact=True)
+        # debug parity (ISSUE 14): tracing/profiling must not go dark
+        # behind the supervisor — merged by default, one partition via
+        # ?worker=<i>
+        self.http.route("GET", "/debug/traces",
+                        self._http_debug_traces, exact=True)
+        self.http.route("GET", "/debug/profile",
+                        self._http_debug_profile, exact=True)
 
-    def _fetch_worker(self, i: int, path: str, qs: str = "") -> tuple:
+    def _fetch_worker(self, i: int, path: str, qs: str = "",
+                      timeout: float = 5.0) -> tuple:
         url = f"http://{self.worker_http_addr(i)}{path}?worker_local=1"
         if qs:
             url += "&" + qs
-        return http_request(url, timeout=5.0)
+        return http_request(url, timeout=timeout)
 
     def _http_status(self, req: Request) -> Response:
         merged = {"Version": "seaweedfs-tpu", "Volumes": [],
@@ -965,14 +1007,130 @@ class ShardedVolumeServer:
                     out.extend(meta[fam_name])
                     emitted.add(fam_name)
             out.append(line)
+        out.append("# HELP seaweedfs_volume_worker_up worker process "
+                   "answering its admin scrape")
         out.append("# TYPE seaweedfs_volume_worker_up gauge")
         for i, v in sorted(up.items()):
             out.append(f'seaweedfs_volume_worker_up{{worker="{i}"}} {v}')
+        # crash supervision is only trustworthy if respawns are
+        # countable: the alert plane reads this next to worker_up
+        out.append("# HELP seaweedfs_volume_worker_respawn_total "
+                   "worker processes respawned by the supervisor")
+        out.append("# TYPE seaweedfs_volume_worker_respawn_total "
+                   "counter")
+        for i in range(self.workers):
+            out.append(f'seaweedfs_volume_worker_respawn_total'
+                       f'{{worker="{i}"}} {self.restarts.get(i, 0)}')
         return Response(200, ("\n".join(out) + "\n").encode(),
                         content_type="text/plain; version=0.0.4")
 
     def _http_workers(self, req: Request) -> Response:
         return Response.json(self.status())
+
+    # -- debug parity: traces + profile through the supervisor -------------
+    @staticmethod
+    def _passthrough_qs(req: Request) -> str:
+        return urllib.parse.urlencode(
+            [(k, v) for k, vals in req.query.items() for v in vals
+             if k not in ("worker", "worker_local")])
+
+    def _select_worker(self, req: Request) -> "int | None":
+        sel = req.qs("worker")
+        if sel == "":
+            return None
+        try:
+            i = int(sel)
+        except ValueError:
+            raise ValueError(f"?worker= must be 0..{self.workers - 1}")
+        if not 0 <= i < self.workers:
+            raise ValueError(f"?worker= must be 0..{self.workers - 1}")
+        return i
+
+    def _http_debug_traces(self, req: Request) -> Response:
+        """Merged span rings (every span stamped with its worker), or
+        one partition's raw page via ?worker=<i>."""
+        qs = self._passthrough_qs(req)
+        try:
+            sel = self._select_worker(req)
+        except ValueError as e:
+            return Response.error(str(e), 400)
+        if sel is not None:
+            status, body, _ = self._fetch_worker(sel, "/debug/traces",
+                                                 qs)
+            return Response(status, body, content_type="application/json")
+        merged = {"spans": [], "workers": {}}
+        for i in range(self.workers):
+            try:
+                status, body, _ = self._fetch_worker(i, "/debug/traces",
+                                                     qs)
+                if status != 200:
+                    raise OSError(f"HTTP {status}")
+                d = json.loads(body)
+            except (OSError, ConnectionError, ValueError) as e:
+                merged["workers"][str(i)] = {"error": str(e)}
+                continue
+            spans = d.get("spans", [])
+            for s in spans:
+                s["worker"] = i
+            merged["spans"].extend(spans)
+            merged["workers"][str(i)] = {"span_count": len(spans)}
+        merged["span_count"] = len(merged["spans"])
+        return Response.json(merged)
+
+    def _http_debug_profile(self, req: Request) -> Response:
+        """Merged collapsed-stack profile: every worker sampled
+        CONCURRENTLY for the same window, stacks prefixed with
+        worker<i>; so a flamegraph shows the partition split.
+        ?worker=<i> passes one partition's page through untouched."""
+        try:
+            seconds = float(req.qs("seconds", "1") or 1)
+        except ValueError:
+            return Response.error("seconds must be a number", 400)
+        timeout = max(10.0, seconds + 10.0)
+        qs = self._passthrough_qs(req)
+        try:
+            sel = self._select_worker(req)
+        except ValueError as e:
+            return Response.error(str(e), 400)
+        if sel is not None:
+            status, body, rhdrs = self._fetch_worker(
+                sel, "/debug/profile", qs, timeout=timeout)
+            keep = {k: v for k, v in rhdrs.items()
+                    if k.lower().startswith("x-profile-")}
+            return Response(status, body, content_type="text/plain",
+                            headers=keep)
+        futs = {i: self._admin_pool.submit(
+                    self._fetch_worker, i, "/debug/profile", qs,
+                    timeout)
+                for i in range(self.workers)}
+        lines: list[str] = []
+        samples = 0
+        errors: dict[str, str] = {}
+        for i, fut in futs.items():
+            try:
+                status, body, rhdrs = fut.result(timeout=timeout + 5)
+                if status != 200:
+                    raise OSError(f"HTTP {status}")
+            # FutureTimeoutError is NOT a TimeoutError subclass until
+            # 3.11 — without it a slow worker 500s the whole merge
+            except (OSError, ConnectionError, TimeoutError,
+                    FutureTimeoutError) as e:
+                errors[str(i)] = str(e)
+                continue
+            try:
+                samples += int(rhdrs.get("X-Profile-Samples", "0"))
+            except ValueError:
+                pass
+            for line in body.decode(errors="replace").splitlines():
+                stack, _, count = line.rpartition(" ")
+                if stack and count.isdigit():
+                    lines.append(f"worker{i};{stack} {count}")
+        headers = {"X-Profile-Samples": str(samples),
+                   "X-Profile-Workers": str(self.workers)}
+        if errors:
+            headers["X-Profile-Errors"] = json.dumps(errors)
+        return Response(200, ("\n".join(lines) + "\n").encode(),
+                        content_type="text/plain", headers=headers)
 
 
 # -- worker process entrypoint ----------------------------------------------

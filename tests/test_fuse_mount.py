@@ -82,6 +82,45 @@ def test_kernel_mount_truncate_chmod_mtime(mounted):
     assert abs(os.stat(f"{mp}/t.bin").st_mtime - 1000000) < 2
 
 
+def test_unmount_restores_sigpipe_disposition(tmp_path):
+    """libfuse's fuse_remove_signal_handlers restores SIGPIPE to
+    SIG_DFL at the C level on teardown (invisible to signal.getsignal,
+    which reads Python's shadow table) — the process's next EPIPE
+    socket write then DIES on signal 13 instead of raising
+    BrokenPipeError.  This took out the whole tier-1 suite at the
+    first post-mount test that killed a server mid-stream.
+    BackgroundMount.stop must re-install SIG_IGN."""
+    from seaweedfs_tpu.mount.fuse_adapter import BackgroundMount
+    from seaweedfs_tpu.mount.weedfs import WeedFS
+
+    def sigpipe_ignored() -> bool:
+        import signal as _signal
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("SigIgn"):
+                    mask = int(line.split()[1], 16)
+                    return bool(mask & (1 << (_signal.SIGPIPE - 1)))
+        return False
+
+    assert sigpipe_ignored(), "CPython should start with SIGPIPE ignored"
+    with SimCluster(volume_servers=1, filers=1,
+                    base_dir=str(tmp_path / "cluster")) as c:
+        fs = WeedFS(c.filers[0].grpc_address, c.master_grpc)
+        fs.start()
+        mp = str(tmp_path / "mnt")
+        bm = BackgroundMount(fs, mp)
+        if not bm.start():
+            fs.stop()
+            pytest.skip("FUSE mount not permitted in this environment")
+        with open(f"{mp}/probe.bin", "wb") as f:
+            f.write(b"probe")
+        bm.stop()
+        fs.stop()
+    assert sigpipe_ignored(), \
+        "SIGPIPE left at SIG_DFL after unmount — the next broken-pipe " \
+        "write would kill the interpreter"
+
+
 def test_kernel_mount_encrypted_round_trip(tmp_path):
     """A kernel mount with -encryptVolumeData: data written through the
     VFS is sealed before it reaches any volume server (VERDICT r4

@@ -1,0 +1,438 @@
+"""Observability v3 (ISSUE 14): in-cluster metrics history (ring TSDB
+with step-down retention), the alerting engine (pending -> firing ->
+resolved, for-durations, silences, seaweedfs_alerts_* self-metrics),
+and the durable cluster event timeline (journal-backed, replayed across
+master kill+restart) — plus the cluster.health / cluster.alerts /
+cluster.events shell verbs and the cluster.top -history sparkline."""
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu import shell
+from seaweedfs_tpu.master.alerts import (AlertEngine, AlertRule,
+                                         builtin_rules)
+from seaweedfs_tpu.master.events import EventLog
+from seaweedfs_tpu.master.history import MetricsHistory
+from seaweedfs_tpu.stats import parse_exposition
+from seaweedfs_tpu.testing import SimCluster
+from seaweedfs_tpu.util.http import http_request
+
+
+# -- unit: history step-down math -------------------------------------------
+
+def test_history_stepdown_and_range_query():
+    h = MetricsHistory(levels=[(1.0, 10.0), (5.0, 1000.0)])
+    key = ("rps", (("server", "a"),))
+    # 30 samples at 1s cadence, value == ts offset
+    for i in range(30):
+        h.record(1000.0 + i, {key: float(i)})
+    # recent window: served from the fine level, raw points
+    recent = h.query("rps", since=1025.0, until=1029.0)["server=a"]
+    assert [v for _, v in recent] == [25.0, 26.0, 27.0, 28.0, 29.0]
+    # a window older than the fine span steps down to the 5s level:
+    # bucket [1000..1005) avg(0..4) = 2.0, [1005..1010) avg = 7.0 ...
+    old = h.query("rps", since=1000.0, until=1014.0)["server=a"]
+    assert old[0] == [1000.0, 2.0]
+    assert old[1] == [1005.0, 7.0]
+    # the LIVE (unsealed) bucket is visible: the last 5s bucket holds
+    # samples 25..29 even though nothing sealed it yet
+    full = h.query("rps", since=1000.0)["server=a"]
+    assert full[-1] == [1025.0, 27.0]
+    # read-time re-bucketing: step=10 averages pairs of 5s buckets
+    coarse = h.query("rps", since=1000.0, until=1019.0,
+                     step=10.0)["server=a"]
+    assert coarse[0] == [1000.0, pytest.approx(4.5)]   # avg(2.0, 7.0)
+    assert coarse[1] == [1010.0, pytest.approx(14.5)]
+    # a window predating ALL data (cluster younger than the ask): every
+    # level spans the same range, so the FINE ring answers — not the
+    # needlessly coarse fallback (review fix)
+    young = MetricsHistory(levels=[(1.0, 100.0), (5.0, 1000.0)])
+    for i in range(8):
+        young.record(1000.0 + i, {key: float(i)})
+    pts = young.query("rps", since=0.0)["server=a"]
+    assert len(pts) == 8 and pts[0] == [1000.0, 0.0]
+
+
+def test_history_eviction_bounds_memory():
+    h = MetricsHistory(levels=[(1.0, 5.0), (10.0, 50.0)])
+    key = ("x", ())
+    for i in range(500):
+        h.record(2000.0 + i, {key: 1.0})
+    st = h.status()
+    # fine ring holds ~span/step points, coarse ring ~span/step buckets
+    assert st["points"] <= (5 + 1) + (5 + 1) + 2
+    assert h.names() == ["x"]
+
+
+def test_history_distinct_labelsets_are_independent():
+    h = MetricsHistory(levels=[(1.0, 100.0)])
+    a = ("rps", (("server", "a"),))
+    b = ("rps", (("server", "b"),))
+    h.record(10.0, {a: 1.0, b: 9.0})
+    h.record(11.0, {a: 2.0})
+    out = h.query("rps", since=0.0)
+    assert [v for _, v in out["server=a"]] == [1.0, 2.0]
+    assert [v for _, v in out["server=b"]] == [9.0]
+
+
+# -- unit: alert state machine ----------------------------------------------
+
+def _engine(rules):
+    events = []
+    eng = AlertEngine(registry=None, rules=rules,
+                      rules_path="",
+                      emit_event=lambda t, message="", **kw:
+                      events.append((t, message, kw)))
+    return eng, events
+
+
+def test_alert_for_duration_pending_then_firing_then_resolved():
+    rule = AlertRule("hot", "temp", ">", 50.0, for_s=10.0,
+                     severity="critical")
+    eng, events = _engine([rule])
+    key = ("temp", (("op", "read"),))
+    assert [t["to"] for t in eng.evaluate({key: 80.0}, now=100.0)] \
+        == ["pending"]
+    # still inside the for-window: no transition
+    assert eng.evaluate({key: 90.0}, now=105.0) == []
+    assert eng.health_rollup(now=105.0)[0] == "yellow"
+    assert [t["to"] for t in eng.evaluate({key: 90.0}, now=111.0)] \
+        == ["firing"]
+    assert eng.health_rollup(now=111.0)[0] == "red"
+    assert [t["to"] for t in eng.evaluate({key: 10.0}, now=120.0)] \
+        == ["resolved"]
+    assert eng.health_rollup(now=120.0)[0] == "green"
+    assert [e[0] for e in events] == ["alert.pending", "alert.firing",
+                                     "alert.resolved"]
+
+
+def test_alert_flap_inside_for_window_never_fires():
+    rule = AlertRule("hot", "temp", ">", 50.0, for_s=10.0)
+    eng, events = _engine([rule])
+    key = ("temp", ())
+    eng.evaluate({key: 80.0}, now=0.0)      # pending
+    eng.evaluate({key: 10.0}, now=5.0)      # resolved before for_s
+    eng.evaluate({key: 80.0}, now=8.0)      # pending again, clock reset
+    out = eng.evaluate({key: 80.0}, now=12.0)
+    assert out == []                        # only 4s into the NEW breach
+    assert "alert.firing" not in [e[0] for e in events]
+
+
+def test_alert_instances_dedup_per_labelset():
+    rule = AlertRule("burn", "burn", ">", 2.0)
+    eng, _ = _engine([rule])
+    t1 = eng.evaluate({("burn", (("op", "read"),)): 5.0,
+                       ("burn", (("op", "write"),)): 1.0}, now=0.0)
+    assert [t["key"] for t in t1] == ["burn{op=read}"]
+    # an already-firing instance does not re-transition
+    assert eng.evaluate({("burn", (("op", "read"),)): 6.0},
+                        now=1.0) == []
+    # vanished series data resolves instead of firing forever
+    out = eng.evaluate({}, now=2.0)
+    assert [t["to"] for t in out] == ["resolved"]
+    assert out[0]["reason"] == "no data"
+
+
+def test_alert_silence_mutes_health_not_evaluation():
+    rule = AlertRule("down", "up", "<", 0.5, severity="critical")
+    eng, _ = _engine([rule])
+    key = ("up", (("server", "v1"),))
+    eng.evaluate({key: 0.0}, now=0.0)
+    assert eng.health_rollup(now=0.0)[0] == "red"
+    eng.silence("down", duration_s=60.0)
+    status, reasons = eng.health_rollup(now=1.0)
+    assert status == "yellow" and "silenced" in reasons[0]
+    st = eng.status(now=1.0)
+    assert st["alerts"][0]["silenced"] is True
+    assert st["alerts"][0]["state"] == "firing"   # still evaluated
+    eng.unsilence("down")
+    assert eng.health_rollup(now=2.0)[0] == "red"
+
+
+def test_alert_rules_file_loads_and_skips_bad_entries(tmp_path,
+                                                     monkeypatch):
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps([
+        {"name": "custom-lag", "series": "sync_lag_events",
+         "op": ">", "threshold": 100, "severity": "warning"},
+        {"series": "missing-name"},
+        {"name": "bad-op", "series": "x", "op": "~"},
+    ]))
+    eng = AlertEngine(registry=None, rules=builtin_rules(),
+                      rules_path=str(path))
+    names = [r.name for r in eng.rules]
+    assert "custom-lag" in names
+    assert "bad-op" not in names
+
+
+def test_windowed_slo_tolerates_server_missing_one_scrape():
+    """Windowed SLO deltas are per-server-then-aggregated: a server
+    missing one federated scrape (network blip) or rejoining must not
+    zero the window's ok-count and false-fire the critical burn rule."""
+    from seaweedfs_tpu.master.history import ObservabilityPlane
+    plane = ObservabilityPlane.__new__(ObservabilityPlane)
+    plane._prev_slo = None
+    read = (("op", "read"),)
+    s1 = {"buckets": {("a", "read"): {0.005: 10.0, float("inf"): 10.0},
+                      ("b", "read"): {0.005: 50.0, float("inf"): 50.0}},
+          "ok": {("a", "read"): 10.0, ("b", "read"): 50.0},
+          "err": {}, "servers": {"a", "b"}}
+    assert plane._windowed_slo(s1) == {}      # first tick: no window yet
+    # server b misses this scrape; a advanced cleanly, and a's errors
+    # counter APPEARS for the first time (lazily created at zero last
+    # tick) with no increments — neither must zero the window
+    s2 = {"buckets": {("a", "read"): {0.005: 14.0, float("inf"): 14.0}},
+          "ok": {("a", "read"): 14.0},
+          "err": {("a", "read"): 0.0}, "servers": {"a"}}
+    out = plane._windowed_slo(s2)
+    assert out[("slo_availability_window", read)] == 1.0
+    assert out[("slo_error_budget_burn_window", read)] == 0.0
+    # b rejoins with its whole gap in its counters: the gap is skipped
+    # (window restarts for b next tick), not dumped into one window
+    s3 = {"buckets": {("a", "read"): {0.005: 16.0, float("inf"): 16.0},
+                      ("b", "read"): {0.005: 90.0, float("inf"): 95.0}},
+          "ok": {("a", "read"): 16.0, ("b", "read"): 95.0},
+          "err": {("b", "read"): 40.0}, "servers": {"a", "b"}}
+    out = plane._windowed_slo(s3)
+    assert out[("slo_availability_window", read)] == 1.0
+    # ...and from the NEXT tick b's deltas count again — including a
+    # lazily-appeared error counter incrementing on a steady server
+    s4 = {"buckets": {("a", "read"): {0.005: 17.0, float("inf"): 17.0},
+                      ("b", "read"): {0.005: 90.0, float("inf"): 96.0}},
+          "ok": {("a", "read"): 17.0, ("b", "read"): 96.0},
+          "err": {("b", "read"): 41.0}, "servers": {"a", "b"}}
+    out = plane._windowed_slo(s4)
+    assert out[("slo_availability_window", read)] \
+        == pytest.approx(2.0 / 3.0)
+
+
+# -- unit: event log durability ---------------------------------------------
+
+def test_event_log_journal_replays_after_reopen(tmp_path):
+    d = str(tmp_path / "events")
+    log = EventLog(d)
+    for i in range(5):
+        log.emit("test.tick", f"tick {i}", n=i)
+    log.emit("test.crit", "boom", severity="critical", sync=True)
+    before = log.query(limit=100)
+    assert len(before) == 6
+    assert all("offset" in e for e in before)
+    log.close()
+    # reopen: the ring replays from the journal
+    log2 = EventLog(d)
+    after = log2.query(limit=100)
+    assert [(e["type"], e.get("n")) for e in after] \
+        == [(e["type"], e.get("n")) for e in before]
+    assert log2.counters["recovered"] == 6
+    # type prefix + since filters
+    assert len(log2.query(types=["test.crit"])) == 1
+    assert len(log2.query(types=["test"])) == 6
+    assert log2.query(since=time.time() + 10) == []
+    log2.close()
+
+
+def test_event_log_without_directory_is_ring_only():
+    log = EventLog(None)
+    log.emit("x.y", "hello")
+    assert log.status()["durable"] is False
+    assert log.query()[0]["type"] == "x.y"
+    log.close()
+
+
+# -- cluster: the fused plane end to end ------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    with SimCluster(volume_servers=2,
+                    base_dir=str(tmp_path_factory.mktemp("v3"))) as c:
+        fid = c.upload(b"v3" * 200)
+        for _ in range(4):
+            c.read(fid)
+        c.masters[0].plane.tick()     # baseline for windowed deltas
+        c._v3_fid = fid
+        yield c
+
+
+def test_healthy_cluster_never_false_fires(cluster):
+    c = cluster
+    for _ in range(3):
+        c.read(c._v3_fid)
+    out = c.masters[0].plane.tick()
+    assert out["transitions"] == []
+    h = c.masters[0].plane.health(refresh=False)
+    assert h["status"] == "green"
+    assert h["servers_up"] == h["servers_total"] >= 3
+
+
+def test_cluster_history_http_range_query(cluster):
+    c = cluster
+    m = c.masters[0]
+    for _ in range(2):
+        c.read(c._v3_fid)
+        time.sleep(0.15)
+        m.plane.tick()
+    status, body, _ = http_request(
+        f"http://{m.address}/cluster/history"
+        "?series=server_rps,slo_availability&since=-600")
+    assert status == 200
+    d = json.loads(body)
+    assert "server_rps" in d["names"]
+    assert d["series"]["server_rps"], "no rps series recorded"
+    some_server = next(iter(d["series"]["server_rps"]))
+    assert some_server.startswith("server=")
+    for ts, v in d["series"]["server_rps"][some_server]:
+        assert ts > 0 and v >= 0
+    avail = d["series"]["slo_availability"]
+    assert any(key == "op=read" for key in avail)
+    # empty series selector lists the vocabulary without points
+    d = json.loads(http_request(
+        f"http://{m.address}/cluster/history")[1])
+    assert d["series"] == {} and len(d["names"]) >= 8
+
+
+def test_alerts_families_exposition_conformance(cluster):
+    """seaweedfs_alerts_* ride the master's /metrics in BOTH formats:
+    strict 0.0.4 (flat counter naming, no exemplar suffixes) and
+    negotiated OpenMetrics (counter family drops _total, samples keep
+    it, page ends in # EOF)."""
+    m = cluster.masters[0]
+    status, body, headers = http_request(f"http://{m.address}/metrics")
+    assert status == 200
+    text = body.decode()
+    assert headers["Content-Type"].startswith("text/plain")
+    assert "# TYPE seaweedfs_alerts_transitions_total counter" in text
+    assert "# TYPE seaweedfs_alerts_firing gauge" in text
+    assert "# TYPE seaweedfs_alerts_eval_seconds gauge" in text
+    assert "# TYPE seaweedfs_history_tick_seconds gauge" in text
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            assert parse_exposition(line), f"unparseable: {line!r}"
+    status, body, headers = http_request(
+        f"http://{m.address}/metrics",
+        headers={"Accept": "application/openmetrics-text"})
+    om = body.decode()
+    assert "openmetrics-text" in headers["Content-Type"]
+    assert om.rstrip().endswith("# EOF")
+    assert "# TYPE seaweedfs_alerts_transitions counter" in om
+    assert "# TYPE seaweedfs_alerts_transitions_total counter" not in om
+
+
+def test_shell_verbs_health_alerts_events_top_history(cluster):
+    c = cluster
+    env = shell.CommandEnv(c.master_grpc)
+    health = shell.run_command(env, "cluster.health")
+    assert "cluster health:" in health
+    assert "evaluated by" in health
+    alerts = shell.run_command(env, "cluster.alerts")
+    assert "rules armed" in alerts or "ALERT" in alerts
+    # silence round-trip renders in the table
+    out = shell.run_command(env,
+                            "cluster.alerts -silence slo- -for 30")
+    assert "silenced slo-" in out
+    out = shell.run_command(env, "cluster.alerts -unsilence slo-")
+    assert "unsilenced slo-: True" in out
+    events = shell.run_command(env, "cluster.events -type topology")
+    assert "topology.join" in events
+    # the timeline carries the cluster's own birth certificate
+    all_events = shell.run_command(env, "cluster.events -limit 100")
+    assert "master.start" in all_events and "leader.elect" in all_events
+    top = shell.run_command(env,
+                            "cluster.top -interval 0.3 -history")
+    assert "HIST(10m)" in top.splitlines()[0]
+    assert len(top.splitlines()) >= 4          # header + >=3 servers
+
+
+def test_cluster_events_http_filters(cluster):
+    c = cluster
+    m = c.masters[0]
+    status, body, _ = http_request(
+        f"http://{m.address}/cluster/events?type=topology.join&limit=5")
+    assert status == 200
+    d = json.loads(body)
+    assert d["events"] and all(e["type"] == "topology.join"
+                               for e in d["events"])
+    assert d["status"]["durable"] is True
+    # ClusterEventAppend tolerates fields that shadow reserved kwargs
+    # (a natural client payload — must not TypeError; review fix)
+    from seaweedfs_tpu.pb.rpc import POOL
+    out = POOL.client(c.master_grpc, "Seaweed").call(
+        "ClusterEventAppend",
+        {"type": "test.custom", "message": "hi", "severity": "warning",
+         "fields": {"severity": "critical", "type": "x", "worker": 3}})
+    assert out["offset"] > 0
+    ev = m.events.query(types=["test.custom"])[-1]
+    assert ev["severity"] == "warning" and ev["worker"] == 3
+
+
+# -- acceptance: breach -> firing within ONE tick, durable timeline ---------
+
+def test_slo_breach_fires_within_one_tick_and_timeline_survives_restart(
+        tmp_path):
+    with SimCluster(volume_servers=1,
+                    base_dir=str(tmp_path / "breach")) as c:
+        m = c.masters[0]
+        vs = c.volume_servers[0]
+        fid = c.upload(b"ok" * 300)
+        for _ in range(4):
+            c.read(fid)
+        m.plane.tick()                      # healthy baseline
+        healthy = m.plane.tick()
+        assert healthy["transitions"] == []
+        # injected SLO breach via the seeded fault plane: every pread
+        # errors, so reads 500 and burn the read error budget
+        c.inject_disk_fault(0, op="pread", mode="error", prob=1.0)
+        for _ in range(6):
+            status, _, _ = http_request(f"http://{vs.url}/{fid}")
+            assert status >= 500
+        c.clear_faults()
+        out = m.plane.tick()                # ONE evaluation interval
+        assert any(t.startswith("slo-error-budget-burn{op=read}"
+                                "->firing")
+                   for t in out["transitions"]), out
+        assert m.plane.health(refresh=False)["status"] == "red"
+        # the transition is IN the durable timeline
+        fired = m.events.query(types=["alert.firing"])
+        assert any("slo-error-budget-burn{op=read}" in e["message"]
+                   for e in fired)
+        # a clean window resolves it
+        for _ in range(5):
+            c.read(fid)
+        out = m.plane.tick()
+        assert any(t.endswith("->resolved") for t in out["transitions"])
+        assert m.plane.health(refresh=False)["status"] == "green"
+        pre_kill = [(e["ts"], e["type"]) for e in
+                    m.events.query(limit=10000)]
+        assert len(pre_kill) >= 5
+        # kill + restart the master on the same event dir: zero lost
+        # pre-ack'd events
+        c.kill_master(0)
+        c.restart_master(0)
+        m2 = c.masters[0]
+        replayed = [(e["ts"], e["type"]) for e in
+                    m2.events.query(limit=10000)]
+        for entry in pre_kill:
+            assert entry in replayed, f"lost event {entry}"
+        assert any(t == "alert.firing" for _, t in replayed)
+        assert any(t == "alert.resolved" for _, t in replayed)
+
+
+def test_follower_proxies_health_and_events_to_leader(tmp_path):
+    with SimCluster(masters=3, volume_servers=1,
+                    base_dir=str(tmp_path / "ha")) as c:
+        fid = c.upload(b"ha" * 100)
+        c.read(fid)
+        leader = c.leader_index()
+        leader_m = c.masters[leader]
+        leader_m.plane.tick()
+        follower = next(i for i in range(3) if i != leader)
+        from seaweedfs_tpu.pb.rpc import POOL
+        stub = POOL.client(c.masters[follower].grpc_address, "Seaweed")
+        h = stub.call("ClusterHealth", {})
+        assert h["leader"] == leader_m.grpc_address
+        assert h["status"] in ("green", "yellow", "red")
+        ev = stub.call("ClusterEvents", {"types": "leader.elect"})
+        assert ev["events"], "leader election not in the timeline"
+        al = stub.call("ClusterAlerts", {})
+        assert "rules" in al

@@ -95,6 +95,24 @@ def test_bad_forksafety_fixture():
                    ("WL110", 23), ("WL110", 29), ("WL110", 31)]
 
 
+def test_bad_wallclock_fixture():
+    # the nested-helper case (line 46) appears exactly ONCE: the
+    # module walk reaches the nested def itself, and the per-function
+    # scan does not descend into nested scopes (no double report)
+    got = _ids_lines(_findings(os.path.join(FIXTURES,
+                                            "bad_wallclock.py")))
+    assert got == [("WL120", 8), ("WL120", 15), ("WL120", 21),
+                   ("WL120", 46)]
+
+
+def test_package_has_no_wallclock_durations():
+    """ISSUE 14 satellite: every latency/duration measurement in the
+    tree derives from a monotonic clock — zero baselined WL120
+    exceptions (the SLO plane would page on NTP steps otherwise)."""
+    got = [f for f in analyze_paths([PACKAGE]) if f.checker == "WL120"]
+    assert got == [], "\n".join(f.render() for f in got)
+
+
 def test_volume_server_fork_safety_is_clean():
     """The process-sharded worker plane (ISSUE 12) holds the WL110
     contract with ZERO baselined exceptions: no forks, no fork-default
@@ -212,5 +230,5 @@ def test_cli_list_checkers():
     for cid in ("WL001", "WL002", "WL010", "WL011", "WL012",
                 "WL020", "WL021", "WL022", "WL030", "WL040",
                 "WL050", "WL060", "WL080", "WL090", "WL100",
-                "WL110"):
+                "WL110", "WL120"):
         assert cid in r.stdout

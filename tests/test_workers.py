@@ -128,6 +128,8 @@ def test_merged_status_and_metrics(sharded):
 def test_worker_crash_respawn_zero_acked_loss(tmp_path):
     """SIGKILL one worker mid-life: the supervisor respawns it on the
     same ports and every previously-acked write reads back."""
+    import time
+
     with SimCluster(masters=1, volume_servers=1, volume_workers=2,
                     pulse_seconds=0.4,
                     base_dir=str(tmp_path / "crash")) as c:
@@ -141,6 +143,75 @@ def test_worker_crash_respawn_zero_acked_loss(tmp_path):
         # the respawned partition still takes NEW writes
         fid = c.upload(b"post-crash")
         assert c.read(fid) == b"post-crash"
+        # the respawn is COUNTABLE (ISSUE 14): merged metrics carry
+        # seaweedfs_volume_worker_respawn_total next to worker_up
+        status, body, _ = http_request(f"http://{vs.url}/metrics")
+        assert status == 200
+        text = body.decode()
+        assert 'seaweedfs_volume_worker_respawn_total{worker="1"} 1' \
+            in text
+        assert 'seaweedfs_volume_worker_respawn_total{worker="0"} 0' \
+            in text
+        # ... and recorded in the master's durable event timeline (the
+        # monitor emits it async right after respawn readiness)
+        m = c.masters[0]
+        deadline = time.time() + 10
+        evs = []
+        while time.time() < deadline:
+            evs = m.events.query(types=["worker.respawn"])
+            if evs:
+                break
+            time.sleep(0.1)
+        assert evs, "worker.respawn event never reached the timeline"
+        assert evs[-1]["worker"] == 1 and evs[-1]["server"] == vs.url
+
+
+def test_sharded_debug_traces_and_profile_parity(sharded):
+    """ISSUE 14 satellite: /debug/traces and /debug/profile on the
+    shared port answer for the WHOLE logical node (supervisor merge,
+    every worker represented), with ?worker= selecting one partition —
+    tracing/profiling must not go dark at WEED_VOLUME_WORKERS>1."""
+    c = sharded
+    vs = c.volume_servers[0]
+    fids = _upload_some(c, 6, b"dbg")
+    # hit BOTH private ports so both workers' span rings are non-empty
+    # (wrong-worker forwards record a span on the receiving worker too)
+    for fid in fids:
+        for w in (0, 1):
+            status, _, _ = http_request(
+                f"http://{vs.worker_http_addr(w)}/{fid}")
+            assert status == 200
+    status, body, _ = http_request(f"http://{vs.url}/debug/traces")
+    assert status == 200
+    merged = json.loads(body)
+    assert merged["span_count"] == len(merged["spans"]) > 0
+    assert {s["worker"] for s in merged["spans"]} == {0, 1}
+    # one partition, raw page (no worker stamps)
+    one = json.loads(http_request(
+        f"http://{vs.url}/debug/traces?worker=0")[1])
+    assert "spans" in one and all("worker" not in s
+                                  for s in one["spans"])
+    status, _, _ = http_request(f"http://{vs.url}/debug/traces?worker=9")
+    assert status == 400
+    # merged profile: concurrent windows, stacks prefixed worker<i>;
+    status, body, headers = http_request(
+        f"http://{vs.url}/debug/profile?seconds=0.6", timeout=30)
+    assert status == 200
+    assert int(headers["X-Profile-Samples"]) > 0
+    assert headers["X-Profile-Workers"] == "2"
+    text = body.decode()
+    prefixes = {line.split(";", 1)[0] for line in text.splitlines()}
+    assert {"worker0", "worker1"} <= prefixes
+    for line in text.splitlines():
+        stack, _, count = line.rpartition(" ")
+        assert stack and count.isdigit()
+    # ?worker= passes one partition's page through, headers intact
+    status, body, headers = http_request(
+        f"http://{vs.url}/debug/profile?seconds=0.3&worker=1",
+        timeout=30)
+    assert status == 200 and "X-Profile-Samples" in headers
+    assert not any(line.startswith("worker1;")
+                   for line in body.decode().splitlines())
 
 
 def test_reuseport_unavailable_fallback(tmp_path, monkeypatch):

@@ -1,0 +1,52 @@
+"""WL120 fixtures: wall-clock self-deltas measuring durations."""
+import time
+
+
+def observe_latency(metrics):
+    t0 = time.time()
+    do_work()
+    metrics.observe(value=time.time() - t0)
+
+
+def two_wall_reads():
+    start = time.time()
+    do_work()
+    end = time.time()
+    return end - start
+
+
+def milliseconds():
+    began = time.time()
+    do_work()
+    return (time.time() - began) * 1000.0
+
+
+def fine_deadline_arithmetic():
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        do_work()
+    return deadline - time.time()      # remaining time, not a duration
+
+
+def fine_monotonic():
+    t0 = time.monotonic()
+    do_work()
+    return time.monotonic() - t0
+
+
+def fine_age_of_external_timestamp(entry):
+    now = time.time()
+    return now - entry.created_at      # absolute-timestamp age: legit
+
+
+def outer_with_nested_helper():
+    def helper():
+        t0 = time.time()
+        do_work()
+        return time.time() - t0            # flagged exactly ONCE
+
+    return helper()
+
+
+def do_work():
+    return 1

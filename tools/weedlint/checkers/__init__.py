@@ -12,3 +12,4 @@ from . import s3authz      # noqa: F401
 from . import metricshygiene  # noqa: F401
 from . import journal      # noqa: F401
 from . import forksafety   # noqa: F401
+from . import wallclock    # noqa: F401

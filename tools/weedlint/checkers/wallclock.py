@@ -1,0 +1,89 @@
+"""WL120 duration-by-wallclock — ``time.time()`` deltas used as
+duration/latency measurements.
+
+``time.time()`` is the WALL clock: NTP steps it, leap-second smearing
+slews it, and an operator can set it.  A latency histogram fed by a
+wall-clock delta records garbage exactly when the fleet is under clock
+correction — and the SLO burn gauges (master/observe.py) then page on
+phantom p99s.  Durations must come from ``time.monotonic()`` or
+``time.perf_counter()``; ``time.time()`` is for absolute timestamps
+(span start times, heartbeat ages, journal mtimes).
+
+The flagged shape is a SELF-DELTA of the wall clock inside one
+function: a local name assigned a bare ``time.time()`` read, later
+subtracted from another wall-clock read —
+
+    t0 = time.time()
+    ...
+    metrics.observe(value=time.time() - t0)     # flagged
+    elapsed = t1 - t0                           # flagged when both wall
+
+Deadline arithmetic (``deadline = time.time() + n`` ...
+``deadline - time.time()``) is NOT flagged: the tracked names must be
+assigned a bare wall read, and the delta must have the wall read (or a
+tracked name) on the LEFT — remaining-time computations put it on the
+right.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .. import Finding, ModuleContext, register
+from ..astutil import dotted_name, walk_shallow
+
+# bare wall-clock reads: `time.time()`, an aliased module
+# (`_time.time()`), or `time()` from `from time import time`
+_WALL_NAMES = {"time", "time.time", "_time.time"}
+
+
+def _is_wall_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) \
+        and dotted_name(node.func) in _WALL_NAMES \
+        and not node.args and not node.keywords
+
+
+def _wall_locals(fn: ast.AST) -> set:
+    # walk_shallow: a nested def has its own scope (and its own pass of
+    # the module walk) — descending into it here would double-report
+    out = set()
+    for node in walk_shallow(fn):
+        if isinstance(node, ast.Assign) and _is_wall_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and _is_wall_call(node.value) \
+                and isinstance(node.target, ast.Name):
+            out.add(node.target.id)
+    return out
+
+
+@register("WL120", "duration-by-wallclock")
+def check_wallclock_durations(ctx: ModuleContext) -> Iterator[Finding]:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        wall = _wall_locals(fn)
+        if not wall:
+            continue
+        for node in walk_shallow(fn):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            right_is_wall = isinstance(node.right, ast.Name) \
+                and node.right.id in wall
+            left_is_wall = _is_wall_call(node.left) \
+                or (isinstance(node.left, ast.Name)
+                    and node.left.id in wall)
+            if right_is_wall and left_is_wall:
+                yield Finding(
+                    "WL120", "duration-by-wallclock", ctx.path,
+                    node.lineno,
+                    "wall-clock self-delta measures a duration; "
+                    "time.time() is not monotonic (NTP steps/slews "
+                    "corrupt the measurement)",
+                    "measure durations with time.monotonic() or "
+                    "time.perf_counter(); keep time.time() only for "
+                    "absolute timestamps")
