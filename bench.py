@@ -874,6 +874,152 @@ def bench_replication(quick: bool = False) -> dict:
     return out
 
 
+def bench_largefile(quick: bool = False) -> dict:
+    """Large-object streaming extras (ISSUE 15): streamed PUT and GET
+    MB/s + p99 on a multi-chunk object (64MB full / 16MB quick), a
+    4-stream concurrent GET sweep, a readahead on/off A/B under
+    injected chunk-fetch latency (the latency readahead exists to
+    hide — an unloaded loopback fetch is too fast to show the
+    pipelining), and the bytes a mid-object 1MB Range read moves off
+    the volume servers (must be < 2 chunks: sub-chunk edges ride the
+    ranged 'G'-frame path)."""
+    import http.client
+    import threading as _threading
+
+    from seaweedfs_tpu.testing import PatternBody, SimCluster
+    from seaweedfs_tpu.util import faults
+
+    chunk = (2 if quick else 8) << 20
+    size = (16 if quick else 64) << 20
+    n_get = 3 if quick else 5
+    out: dict = {"largefile_object_mb": size >> 20,
+                 "largefile_chunk_mb": chunk >> 20}
+
+    def stream_put(addr, path, total, seed):
+        host, port = addr.rsplit(":", 1)
+        conn = http.client.HTTPConnection(host, int(port), timeout=120)
+        t0 = time.perf_counter()
+        conn.request("POST", path, body=PatternBody(total, seed),
+                     headers={"Content-Length": str(total)})
+        r = conn.getresponse()
+        r.read()
+        conn.close()
+        assert r.status == 201, r.status
+        return time.perf_counter() - t0
+
+    def stream_get(addr, path, headers=None):
+        host, port = addr.rsplit(":", 1)
+        conn = http.client.HTTPConnection(host, int(port), timeout=120)
+        t0 = time.perf_counter()
+        conn.request("GET", path, headers=headers or {})
+        r = conn.getresponse()
+        n = 0
+        while True:
+            piece = r.read(1 << 20)
+            if not piece:
+                break
+            n += len(piece)
+        conn.close()
+        return time.perf_counter() - t0, n, r.status
+
+    with SimCluster(volume_servers=2, filers=1, max_volumes=60,
+                    filer_chunk_size=chunk, seed=81) as c:
+        addr = c.filers[0].address
+        # streamed PUT MB/s (each run writes a fresh object)
+        put_s = [stream_put(addr, f"/bench/large{i}.bin", size, i)
+                 for i in range(2 if quick else 3)]
+        mbs, mbs_spread = spread(
+            [size / 1e6 / s for s in put_s], digits=1)
+        out["largefile_put_mb_s"] = mbs
+        out["largefile_put_mb_s_spread"] = mbs_spread
+
+        # single-stream GET MB/s + p99 across repeats
+        gets = [stream_get(addr, "/bench/large0.bin")
+                for _ in range(n_get)]
+        assert all(n == size and st == 200 for _, n, st in gets)
+        gmbs, gmbs_spread = spread(
+            [size / 1e6 / t for t, _, _ in gets], digits=1)
+        out["largefile_get_mb_s"] = gmbs
+        out["largefile_get_mb_s_spread"] = gmbs_spread
+        lats = sorted(t * 1e3 for t, _, _ in gets)
+        out["largefile_get_p99_ms"] = round(
+            lats[min(len(lats) - 1, int(0.99 * len(lats)))], 1)
+
+        # 4 concurrent streams: aggregate MB/s + slowest-stream p99
+        times = [0.0] * 4
+
+        def worker(i):
+            t, n, st = stream_get(addr, "/bench/large0.bin")
+            assert n == size and st == 200
+            times[i] = t
+
+        t0 = time.perf_counter()
+        threads = [_threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        out["largefile_get_4stream_mb_s"] = round(
+            4 * size / 1e6 / wall, 1)
+        out["largefile_get_4stream_p99_ms"] = round(
+            max(times) * 1e3, 1)
+
+        # readahead A/B under injected chunk-fetch latency: a FRESH
+        # (cold-cache) object per read, same fault schedule, only
+        # WEED_READAHEAD_CHUNKS differs — the pipelined reader must
+        # hide the per-chunk stall the fault injects
+        runs = 2 if quick else 3
+        for i in range(2 * runs):
+            stream_put(addr, f"/bench/ab{i}.bin", size, 100 + i)
+        rules = [c.inject_disk_fault(i, op="pread", mode="latency",
+                                     latency=0.03)
+                 for i in range(2)]
+        saved = os.environ.get("WEED_READAHEAD_CHUNKS")
+        try:
+            on_s, off_s = [], []
+            for i in range(runs):
+                os.environ["WEED_READAHEAD_CHUNKS"] = "0"
+                off_s.append(
+                    stream_get(addr, f"/bench/ab{2 * i}.bin")[0])
+                os.environ["WEED_READAHEAD_CHUNKS"] = "3"
+                on_s.append(
+                    stream_get(addr, f"/bench/ab{2 * i + 1}.bin")[0])
+        finally:
+            if saved is None:
+                os.environ.pop("WEED_READAHEAD_CHUNKS", None)
+            else:
+                os.environ["WEED_READAHEAD_CHUNKS"] = saved
+            faults.clear()
+            assert rules
+        out["largefile_readahead_on_s"] = round(
+            float(np.median(on_s)), 3)
+        out["largefile_readahead_off_s"] = round(
+            float(np.median(off_s)), 3)
+        out["largefile_readahead_speedup"] = round(
+            float(np.median(off_s)) / max(1e-9,
+                                          float(np.median(on_s))), 2)
+
+        # mid-object 1MB Range: bytes moved off the volume servers
+        # (fresh object so the filer chunk cache is cold)
+        stream_put(addr, "/bench/ranged.bin", size, 9)
+        reader = c.filers[0]._chunk_reader
+        before = (reader.stats["chunk_bytes"],
+                  reader.stats["range_bytes"])
+        lo = size // 2 + 12345
+        t, n, st = stream_get(
+            addr, "/bench/ranged.bin",
+            headers={"Range": f"bytes={lo}-{lo + (1 << 20) - 1}"})
+        assert st == 206 and n == 1 << 20, (st, n)
+        moved = (reader.stats["chunk_bytes"] - before[0]) \
+            + (reader.stats["range_bytes"] - before[1])
+        out["largefile_range_1mb_bytes_moved"] = moved
+        out["largefile_range_1mb_vs_2chunks"] = round(
+            moved / (2 * chunk), 3)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -1254,6 +1400,10 @@ def main():
                 smallfile.update(bench_worker_scaling(quick=args.quick))
             except Exception as e:
                 smallfile["worker_scaling_error"] = str(e)[:200]
+            try:
+                smallfile.update(bench_largefile(quick=args.quick))
+            except Exception as e:
+                smallfile["largefile_error"] = str(e)[:200]
         except Exception as e:   # never fail the headline metric
             smallfile = {"smallfile_error": str(e)[:200]}
     # end-to-end disk path (VERDICT r3 missing #1)

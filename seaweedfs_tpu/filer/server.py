@@ -30,7 +30,8 @@ from ..pb.rpc import RpcError, RpcServer
 from ..stats import ServerMetrics
 from ..util import cipher, compression
 from ..util.compression import accepts_gzip as _accepts_gzip
-from ..util.http import HttpServer, Request, Response
+from ..util.http import (HttpServer, Request, Response, StreamBody,
+                         parse_byte_range)
 from ..util import tracing
 from ..util.tracing import Tracer
 from ..util.weedlog import logger
@@ -94,24 +95,24 @@ class FilerConf:
 
 
 def _parse_range(spec: str, size: int) -> "tuple[int, int] | None":
-    """One RFC 7233 byte-range -> [start, stop) clamped to size, or None if
-    unsatisfiable.  Multi-range requests fall back to the full body."""
-    if "," in spec:
-        return (0, size)  # multi-range: serve 200 with everything
+    """One RFC 7233 byte-range -> [start, stop) clamped to size, or None
+    if unsatisfiable.  A multi-range request answers with its FIRST
+    range as a 206 (single-range semantics, the common-server behavior)
+    — the old full-200 fallback made `bytes=0-0,5-5` on a 4GB object
+    ship the whole body.  Shared math with the volume handler
+    (util/http.parse_byte_range)."""
+    return parse_byte_range(spec, size)
+
+
+def _upload_window() -> int:
+    """WEED_UPLOAD_WINDOW: in-flight chunk uploads a streaming PUT may
+    hold — peak filer memory per upload is O(chunk_size × window), not
+    O(object).  0 restores the buffered whole-body write path
+    byte-identically."""
     try:
-        first, _, last = spec.partition("-")
-        if first == "":            # suffix form: last N bytes
-            n = int(last)
-            if n <= 0:
-                return None
-            return (max(0, size - n), size)
-        start = int(first)
-        stop = int(last) + 1 if last else size
+        return max(0, int(os.environ.get("WEED_UPLOAD_WINDOW", "2")))
     except ValueError:
-        return None
-    if start >= size or start < 0 or stop <= start:
-        return None
-    return (start, min(stop, size))
+        return 2
 
 
 class FilerServer:
@@ -199,6 +200,12 @@ class FilerServer:
         # fids consumed locally — the per-small-write cluster RPC the
         # reference's batched assigns amortize (operation.FidLeaser)
         self._fid_leaser = operation.FidLeaser()
+        # rolling-flush upload pool: streaming PUTs submit chunk uploads
+        # here while the next chunk is still being read off the wire;
+        # the per-request WINDOW (not this pool's size) bounds memory
+        from concurrent.futures import ThreadPoolExecutor
+        self._flush_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="filer-flush")
         self._stop = threading.Event()
         # aggregate feed = local events + peer filers' events
         # (meta_aggregator.go); peers follow our LOCAL stream only, so
@@ -245,6 +252,8 @@ class FilerServer:
             self._master_client.stop()
         self.http.stop()
         self.rpc.stop()
+        self._flush_pool.shutdown(wait=False)
+        self._chunk_reader.close()
         self.filer.store.close()
         if self.journal is not None:
             self.journal.close()
@@ -388,7 +397,10 @@ class FilerServer:
         from ..util import profiling
         self.http.route("GET", "/debug/profile",
                         profiling.profile_http_handler(), exact=True)
-        self.http.route("*", "/", self._http_dispatch)
+        # stream_body: uploads arrive as a reader, so PUT/POST bodies
+        # chunk-and-flush as bytes arrive instead of buffering whole
+        # multi-GB objects (reads/deletes materialize on entry)
+        self.http.route("*", "/", self._http_dispatch, stream_body=True)
 
     def _http_metrics(self, req: Request) -> Response:
         from ..stats import metrics_response
@@ -432,6 +444,9 @@ class FilerServer:
         t0 = time.perf_counter()   # monotonic: latency, not timestamp
         path = urllib.parse.unquote(req.path) or "/"
         kind = self._KINDS.get(req.method, "other")
+        if kind != "write" and req.body_stream is not None:
+            # only uploads understand streamed bodies
+            req.materialize_body()
         try:  # finally: handler exceptions (-> 500 upstream) must count
             if kind == "write":
                 return self._http_write(path, req)
@@ -447,24 +462,48 @@ class FilerServer:
                 trace_id=tracing.current_trace_id())
 
     def _http_write(self, path: str, req: Request) -> Response:
-        """Auto-chunked upload (doPostAutoChunk)."""
-        if path.endswith("/") and not req.body:
-            # explicit directory creation
-            from .entry import new_directory_entry
-            self.filer.create_entry(new_directory_entry(path.rstrip("/")))
-            return Response.json({"name": path}, status=201)
+        """Auto-chunked upload (doPostAutoChunk).  Streamed bodies
+        chunk-and-flush as bytes arrive: each full chunk uploads on the
+        rolling-flush pool while the next is read off the wire, bounded
+        by WEED_UPLOAD_WINDOW in-flight uploads — peak filer RSS per
+        PUT is O(chunk_size × window) however large the object.
+        Single-chunk bodies (and WEED_UPLOAD_WINDOW=0) take the
+        original buffered path byte-identically."""
+        if path.endswith("/"):
+            # directories carry no real body
+            req.materialize_body()   # weedlint: disable=WL130
+            if not req.body:         # weedlint: disable=WL130
+                # explicit directory creation
+                from .entry import new_directory_entry
+                self.filer.create_entry(
+                    new_directory_entry(path.rstrip("/")))
+                return Response.json({"name": path}, status=201)
         ts_ns = time.time_ns()
-        chunks: list[FileChunk] = []
-        body = req.body
         mime = req.headers.get("Content-Type", "")
-        for off in range(0, len(body), self.chunk_size) or [0]:
-            piece = body[off:off + self.chunk_size]
-            if piece or off == 0:
-                chunks.append(self._save_chunk(piece, ts_ns, off,
-                                               path=path, mime=mime))
+        window = _upload_window()
+        if req.body_stream is not None \
+                and (window == 0
+                     or 0 <= req.content_length <= self.chunk_size):
+            # knob off, or a single-chunk body: the rolling window buys
+            # nothing — keep the small-write hot path allocation-free
+            req.materialize_body()   # weedlint: disable=WL130
+        import hashlib
+        if req.body_stream is not None:
+            chunks, etag_hex = self._write_streaming(
+                path, req.body_stream, ts_ns, mime, window)
+        else:
+            # legacy buffered path (knob off / single-chunk): pinned
+            # byte-identical to the pre-streaming write loop
+            body = req.body          # weedlint: disable=WL130
+            chunks = []
+            for off in range(0, len(body), self.chunk_size) or [0]:
+                piece = body[off:off + self.chunk_size]
+                if piece or off == 0:
+                    chunks.append(self._save_chunk(piece, ts_ns, off,
+                                                   path=path, mime=mime))
+            etag_hex = hashlib.md5(body).hexdigest()
         chunks = maybe_manifestize(self._save_manifest_blob, chunks)
         now = time.time()
-        import hashlib
         from ..storage.ttl import TTL
         rule = self.conf.match(path)
         ttl_sec = 0
@@ -477,7 +516,7 @@ class FilerServer:
         # SaveAmzMetaData analogue): the S3 gateway stamps ownership and
         # ACL grants this way in the SAME upload round-trip instead of a
         # lookup+update pair per PUT
-        extended = {"etag": hashlib.md5(body).hexdigest()}
+        extended = {"etag": etag_hex}
         for h, v in req.headers.items():
             if h.lower().startswith("seaweed-"):
                 extended[h[len("Seaweed-"):]] = v
@@ -491,6 +530,54 @@ class FilerServer:
         self.filer.create_entry(entry)
         return Response.json({"name": entry.name,
                               "size": total_size(chunks)}, status=201)
+
+    def _write_streaming(self, path: str, stream, ts_ns: int, mime: str,
+                         window: int) -> "tuple[list[FileChunk], str]":
+        """Rolling-flush upload loop: read a chunk, submit its upload,
+        keep at most `window` uploads in flight, md5 computed
+        incrementally.  An upload failure aborts the read loop (the
+        serving layer answers 500 and closes the half-read connection);
+        already-uploaded chunks are queued for async deletion so a
+        failed multi-GB PUT doesn't strand gigabytes."""
+        import hashlib
+        from collections import deque
+        md5 = hashlib.md5()
+        chunks: list[FileChunk] = []
+        futs: "deque" = deque()
+        save = tracing.propagate(self._save_chunk)
+        off = 0
+        try:
+            while True:
+                piece = stream.read(self.chunk_size)
+                if not piece and off > 0:
+                    break
+                md5.update(piece)
+                while len(futs) >= max(1, window):
+                    chunks.append(futs.popleft().result())
+                futs.append(self._flush_pool.submit(
+                    save, piece, ts_ns, off, path, mime))
+                off += len(piece)
+                empty = not piece
+                piece = None   # the future owns it now; don't pin a
+                #                second copy across the next blocking read
+                if empty:
+                    break   # empty body: one empty chunk, matching the
+                            # buffered path's `range(...) or [0]`
+            while futs:
+                chunks.append(futs.popleft().result())
+        except BaseException:
+            # collect what did land and release it — the entry is never
+            # created, so these chunks are already garbage
+            for f in futs:
+                try:
+                    chunks.append(f.result())
+                except Exception as e2:
+                    LOG.debug("abandoned chunk upload also failed "
+                              "(nothing to clean): %s", e2)
+            if chunks:
+                self._enqueue_deletion(chunks)
+            raise
+        return chunks, md5.hexdigest()
 
     def _http_read(self, path: str, req: Request) -> Response:
         try:
@@ -549,7 +636,31 @@ class FilerServer:
                        "Content-Length": str(length)}
         else:
             try:
-                data = self._stream_content(chunks, offset, length)
+                from ..wdclient import readahead_chunks
+                n_ahead = readahead_chunks()
+                views = read_views(chunks, offset, length) \
+                    if n_ahead > 0 else []
+                if n_ahead > 0 and len(views) > 1:
+                    # multi-chunk body: pipelined streaming read — a
+                    # readahead window of chunk fetches runs while
+                    # earlier bytes stream out, so the filer never
+                    # holds more than ~window chunks of a 4GB object
+                    data = self._stream_content_pipelined(
+                        chunks, views, offset, length, n_ahead)
+                elif n_ahead > 0 and len(views) == 1 \
+                        and views[0].logic_offset == offset \
+                        and views[0].size == length:
+                    # a Range that lands inside ONE chunk: fetch just
+                    # the window (plaintext chunks ride the ranged
+                    # fast path and move only `length` bytes off the
+                    # volume server instead of the whole chunk)
+                    by_fid = {c.file_id: c for c in chunks}
+                    data = self._fetch_view(views[0],
+                                            by_fid[views[0].file_id])
+                else:
+                    # single chunk / WEED_READAHEAD_CHUNKS=0: the
+                    # original serial whole-buffer path, byte-identical
+                    data = self._stream_content(chunks, offset, length)
             except cipher.CipherError as e:
                 # loud, never silent garbage: wrong/corrupt key or
                 # tampered ciphertext is an integrity failure
@@ -599,6 +710,73 @@ class FilerServer:
             at = view.logic_offset - offset
             out[at:at + len(piece)] = piece
         return bytes(out)
+
+    def _fetch_view(self, view, c: FileChunk) -> bytes:
+        """One ChunkView's decoded bytes.  Whole-chunk views go through
+        the tiered chunk cache (populating it for the next reader);
+        plaintext sub-chunk edges ride the ranged fast path and move
+        only their window off the volume server."""
+        whole = view.offset_in_chunk == 0 and view.size == c.size
+        if not whole and not c.is_compressed and not c.cipher_key:
+            return self._with_master(
+                lambda m: self._chunk_reader.read_range(
+                    m, view.file_id, view.offset_in_chunk, view.size))
+        blob = compression.decode_chunk_record(
+            self._read_chunk_blob(view.file_id), c)
+        return blob[view.offset_in_chunk:view.offset_in_chunk
+                    + view.size]
+
+    _ZERO_BLOCK = bytes(1 << 20)
+
+    def _stream_content_pipelined(self, chunks: list[FileChunk], views,
+                                  offset: int, length: int,
+                                  window: int) -> StreamBody:
+        """The pipelined large-object read: per-view fetch+decode tasks
+        run on the shared readahead pool, at most `window` ahead of the
+        byte currently streaming out; sparse gaps zero-fill in bounded
+        blocks.  The FIRST view resolves before the response headers go
+        out, so the common failure modes (missing chunk, bad key,
+        corrupt gzip) still answer a clean 500 instead of a torn 200."""
+        by_fid = {c.file_id: c for c in chunks}
+        fetch = tracing.propagate(self._fetch_view)
+        submit = self._chunk_reader.submit
+
+        def gen():
+            from collections import deque
+            futs: "deque" = deque()
+            nxt = 0
+            pos = offset
+            end = offset + length
+            try:
+                for i, view in enumerate(views):
+                    while nxt < len(views) and nxt <= i + window:
+                        v = views[nxt]
+                        futs.append(submit(fetch, v,
+                                           by_fid[v.file_id]))
+                        nxt += 1
+                    piece = futs.popleft().result()
+                    gap = view.logic_offset - pos
+                    while gap > 0:   # sparse hole: bounded zero blocks
+                        block = self._ZERO_BLOCK[:min(
+                            gap, len(self._ZERO_BLOCK))]
+                        yield block
+                        gap -= len(block)
+                        pos += len(block)
+                    yield piece
+                    pos += len(piece)
+                while pos < end:     # sparse tail
+                    block = self._ZERO_BLOCK[:min(
+                        end - pos, len(self._ZERO_BLOCK))]
+                    yield block
+                    pos += len(block)
+            finally:
+                for f in futs:
+                    f.cancel()
+
+        it = gen()
+        first = next(it)   # resolve view 0 pre-headers (errors -> 500)
+        import itertools
+        return StreamBody(itertools.chain([first], it), length)
 
     def _http_delete(self, path: str, req: Request) -> Response:
         try:

@@ -14,6 +14,9 @@ from dataclasses import dataclass, field
 
 from ..pb.rpc import POOL
 from ..util.http import http_request
+from ..util.weedlog import logger
+
+LOG = logger(__name__)
 
 
 @dataclass
@@ -252,6 +255,18 @@ def read_batch_tcp(tcp_addr: str, fids: list[str]
 
 def read_file_tcp(tcp_addr: str, fid: str) -> bytes:
     return _tcp_call(tcp_addr, "R", fid)
+
+
+def read_range_tcp(tcp_addr: str, fid: str, offset: int,
+                   length: int) -> bytes:
+    """Ranged read over the frame fast path ('G'): only [offset,
+    offset+length) of the needle's data crosses the wire.  Raises
+    RuntimeError when the server can't serve it ranged (old server,
+    rich/compressed needle, EC volume) — callers fall back to a
+    whole-chunk read."""
+    from ..volume_server.tcp import pack_range_body
+    return _tcp_call(tcp_addr, "G", fid,
+                     body=pack_range_body(offset, length))
 
 
 def delete_file_tcp(tcp_addr: str, fid: str, jwt: str = "") -> dict:
@@ -568,6 +583,88 @@ def _read_file_resolve(master_grpc: str, fid: str, vid: int,
                 return body
             last_err = f"{loc['url']}: HTTP {status}"
     raise RuntimeError(f"read {fid} failed: {last_err}")
+
+
+def read_file_range(master_grpc: str, fid: str, offset: int,
+                    length: int, stats: "dict | None" = None) -> bytes:
+    """[offset, offset+length) of a STORED blob — the sub-chunk fast
+    path for large-object Range requests.  Rides the cached per-vid
+    frame route when one exists ('G' frame), falls back to an HTTP
+    Range request per replica, and degrades to slicing a whole-chunk
+    read when neither end can serve ranged (old server, rich needle).
+    Only plaintext chunks should come here: the stored bytes of a
+    compressed/sealed chunk can't be sub-sliced meaningfully.
+
+    `stats` (a CachedFileReader.stats-shaped dict) gets the TRUE bytes
+    moved on the whole-chunk degrade recorded as chunk_bytes — without
+    this, a silently-broken ranged path would keep reporting
+    window-sized transfers and the bytes-moved acceptance gate could
+    never catch the regression."""
+    if length <= 0:
+        return b""
+    vid = int(fid.split(",", 1)[0])
+    now = time.time()
+    refused: set = set()   # addrs that answered 'G' with a server error
+    #                        this call — don't pay the same RPC twice
+    route = _TCP_ROUTE.get((master_grpc, vid))
+    if route is not None and route[0] > now \
+            and _TCP_DEAD.get(route[1], 0) < now:
+        try:
+            return read_range_tcp(route[1], fid, offset, length)
+        except (OSError, ConnectionError):
+            _TCP_DEAD[route[1]] = now + _TCP_DEAD_TTL
+            _TCP_ROUTE.pop((master_grpc, vid), None)
+        except RuntimeError:
+            # server can't serve this ranged (or moved): resolve below
+            refused.add(route[1])
+    import http.client
+    last_err = ""
+    locs = lookup_volume(master_grpc, vid)
+    for loc in locs:
+        tcp = loc.get("tcp_url", "")
+        if tcp and tcp not in refused \
+                and _TCP_DEAD.get(tcp, 0) < now:
+            try:
+                data = read_range_tcp(tcp, fid, offset, length)
+                _TCP_ROUTE[(master_grpc, vid)] = (
+                    time.time() + _LOOKUP_TTL, tcp)
+                return data
+            except (OSError, ConnectionError):
+                _TCP_DEAD[tcp] = time.time() + _TCP_DEAD_TTL
+            except RuntimeError as e:
+                last_err = str(e)
+        if http_dead(loc["url"]):
+            continue
+        try:
+            # Accept-Encoding: gzip = stored bytes (matching read_file);
+            # plaintext chunks serve identity either way, and a server
+            # that ignores Range answers 200-full, which we slice
+            status, body, _ = http_request(
+                f"http://{loc['url']}/{fid}",
+                headers={"Accept-Encoding": "gzip",
+                         "Range":
+                         f"bytes={offset}-{offset + length - 1}"})
+        except (OSError, http.client.HTTPException) as e:
+            mark_http_dead(loc["url"])
+            last_err = f"{loc['url']}: {e}"
+            continue
+        if status == 206:
+            return body
+        if status == 200:
+            return body[offset:offset + length]
+        if status == 416:
+            return b""
+        last_err = f"{loc['url']}: HTTP {status}"
+    # every location refused the ranged forms (last_err tells why the
+    # final one did): whole-chunk fallback — read_file runs the full
+    # failover walk and raises its own error when truly unreachable
+    LOG.debug("ranged read of %s fell back to whole-chunk: %s", fid,
+              last_err or "no reachable locations")
+    blob = read_file(master_grpc, fid)
+    if stats is not None:
+        stats["range_fallbacks"] = stats.get("range_fallbacks", 0) + 1
+        stats["chunk_bytes"] = stats.get("chunk_bytes", 0) + len(blob)
+    return blob[offset:offset + length]
 
 
 def delete_file(master_grpc: str, fid: str) -> None:

@@ -13,6 +13,7 @@ GCS/Azure/B2 sinks follow the same interface (SDKs absent from image).
 from __future__ import annotations
 
 import os
+import threading
 from typing import Protocol
 
 from ..filer.entry import Entry
@@ -46,6 +47,39 @@ def _mtime_ns(entry_dict_or_entry) -> int:
         if isinstance(entry_dict_or_entry, dict) \
         else vars(entry_dict_or_entry.attr)
     return int(float(attr.get("mtime", 0.0)) * 1e9)
+
+
+# shared chunk-copy pool for pipelined cross-cluster transfers: one per
+# process, every FilerSink direction rides it (worker threads keep their
+# per-thread frame connections warm across applies, like the volume
+# fan-out executor).  Lock: two directions' first multi-chunk applies
+# can race the lazy init, and the loser's executor would leak.
+_COPY_POOL = None
+_COPY_POOL_LOCK = threading.Lock()
+
+
+def _chunk_copy_concurrency() -> int:
+    """In-flight chunk copies within ONE entry apply.  Honors
+    WEED_SYNC_APPLY_CONCURRENCY when set; otherwise defaults to 4 —
+    unlike entry applies (which a sqlite target serializes server-side,
+    so concurrency loses on small boxes), chunk copies are pure
+    data-plane round-trips that overlap anywhere."""
+    try:
+        n = int(os.environ.get("WEED_SYNC_APPLY_CONCURRENCY", "0"))
+    except ValueError:
+        n = 0
+    return n if n > 0 else 4
+
+
+def _chunk_copy_pool():
+    global _COPY_POOL
+    with _COPY_POOL_LOCK:
+        if _COPY_POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _COPY_POOL = ThreadPoolExecutor(
+                max_workers=max(2, _chunk_copy_concurrency()),
+                thread_name_prefix="sync-chunk-copy")
+        return _COPY_POOL
 
 
 class FilerSink:
@@ -93,8 +127,24 @@ class FilerSink:
         as-is — raw ciphertext travels, cipher_key rides in the entry, so
         the target cluster is exactly as encrypted as the source.  Fids
         already copied this stream's lifetime are reused (chunk-level
-        dedup): an entry update that keeps 9 of 10 chunks ships one."""
-        out = []
+        dedup): an entry update that keeps 9 of 10 chunks ships one.
+
+        Multi-chunk entries PIPELINE their copies: up to
+        WEED_SYNC_APPLY_CONCURRENCY fetch/store round-trips of the SAME
+        entry run concurrently on the shared copy pool — a 10-chunk
+        80MB entry costs ~max(chunk RTT) instead of their sum.  The fid
+        cache is read/written only from this thread; workers touch only
+        the data plane.  On a partial failure every chunk that DID land
+        is still recorded in the cache (the retry re-ships only the
+        losers), then the first error propagates so the stream never
+        advances past an unapplied entry."""
+        out: list[dict] = []
+        pending: "list[tuple[int, FileChunk]]" = []
+        # fid -> every out-index wanting its copy: a fid repeated
+        # WITHIN one entry still crosses the wire once (the old inline
+        # loop got this via the cache; batched collection must dedupe
+        # before dispatch)
+        wanted: "dict[str, list[int]]" = {}
         for c in entry.chunks:
             d = c.to_dict()
             if self.read_chunk and self.write_chunk:
@@ -103,15 +153,53 @@ class FilerSink:
                 if cached is not None:
                     d["file_id"] = cached
                     self.stats["chunks_deduped"] += 1
+                elif c.file_id in wanted:
+                    wanted[c.file_id].append(len(out))
+                    self.stats["chunks_deduped"] += 1
                 else:
-                    data = self.read_chunk(c.file_id)
-                    d["file_id"] = self.write_chunk(data)
-                    self.stats["chunks_copied"] += 1
-                    if self.fid_cache is not None:
-                        if len(self.fid_cache) > 100_000:
-                            self.fid_cache.clear()   # bounded, coarse
-                        self.fid_cache[c.file_id] = d["file_id"]
+                    wanted[c.file_id] = [len(out)]
+                    pending.append((len(out), c))
             out.append(d)
+        if not pending:
+            return out
+
+        def copy(chunk):
+            return self.write_chunk(self.read_chunk(chunk.file_id))
+
+        results = []
+        if len(pending) == 1 or _chunk_copy_concurrency() <= 1:
+            # serial mode shares the same per-chunk error bookkeeping
+            # as the pipelined branch: chunks copied BEFORE a failure
+            # must still reach the dedup cache below, or every stream
+            # retry re-ships them as fresh (orphaned) target fids
+            for i, c in pending:
+                try:
+                    results.append((i, c, copy(c), None))
+                except Exception as e:
+                    results.append((i, c, None, e))
+                    break    # serial: later chunks were never attempted
+        else:
+            pool = _chunk_copy_pool()
+            futs = [(i, c, pool.submit(copy, c)) for i, c in pending]
+            for i, c, f in futs:
+                try:
+                    results.append((i, c, f.result(), None))
+                except Exception as e:
+                    results.append((i, c, None, e))
+        first_err = None
+        for i, c, dst, err in results:
+            if err is not None:
+                first_err = first_err or err
+                continue
+            for j in wanted[c.file_id]:
+                out[j]["file_id"] = dst
+            self.stats["chunks_copied"] += 1
+            if self.fid_cache is not None:
+                if len(self.fid_cache) > 100_000:
+                    self.fid_cache.clear()   # bounded, coarse
+                self.fid_cache[c.file_id] = dst
+        if first_err is not None:
+            raise first_err
         return out
 
     # -- conflict rules (lww mode) ----------------------------------------

@@ -71,6 +71,28 @@ class _BucketMeta:
 LOG = logger(__name__)
 
 
+class _Md5Tee:
+    """File-like over a streamed request body: forwards read() to the
+    filer upload while folding the bytes into an md5 — the S3 ETag of
+    a streamed PUT without a second pass (or a buffered copy).
+    Deliberately has no seek(): the pooled HTTP client sees that and
+    sends it on a fresh connection with no stale-socket resend."""
+
+    __slots__ = ("_s", "md5", "consumed")
+
+    def __init__(self, stream):
+        self._s = stream
+        self.md5 = hashlib.md5()
+        self.consumed = 0
+
+    def read(self, n: int = -1) -> bytes:
+        piece = self._s.read(n)
+        if piece:
+            self.md5.update(piece)
+            self.consumed += len(piece)
+        return piece
+
+
 def _xml(root: ET.Element) -> bytes:
     return (b'<?xml version="1.0" encoding="UTF-8"?>'
             + ET.tostring(root))
@@ -110,7 +132,10 @@ class S3ApiServer:
         # query-carrying requests (a bucket literally named "metrics":
         # ?list-type, ?acl, ?location, ...) re-enter the S3 dispatch
         self.http.route("GET", "/metrics", self._http_metrics, exact=True)
-        self.http.route("*", "/", self._dispatch)
+        # stream_body: plain object PUT / part PUT forward their bytes
+        # to the filer as they arrive (rolling chunk flush end-to-end);
+        # every other request materializes on entry (_dispatch_inner)
+        self.http.route("*", "/", self._dispatch, stream_body=True)
         self._iam_stop = threading.Event()
         self._bucket_meta_cache: "dict[str, tuple[_BucketMeta, float]]" \
             = {}
@@ -217,7 +242,9 @@ class S3ApiServer:
                 # bytes: request size for uploads, response size for
                 # reads — never the error XML's length for a rejected PUT
                 if req.method in ("PUT", "POST"):
-                    nbytes = len(req.body or b"")
+                    streamed = getattr(req, "_streamed_nbytes", None)
+                    nbytes = streamed if streamed is not None \
+                        else len(req.body or b"")
                 else:
                     nbytes = len(resp.body) if resp is not None \
                         and resp.body else 0
@@ -240,12 +267,41 @@ class S3ApiServer:
                     duration_ms=(time.perf_counter() - t0) * 1000,
                     authz=authz, authz_source=authz_source)
 
+    def _stream_ok(self, req: Request, key: str) -> bool:
+        """May this request's body stay a stream all the way to the
+        filer?  Only plain object PUT and part PUT qualify, and only
+        when signature verification doesn't need the whole payload
+        (UNSIGNED-PAYLOAD, or an open gateway) — signed payloads,
+        aws-chunked framing, and every body-parsing sub-resource
+        (?tagging, ?acl, ?policy, ?delete, POST forms) materialize."""
+        if req.method != "PUT" or not key:
+            return False
+        q = set(req.query)
+        if q - {"partNumber", "uploadId"}:
+            return False
+        if ("partNumber" in q) != ("uploadId" in q):
+            return False
+        from .auth import STREAMING_SENTINELS
+        sha = req.headers.get("X-Amz-Content-Sha256", "")
+        if sha in STREAMING_SENTINELS \
+                or "aws-chunked" in req.headers.get("Content-Encoding",
+                                                    "").lower():
+            # aws-chunked framing must be decoded whole-body regardless
+            # of auth posture — streaming it through would store the
+            # chunk-signature envelope as object bytes
+            return False
+        if not self.iam.is_enabled():
+            return True
+        return sha == "UNSIGNED-PAYLOAD"
+
     def _dispatch_inner(self, req: Request) -> Response:
         path = urllib.parse.unquote(req.path)
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
         req._audit_bucket, req._audit_key = bucket, key  # ONE parse
+        if req.body_stream is not None and not self._stream_ok(req, key):
+            req.materialize_body()  # weedlint: disable=WL130
         # browser POST-policy uploads authenticate via the signed policy
         # INSIDE the form, not the Authorization header — route them
         # before the header-based authenticate rejects them
@@ -761,19 +817,27 @@ class S3ApiServer:
         return {f"Seaweed-{OWNER_ATTR}": ident.name,
                 f"Seaweed-{ACL_ATTR}": acp.to_json()}
 
-    def _store_object(self, bucket: str, key: str, data: bytes,
+    def _store_object(self, bucket: str, key: str, data,
                       content_type: str = "",
-                      extra_headers: "dict[str, str] | None" = None
+                      extra_headers: "dict[str, str] | None" = None,
+                      length: int = -1
                       ) -> "tuple[str, Response | None]":
         """Quota gate + filer upload + error mapping — the storage tail
-        shared by PUT object and POST-policy uploads.  -> (etag, None)
-        on success, ("", error Response) otherwise."""
+        shared by PUT object and POST-policy uploads.  `data` is bytes
+        OR a streamed-body reader: streams forward to the filer as they
+        arrive (Content-Length when declared, chunked otherwise) with
+        the ETag md5 computed by a tee, never a buffered copy.
+        -> (etag, None) on success, ("", error Response) otherwise."""
         denied = self._quota_response(bucket)
         if denied:
             return "", denied
         headers = dict(extra_headers or {})
         if content_type:
             headers["Content-Type"] = content_type
+        if hasattr(data, "read"):
+            data = _Md5Tee(data)
+            if length >= 0:
+                headers["Content-Length"] = str(length)
         status, body, _ = http_request(self._object_url(bucket, key),
                                        method="POST", body=data,
                                        headers=headers)
@@ -782,6 +846,8 @@ class S3ApiServer:
                 500, _error_xml("InternalError",
                                 body.decode(errors="replace")),
                 content_type="application/xml")
+        if isinstance(data, _Md5Tee):
+            return data.md5.hexdigest(), None
         return hashlib.md5(data).hexdigest(), None
 
     def _put_object(self, bucket: str, key: str, ident: Identity,
@@ -792,9 +858,21 @@ class S3ApiServer:
             return Response(400, _error_xml("InvalidArgument", str(e),
                                             key),
                             content_type="application/xml")
-        etag, err = self._store_object(
-            bucket, key, req.body, req.headers.get("Content-Type", ""),
-            extra_headers=stamp)
+        if req.body_stream is not None:
+            data, length = req.body_stream, req.content_length
+        else:
+            # materialized upstream (signed payload / aws-chunked)
+            data, length = req.body, len(req.body)  # weedlint: disable=WL130
+        try:
+            etag, err = self._store_object(
+                bucket, key, data, req.headers.get("Content-Type", ""),
+                extra_headers=stamp, length=length)
+        finally:
+            if req.body_stream is not None:
+                # audit ingress = bytes actually consumed off the wire,
+                # recorded on error paths too (a failed streamed PUT
+                # must not report zero)
+                req._streamed_nbytes = req.body_stream.consumed
         if err is not None:
             return err
         return Response(200, b"", headers={"ETag": f'"{etag}"'})
@@ -1169,12 +1247,28 @@ class S3ApiServer:
         upload_id = req.qs("uploadId")
         url = (f"http://{self.filer_http}"
                f"{self._uploads_dir(bucket, upload_id)}/{part:04d}.part")
-        status, body, _ = http_request(url, method="POST", body=req.body)
+        headers = {}
+        if req.body_stream is not None:
+            # part bytes stream straight through to the filer's rolling
+            # chunk flush — a 5GB part costs O(chunk window) RAM here
+            data = _Md5Tee(req.body_stream)
+            if req.content_length >= 0:
+                headers["Content-Length"] = str(req.content_length)
+        else:
+            # materialized upstream (signed payload / aws-chunked)
+            data = req.body          # weedlint: disable=WL130
+        try:
+            status, body, _ = http_request(url, method="POST",
+                                           body=data, headers=headers)
+        finally:
+            if isinstance(data, _Md5Tee):
+                req._streamed_nbytes = data.consumed
         if status >= 300:
             return Response(500, _error_xml("InternalError",
                                             body.decode(errors="replace")),
                             content_type="application/xml")
-        etag = hashlib.md5(req.body).hexdigest()
+        etag = data.md5.hexdigest() if isinstance(data, _Md5Tee) \
+            else hashlib.md5(data).hexdigest()
         return Response(200, b"", headers={"ETag": f'"{etag}"'})
 
     def _list_parts(self, bucket: str, key: str,

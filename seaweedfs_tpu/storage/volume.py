@@ -36,7 +36,7 @@ from dataclasses import dataclass
 
 from . import types as t
 from ..util.weedlog import logger
-from .backend import BackendStorageFile, open_backend
+from .backend import BackendStorageFile, MemoryMappedFile, open_backend
 from .idx import idx_entry_bytes, parse_index_bytes
 from .needle import Needle, read_needle_header
 from .needle_map import KIND_MEMORY, NeedleMapper, new_needle_map
@@ -495,6 +495,117 @@ class Volume:
             # closed/swapped backend mid-read (vacuum): one coherent
             # locked retry; real corruption re-raises the same error
             return self._locked_retry(attempt)
+
+    def read_needle_range(self, n_id: int, cookie: "int | None",
+                          offset: int, length: int) -> bytes:
+        """Sub-range of a needle's DATA bytes with exactly the preads
+        the range needs: one 21-byte header probe (cookie/id/size/
+        dataSize + a flags peek) and one ranged pread — never the whole
+        record.  This is the large-object fast path: a 1MB Range read
+        out of an 8MB chunk moves 1MB off this disk, not 8.
+
+        Restricted to plain blobs (flags==0 on v2+; any v1 record):
+        compressed/TTL'd/named needles raise VolumeError so the caller
+        falls back to the full read where the complete parse runs.
+        Sub-range reads skip the data CRC — verifying it would require
+        reading the whole record, defeating the point; whole-chunk
+        reads on every path still verify, and the anti-entropy scrub
+        owns at-rest rot detection."""
+        if length <= 0:
+            return b""
+
+        def attempt(nm: NeedleMapper,
+                    backend: BackendStorageFile) -> bytes:
+            nv = nm.get(n_id)
+            if nv is None or nv.offset == 0 or t.size_is_deleted(nv.size):
+                raise NotFoundError(
+                    f"needle {n_id:x} not found in volume {self.id}")
+            head = backend.read_at(t.NEEDLE_HEADER_SIZE + 4, nv.offset)
+            if len(head) < t.NEEDLE_HEADER_SIZE:
+                raise VolumeError(
+                    f"short header read at offset {nv.offset}")
+            rec = Needle()
+            rec.parse_header(head)
+            if rec.id != n_id:
+                # fd-reuse race with a vacuum swap (see read_needle):
+                # the locked retry re-reads coherently
+                raise VolumeError(
+                    f"needle id mismatch at offset {nv.offset}: "
+                    f"read {rec.id:x}, wanted {n_id:x}")
+            if rec.size != nv.size:
+                raise VolumeError(
+                    f"needle {n_id:x} size mismatch: header "
+                    f"{rec.size}, map {nv.size}")
+            if cookie is not None and rec.cookie != cookie:
+                raise CookieMismatchError(
+                    f"cookie mismatch for needle {n_id:x}")
+            if self.version == t.VERSION1:
+                data_off, data_len = t.NEEDLE_HEADER_SIZE, rec.size
+            else:
+                import struct as _struct
+                data_len = _struct.unpack_from(">I", head,
+                                               t.NEEDLE_HEADER_SIZE)[0]
+                data_off = t.NEEDLE_HEADER_SIZE + 4
+                if rec.size != data_len + 5:
+                    # flags/name/mime/ttl present: not a plain blob
+                    raise VolumeError(
+                        f"needle {n_id:x} is not a plain blob")
+                flags_b = backend.read_at(
+                    1, nv.offset + data_off + data_len)
+                if not flags_b or flags_b[0] != 0:
+                    raise VolumeError(
+                        f"needle {n_id:x} has flags "
+                        f"{flags_b[0] if flags_b else '??'}; ranged "
+                        "reads serve plain blobs only")
+            if offset >= data_len:
+                raise VolumeError(
+                    f"range start {offset} beyond needle data "
+                    f"{data_len}")
+            want = min(length, data_len - offset)
+            piece = backend.read_at(want, nv.offset + data_off + offset)
+            if len(piece) < want:
+                raise VolumeError(
+                    f"short ranged read: {len(piece)} of {want}")
+            return piece
+
+        try:
+            return attempt(*self._read_ref)
+        except (NotFoundError, CookieMismatchError):
+            raise
+        except Exception:
+            return self._locked_retry(attempt)
+
+    def data_fd_for_sendfile(self, n_id: int,
+                             volume_offset: int) -> "int | None":
+        """A dup'ed fd of the live .dat, taken under the volume lock and
+        only while needle `n_id` still lives at `volume_offset` — the
+        zero-copy serving guard.  The dup stays valid for the whole
+        sendfile even if a vacuum swaps the backend mid-send (the old
+        inode survives while the dup holds it); a swap BEFORE the dup is
+        caught by the offset re-check, because the fresh map's offsets
+        describe the fresh file.  None = serve from memory instead."""
+        with self._lock:
+            nv = self.nm.get(n_id)
+            if nv is None or nv.offset != volume_offset \
+                    or t.size_is_deleted(nv.size):
+                return None
+            b = self.data_backend
+            if isinstance(b, MemoryMappedFile):
+                b = b.disk
+            fd = getattr(b, "fd", None)
+            if fd is None or getattr(b, "_closed", False):
+                return None   # tiered/in-memory backends: no real fd
+            try:
+                return os.dup(fd)
+            except OSError:
+                return None
+
+    def needle_data_offset(self, volume_offset: int) -> int:
+        """Absolute .dat offset of a needle's data bytes, given its
+        record offset (header + the v2+ dataSize field) — where a
+        zero-copy sendfile starts."""
+        return volume_offset + t.NEEDLE_HEADER_SIZE \
+            + (0 if self.version == t.VERSION1 else 4)
 
     def has_needle(self, n_id: int) -> bool:
         nm, _ = self._read_ref

@@ -35,6 +35,39 @@ from ..volume_server import VolumeServer
 LOG = logger(__name__)
 
 
+class PatternBody:
+    """File-like deterministic byte stream for large-object drills: a
+    seeded 1MB block repeated `total` bytes, with an md5 folded as it
+    is read — neither the producing test/bench client nor the server
+    under test ever holds the whole object.  Shared by
+    tests/test_largefile.py and bench_largefile."""
+
+    def __init__(self, total: int, seed: int = 0):
+        self.total = total
+        self.sent = 0
+        import hashlib
+        self.md5 = hashlib.md5()
+        self._block = random.Random(seed).randbytes(1 << 20)
+
+    def read(self, n: int = -1) -> bytes:
+        if self.sent >= self.total:
+            return b""
+        want = self.total - self.sent if n is None or n < 0 \
+            else min(n, self.total - self.sent)
+        out = bytearray()
+        blk = len(self._block)
+        off = self.sent
+        while len(out) < want:
+            i = off % blk
+            take = min(want - len(out), blk - i)
+            out += self._block[i:i + take]
+            off += take
+        self.sent = off
+        piece = bytes(out)
+        self.md5.update(piece)
+        return piece
+
+
 def free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -58,6 +91,7 @@ class SimCluster:
                  repair: "dict | None" = None,
                  filer_store: str = "memory",
                  filer_journal: bool = True,
+                 filer_chunk_size: int = 0,
                  volume_workers: int = 1,
                  history_interval: float = 0.0):
         # self-healing loop (master/repair.py): off by default so kill/
@@ -110,6 +144,9 @@ class SimCluster:
         # resume tokens surviving
         self._filer_store = filer_store
         self._filer_journal = filer_journal
+        # 0 = the filer's 8MB default; large-object tests shrink it so
+        # multi-chunk paths exercise without multi-GB fixtures
+        self._filer_chunk_size = filer_chunk_size
         # >1: each volume server becomes a supervisor over that many
         # worker subprocesses sharing its data port (ISSUE 12)
         self.volume_workers = max(1, int(volume_workers))
@@ -154,11 +191,14 @@ class SimCluster:
             store_path = os.path.join(fdir, "meta.db")
         journal_dir = os.path.join(fdir, "journal") \
             if self._filer_journal else None
+        kw = {}
+        if self._filer_chunk_size > 0:
+            kw["chunk_size"] = self._filer_chunk_size
         return FilerServer(self._master_list(), port=port,
                            grpc_port=grpc_port,
                            store_kind=store_kind, store_path=store_path,
                            journal_dir=journal_dir,
-                           encrypt_data=self.encrypt_data)
+                           encrypt_data=self.encrypt_data, **kw)
 
     def _master_list(self) -> str:
         if self.peers:

@@ -69,16 +69,219 @@ class Request:
     headers: CIDict
     body: bytes
     remote_addr: str = ""  # client IP (audit logging)
+    # streaming request body (routes registered with stream_body=True):
+    # a BodyReader/ChunkedBodyReader over the connection instead of a
+    # materialized `body`.  Handlers that don't understand streams call
+    # materialize_body() and get exactly the old behavior.
+    body_stream: "object | None" = None
+    content_length: int = 0   # declared length; -1 = chunked/unknown
+    # the route handler matched at parse time (serving loop only):
+    # dispatch uses this instead of re-scanning the route table
+    handler: "object | None" = None
 
     def qs(self, key: str, default: str = "") -> str:
         vals = self.query.get(key)
         return vals[0] if vals else default
 
+    def materialize_body(self) -> bytes:
+        """Buffer a streamed body fully (the pre-streaming behavior) —
+        the escape hatch for handlers that need the whole payload
+        (signed-body verification, XML parses)."""
+        if self.body_stream is not None:
+            self.body = self.body_stream.read_all()
+            self.body_stream = None
+        return self.body
+
+
+class BodyReader:
+    """Streaming request body with a declared Content-Length: read(n)
+    pulls straight off the connection's buffered reader, so a handler
+    consuming in chunk-size pieces keeps peak memory at O(piece), not
+    O(body)."""
+
+    def __init__(self, rf, length: int):
+        self._rf = rf
+        self.length = length
+        self.consumed = 0
+
+    @property
+    def done(self) -> bool:
+        return self.consumed >= self.length
+
+    def read(self, n: int = -1) -> bytes:
+        remaining = self.length - self.consumed
+        if remaining <= 0:
+            return b""
+        want = remaining if n is None or n < 0 else min(n, remaining)
+        piece = self._rf.read(want)
+        if len(piece) < want:
+            raise _BadRequest("truncated body")
+        self.consumed += len(piece)
+        return piece
+
+    def read_all(self) -> bytes:
+        return self.read(-1)  # weedlint: disable=WL130
+
+    def drain(self, cap: int) -> bool:
+        """Discard up to `cap` unread bytes; True when fully drained
+        (keep-alive framing intact)."""
+        while not self.done and cap > 0:
+            piece = self.read(min(cap, 64 << 10))
+            cap -= len(piece)
+        return self.done
+
+
+class ChunkedBodyReader:
+    """Streaming Transfer-Encoding: chunked request body (same interface
+    as BodyReader; length unknown).  read_all() keeps the historical
+    64MB pre-dispatch cap — an unbounded chunk stream only passes
+    through this reader when the handler consumes it incrementally."""
+
+    MATERIALIZE_CAP = 64 << 20
+
+    def __init__(self, rf):
+        self._rf = rf
+        self.length = -1
+        self.consumed = 0
+        self._chunk_left = 0
+        self._eof = False
+
+    @property
+    def done(self) -> bool:
+        return self._eof
+
+    def _next_chunk(self) -> None:
+        size_line = self._rf.readline(_MAX_LINE)
+        if not size_line:
+            raise _BadRequest("truncated chunked body")
+        try:
+            size = int(size_line.split(b";", 1)[0].strip(), 16)
+        except ValueError:
+            raise _BadRequest("bad chunk size") from None
+        if size == 0:
+            while True:     # drain trailers to the blank line
+                t = self._rf.readline(_MAX_LINE)
+                if t in (b"\r\n", b"\n", b""):
+                    break
+            self._eof = True
+            return
+        self._chunk_left = size
+
+    def read(self, n: int = -1) -> bytes:
+        out = bytearray()
+        while not self._eof and (n < 0 or len(out) < n):
+            if self._chunk_left == 0:
+                self._next_chunk()
+                if self._eof:
+                    break
+            want = self._chunk_left if n < 0 \
+                else min(self._chunk_left, n - len(out))
+            piece = self._rf.read(want)
+            if len(piece) < want:
+                raise _BadRequest("truncated chunk")
+            out += piece
+            self.consumed += len(piece)
+            self._chunk_left -= len(piece)
+            if self._chunk_left == 0:
+                self._rf.read(2)  # trailing CRLF
+        return bytes(out)
+
+    def read_all(self) -> bytes:
+        out = bytearray()
+        while not self._eof:
+            out += self.read(1 << 20)
+            if len(out) > self.MATERIALIZE_CAP:
+                raise _BadRequest("chunked body too large")
+        return bytes(out)
+
+    def drain(self, cap: int) -> bool:
+        while not self._eof and cap > 0:
+            cap -= len(self.read(min(cap, 64 << 10)))
+        return self._eof
+
+
+class StreamBody:
+    """Streaming response body: an iterator of byte pieces plus the
+    total length (the serving loop still advertises Content-Length —
+    large-object GETs stream chunk by chunk instead of materializing
+    the whole object in filer memory)."""
+
+    __slots__ = ("it", "length")
+
+    def __init__(self, it, length: int):
+        self.it = it
+        self.length = length
+
+
+class FileRegion:
+    """Zero-copy response body: `count` bytes at `offset` of file
+    descriptor `fd`, sent with os.sendfile; `fallback` holds the same
+    (already CRC-verified) bytes for paths where sendfile can't run.
+    The region owns the (dup'ed) fd and closes it after the send."""
+
+    __slots__ = ("fd", "offset", "count", "fallback")
+
+    def __init__(self, fd: int, offset: int, count: int, fallback):
+        self.fd = fd
+        self.offset = offset
+        self.count = count
+        self.fallback = fallback
+
+    def close(self) -> None:
+        if self.fd >= 0:
+            try:
+                os.close(self.fd)
+            except OSError:
+                pass
+            self.fd = -1
+
+
+def parse_byte_range(spec: str, size: int) -> "tuple[int, int] | None":
+    """One RFC 7233 byte-range spec ('a-b', 'a-', '-n') -> [start, stop)
+    clamped to `size`, or None when unsatisfiable.  A multi-range list
+    answers with its FIRST range (single-range semantics, the common-
+    server behavior) — shared by the filer and volume read handlers so
+    both ends of a ranged chunk fetch agree on the math."""
+    if "," in spec:
+        spec = spec.split(",", 1)[0].strip()
+    try:
+        first, _, last = spec.partition("-")
+        if first == "":            # suffix form: last N bytes
+            n = int(last)
+            if n <= 0:
+                return None
+            return (max(0, size - n), size)
+        start = int(first)
+        stop = int(last) + 1 if last else size
+    except ValueError:
+        return None
+    if start >= size or start < 0 or stop <= start:
+        return None
+    return (start, min(stop, size))
+
+
+def _body_len(body) -> int:
+    if isinstance(body, StreamBody):
+        return body.length
+    if isinstance(body, FileRegion):
+        return body.count
+    return len(body)
+
+
+def _body_bytes(body) -> bytes:
+    """Materialized view of any response-body shape (fault injection and
+    other cold paths that must slice real bytes)."""
+    if isinstance(body, StreamBody):
+        return b"".join(bytes(p) for p in body.it)  # weedlint: disable=WL130
+    if isinstance(body, FileRegion):
+        return bytes(body.fallback)
+    return bytes(body)
+
 
 @dataclass
 class Response:
     status: int = 200
-    body: bytes = b""
+    body: bytes = b""    # bytes/memoryview | StreamBody | FileRegion
     content_type: str = "application/octet-stream"
     headers: dict[str, str] = field(default_factory=dict)
 
@@ -185,7 +388,8 @@ class HttpServer:
     span per request into that server's /debug/traces ring."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self.routes: list[tuple[str, str, Handler, bool]] = []
+        # (method, prefix, handler, exact, stream_body)
+        self.routes: list[tuple[str, str, Handler, bool, bool]] = []
         self.tracer: "tracing.Tracer | None" = None
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -207,17 +411,24 @@ class HttpServer:
         self._conns_lock = threading.Lock()
 
     def route(self, method: str, prefix: str, handler: Handler,
-              exact: bool = False) -> None:
-        self.routes.append((method, prefix, handler, exact))
+              exact: bool = False, stream_body: bool = False) -> None:
+        """stream_body=True: matched requests get their body as a
+        Request.body_stream reader instead of a materialized buffer —
+        the handler owns consumption (streaming uploads)."""
+        self.routes.append((method, prefix, handler, exact, stream_body))
         self.routes.sort(key=lambda r: (len(r[1]), r[3]), reverse=True)
 
-    def _match(self, method: str, path: str) -> Optional[Handler]:
-        for m, prefix, h, exact in self.routes:
+    def _match(self, method: str, path: str
+               ) -> "tuple[Optional[Handler], bool]":
+        """-> (handler, stream_body) — ONE matcher for both the
+        handler lookup and the body-streaming decision, so the two can
+        never route to different entries."""
+        for m, prefix, h, exact, stream in self.routes:
             if m not in (method, "*"):
                 continue
             if path == prefix if exact else path.startswith(prefix):
-                return h
-        return None
+                return h, stream
+        return None, False
 
     def start(self) -> int:
         self._thread = threading.Thread(target=self._accept_loop,
@@ -330,14 +541,53 @@ class HttpServer:
                 if req is None:       # clean EOF between requests
                     return
                 resp = self._dispatch(req)
-                if faults.ACTIVE and self._serve_fault(conn, req, resp):
-                    return            # injected mid-body reset
+                unread = req.body_stream is not None \
+                    and not req.body_stream.done
+                if unread:
+                    # handler answered without consuming the streamed
+                    # body (early error): cheaply complete the framing
+                    # so keep-alive survives, else close after replying
+                    try:
+                        unread = not req.body_stream.drain(1 << 20)
+                    except (_BadRequest, OSError, ConnectionError):
+                        unread = True
+                    if unread:
+                        close = True
                 try:
-                    self._emit(conn, req.method, resp, close=close)
-                except (BrokenPipeError, ConnectionResetError, OSError):
+                    if faults.ACTIVE and self._serve_fault(conn, req,
+                                                           resp):
+                        return        # injected mid-body reset
+                    try:
+                        self._emit(conn, req.method, resp, close=close)
+                    except (BrokenPipeError, ConnectionResetError,
+                            OSError):
+                        return
+                finally:
+                    if isinstance(resp.body, FileRegion):
+                        resp.body.close()
+                if unread:
+                    # the client may still be mid-send: flush a FIN and
+                    # drain a bounded slice of the abandoned body so the
+                    # queued response isn't RST away (same discipline as
+                    # _reply_error_and_drain on the frame path)
+                    try:
+                        conn.shutdown(socket.SHUT_WR)
+                        conn.settimeout(1.0)  # weedlint: disable=WL060
+                        drained = 0
+                        while drained < (8 << 20):
+                            piece = conn.recv(64 << 10)
+                            if not piece:
+                                break
+                            drained += len(piece)
+                    except OSError:
+                        pass
                     return
                 if close:
                     return
+                # keep-alive: drop request/response refs before parking
+                # in readline — an idle conn must not pin a multi-MB
+                # body until the peer's next request
+                req = resp = None  # noqa: F841
         except (ConnectionError, OSError, ValueError):
             pass
         finally:
@@ -390,26 +640,44 @@ class HttpServer:
             raise _BadRequest("too many headers")
         if headers.get("Expect", "").lower() == "100-continue":
             conn.sendall(b"HTTP/1.1 100 Continue\r\n\r\n")
+        target = target_b.decode("latin-1")
+        parsed = urllib.parse.urlsplit(target)
+        method = method_b.decode("latin-1")
+        # streaming routes take their body as a reader; everything else
+        # keeps the historical buffer-before-dispatch behavior.  The
+        # matched handler rides on the request so dispatch never
+        # re-scans (or diverges from) the route table.
+        handler, streams = self._match(method, parsed.path)
         body = b""
+        body_stream = None
+        content_length = 0
         te = headers.get("Transfer-Encoding", "").lower()
         if "chunked" in te:
-            body = self._read_chunked(rf)
+            content_length = -1
+            if streams:
+                body_stream = ChunkedBodyReader(rf)
+            else:
+                body = self._read_chunked(rf)
         else:
             try:
                 length = int(headers.get("Content-Length") or 0)
             except ValueError:
                 raise _BadRequest("bad Content-Length") from None
+            content_length = length
             if length:
-                body = rf.read(length)
-                if len(body) < length:
-                    raise _BadRequest("truncated body")
-        target = target_b.decode("latin-1")
-        parsed = urllib.parse.urlsplit(target)
+                if streams:
+                    body_stream = BodyReader(rf, length)
+                else:
+                    body = rf.read(length)
+                    if len(body) < length:
+                        raise _BadRequest("truncated body")
         req = Request(
-            method=method_b.decode("latin-1"), path=parsed.path,
+            method=method, path=parsed.path,
             query=urllib.parse.parse_qs(parsed.query,
                                         keep_blank_values=True),
-            headers=headers, body=body, remote_addr=addr[0])
+            headers=headers, body=body, remote_addr=addr[0],
+            body_stream=body_stream, content_length=content_length,
+            handler=handler)
         conn_hdr = headers.get("Connection", "").lower()
         close = (conn_hdr == "close"
                  or (version == b"HTTP/1.0"
@@ -417,37 +685,16 @@ class HttpServer:
         return req, close
 
     @staticmethod
-    def _read_chunked(rf, max_body: int = 64 << 20) -> bytes:
+    def _read_chunked(rf) -> bytes:
         """Chunked request body (aws CLI streams uploads this way),
-        capped like the TCP frame path's MAX_FRAME_BODY — an unbounded
-        chunk stream must not be able to OOM the server pre-dispatch."""
-        out = bytearray()
-        while True:
-            size_line = rf.readline(_MAX_LINE)
-            if not size_line:
-                raise _BadRequest("truncated chunked body")
-            try:
-                # chunk extensions after ';' are ignored per RFC 7230
-                size = int(size_line.split(b";", 1)[0].strip(), 16)
-            except ValueError:
-                raise _BadRequest("bad chunk size") from None
-            if size == 0:
-                # drain trailers to the blank line
-                while True:
-                    t = rf.readline(_MAX_LINE)
-                    if t in (b"\r\n", b"\n", b""):
-                        break
-                return bytes(out)
-            if len(out) + size > max_body:
-                raise _BadRequest("chunked body too large")
-            piece = rf.read(size)
-            if len(piece) < size:
-                raise _BadRequest("truncated chunk")
-            out += piece
-            rf.read(2)  # trailing CRLF
+        capped at ChunkedBodyReader.MATERIALIZE_CAP like the TCP frame
+        path's MAX_FRAME_BODY — an unbounded chunk stream must not be
+        able to OOM the server pre-dispatch.  ONE decoder serves both
+        the buffered and the streamed paths."""
+        return ChunkedBodyReader(rf).read_all()
 
     def _dispatch(self, req: Request) -> Response:
-        handler = self._match(req.method, req.path)
+        handler = req.handler
         if not tracing.enabled():
             # WEED_TRACE=0: no minting, no scope, no span — the
             # uninstrumented baseline the bench prices tracing against
@@ -455,6 +702,12 @@ class HttpServer:
                 return Response.error("not found", 404)
             try:
                 return handler(req)
+            except _BadRequest as e:
+                # a streamed body failing mid-handler (client hung up,
+                # oversized chunked frame) is the CLIENT's fault: answer
+                # 400 like the parse-time reads always did, never a
+                # budget-burning 500
+                return Response.error(str(e) or "bad request", 400)
             except Exception as e:
                 return Response.error(f"{type(e).__name__}: {e}")
         t0 = time.time()            # span start: wall, for alignment
@@ -477,6 +730,10 @@ class HttpServer:
             else:
                 try:
                     resp = handler(req)
+                except _BadRequest as e:
+                    # client-side streamed-body failure: 400, not 500
+                    # (see the untraced branch above)
+                    resp = Response.error(str(e) or "bad request", 400)
                 except Exception as e:
                     resp = Response.error(f"{type(e).__name__}: {e}")
         resp.headers.setdefault(tracing.TRACE_HEADER, tid)
@@ -498,9 +755,9 @@ class HttpServer:
         if p is None or p.mode != "reset":
             return False
         head = self._build_head(resp, close=True)
+        body = _body_bytes(resp.body)   # streamed shapes materialize here
         try:
-            conn.sendall(bytes(head) + bytes(resp.body[:len(resp.body)
-                                                       // 2]))
+            conn.sendall(bytes(head) + body[:len(body) // 2])
             conn.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
@@ -518,7 +775,8 @@ class HttpServer:
         # the real size with an empty body)
         explicit_cl = resp.headers.pop("Content-Length", None)
         head += b"Content-Length: "
-        head += (explicit_cl or str(len(resp.body))).encode("latin-1")
+        head += (explicit_cl
+                 or str(_body_len(resp.body))).encode("latin-1")
         head += b"\r\n"
         for k, v in resp.headers.items():
             head += f"{k}: {v}\r\n".encode("latin-1")
@@ -530,12 +788,69 @@ class HttpServer:
     @classmethod
     def _emit(cls, conn, method: str, resp: Response, close: bool) -> None:
         """Prebuilt status line + cached Date + ONE gather-write of head
-        and body (see _sendmsg_all)."""
+        and body (see _sendmsg_all).  Streaming shapes send the head
+        first, then the pieces / the sendfile'd file region."""
         head = cls._build_head(resp, close)
-        if method != "HEAD" and resp.body:
-            _sendmsg_all(conn, [bytes(head), resp.body])
-        else:
+        body = resp.body
+        if method == "HEAD" or not _body_len(body):
             conn.sendall(bytes(head))
+            return
+        if isinstance(body, FileRegion):
+            cls._emit_region(conn, head, body)
+            return
+        if isinstance(body, StreamBody):
+            cls._emit_stream(conn, head, body)
+            return
+        _sendmsg_all(conn, [bytes(head), body])
+
+    @staticmethod
+    def _emit_region(conn, head: bytearray, region: FileRegion) -> None:
+        """Zero-copy: os.sendfile straight from the (dup'ed) volume fd
+        to the socket.  Any sendfile failure resumes from the verified
+        in-memory fallback at the exact byte it stopped at — the client
+        always sees the advertised Content-Length or a hard close."""
+        conn.sendall(bytes(head))
+        sent = 0
+        if region.fd >= 0 and hasattr(os, "sendfile"):
+            try:
+                while sent < region.count:
+                    n = os.sendfile(conn.fileno(), region.fd,
+                                    region.offset + sent,
+                                    region.count - sent)
+                    if n == 0:
+                        break
+                    sent += n
+            except OSError as e:
+                import errno
+                if e.errno in (errno.EPIPE, errno.ECONNRESET):
+                    raise    # peer is gone; nothing to resume
+                LOG.debug("sendfile failed at +%d/%d, resuming from "
+                          "memory: %s", sent, region.count, e)
+        if sent < region.count:
+            conn.sendall(memoryview(region.fallback)[sent:])
+
+    @staticmethod
+    def _emit_stream(conn, head: bytearray, body: StreamBody) -> None:
+        conn.sendall(bytes(head))
+        sent = 0
+        try:
+            for piece in body.it:
+                if piece:
+                    conn.sendall(piece)
+                    sent += len(piece)
+        except (OSError, ConnectionError):
+            raise
+        except Exception as e:
+            # producer failure mid-body: the head (with Content-Length)
+            # is already on the wire, so the only honest move is a hard
+            # close — the client sees a truncated body, never garbage
+            LOG.warning("streaming body failed after %d/%d bytes: %s",
+                        sent, body.length, e)
+            raise ConnectionError(
+                f"stream body aborted mid-send: {e}") from e
+        if sent != body.length:
+            raise ConnectionError(
+                f"stream body produced {sent} of {body.length} bytes")
 
 
 # -- client helpers ---------------------------------------------------------
@@ -613,12 +928,16 @@ class ConnectionPool:
 
     # -- checkout / checkin ------------------------------------------------
     def _acquire(self, key: tuple, timeout: float,
-                 fresh: bool = False) -> tuple[_Conn, bool]:
+                 fresh: bool = False,
+                 no_reuse: bool = False) -> tuple[_Conn, bool]:
         """-> (conn, reused).  Blocks up to `self.wait` when the host is
         at capacity, then overflows.  `fresh=True` skips the idle stack
         — the stale-socket retry must get a genuinely NEW connection,
         not the next idle socket that may be just as stale (every idle
-        conn to a restarted peer is)."""
+        conn to a restarted peer is).  `no_reuse=True` also skips the
+        idle stack but leaves it intact: a non-seekable streamed body
+        must never ride a reused socket whose staleness would force an
+        (impossible) resend."""
         host, port = key
         deadline = None
         with self._cv:
@@ -629,7 +948,7 @@ class ConnectionPool:
                 for conn in self._idle.pop(key, []):
                     conn.close()
             while True:
-                idle = self._idle.get(key)
+                idle = None if no_reuse else self._idle.get(key)
                 if idle:
                     conn = idle.pop()
                     self._in_use[key] = self._in_use.get(key, 0) + 1
@@ -704,9 +1023,15 @@ class ConnectionPool:
                     f"injected fault #{p.rule_id}: reset by "
                     f"{parsed.netloc}")
         path = parsed.path + (f"?{parsed.query}" if parsed.query else "")
+        # a file-like body that can't rewind must go out on a socket
+        # that can't be stale: skip idle reuse so a send failure is a
+        # REAL failure (raised), never a silent half-consumed resend
+        one_shot_body = hasattr(body, "read") \
+            and not hasattr(body, "seek")
         for attempt in (0, 1):
             conn, reused = self._acquire(key, timeout,
-                                         fresh=attempt == 1)
+                                         fresh=attempt == 1,
+                                         no_reuse=one_shot_body)
             conn.set_timeout(timeout)
             try:
                 if attempt and hasattr(body, "seek"):
@@ -729,7 +1054,10 @@ class ConnectionPool:
                 # zero requests in flight
                 self._release(key, conn, discard=True)
                 raise
-            discard = bool(resp.will_close)
+            # one-shot-body conns never COME from the idle stack, so
+            # returning them there would grow it one socket per
+            # streamed upload, unbounded — close instead
+            discard = bool(resp.will_close) or one_shot_body
             self._release(key, conn, discard=discard)
             resp_headers = dict(resp.getheaders())
             if resp.status in (301, 302, 307, 308) and follow_redirects \

@@ -181,6 +181,14 @@ class SamplingProfiler:
         except Exception:
             return
         items = [(tid, f) for tid, f in frames.items() if tid != me]
+        # break the self-referential cycle NOW: the dict contains THIS
+        # thread's frame, and that frame's `frames` local holds the
+        # dict — left alone, every tick leaks one cycle pinning a
+        # full-process frame snapshot (and every multi-MB local caught
+        # in it, e.g. in-flight 8MB chunk bodies) until a gen-2 GC.
+        # Found via the large-object RSS drill: the "always-on <5%"
+        # sampler was retaining hundreds of MB between collections.
+        frames.clear()
         cap = self.max_threads_per_tick
         if len(items) > cap:
             # rotating slice: uniform coverage across ticks, bounded

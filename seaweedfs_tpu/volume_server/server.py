@@ -37,7 +37,8 @@ from ..storage.ttl import TTL
 from ..storage.types import FileId
 from ..storage.volume import NotFoundError, volume_file_name
 from ..util import tracing
-from ..util.http import HttpServer, Request, Response, http_request
+from ..util.http import (FileRegion, HttpServer, Request, Response,
+                         http_request, parse_byte_range)
 
 from ..util.weedlog import logger
 
@@ -49,6 +50,22 @@ EC_LOCATION_STALENESS = 11.0  # the freshest staleness tier (store_ec.go:227)
 # burst, short enough that a just-heartbeated volume becomes reachable
 # within one pulse
 NEGATIVE_LOOKUP_TTL = 1.0
+
+
+def sendfile_enabled() -> bool:
+    """WEED_SENDFILE=0 turns zero-copy serving off fleet-wide — the
+    byte-identical fallback knob (PR 12 workers=1 precedent)."""
+    return os.environ.get("WEED_SENDFILE", "1") != "0" \
+        and hasattr(os, "sendfile")
+
+
+def _sendfile_min() -> int:
+    """Needles below this serve from memory: a sendfile syscall tax on
+    1KB smallfile reads would cost more than the copy it saves."""
+    try:
+        return int(os.environ.get("WEED_SENDFILE_MIN", str(64 << 10)))
+    except ValueError:
+        return 64 << 10
 
 
 def _maybe_resize_image(data: bytes, mime: str, width: str, height: str,
@@ -518,20 +535,29 @@ class VolumeServer:
                              data_only=False),
                 lambda: v.needle_offset(fid.key))
         return self._serve_needle(req, n.data, n.etag(), n.name, n.mime,
-                                  n.is_compressed(), t0)
+                                  n.is_compressed(), t0,
+                                  volume=v, fid=fid,
+                                  volume_offset=getattr(
+                                      n, "volume_offset", None))
 
     def _serve_needle(self, req: Request, data, etag: str, name: bytes,
-                      mime_b: bytes, compressed: bool, t0: float
-                      ) -> Response:
+                      mime_b: bytes, compressed: bool, t0: float,
+                      volume=None, fid: "FileId | None" = None,
+                      volume_offset: "int | None" = None) -> Response:
         """Response assembly shared by the cache-hit and disk paths.
         `data` may be bytes or a memoryview (zero-copy serving); the
         negotiation/resize branches materialize bytes only when they
-        must transform the payload."""
+        must transform the payload.  Single-range requests answer 206
+        on identity bytes; big uncompressed disk reads go out through
+        os.sendfile from the .dat fd (volume/fid/volume_offset plumb
+        the disk-read provenance — cache hits and EC reads serve from
+        memory)."""
         headers = {"Etag": f'"{etag}"'}
         if name:
             headers["X-File-Name"] = bytes(name).decode(errors="replace")
         mime = (bytes(mime_b).decode(errors="replace")
                 if mime_b else "application/octet-stream")
+        gzip_verbatim = False
         if compressed:
             # negotiate like volume_server_handlers_read.go:208-215:
             # gzip-accepting clients get the stored bytes verbatim (zero
@@ -548,6 +574,7 @@ class VolumeServer:
                 # validators — If-None-Match does not key on encoding,
                 # so the gzip body must not share the identity ETag
                 headers["Etag"] = f'"{etag}-gzip"'
+                gzip_verbatim = True
             else:
                 data = decompress(bytes(data))
         else:
@@ -556,10 +583,55 @@ class VolumeServer:
             data, mime = _maybe_resize_image(
                 data, mime, req.qs("width"), req.qs("height"),
                 req.qs("mode"))
+        # single-range serving on identity bytes (the HTTP fallback of
+        # the ranged chunk-read fast path).  The gzip-verbatim branch
+        # keeps today's ignore-Range behavior: ranges into a stored
+        # gzip stream would index the wrong representation.
+        status, range_start = 200, 0
+        rng = req.headers.get("Range", "")
+        if rng.startswith("bytes=") and not gzip_verbatim \
+                and not resizing and len(data) > 0:
+            parsed = parse_byte_range(rng[6:], len(data))
+            if parsed is None:
+                self.metrics.volume_latency.observe(
+                    "read", value=time.perf_counter() - t0,
+                    trace_id=tracing.current_trace_id())
+                return Response(416, b"", headers={
+                    "Content-Range": f"bytes */{len(data)}"})
+            if parsed != (0, len(data)):
+                start, stop = parsed
+                headers["Content-Range"] = \
+                    f"bytes {start}-{stop - 1}/{len(data)}"
+                status, range_start = 206, start
+                data = data[start:stop]
+        headers["Accept-Ranges"] = "bytes"
+        body = data
+        if volume is not None and fid is not None \
+                and volume_offset is not None \
+                and not compressed and not resizing \
+                and isinstance(data, memoryview) \
+                and len(data) >= _sendfile_min() \
+                and req.method == "GET" and sendfile_enabled():
+            from ..util import faults
+            if not faults.ACTIVE:
+                # zero-copy eligible: an uncompressed, CRC-verified
+                # disk read with no transform and no fault hooks in
+                # play.  The dup'ed fd is taken under the volume lock
+                # while the needle still lives at the read offset, so
+                # a racing vacuum can't redirect the send; the
+                # verified memoryview rides along as the fallback.
+                dup_fd = volume.data_fd_for_sendfile(fid.key,
+                                                     volume_offset)
+                if dup_fd is not None:
+                    body = FileRegion(
+                        dup_fd,
+                        volume.needle_data_offset(volume_offset)
+                        + range_start,
+                        len(data), data)
         self.metrics.volume_latency.observe(
             "read", value=time.perf_counter() - t0,
             trace_id=tracing.current_trace_id())
-        return Response(200, data, content_type=mime, headers=headers)
+        return Response(status, body, content_type=mime, headers=headers)
 
     def _redirect_or_404(self, fid: FileId) -> Response:
         # short TTL, positive AND negative: a burst of misses costs one
@@ -775,18 +847,83 @@ class VolumeServer:
                 "read", value=time.perf_counter() - t0,
                 trace_id=tracing.current_trace_id())
             return data
-        from ..util.http import CIDict
+        from ..util.http import CIDict, FileRegion, _body_bytes
         req = Request(method="GET", path="", query={},
                       headers=CIDict(), body=b"")
         resp = self._read_needle(fid, req)  # EC / redirect cases
+        # a volume mounted mid-request can route the synthetic GET down
+        # the local disk path, which may answer with a sendfile
+        # FileRegion — the frame reply needs real bytes, and the
+        # region's dup'ed fd must not leak
+        if isinstance(resp.body, FileRegion):
+            resp.body.close()
         if resp.status >= 500:
             self.metrics.volume_errors.inc("read")
         if resp.status >= 300:
-            raise ValueError(bytes(resp.body).decode(errors="replace"))
-        # the frame writers concat the payload into the reply: a
-        # zero-copy memoryview body (volume mounted mid-request) must
-        # materialize here
-        return bytes(resp.body)
+            raise ValueError(
+                _body_bytes(resp.body).decode(errors="replace"))
+        return _body_bytes(resp.body)
+
+    def tcp_read_range(self, fid_str: str, offset: int,
+                       length: int) -> bytes:
+        """The 'G' frame: exactly [offset, offset+length) of a plain
+        needle's data — sub-chunk Range requests move only the bytes
+        they need off this server.  Anything the ranged fast path can't
+        serve (EC volumes, rich/compressed needles, remote volumes)
+        raises, and the client falls back to a whole-chunk 'R'/HTTP
+        read."""
+        from .tcp import MAX_FRAME_BODY
+        fid = FileId.parse(fid_str)
+        if length > MAX_FRAME_BODY:
+            # bounds the reply allocation the same way request bodies
+            # are bounded — a ranged read never needs more than a chunk
+            raise ValueError(
+                f"ranged read of {length} exceeds cap {MAX_FRAME_BODY}")
+        if self._worker is not None \
+                and not self._worker.owns(fid.volume_id):
+            from .. import operation
+            return operation.read_range_tcp(
+                self._worker.peer_tcp_addr(fid.volume_id), fid_str,
+                offset, length)
+        v = self.store.find_volume(fid.volume_id)
+        if v is None:
+            raise ValueError(
+                f"volume {fid.volume_id} not local; ranged reads "
+                "serve plain local volumes only")
+        t0 = time.perf_counter()
+        self.metrics.volume_requests.inc("read")
+        # cache slice ONLY for entries KNOWN plain (HTTP-populated,
+        # metadata-bearing, uncompressed): a data_only entry may hold a
+        # compressed needle's STORED gzip bytes with no flag to say so
+        # — slicing those would answer status-0 garbage instead of the
+        # error the client's whole-chunk fallback keys off.  Bounds
+        # behave exactly like the disk path (start past the data is an
+        # error, never an empty success).
+        ce = self.needle_cache.get(fid.volume_id, fid.key, fid.cookie,
+                                   need_metadata=True)
+        if ce is not None and not ce.is_compressed:
+            self.metrics.needle_cache_ops.inc("hit")
+            if offset >= len(ce.data):
+                raise ValueError(
+                    f"range start {offset} beyond needle data "
+                    f"{len(ce.data)}")
+            piece = ce.data[offset:offset + length]
+        else:
+            self.metrics.needle_cache_ops.inc("miss")
+            try:
+                piece = v.read_needle_range(fid.key, fid.cookie,
+                                            offset, length)
+            except NotFoundError:
+                raise ValueError("not found") from None
+            except OSError:
+                # disk faults on the ranged path burn the SLO error
+                # budget like every other read-path 500
+                self.metrics.volume_errors.inc("read")
+                raise
+        self.metrics.volume_latency.observe(
+            "read", value=time.perf_counter() - t0,
+            trace_id=tracing.current_trace_id())
+        return piece
 
     def tcp_delete(self, fid_str: str, jwt: str) -> dict:
         from ..util.http import CIDict

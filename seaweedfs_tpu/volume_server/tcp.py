@@ -9,10 +9,21 @@ email-parser headers), which dominates 1KB blob IO; the TCP frame path
 is a single recv/send pair per op.
 
 Frame (client -> server), little-endian:
-    op:u8 ('W' write | 'X' extended write | 'R' read | 'D' delete)
+    op:u8 ('W' write | 'X' extended write | 'R' read | 'G' ranged read
+           | 'D' delete)
     fid_len:u16, fid bytes
     jwt_len:u16, jwt bytes
-    body_len:u32, body bytes            (writes; 0 otherwise)
+    body_len:u32, body bytes            (writes; 'G': offset:u64 len:u32;
+                                         0 otherwise)
+
+The ranged read ('G') carries its byte window in the body slot
+(pack_range_body/unpack_range_body) and replies with exactly those
+bytes of the needle's data — the sub-chunk fast path large-object Range
+requests ride, so a 1MB read out of an 8MB chunk moves 1MB off the
+server, not 8.  Restricted to plain uncompressed needles (flags==0):
+anything richer falls back to a whole-record 'R' read where the full
+parse/CRC/expiry machinery runs.  Old servers answer 'G' with an
+unknown-op error, which clients treat as "fall back to 'R'".
 
 The extended write ('X') keeps this exact layout — the generic parsers
 (Python and native C) stay oblivious — and carries its extensions as a
@@ -147,6 +158,19 @@ def unpack_ext_body(body: bytes
         at += parent_len
     return (bool(flags & XFLAG_REPLICATE), bool(flags & XFLAG_COMPRESSED),
             ttl, trace_id, parent, bytes(body[at:]))
+
+
+_RANGE_BODY = struct.Struct("<QI")   # offset:u64, length:u32
+
+
+def pack_range_body(offset: int, length: int) -> bytes:
+    return _RANGE_BODY.pack(offset, length)
+
+
+def unpack_range_body(body: bytes) -> tuple[int, int]:
+    if len(body) != _RANGE_BODY.size:
+        raise ValueError("ranged read frame body must be 12 bytes")
+    return _RANGE_BODY.unpack(body)
 
 
 class FrameTooLarge(ValueError):
@@ -306,6 +330,10 @@ class TcpDataServer:
                     write_reply(conn, 0, payload)
                 except Exception as e:
                     write_reply(conn, 1, str(e).encode())
+                # drop the frame refs BEFORE parking in the next read:
+                # a conn blocked between ops must not pin its last
+                # (multi-MB, large-object) body in memory
+                body = payload = None  # noqa: F841
         except (ConnectionError, OSError):
             pass
         finally:
@@ -337,6 +365,8 @@ class TcpDataServer:
                     fp.write_reply(ctx, 0, payload)
                 except Exception as e:
                     fp.write_reply(ctx, 1, str(e).encode())
+                # see _serve_conn: parked conns must not pin bodies
+                body = payload = None  # noqa: F841
         except (ConnectionError, OSError):
             pass
         finally:
@@ -395,6 +425,9 @@ class TcpDataServer:
                 % (size, etag.encode())
         if op == "R":
             return self.vs.tcp_read(fid)
+        if op == "G":
+            offset, length = unpack_range_body(body)
+            return self.vs.tcp_read_range(fid, offset, length)
         if op == "D":
             out = self.vs.tcp_delete(fid, jwt)
             return json.dumps(out, separators=(",", ":")).encode()

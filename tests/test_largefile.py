@@ -1,0 +1,462 @@
+"""Large-object streaming path (ISSUE 15): ranged chunk reads, filer
+Range semantics at chunk boundaries, readahead-pipelined GET, streaming
+rolling-flush uploads with bounded memory, and sendfile/fallback byte
+identity.
+
+The knob-off paths (WEED_READAHEAD_CHUNKS=0, WEED_UPLOAD_WINDOW=0,
+WEED_SENDFILE=0) are pinned byte-identical to the pre-streaming code,
+matching the PR 12 workers=1 precedent.
+"""
+
+import hashlib
+import http.client
+import random
+import resource
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import (CookieMismatchError,
+                                          NotFoundError, Volume,
+                                          VolumeError)
+from seaweedfs_tpu.testing import PatternBody, SimCluster
+from seaweedfs_tpu.util.http import http_request, parse_byte_range
+
+CHUNK = 64 * 1024          # small chunks: multi-chunk paths without GBs
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with SimCluster(volume_servers=2, filers=1,
+                    filer_chunk_size=CHUNK) as c:
+        yield c
+
+
+def _filer_url(c, path):
+    return f"http://{c.filers[0].address}{path}"
+
+
+def _put(c, path, data):
+    status, body, _ = http_request(_filer_url(c, path), method="POST",
+                                   body=data)
+    assert status == 201, body
+    return data
+
+
+def _get(c, path, headers=None):
+    return http_request(_filer_url(c, path), headers=headers or {})
+
+
+def _data(n, seed=1):
+    return random.Random(seed).randbytes(n)
+
+
+# -- range matrix (satellite: multi-range fix + boundary semantics) --------
+
+def test_parse_byte_range_units():
+    size = 1000
+    assert parse_byte_range("0-99", size) == (0, 100)
+    assert parse_byte_range("990-2000", size) == (990, 1000)  # clamped
+    assert parse_byte_range("500-", size) == (500, 1000)
+    assert parse_byte_range("-100", size) == (900, 1000)
+    assert parse_byte_range("-2000", size) == (0, 1000)  # big suffix
+    assert parse_byte_range("1000-", size) is None       # start == size
+    assert parse_byte_range("-0", size) is None
+    assert parse_byte_range("5-4", size) is None
+    assert parse_byte_range("abc", size) is None
+    # multi-range: FIRST range answers (the old code served a 200 with
+    # the whole body for any multi-range request)
+    assert parse_byte_range("0-99,200-299", size) == (0, 100)
+    assert parse_byte_range("-100, 0-1", size) == (900, 1000)
+
+
+def test_range_matrix_at_chunk_boundaries(cluster):
+    size = int(3.5 * CHUNK)
+    data = _put(cluster, "/large/matrix.bin", _data(size))
+    cases = [
+        ("bytes=0-99", 206, 0, 100),
+        # crossing the first chunk boundary
+        (f"bytes={CHUNK - 10}-{CHUNK + 9}", 206, CHUNK - 10,
+         CHUNK + 10),
+        # exactly one aligned chunk
+        (f"bytes={CHUNK}-{2 * CHUNK - 1}", 206, CHUNK, 2 * CHUNK),
+        # open-ended from mid-chunk into the short tail chunk
+        (f"bytes={3 * CHUNK + 7}-", 206, 3 * CHUNK + 7, size),
+        # suffix inside the tail chunk
+        ("bytes=-100", 206, size - 100, size),
+        # suffix crossing a chunk boundary
+        (f"bytes=-{CHUNK + 100}", 206, size - CHUNK - 100, size),
+    ]
+    for spec, want_status, lo, hi in cases:
+        status, body, hdrs = _get(cluster, "/large/matrix.bin",
+                                  headers={"Range": spec})
+        assert status == want_status, (spec, status)
+        assert body == data[lo:hi], spec
+        assert hdrs.get("Content-Range") == \
+            f"bytes {lo}-{hi - 1}/{size}", spec
+    # an over-long suffix covers everything: a plain 200 (today's
+    # pinned semantics; no Content-Range)
+    status, body, hdrs = _get(cluster, "/large/matrix.bin",
+                              headers={"Range": f"bytes=-{size + 5}"})
+    assert status == 200 and body == data
+    # unsatisfiable
+    status, body, hdrs = _get(cluster, "/large/matrix.bin",
+                              headers={"Range": f"bytes={size}-"})
+    assert status == 416
+    assert hdrs.get("Content-Range") == f"bytes */{size}"
+
+
+def test_multi_range_serves_first_range_as_206(cluster):
+    size = 2 * CHUNK
+    data = _put(cluster, "/large/multi.bin", _data(size, seed=2))
+    status, body, hdrs = _get(
+        cluster, "/large/multi.bin",
+        headers={"Range": f"bytes=10-109,{CHUNK}-{CHUNK + 9}"})
+    assert status == 206
+    assert body == data[10:110]
+    assert hdrs.get("Content-Range") == f"bytes 10-109/{size}"
+
+
+# -- readahead pipelining ---------------------------------------------------
+
+def test_readahead_off_restores_serial_path(cluster, monkeypatch):
+    """WEED_READAHEAD_CHUNKS=0 pins the original serial whole-buffer
+    read: the pipelined reader must not even be entered, and the bytes
+    must be identical to the pipelined answer."""
+    size = 3 * CHUNK + 123
+    data = _put(cluster, "/large/knob.bin", _data(size, seed=3))
+    status, piped, _ = _get(cluster, "/large/knob.bin")
+    assert status == 200 and piped == data
+
+    calls = []
+    filer = cluster.filers[0]
+    orig = filer._stream_content_pipelined
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(filer, "_stream_content_pipelined", spy)
+    monkeypatch.setenv("WEED_READAHEAD_CHUNKS", "0")
+    status, serial, _ = _get(cluster, "/large/knob.bin")
+    assert status == 200 and serial == data
+    assert calls == [], "knob off must not enter the pipelined reader"
+    # knob back on: the pipelined reader IS the multi-chunk path
+    monkeypatch.delenv("WEED_READAHEAD_CHUNKS")
+    status, piped2, _ = _get(cluster, "/large/knob.bin")
+    assert status == 200 and piped2 == data and calls
+
+
+def test_readahead_correct_under_slow_chunk_fault(cluster):
+    """A slow disk (injected pread latency on every volume server) must
+    not reorder or corrupt the pipelined stream — byte identity under
+    the exact condition readahead exists to hide."""
+    size = 4 * CHUNK
+    data = _put(cluster, "/large/slow.bin", _data(size, seed=4))
+    rules = [cluster.inject_disk_fault(i, op="pread", mode="latency",
+                                       latency=0.02)
+             for i in range(len(cluster.volume_servers))]
+    try:
+        status, body, _ = _get(cluster, "/large/slow.bin")
+        assert status == 200 and body == data
+        status, body, _ = _get(cluster, "/large/slow.bin",
+                               headers={"Range":
+                                        f"bytes=100-{3 * CHUNK}"})
+        assert status == 206 and body == data[100:3 * CHUNK + 1]
+    finally:
+        cluster.clear_faults()
+    assert rules
+
+
+def test_mid_object_range_moves_subchunk_bytes(cluster):
+    """Acceptance: a mid-object 1MB-class Range read moves < 2 chunks
+    of data off the volume servers — the edges ride the ranged ('G'
+    frame / HTTP Range) path, whole chunks only where the range covers
+    them fully."""
+    size = 32 * CHUNK
+    data = _put(cluster, "/large/ranged.bin", _data(size, seed=5))
+    reader = cluster.filers[0]._chunk_reader
+    before = dict(reader.stats)
+    # ~1.5 chunks, deliberately misaligned: two sub-chunk edges plus
+    # zero-or-one whole chunk
+    lo = 10 * CHUNK + 777
+    hi = lo + CHUNK + CHUNK // 2
+    status, body, _ = _get(cluster, "/large/ranged.bin",
+                           headers={"Range": f"bytes={lo}-{hi - 1}"})
+    assert status == 206 and body == data[lo:hi]
+    moved = (reader.stats["chunk_bytes"] - before["chunk_bytes"]) \
+        + (reader.stats["range_bytes"] - before["range_bytes"])
+    assert moved < 2 * CHUNK, \
+        f"range read moved {moved} bytes (>= 2 chunks)"
+    assert reader.stats["range_reads"] > before["range_reads"], \
+        "sub-chunk edges must ride the ranged path"
+
+
+def test_ranged_read_primitives_match_full_read(cluster):
+    """operation.read_file_range ('G' frame w/ HTTP fallback) returns
+    exactly the slice the whole-chunk read returns."""
+    blob = _data(200_000, seed=6)
+    fid = cluster.upload(blob)
+    full = operation.read_file(cluster.master_grpc, fid)
+    assert bytes(full) == blob
+    for off, ln in ((0, 100), (65_536, 4096), (199_000, 5000),
+                    (199_999, 1), (123, 0)):
+        got = operation.read_file_range(cluster.master_grpc, fid,
+                                        off, ln)
+        assert got == blob[off:off + ln], (off, ln)
+
+
+# -- volume-level units -----------------------------------------------------
+
+def test_volume_read_needle_range_unit(tmp_path):
+    v = Volume(str(tmp_path), "", 7)
+    data = _data(10_000, seed=7)
+    v.write_needle(Needle(id=1, cookie=0x1234, data=data))
+    rich = Needle(id=2, cookie=0x1234, data=b"y" * 2048)
+    rich.set_name(b"named.bin")
+    v.write_needle(rich)
+    assert v.read_needle_range(1, 0x1234, 0, 100) == data[:100]
+    assert v.read_needle_range(1, 0x1234, 5000, 2000) == data[5000:7000]
+    assert v.read_needle_range(1, 0x1234, 9990, 100) == data[9990:]
+    assert v.read_needle_range(1, None, 42, 1) == data[42:43]
+    with pytest.raises(CookieMismatchError):
+        v.read_needle_range(1, 0xdead, 0, 10)
+    with pytest.raises(NotFoundError):
+        v.read_needle_range(99, None, 0, 10)
+    # rich needles (name flag set) refuse the ranged fast path — the
+    # caller falls back to the full parse
+    with pytest.raises(VolumeError):
+        v.read_needle_range(2, 0x1234, 0, 10)
+    v.delete_needle(1, 0x1234)
+    with pytest.raises(NotFoundError):
+        v.read_needle_range(1, 0x1234, 0, 10)
+    v.close()
+
+
+# -- zero-copy serving ------------------------------------------------------
+
+def test_sendfile_and_fallback_byte_identity(cluster, monkeypatch):
+    """The sendfile path and the WEED_SENDFILE=0 fallback serve
+    byte-identical responses — full body, ranged, and HEAD."""
+    blob = _data(300_000, seed=8)    # well above WEED_SENDFILE_MIN
+    fid = cluster.upload(blob)
+    vid = int(fid.split(",")[0])
+    locs = operation.lookup_volume(cluster.master_grpc, vid)
+    url = f"http://{locs[0]['url']}/{fid}"
+    specs = [{}, {"Range": "bytes=1000-99999"},
+             {"Range": "bytes=-1"}, {"Range": f"bytes=-{len(blob)}"}]
+    fast = [http_request(url, headers=h) for h in specs]
+    monkeypatch.setenv("WEED_SENDFILE", "0")
+    slow = [http_request(url, headers=h) for h in specs]
+    for h, (fs, fb, fh), (ss, sb, sh) in zip(specs, fast, slow):
+        assert fs == ss, h
+        assert fb == sb, h
+        assert fh.get("Content-Range") == sh.get("Content-Range"), h
+        assert fh.get("Etag") == sh.get("Etag"), h
+    assert fast[0][1] == blob
+    assert fast[1][1] == blob[1000:100000]
+
+
+def test_tcp_range_frame_roundtrip(cluster):
+    """The 'G' frame against a live volume server returns the window;
+    an oversized fid errors cleanly instead of desyncing the stream."""
+    blob = _data(150_000, seed=9)
+    fid = cluster.upload(blob)
+    vid = int(fid.split(",")[0])
+    locs = operation.lookup_volume(cluster.master_grpc, vid)
+    tcp = next(l["tcp_url"] for l in locs if l.get("tcp_url"))
+    assert operation.read_range_tcp(tcp, fid, 0, 64) == blob[:64]
+    assert operation.read_range_tcp(tcp, fid, 100_000, 64 * 1024) \
+        == blob[100_000:150_000]
+    with pytest.raises(RuntimeError):
+        # a vid this server doesn't hold answers a clean frame error
+        operation.read_range_tcp(tcp, "9999,0000000000000000", 0, 64)
+
+
+# -- streaming uploads ------------------------------------------------------
+
+def _stream_put(address, path, body, extra_headers=None,
+                method="POST"):
+    host, port = address.rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=120)
+    headers = {"Content-Length": str(body.total),
+               "Content-Type": "application/octet-stream"}
+    headers.update(extra_headers or {})
+    conn.request(method, path, body=body, headers=headers)
+    r = conn.getresponse()
+    out = (r.status, r.read(), dict(r.getheaders()))
+    conn.close()
+    return out
+
+
+def _stream_get_md5(address, path):
+    host, port = address.rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=120)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    md5 = hashlib.md5()
+    total = 0
+    while True:
+        piece = r.read(1 << 20)
+        if not piece:
+            break
+        md5.update(piece)
+        total += len(piece)
+    conn.close()
+    return r.status, md5.hexdigest(), total
+
+
+BIG_CHUNK = 8 << 20
+
+
+@pytest.fixture(scope="module")
+def big_cluster():
+    # default 8MB chunks, one replica: the bounded-memory drill
+    with SimCluster(volume_servers=1, filers=1,
+                    filer_chunk_size=BIG_CHUNK) as c:
+        yield c
+
+
+def _pin_malloc_thresholds():
+    """Pin glibc's dynamic mmap threshold below chunk size so freed
+    chunk buffers actually return to the OS.  Without this, glibc
+    adapts the threshold ABOVE 8MB after a few alloc/free cycles and
+    then serves chunk buffers from arenas that never shrink —
+    ru_maxrss would measure allocator retention, not live memory, and
+    the bounded-RSS assertion would be testing malloc heuristics."""
+    import ctypes
+    try:
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        m_mmap_threshold, m_trim_threshold = -3, -1
+        ok = libc.mallopt(m_mmap_threshold, 1 << 20)
+        libc.mallopt(m_trim_threshold, 1 << 20)
+        return ok == 1
+    except OSError:
+        return False
+
+
+def test_streaming_put_bounded_rss(big_cluster, monkeypatch):
+    """Acceptance: a streamed 256MB PUT keeps peak RSS growth under
+    4 × chunk_size.  A warmup PUT first reaches the pipeline's
+    steady-state allocations (sockets, pools, per-chunk transients), so
+    the 256MB run's ru_maxrss delta isolates exactly what scales with
+    OBJECT size — the old buffered path fails this by ~256MB."""
+    if not _pin_malloc_thresholds():
+        pytest.skip("mallopt unavailable: ru_maxrss would measure "
+                    "allocator retention, not live memory")
+    monkeypatch.setenv("WEED_UPLOAD_WINDOW", "1")
+    addr = big_cluster.filers[0].address
+    warm = PatternBody(4 * BIG_CHUNK, seed=11)
+    status, body, _ = _stream_put(addr, "/big/warmup.bin", warm)
+    assert status == 201, body
+    base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+    total = 256 << 20
+    big = PatternBody(total, seed=12)
+    t0 = time.perf_counter()
+    status, body, _ = _stream_put(addr, "/big/object.bin", big)
+    assert status == 201, body
+    put_s = time.perf_counter() - t0
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    growth = (peak - base) * 1024      # ru_maxrss is KiB on Linux
+    assert growth < 4 * BIG_CHUNK, \
+        f"peak RSS grew {growth >> 20}MB on a streamed 256MB PUT " \
+        f"(cap {4 * BIG_CHUNK >> 20}MB); put took {put_s:.1f}s"
+
+    # byte identity end to end, read back as a bounded stream too
+    status, digest, nbytes = _stream_get_md5(addr, "/big/object.bin")
+    assert status == 200 and nbytes == total
+    assert digest == big.md5.hexdigest()
+    read_peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    assert (read_peak - base) * 1024 < 16 * BIG_CHUNK, \
+        "streaming GET must not materialize the object either"
+
+
+def test_upload_window_zero_restores_buffered_path(cluster,
+                                                   monkeypatch):
+    """WEED_UPLOAD_WINDOW=0 pins the original buffer-then-chunk write
+    path: _write_streaming must not run, and the stored entry (etag,
+    size, bytes) must equal the streamed twin's."""
+    filer = cluster.filers[0]
+    calls = []
+    orig = filer._write_streaming
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(filer, "_write_streaming", spy)
+    size = 3 * CHUNK + 41
+    body_a = PatternBody(size, seed=13)
+    monkeypatch.setenv("WEED_UPLOAD_WINDOW", "0")
+    status, _, _ = _stream_put(filer.address, "/big/buffered.bin",
+                               body_a)
+    assert status == 201 and calls == []
+    monkeypatch.delenv("WEED_UPLOAD_WINDOW")
+    body_b = PatternBody(size, seed=13)
+    status, _, _ = _stream_put(filer.address, "/big/streamed.bin",
+                               body_b)
+    assert status == 201 and calls == [1]
+    ea = filer.filer.find_entry("/big/buffered.bin")
+    eb = filer.filer.find_entry("/big/streamed.bin")
+    assert ea.extended["etag"] == eb.extended["etag"]
+    assert len(ea.chunks) == len(eb.chunks)
+    sa, ba, _ = _get(cluster, "/big/buffered.bin")
+    sb, bb, _ = _get(cluster, "/big/streamed.bin")
+    assert sa == sb == 200 and ba == bb
+
+
+def test_streaming_put_failed_chunk_fails_loud():
+    """A volume-side write fault mid-stream fails the PUT (5xx or a
+    torn connection — never a silent 201) and leaves no entry.  Own
+    cluster: the fault degrades its volumes read-only for good."""
+    with SimCluster(volume_servers=1, filers=1,
+                    filer_chunk_size=CHUNK) as c:
+        # make sure at least one chunk CAN land before the disk dies
+        _put(c, "/big/canary.bin", _data(CHUNK, seed=99))
+        c.inject_disk_fault(0, op="pwrite", mode="error")
+        try:
+            body = PatternBody(6 * CHUNK, seed=14)
+            try:
+                status, out, _ = _stream_put(c.filers[0].address,
+                                             "/big/fail.bin", body)
+                assert status >= 500, out
+            except (ConnectionError, http.client.HTTPException,
+                    OSError):
+                pass    # server closed the half-read stream: also loud
+        finally:
+            c.clear_faults()
+        status, _, _ = _get(c, "/big/fail.bin")
+        assert status == 404
+
+
+# -- S3 end to end ----------------------------------------------------------
+
+def test_s3_streaming_put_and_multipart_part(cluster):
+    """An open-gateway S3 PUT streams end to end (ETag = md5 of the
+    body computed by the tee, bytes land intact), and a part PUT
+    streams into the staging area."""
+    from seaweedfs_tpu.s3 import S3ApiServer
+    filer = cluster.filers[0]
+    s3 = S3ApiServer(filer.address, filer.grpc_address)
+    s3.start()
+    try:
+        status, _, _ = http_request(f"http://{s3.address}/streambkt",
+                                    method="PUT")
+        assert status == 200
+        size = 3 * CHUNK + 17
+        body = PatternBody(size, seed=15)
+        status, out, hdrs = _stream_put(s3.address,
+                                        "/streambkt/obj.bin", body,
+                                        method="PUT")
+        assert status in (200, 201), out
+        assert hdrs.get("ETag", "").strip('"') == body.md5.hexdigest()
+        status, got, _ = http_request(
+            f"http://{s3.address}/streambkt/obj.bin")
+        assert status == 200
+        check = PatternBody(size, seed=15)
+        assert got == check.read(size)
+    finally:
+        s3.stop()

@@ -105,6 +105,22 @@ def test_bad_wallclock_fixture():
                    ("WL120", 46)]
 
 
+def test_bad_buffering_fixture():
+    got = _ids_lines(_findings(os.path.join(FIXTURES,
+                                            "bad_buffering.py")))
+    assert got == [("WL130", 9), ("WL130", 11), ("WL130", 12),
+                   ("WL130", 14), ("WL130", 15), ("WL130", 20)]
+
+
+def test_streaming_handlers_have_no_unmarked_buffering():
+    """ISSUE 15 satellite: the streaming upload handlers (filer PUT,
+    S3 object PUT / part PUT) hold the WL130 contract — every
+    deliberate whole-body buffer carries an inline pragma, so the
+    O(chunk × window) RSS bound can only be broken visibly."""
+    got = [f for f in analyze_paths([PACKAGE]) if f.checker == "WL130"]
+    assert got == [], "\n".join(f.render() for f in got)
+
+
 def test_package_has_no_wallclock_durations():
     """ISSUE 14 satellite: every latency/duration measurement in the
     tree derives from a monotonic clock — zero baselined WL120
@@ -230,5 +246,5 @@ def test_cli_list_checkers():
     for cid in ("WL001", "WL002", "WL010", "WL011", "WL012",
                 "WL020", "WL021", "WL022", "WL030", "WL040",
                 "WL050", "WL060", "WL080", "WL090", "WL100",
-                "WL110", "WL120"):
+                "WL110", "WL120", "WL130"):
         assert cid in r.stdout

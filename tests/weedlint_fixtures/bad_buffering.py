@@ -1,0 +1,24 @@
+"""WL130 fixtures — whole-body buffering inside streaming handlers.
+
+Line numbers are asserted exactly by tests/test_weedlint.py.
+"""
+
+
+class Handlers:
+    def _http_write(self, path, req):
+        body = req.body                         # line 9: flagged
+        stream = req.body_stream
+        junk = stream.read()                    # line 11: flagged
+        junk2 = stream.read(-1)                 # line 12: flagged
+        piece = stream.read(8 << 20)            # bounded: ok
+        whole = req.materialize_body()          # line 14: flagged
+        everything = stream.read_all()          # line 15: flagged
+        ok = req.materialize_body()  # weedlint: disable=WL130
+        return body, junk, junk2, piece, whole, everything, ok
+
+    def _upload_part(self, bucket, key, req):
+        return req.body                         # line 20: flagged
+
+    def _get_object(self, bucket, key, req):
+        # not a streaming handler: whole-body access is fine here
+        return req.body, req.body_stream.read()

@@ -13,3 +13,4 @@ from . import metricshygiene  # noqa: F401
 from . import journal      # noqa: F401
 from . import forksafety   # noqa: F401
 from . import wallclock    # noqa: F401
+from . import buffering    # noqa: F401

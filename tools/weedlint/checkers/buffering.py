@@ -1,0 +1,112 @@
+"""WL130 whole-body-buffering — streaming upload handlers must not
+materialize the request body.
+
+ISSUE 15's large-object upload path is only O(chunk_size × window) in
+memory if every handler between the socket and the volume servers
+passes the body through as a stream: the filer's autochunk PUT and the
+S3 gateway's object PUT / multipart part PUT (the "paths marked
+streaming").  The historical failure shape is a convenience refactor
+reaching for ``req.body`` — one attribute access silently re-buffers
+multi-GB uploads and the peak-RSS guarantee evaporates without any test
+noticing until someone ships a 4GB model checkpoint.
+
+The rule, scoped to filer/server.py + s3/server.py (the two modules
+with streaming routes) and the fixture corpus — inside the streaming
+handler set (``_http_write``, ``_put_object``, ``_upload_part``,
+``_store_object``):
+
+- ``req.body`` reads are flagged (whole-body access);
+- no-arg / negative ``.read()`` calls are flagged (unbounded slurp of a
+  stream — bounded ``read(n)`` is the sanctioned shape);
+- ``materialize_body()`` / ``read_all()`` calls are flagged (explicit
+  whole-body buffering).
+
+Intentionally-buffered sites (the single-chunk fast path, the
+directory-create probe, the non-streamed legacy branch) carry an inline
+``# weedlint: disable=WL130`` pragma, making every deliberate buffer
+visible at the call site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .. import Finding, ModuleContext, register
+
+_SCOPE_PARTS = ("seaweedfs_tpu/filer/server.py",
+                "seaweedfs_tpu/s3/server.py")
+
+# handlers on paths marked streaming (filer PUT; S3 object PUT / part)
+_STREAMING_FUNCS = {"_http_write", "_put_object", "_upload_part",
+                    "_store_object"}
+
+_MATERIALIZERS = {"materialize_body", "read_all"}
+
+
+def _in_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(part in p for part in _SCOPE_PARTS) \
+        or "weedlint_fixtures" in p
+
+
+def _is_unbounded_read(call: ast.Call) -> bool:
+    """``x.read()`` or ``x.read(-1)`` — a size-capped read(n) is the
+    sanctioned streaming shape."""
+    if not (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "read"):
+        return False
+    if call.keywords:
+        return False
+    if not call.args:
+        return True
+    if len(call.args) == 1:
+        a = call.args[0]
+        if isinstance(a, ast.UnaryOp) and isinstance(a.op, ast.USub) \
+                and isinstance(a.operand, ast.Constant):
+            return True
+        if isinstance(a, ast.Constant) and isinstance(a.value, int) \
+                and a.value < 0:
+            return True
+    return False
+
+
+@register("WL130", "whole-body-buffering")
+def check_whole_body_buffering(ctx: ModuleContext) -> Iterator[Finding]:
+    if not _in_scope(ctx.path):
+        return
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                or fn.name not in _STREAMING_FUNCS:
+            continue
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Attribute) and n.attr == "body" \
+                    and isinstance(n.ctx, ast.Load) \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id == "req":
+                yield Finding(
+                    "WL130", "whole-body-buffering", ctx.path, n.lineno,
+                    f"req.body read inside streaming handler "
+                    f"{fn.name}() — the whole upload buffers in "
+                    "memory, breaking the O(chunk × window) RSS bound",
+                    "consume req.body_stream.read(chunk_size) pieces; "
+                    "if buffering is genuinely intended, pragma the "
+                    "site (# weedlint: disable=WL130)")
+            elif isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute):
+                if n.func.attr in _MATERIALIZERS:
+                    yield Finding(
+                        "WL130", "whole-body-buffering", ctx.path,
+                        n.lineno,
+                        f"{n.func.attr}() inside streaming handler "
+                        f"{fn.name}() buffers the whole request body",
+                        "stream in bounded pieces, or pragma the "
+                        "deliberate buffer site "
+                        "(# weedlint: disable=WL130)")
+                elif _is_unbounded_read(n):
+                    yield Finding(
+                        "WL130", "whole-body-buffering", ctx.path,
+                        n.lineno,
+                        f"unbounded .read() inside streaming handler "
+                        f"{fn.name}() slurps the whole stream",
+                        "pass a size cap: .read(chunk_size)")
