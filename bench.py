@@ -595,6 +595,111 @@ def bench_observability(quick: bool = False, n_files: int = 1500,
     return out
 
 
+def bench_heat(quick: bool = False, ops: int = 1_000_000,
+               n_keys: int = 100_000, n_files: int = 1200,
+               passes: int = 3) -> dict:
+    """Workload heat plane tax + fidelity (ISSUE 16).
+
+    Two honest measurements:
+
+    - an in-process zipfian million-op drive straight into
+      HeatTracker.record — per-op cost, top-K recall against the TRUE
+      top-10 of the drive, bounded sketch memory, and the
+      merge_snapshots cost the master pays per federation tick;
+    - the read-path A/B: HTTP read rps against a real volume server
+      with the tracker constructed under WEED_HEAT=0 vs the default,
+      interleaved round-robin like bench_observability so box drift
+      hits both configs equally.  heat_track_overhead_pct compares
+      BEST passes (noise only subtracts throughput)."""
+    import random as _random
+
+    from seaweedfs_tpu import operation
+    from seaweedfs_tpu.testing import SimCluster
+    from seaweedfs_tpu.util.http import http_request
+    from seaweedfs_tpu.util.sketch import HeatTracker, merge_snapshots
+
+    if quick:
+        ops, n_keys, n_files, passes = 100_000, 10_000, 300, 2
+    out: dict = {}
+
+    # -- zipfian drive into the sketches --------------------------------
+    weights = [(i + 1) ** -1.2 for i in range(n_keys)]
+    scale = ops / sum(weights)
+    counts = [max(0, int(w * scale)) for w in weights]
+    stream = [i for i, c in enumerate(counts) for _ in range(c)]
+    _random.Random(1234).shuffle(stream)
+    keys = [f"3,{i:08x}" for i in range(n_keys)]
+    tracker = HeatTracker(enabled=True)
+    t0 = time.perf_counter()
+    for i in stream:
+        tracker.record("read", volume=i & 7, key=keys[i], nbytes=1024)
+    drive_s = time.perf_counter() - t0
+    out["heat_record_ns_per_op"] = round(drive_s / len(stream) * 1e9)
+    out["heat_drive_ops"] = len(stream)
+    true_top = [keys[i] for i in range(10)]
+    got_top = [k for k, *_ in tracker.objects.top(10)]
+    out["heat_topk_recall"] = round(
+        len(set(true_top) & set(got_top)) / 10.0, 2)
+    out["heat_sketch_memory_bytes"] = tracker.memory_bytes()
+
+    # master-side merge cost: one federation tick folds every
+    # data-plane snapshot (8 stand-ins here, freq matrices included)
+    snaps = [tracker.snapshot(include_freq=True) for _ in range(8)]
+    merge_ms = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        merge_snapshots(snaps)
+        merge_ms.append((time.perf_counter() - t0) * 1000.0)
+    out["heat_merge_ms"] = round(min(merge_ms), 2)
+
+    # -- read-path A/B: WEED_HEAT=0 vs on -------------------------------
+    payload = b"h" * 1024
+    with SimCluster(volume_servers=1) as cluster:
+        vs = cluster.volume_servers[0]
+        r = operation.assign(cluster.master_grpc, count=n_files)
+        fids = operation.derive_fids(r)
+        for fid in fids:
+            operation.upload_to(r, fid, payload)
+        url = r.url
+
+        def one_pass() -> float:
+            t0 = time.perf_counter()
+            for fid in fids:
+                status, _, _ = http_request(f"http://{url}/{fid}")
+                assert status == 200
+            return len(fids) / (time.perf_counter() - t0)
+
+        def set_heat(on: bool) -> None:
+            # the real knob: a tracker CONSTRUCTED under WEED_HEAT=0
+            # is permanently disabled — record() returns at the top
+            prev = os.environ.get("WEED_HEAT")
+            os.environ["WEED_HEAT"] = "1" if on else "0"
+            try:
+                vs.heat = HeatTracker()
+            finally:
+                if prev is None:
+                    os.environ.pop("WEED_HEAT", None)
+                else:
+                    os.environ["WEED_HEAT"] = prev
+
+        rates: dict = {"off": [], "on": []}
+        configs = [("off", False), ("on", True)]
+        one_pass()      # warm connections / needle cache, untimed
+        for i in range(passes * 2):
+            for key, on in (configs[i % 2:] + configs[:i % 2]):
+                set_heat(on)
+                rates[key].append(one_pass())
+        set_heat(True)
+        out["heat_off_read_rps"], out["heat_off_read_rps_spread"] = \
+            spread(rates["off"], digits=1)
+        out["heat_on_read_rps"], out["heat_on_read_rps_spread"] = \
+            spread(rates["on"], digits=1)
+        base = max(rates["off"])
+        out["heat_track_overhead_pct"] = round(
+            100.0 * (base - max(rates["on"])) / base, 2)
+    return out
+
+
 def bench_replicated_write(concurrency: int, quick: bool = False,
                            n_files: int = 1000, runs: int = 3) -> dict:
     """Replicated small-write throughput (ISSUE 5): replication 001
@@ -1392,6 +1497,10 @@ def main():
                 smallfile.update(bench_observability(quick=args.quick))
             except Exception as e:
                 smallfile["observability_error"] = str(e)[:200]
+            try:
+                smallfile.update(bench_heat(quick=args.quick))
+            except Exception as e:
+                smallfile["heat_error"] = str(e)[:200]
             try:
                 smallfile.update(bench_replication(quick=args.quick))
             except Exception as e:

@@ -177,12 +177,21 @@ class FilerServer:
         # chunk reads ride the shared wdclient reader: cache tiers +
         # TTL'd volume-location cache + raw-TCP fast path
         self._chunk_reader = CachedFileReader(cache=self.chunk_cache)
+        # workload heat sketches (util/sketch.py): the GET path folds
+        # path/bucket accesses in; the chunk reader reports cache HITS
+        # (reads the volume servers never see) so federated per-volume
+        # heat stays true under client-side caching
+        from ..util.sketch import HeatTracker
+        self.heat = HeatTracker()
+        self._chunk_reader.heat = self.heat
         self.http = HttpServer(host, port)
         self.rpc = RpcServer(host, grpc_port)
         # request counters/latency (the filer_requests/filer_latency
         # families in stats/__init__.py, served at GET /metrics) and the
         # span ring behind GET /debug/traces
         self.metrics = ServerMetrics()
+        self._heat_gauges = HeatTracker.register_metrics(
+            self.metrics.registry)
         self.filer.on_subscriber_overflow = \
             self.metrics.filer_sub_overflow.inc
         # per-client subscription progress (offset of the last event
@@ -391,6 +400,7 @@ class FilerServer:
         self.http.route("GET", "/metrics", self._http_metrics,
                         exact=True)
         self.http.route("GET", "/status", self._http_status, exact=True)
+        self.http.route("GET", "/heat", self._http_heat, exact=True)
         self.http.route("GET", "/debug/traces",
                         tracing.traces_http_handler(self.tracer),
                         exact=True)
@@ -405,7 +415,12 @@ class FilerServer:
     def _http_metrics(self, req: Request) -> Response:
         from ..stats import metrics_response
         self._refresh_sync_gauges()
+        self.heat.fill_metrics(self._heat_gauges)
         return metrics_response(req, self.metrics.render)
+
+    def _http_heat(self, req: Request) -> Response:
+        return Response.json(
+            self.heat.snapshot(include_freq=req.qs("freq") != "0"))
 
     def _refresh_sync_gauges(self) -> None:
         """seaweedfs_sync_* gauges are point-in-time: journal head/tail
@@ -447,19 +462,37 @@ class FilerServer:
         if kind != "write" and req.body_stream is not None:
             # only uploads understand streamed bodies
             req.materialize_body()
+        resp = None
         try:  # finally: handler exceptions (-> 500 upstream) must count
             if kind == "write":
-                return self._http_write(path, req)
-            if kind == "read":
-                return self._http_read(path, req)
-            if kind == "delete":
-                return self._http_delete(path, req)
-            return Response.error("method not allowed", 405)
+                resp = self._http_write(path, req)
+            elif kind == "read":
+                resp = self._http_read(path, req)
+            elif kind == "delete":
+                resp = self._http_delete(path, req)
+            else:
+                resp = Response.error("method not allowed", 405)
+            return resp
         finally:
             self.metrics.filer_requests.inc(kind)
             self.metrics.filer_latency.observe(
                 kind, value=time.perf_counter() - t0,
                 trace_id=tracing.current_trace_id())
+            # heat sketches track the GET path (path + bucket top-K).
+            # The S3 gateway stamps its filer hop so a gateway-served
+            # object isn't double-counted at both layers.
+            if kind == "read" \
+                    and not req.headers.get("X-Weed-Heat-Skip"):
+                from ..util.http import _body_len
+                bucket = None
+                if path.startswith("/buckets/"):
+                    seg = path.split("/", 3)
+                    bucket = seg[2] if len(seg) > 2 and seg[2] else None
+                self.heat.record(
+                    "read", key=path, bucket=bucket,
+                    nbytes=(_body_len(resp.body)
+                            if resp is not None and resp.body else 0),
+                    error=resp is None or resp.status >= 500)
 
     def _http_write(self, path: str, req: Request) -> Response:
         """Auto-chunked upload (doPostAutoChunk).  Streamed bodies
@@ -822,6 +855,7 @@ class FilerServer:
                 # instead of guessing the HTTP port
                 "DebugTraces": tracing.traces_rpc_handler(self.tracer),
                 "Metrics": self._rpc_metrics,
+                "Heat": self._rpc_heat,
                 "JournalStatus": self._rpc_journal_status,
             },
             stream={
@@ -1075,6 +1109,12 @@ class FilerServer:
     def _rpc_metrics(self, req: dict) -> dict:
         self._refresh_sync_gauges()
         return {"text": self.metrics.render()}
+
+    def _rpc_heat(self, req: dict) -> dict:
+        """Heat sketches over gRPC — filers federate by grpc address
+        (the master cluster registry), matching Metrics/DebugTraces."""
+        return {"heat": self.heat.snapshot(
+            include_freq=not req.get("skip_freq"))}
 
     def _rpc_journal_status(self, req: dict) -> dict:
         """Journal head/tail + per-subscriber progress — what

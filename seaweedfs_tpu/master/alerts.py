@@ -119,6 +119,12 @@ def builtin_rules() -> "list[AlertRule]":
         AlertRule("node-capacity-full", "node_fullness_pct", ">",
                   _env_f("WEED_ALERT_NODE_PCT", 95.0), 0.0, "warning",
                   "fullest node's volume slots as % of max_volumes"),
+        AlertRule("hot-volume-skew", "volume_heat_skew", ">",
+                  _env_f("WEED_ALERT_HEAT_SKEW", 4.0),
+                  _env_f("WEED_ALERT_HEAT_SKEW_FOR_S", 0.0), "warning",
+                  "hottest volume's heat score over the fleet mean "
+                  "(workload heat plane) — one volume is soaking the "
+                  "traffic; rebalance or cache-tier candidate"),
     ]
 
 

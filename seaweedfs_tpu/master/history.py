@@ -27,6 +27,10 @@ Curated series (names are the /cluster/history query vocabulary):
     federation_up{server}  repair_queue_depth  sync_lag_events
     volumes_readonly  volume_fullness_pct  node_fullness_pct
     subscriber_overflow_delta
+    volume_heat{volume}  volume_heat_skew  read_write_ratio
+    zipf_skew_estimate  cold_volume_count    (workload heat plane —
+                                          master/observe.py heat_report
+                                          over the federated sketches)
 
 One ObservabilityPlane tick = ONE federated scrape feeding BOTH
 subsystems: the parsed samples become a history record and the same
@@ -290,6 +294,7 @@ class ObservabilityPlane:
             now = time.time()
             text = self.master.observer.federate_metrics()
             snap = self._snapshot(parse_exposition(text), now)
+            snap.update(self._heat_series())
             self.history.record(now, snap)
             transitions = self.alerts.evaluate(snap, now=now)
             self._last_tick = now
@@ -442,6 +447,45 @@ class ObservabilityPlane:
                 budget = 1.0 - targets[op]["availability"]
                 out[("slo_error_budget_burn_window", key)] = round(
                     0.0 if budget <= 0 else (1.0 - avail) / budget, 4)
+        return out
+
+    def _heat_series(self) -> "dict[tuple, float]":
+        """Workload-heat series from the federated sketch merge
+        (master/observe.py heat_report).  volume_heat carries one
+        labelset per topology volume — bounded by the volume count,
+        like the per-server series; the sketches bound everything
+        keyed by object."""
+        try:
+            report = self.master.observer.heat_report()
+        except Exception as e:
+            LOG.debug("heat federation failed during tick: %s", e)
+            return {}
+        out: "dict[tuple, float]" = {
+            ("read_write_ratio", ()): report["read_write_ratio"],
+            ("zipf_skew_estimate", ()): report["zipf_skew"],
+            ("cold_volume_count", ()):
+                float(len(report["cold_candidates"])),
+        }
+        heats = []
+        for v in report["volumes"]:
+            out[("volume_heat",
+                 (("volume", str(v["volume"])),))] = v["heat"]
+            heats.append(v["heat"])
+        # hottest volume over the fleet mean: ~1.0 balanced, large =
+        # one volume soaking the workload (the hot-volume-skew alert).
+        # Below WEED_ALERT_HEAT_MIN of peak heat the ratio is noise —
+        # a near-idle cluster's single touched volume is not "hot", so
+        # report balanced instead of false-firing the skew alert.
+        mean = sum(heats) / len(heats) if heats else 0.0
+        peak = max(heats) if heats else 0.0
+        try:
+            min_heat = float(os.environ.get("WEED_ALERT_HEAT_MIN",
+                                            "1.0"))
+        except ValueError:
+            min_heat = 1.0
+        out[("volume_heat_skew", ())] = \
+            round(peak / mean, 4) if mean > 0 and peak >= min_heat \
+            else 1.0
         return out
 
     def _topology_series(self) -> "dict[tuple, float]":

@@ -26,6 +26,8 @@ locally."""
 
 from __future__ import annotations
 
+import json
+import math
 import os
 import re
 import time
@@ -34,6 +36,7 @@ from concurrent.futures import ThreadPoolExecutor
 from ..pb.rpc import POOL
 from ..stats import parse_exposition, quantile_from_buckets
 from ..util.http import http_request
+from ..util.sketch import merge_snapshots, zipf_skew
 from ..util.weedlog import logger
 
 LOG = logger(__name__)
@@ -73,6 +76,23 @@ def _tombstone_ttl() -> float:
         return float(os.environ.get("WEED_SCRAPE_TOMBSTONE_S", "300"))
     except ValueError:
         return 300.0
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def heat_cold_params() -> "tuple[float, float, float]":
+    """(max_rps, min_age_s, min_fullness) qualifying a volume as a
+    cold-seal candidate: at-or-below max_rps of decayed traffic, no
+    access for min_age_s, and at least min_fullness of the size limit
+    (sealing a near-empty volume frees nothing; it just fragments)."""
+    return (_env_f("WEED_HEAT_COLD_MAX_RPS", 0.05),
+            _env_f("WEED_HEAT_COLD_AGE_S", 3600.0),
+            _env_f("WEED_HEAT_COLD_MIN_FULL", 0.5))
 
 
 # sample line: name, optional {labels}, then everything else (value,
@@ -143,7 +163,11 @@ class ClusterObserver:
             LOG.debug("topology walk failed during federation: %s", e)
         with self.master._sub_lock:
             filers = list(self.master.cluster_nodes.get("filer", {}))
+            s3s = list(self.master.cluster_nodes.get("s3", {}))
         out.extend((addr, "filer") for addr in filers)
+        # S3 gateways register with their HTTP address (their only
+        # port); both /metrics and /heat answer there
+        out.extend((addr, "s3") for addr in s3s)
         return out
 
     def _map(self, fn, targets) -> dict:
@@ -166,9 +190,14 @@ class ClusterObserver:
                 return self.master.metrics.render()
             return POOL.client(server, "Seaweed").call(
                 "Metrics", {})["text"]
-        if role == "volume":
+        if role in ("volume", "s3"):
             status, body, _ = http_request(f"http://{server}/metrics",
                                            timeout=5)
+            if role == "s3" and status in (401, 403):
+                # IAM-gated gateway: alive, but the scrape needs tenant
+                # credentials the master doesn't hold — report it up
+                # with an empty page instead of tombstoning it
+                return ""
             if status != 200:
                 raise RuntimeError(f"HTTP {status}")
             return body.decode(errors="replace")
@@ -332,8 +361,9 @@ class ClusterObserver:
                     trace_id=trace_id, limit=limit, min_ms=min_ms)
             return POOL.client(server, "Seaweed").call(
                 "DebugTraces", req).get("spans", [])
+        if role == "s3":
+            return []   # the gateway exports no span ring over HTTP
         if role == "volume":
-            import json
             import urllib.parse
             qs = urllib.parse.urlencode(
                 {"trace_id": trace_id, "limit": limit,
@@ -364,6 +394,164 @@ class ClusterObserver:
                 spans.extend(got)
         return {"spans": spans, "errors": errors,
                 "servers": [s for s, _ in targets]}
+
+    # -- heat federation -----------------------------------------------------
+    def _fetch_heat(self, server: str, role: str,
+                    include_freq: bool) -> dict:
+        if role in ("volume", "s3"):
+            qs = "" if include_freq else "?freq=0"
+            status, body, _ = http_request(
+                f"http://{server}/heat{qs}", timeout=5)
+            if role == "s3" and status in (401, 403):
+                return {}   # IAM-gated gateway: up, scrape private
+            if status != 200:
+                raise RuntimeError(f"HTTP {status}")
+            return json.loads(body)
+        return POOL.client(server, "SeaweedFiler").call(
+            "Heat", {"skip_freq": not include_freq})["heat"]
+
+    def federate_heat(self, include_freq: bool = False) -> dict:
+        """Every data-plane server's /heat snapshot merged into one
+        document (util/sketch.merge_snapshots) — masters serve no data
+        and carry no tracker, so they are not polled.  Per-node
+        failures are reported inline, never fatal."""
+        targets = [(s, r) for s, r in self._targets()
+                   if r != "master"]
+        results = self._map(
+            lambda server, role: self._fetch_heat(
+                server, role, include_freq), targets)
+        snaps: list[dict] = []
+        errors: dict[str, str] = {}
+        for server, got in results.items():
+            if isinstance(got, Exception):
+                errors[server] = str(got)
+            elif got:
+                snaps.append(got)
+        merged = merge_snapshots(snaps)
+        merged["servers"] = {"up": len(snaps), "of": len(targets)}
+        if errors:
+            merged["errors"] = errors
+        return merged
+
+    @staticmethod
+    def _heat_score(read_rps: float, write_rps: float,
+                    byte_rps: float) -> float:
+        """Per-volume heat: ops-rate dominated, with a logarithmic
+        bytes term so a few huge streams rank above many empty probes
+        at equal op rates (64 KiB/s of throughput ~ one extra op/s)."""
+        return read_rps + write_rps \
+            + math.log1p(max(0.0, byte_rps) / 65536.0)
+
+    def heat_report(self, include_freq: bool = False) -> dict:
+        """The /cluster/heat document: merged top-K objects/buckets as
+        rates, every topology volume enriched with heat + fullness, and
+        the cold-seal candidate list (heat_cold_params qualified).
+        Rates use the decayed-count identity rps = count / decay_s."""
+        merged = self.federate_heat(include_freq=include_freq)
+        decay = float(merged.get("decay_s") or 1.0)
+
+        def as_rates(rows: list) -> list:
+            out = []
+            for key, count, err, nbytes, errs in rows:
+                out.append({
+                    "key": key,
+                    "rps": round(count / decay, 4),
+                    "rps_err": round(err / decay, 4),
+                    "bytes_rps": round(nbytes / decay, 2),
+                    "err_pct": round(100.0 * errs / count, 2)
+                    if count > 0 else 0.0,
+                })
+            return out
+
+        # walk the topology so NEVER-ACCESSED volumes appear too — the
+        # coldest volume of all is one the sketches have no entry for
+        heat_vols = merged.get("volumes") or {}
+        max_rps, min_age, min_full = heat_cold_params()
+        limit = float(getattr(self.master.topo, "volume_size_limit", 0)
+                      or 0)
+        vols: dict[int, dict] = {}
+        try:
+            for dn in self.master.topo.data_nodes():
+                if not dn.is_active:
+                    continue
+                for vid, v in dn.volumes.items():
+                    row = vols.setdefault(int(vid), {
+                        "volume": int(vid), "size": 0,
+                        "read_only": False, "replicas": 0})
+                    row["size"] = max(row["size"], int(v.size))
+                    row["read_only"] |= bool(v.read_only)
+                    row["replicas"] += 1
+        except Exception as e:
+            LOG.debug("topology walk failed during heat report: %s", e)
+        for vid_s, h in heat_vols.items():
+            try:
+                vid = int(vid_s)
+            except ValueError:
+                continue
+            vols.setdefault(vid, {"volume": vid, "size": 0,
+                                  "read_only": False, "replicas": 0})
+        cold: list[int] = []
+        out_vols = []
+        for vid in sorted(vols):
+            row = vols[vid]
+            h = heat_vols.get(str(vid)) or {}
+            read_rps = float(h.get("reads", 0.0)) / decay
+            write_rps = float(h.get("writes", 0.0)) / decay
+            byte_rps = (float(h.get("read_bytes", 0.0))
+                        + float(h.get("write_bytes", 0.0))) / decay
+            ops = float(h.get("reads", 0.0)) + float(h.get("writes",
+                                                           0.0))
+            row.update({
+                "read_rps": round(read_rps, 4),
+                "write_rps": round(write_rps, 4),
+                "byte_rps": round(byte_rps, 2),
+                "err_pct": round(
+                    100.0 * float(h.get("errors", 0.0)) / ops, 2)
+                if ops > 0 else 0.0,
+                "age_s": round(float(h.get("age_s", -1.0)), 3)
+                if h else -1.0,   # -1 = never seen by any tracker
+                "heat": round(self._heat_score(read_rps, write_rps,
+                                               byte_rps), 4),
+                "fullness_pct": round(100.0 * row["size"] / limit, 2)
+                if limit > 0 else 0.0,
+            })
+            age = row["age_s"] if row["age_s"] >= 0 else float("inf")
+            row["cold_candidate"] = bool(
+                not row["read_only"]
+                and read_rps + write_rps <= max_rps
+                and age >= min_age
+                and limit > 0 and row["size"] / limit >= min_full)
+            if row["cold_candidate"]:
+                cold.append(vid)
+            out_vols.append(row)
+        out_vols.sort(key=lambda r: (-r["heat"], r["volume"]))
+        reads = float(merged.get("totals", {}).get("reads", 0.0))
+        writes = float(merged.get("totals", {}).get("writes", 0.0))
+        report = {
+            "decay_s": decay,
+            "topk": merged.get("topk"),
+            "objects": as_rates(merged.get("objects") or []),
+            "buckets": as_rates(merged.get("buckets") or []),
+            "volumes": out_vols,
+            "cold_candidates": cold,
+            "cold_params": {"max_rps": max_rps, "min_age_s": min_age,
+                            "min_fullness": min_full},
+            # Laplace-smoothed so an idle cluster reads 1.0 and an
+            # all-read workload stays finite
+            "read_write_ratio": round((reads + 1.0) / (writes + 1.0),
+                                      4),
+            "zipf_skew": round(zipf_skew(
+                [r[1] for r in merged.get("objects") or []]), 4),
+            "totals": merged.get("totals", {}),
+            "tracked_ops": merged.get("tracked_ops", 0),
+            "memory_bytes": merged.get("memory_bytes", 0),
+            "servers": merged.get("servers", {}),
+        }
+        if merged.get("errors"):
+            report["errors"] = merged["errors"]
+        if include_freq and merged.get("freq"):
+            report["freq"] = merged["freq"]
+        return report
 
 
 def cluster_trace_rpc_handler(observer: ClusterObserver):

@@ -516,7 +516,7 @@ class MasterServer:
         # registrant of a type is that type's leader (filer leader election)
         node_type = (first or {}).get("client_type", "client")
         node_name = (first or {}).get("client_name", "")
-        registered = node_type in ("filer", "broker") and node_name
+        registered = node_type in ("filer", "broker", "s3") and node_name
         if registered:
             with self._sub_lock:
                 counts = self.cluster_nodes.setdefault(node_type, {})
@@ -646,6 +646,7 @@ class MasterServer:
                 "ClusterHealth": self._rpc_cluster_health,
                 "ClusterAlerts": self._rpc_cluster_alerts,
                 "ClusterHistory": self._rpc_cluster_history,
+                "ClusterHeat": self._rpc_cluster_heat,
                 "ClusterEvents": self._rpc_cluster_events,
                 "ClusterEventAppend": self._rpc_cluster_event_append,
             },
@@ -740,6 +741,17 @@ class MasterServer:
                        for name in names},
             "status": hist.status(),
         }
+
+    def _rpc_cluster_heat(self, req: dict) -> dict:
+        """Merged workload heat (master/observe.py heat_report):
+        top-K hot objects/buckets/volumes as rates plus cold-seal
+        candidates.  Leader-answered (its registry knows every filer
+        and gateway); followers proxy like the other v3 RPCs."""
+        out = self._proxy_to_leader("ClusterHeat", req)
+        if out is not None:
+            return out
+        return self.observer.heat_report(
+            include_freq=bool(req.get("freq")))
 
     def _rpc_cluster_events(self, req: dict) -> dict:
         out = self._proxy_to_leader("ClusterEvents", req)
@@ -865,6 +877,8 @@ class MasterServer:
                         self._http_cluster_health, exact=True)
         self.http.route("GET", "/cluster/history",
                         self._http_cluster_history, exact=True)
+        self.http.route("GET", "/cluster/heat",
+                        self._http_cluster_heat, exact=True)
         self.http.route("GET", "/cluster/events",
                         self._http_cluster_events, exact=True)
         self.http.route("GET", "/debug/traces",
@@ -940,6 +954,16 @@ class MasterServer:
                 "step": req.qs("step") or "0"}))
         except (RpcError, ValueError) as e:
             return Response.json({"error": str(e)}, status=400)
+
+    def _http_cluster_heat(self, req: Request) -> Response:
+        """JSON workload heat: merged heavy-hitter sketches + per-volume
+        heat/cold-candidate report (?freq=1 includes the merged
+        count-min matrix)."""
+        try:
+            return Response.json(self._rpc_cluster_heat(
+                {"freq": req.qs("freq", "") not in ("", "0")}))
+        except RpcError as e:
+            return Response.json({"error": str(e)}, status=503)
 
     def _http_cluster_events(self, req: Request) -> Response:
         """JSON event timeline with type/time filters:

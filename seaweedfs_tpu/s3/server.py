@@ -30,7 +30,9 @@ from ..filer.entry import Entry, FileChunk
 from ..filer.filechunks import total_size
 from ..pb.rpc import POOL, RpcError
 from ..stats import ServerMetrics
-from ..util.http import HttpServer, Request, Response, http_request
+from ..util.http import (HttpServer, Request, Response, StreamBody,
+                         _body_len, http_request, http_request_stream)
+from ..util.sketch import HeatTracker
 from ..util.weedlog import logger
 from . import acl as aclmod
 from .acl import (ACL_ATTR, OWNER_ATTR, POLICY_ATTR, AccessControlPolicy,
@@ -118,20 +120,31 @@ class S3ApiServer:
     def __init__(self, filer_http: str, filer_grpc: str,
                  host: str = "127.0.0.1", port: int = 0,
                  iam: IdentityAccessManagement | None = None,
-                 audit_log=None, enforce_authz: bool = True):
+                 audit_log=None, enforce_authz: bool = True,
+                 masters: str = ""):
         self.filer_http = filer_http
         self.filer_grpc = filer_grpc
+        # optional: announce to the master's cluster registry so the
+        # observability plane federates this gateway's metrics + heat
+        self.masters = masters
+        self._master_client = None
         self.iam = iam or IdentityAccessManagement()
         self.audit = audit_log      # s3/audit.py AuditLog or None
         # bench knob: short-circuit the fused gate to measure its cost —
         # NEVER disable in production (the gate is the tenant boundary)
         self.enforce_authz = enforce_authz
         self.metrics = ServerMetrics()
+        # bucket/key heavy hitters at S3 granularity — the volume
+        # servers only ever see fids, so tenant-facing names live here
+        self.heat = HeatTracker()
+        self._heat_gauges = HeatTracker.register_metrics(
+            self.metrics.registry)
         self.http = HttpServer(host, port)
         # exact route: the bare GET /metrics is the Prometheus scrape;
         # query-carrying requests (a bucket literally named "metrics":
         # ?list-type, ?acl, ?location, ...) re-enter the S3 dispatch
         self.http.route("GET", "/metrics", self._http_metrics, exact=True)
+        self.http.route("GET", "/heat", self._http_heat, exact=True)
         # stream_body: plain object PUT / part PUT forward their bytes
         # to the filer as they arrive (rolling chunk flush end-to-end);
         # every other request materializes on entry (_dispatch_inner)
@@ -147,29 +160,58 @@ class S3ApiServer:
         # sends params
         if req.query:
             return self._dispatch(req)
-        # the scrape lives on the TENANT-facing port: with IAM enabled
-        # it requires any signed identity — per-tenant allow/deny rates
-        # are operational intelligence, not public data (upstream
-        # sidesteps this by scraping a separate port)
-        if self.iam.is_enabled():
-            try:
-                ident = self.iam.authenticate(
-                    req.method, req.path, req.query, req.headers,
-                    req.body)
-            except S3AuthError as e:
-                return Response(e.status,
-                                _error_xml(e.code, str(e), req.path),
-                                content_type="application/xml")
-            if ident.is_anonymous:
-                return Response(
-                    403, _error_xml("AccessDenied",
-                                    "metrics require authentication"),
-                    content_type="application/xml")
+        denied = self._scrape_denied(req)
+        if denied is not None:
+            return denied
+        self.heat.fill_metrics(self._heat_gauges)
         return Response(200, self.metrics.render().encode(),
                         content_type="text/plain; version=0.0.4")
 
+    def _scrape_denied(self, req: Request) -> "Response | None":
+        """Operational scrapes (/metrics, /heat) live on the
+        TENANT-facing port: with IAM enabled they require any signed
+        identity — per-tenant rates and hot KEY NAMES are operational
+        intelligence, not public data (upstream sidesteps this by
+        scraping a separate port).  The master's federation treats the
+        403 as 'up but private', not as a dead server."""
+        if not self.iam.is_enabled():
+            return None
+        try:
+            ident = self.iam.authenticate(
+                req.method, req.path, req.query, req.headers,
+                req.body)
+        except S3AuthError as e:
+            return Response(e.status,
+                            _error_xml(e.code, str(e), req.path),
+                            content_type="application/xml")
+        if ident.is_anonymous:
+            return Response(
+                403, _error_xml("AccessDenied",
+                                "scrape requires authentication"),
+                content_type="application/xml")
+        return None
+
+    def _http_heat(self, req: Request) -> Response:
+        # same disambiguation as /metrics: with params this is an S3
+        # operation on a bucket literally named "heat" — except the
+        # heat endpoint's own ?freq=0 knob (no real S3 verb sends a
+        # bare `freq` param)
+        if req.query and set(req.query) != {"freq"}:
+            return self._dispatch(req)
+        denied = self._scrape_denied(req)
+        if denied is not None:
+            return denied
+        return Response.json(self.heat.snapshot(
+            include_freq=req.qs("freq") != "0"))
+
     def start(self) -> None:
         self.http.start()
+        if self.masters:
+            from ..wdclient import MasterClient
+            self._master_client = MasterClient(
+                self.masters, client_name=self.address,
+                client_type="s3")
+            self._master_client.start()
         if self.filer_grpc:
             threading.Thread(target=self._watch_iam_config, daemon=True,
                              name="s3-iam-reload").start()
@@ -216,6 +258,8 @@ class S3ApiServer:
 
     def stop(self) -> None:
         self._iam_stop.set()
+        if self._master_client is not None:
+            self._master_client.stop()
         self.http.stop()
 
     @property
@@ -237,17 +281,30 @@ class S3ApiServer:
             # verb table; the fallback is the (closed) HTTP method set
             action = getattr(req, "_s3_action", "") or req.method.lower()
             self.metrics.s3_requests.inc(action)
+            status = resp.status if resp is not None else 500
+            # bytes: request size for uploads, response size for
+            # reads — never the error XML's length for a rejected PUT
+            if req.method in ("PUT", "POST"):
+                streamed = getattr(req, "_streamed_nbytes", None)
+                nbytes = streamed if streamed is not None \
+                    else len(req.body or b"")
+            else:
+                # _body_len, not len(): a streamed GET passthrough
+                # carries a StreamBody, not bytes
+                nbytes = (_body_len(resp.body) or 0) \
+                    if resp is not None and resp.body else 0
+            bucket = getattr(req, "_audit_bucket", "")
+            key = getattr(req, "_audit_key", "")
+            if bucket:
+                # S3-granularity heat: bucket/key heavy hitters (the
+                # sketches bound memory; labels would not)
+                self.heat.record(
+                    "write" if req.method in ("PUT", "POST") else
+                    "delete" if req.method == "DELETE" else "read",
+                    key=f"{bucket}/{key}" if key else bucket,
+                    bucket=bucket, nbytes=nbytes,
+                    error=status >= 500)
             if self.audit is not None:
-                status = resp.status if resp is not None else 500
-                # bytes: request size for uploads, response size for
-                # reads — never the error XML's length for a rejected PUT
-                if req.method in ("PUT", "POST"):
-                    streamed = getattr(req, "_streamed_nbytes", None)
-                    nbytes = streamed if streamed is not None \
-                        else len(req.body or b"")
-                else:
-                    nbytes = len(resp.body) if resp is not None \
-                        and resp.body else 0
                 authz, authz_source = getattr(req, "_audit_authz",
                                               ("", ""))
                 self.audit.record(
@@ -991,15 +1048,31 @@ class S3ApiServer:
         return Response(204, b"", headers={"ETag": f'"{etag}"'})
 
     def _get_object(self, bucket: str, key: str, req: Request) -> Response:
-        headers = {}
+        # the gateway already records this access at bucket/key
+        # granularity; without the skip header the filer would count
+        # the SAME read again and cluster totals double
+        headers = {"X-Weed-Heat-Skip": "1"}
         if req.headers.get("Range"):
             headers["Range"] = req.headers["Range"]
-        status, body, resp_headers = http_request(
+        # streamed passthrough: 2xx GET bodies arrive as a chunk
+        # iterator and leave as a StreamBody — the gateway never holds
+        # the object; filer and client stream concurrently (HEAD and
+        # error bodies materialize inside request_stream)
+        status, body, resp_headers = http_request_stream(
             self._object_url(bucket, key), method=req.method,
             headers=headers)
         if status == 404:
             return Response(404, _error_xml("NoSuchKey", key),
                             content_type="application/xml")
+        if not isinstance(body, (bytes, bytearray)):
+            clen = resp_headers.get("Content-Length")
+            if clen is not None:
+                body = StreamBody(body, int(clen))
+            else:
+                # no declared length (shouldn't happen against our own
+                # filer): the serving loop needs Content-Length up
+                # front, so fall back to materializing
+                body = b"".join(body)
         out = Response(status, body,
                        content_type=resp_headers.get(
                            "Content-Type", "application/octet-stream"))

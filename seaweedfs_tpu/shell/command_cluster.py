@@ -396,6 +396,75 @@ def cmd_cluster_events(env: CommandEnv, args: list[str]) -> str:
     return "\n".join(lines)
 
 
+@command("cluster.heat",
+         "workload heat from the federated heavy-hitter sketches: "
+         "[-top N] [-volumes|-buckets|-objects] [-json] — hot "
+         "objects/buckets/volumes as rates, cold-seal candidates "
+         "marked")
+def cmd_cluster_heat(env: CommandEnv, args: list[str]) -> str:
+    flags = parse_flags(args)
+    try:
+        top = int(flags.get("top", "10"))
+    except ValueError:
+        raise ShellError(f"-top must be an integer, got {flags['top']!r}")
+    out = env.master().call("ClusterHeat", {})
+    if "json" in flags:
+        return json.dumps(out)
+    picked = [s for s in ("volumes", "buckets", "objects") if s in flags]
+    sections = picked or ["volumes", "buckets", "objects"]
+    servers = out.get("servers", {})
+    lines = [
+        f"workload heat: {servers.get('up', 0)}/{servers.get('of', 0)} "
+        f"servers, decay {out.get('decay_s', 0):.0f}s, "
+        f"{out.get('tracked_ops', 0)} ops tracked, "
+        f"{out.get('memory_bytes', 0)} sketch bytes",
+        f"read/write ratio {out.get('read_write_ratio', 0):.2f}, "
+        f"zipf skew {out.get('zipf_skew', 0):.2f}, "
+        f"cold-seal candidates: "
+        + (", ".join(f"v{v}" for v in out.get("cold_candidates", []))
+           or "none")]
+    if "volumes" in sections:
+        lines.append("")
+        lines.append("%-8s %8s %9s %9s %10s %6s %8s %6s  %s" % (
+            "VOLUME", "HEAT", "READ_RPS", "WRITE_RPS", "KB/S", "ERR%",
+            "AGE_S", "FULL%", "FLAGS"))
+        for v in out.get("volumes", [])[:top]:
+            markers = []
+            if v.get("cold_candidate"):
+                markers.append("cold-seal")
+            if v.get("read_only"):
+                markers.append("ro")
+            lines.append("%-8s %8.3f %9.2f %9.2f %10.1f %6.2f %8s "
+                         "%6.1f  %s" % (
+                             f"v{v.get('volume')}", v.get("heat", 0.0),
+                             v.get("read_rps", 0.0),
+                             v.get("write_rps", 0.0),
+                             v.get("byte_rps", 0.0) / 1024.0,
+                             v.get("err_pct", 0.0),
+                             "-" if v.get("age_s", -1) < 0
+                             else f"{v['age_s']:.0f}",
+                             v.get("fullness_pct", 0.0),
+                             " ".join(markers)))
+    for section in ("buckets", "objects"):
+        if section not in sections:
+            continue
+        rows = out.get(section, [])[:top]
+        lines.append("")
+        lines.append("%-44s %9s %10s %6s %9s" % (
+            f"TOP {section.upper()}", "RPS", "KB/S", "ERR%", "±RPS"))
+        if not rows:
+            lines.append("  (no tracked accesses)")
+        for r in rows:
+            lines.append("%-44s %9.2f %10.1f %6.2f %9.2f" % (
+                r.get("key", "?")[:44], r.get("rps", 0.0),
+                r.get("bytes_rps", 0.0) / 1024.0,
+                r.get("err_pct", 0.0), r.get("rps_err", 0.0)))
+    errors = out.get("errors", {})
+    for server, err in errors.items():
+        lines.append(f"! {server}: {err}")
+    return "\n".join(lines)
+
+
 @command("metrics.dump",
          "snapshot every node's prometheus /metrics text")
 def cmd_metrics_dump(env: CommandEnv, args: list[str]) -> str:

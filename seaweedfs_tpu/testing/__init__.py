@@ -241,7 +241,8 @@ class SimCluster:
         if self._want_s3:
             assert self.filers, "s3 needs a filer"
             self.s3_server = S3ApiServer(self.filers[0].address,
-                                         self.filers[0].grpc_address)
+                                         self.filers[0].grpc_address,
+                                         masters=self.master_grpc)
             self.s3_server.start()
         return self
 

@@ -1074,6 +1074,96 @@ class ConnectionPool:
             return resp.status, data, resp_headers
         raise OSError("unreachable")
 
+    def request_stream(self, url: str, method: str, headers: dict,
+                       timeout: float, chunk: int = 1 << 16
+                       ) -> tuple[int, object, dict]:
+        """GET/HEAD whose 2xx body comes back as a chunk ITERATOR
+        instead of one buffered bytes — the proxy hop of a gateway
+        (S3 object GET -> filer) must not double-buffer what both ends
+        already stream.  The pooled connection stays checked out until
+        the iterator is exhausted (returned to the pool) or closed
+        early (discarded — a half-read keep-alive socket would poison
+        the next request).  Non-2xx and bodyless responses are
+        materialized and behave exactly like request()."""
+        import http.client
+
+        parsed = urllib.parse.urlsplit(url)
+        if parsed.scheme == "https":
+            raise NotImplementedError(
+                "https is not supported by the pooled client")
+        key = (parsed.hostname, parsed.port)
+        if faults.ACTIVE:
+            p = faults.hit("http.request",
+                           f"{parsed.hostname}:{parsed.port}")
+            if p is not None:
+                if p.mode == "refuse":
+                    raise ConnectionRefusedError(
+                        f"injected fault #{p.rule_id}: connect refused "
+                        f"{parsed.netloc}")
+                raise ConnectionResetError(
+                    f"injected fault #{p.rule_id}: reset by "
+                    f"{parsed.netloc}")
+        path = parsed.path + (f"?{parsed.query}" if parsed.query else "")
+        for attempt in (0, 1):
+            conn, reused = self._acquire(key, timeout,
+                                         fresh=attempt == 1)
+            conn.set_timeout(timeout)
+            try:
+                conn.hc.request(method, path, headers=headers)
+                resp = conn.hc.getresponse()
+            except (http.client.HTTPException, ConnectionError, OSError):
+                self._release(key, conn, discard=True)
+                if attempt or not reused:
+                    raise   # same stale-keep-alive retry as request()
+                continue
+            except BaseException:
+                self._release(key, conn, discard=True)
+                raise
+            resp_headers = dict(resp.getheaders())
+            if not (200 <= resp.status < 300) or method == "HEAD":
+                # error/redirect bodies are small XML/JSON: buffer them
+                # so every existing error path keeps working on bytes
+                try:
+                    data = resp.read()
+                except (http.client.HTTPException, ConnectionError,
+                        OSError):
+                    self._release(key, conn, discard=True)
+                    raise
+                self._release(key, conn,
+                              discard=bool(resp.will_close))
+                if resp.status in (301, 302, 307, 308) \
+                        and method in ("GET", "HEAD"):
+                    loc = resp_headers.get("Location", "")
+                    if loc:
+                        if loc.startswith("/"):
+                            loc = f"http://{parsed.netloc}{loc}"
+                        return self.request_stream(loc, method, headers,
+                                                   timeout, chunk)
+                return resp.status, data, resp_headers
+
+            def body_iter(conn=conn, resp=resp, key=key):
+                done = False
+                try:
+                    while True:
+                        piece = resp.read(chunk)
+                        if not piece:
+                            done = True
+                            return
+                        yield piece
+                except (http.client.HTTPException, ConnectionError,
+                        OSError):
+                    raise
+                finally:
+                    # exhausted cleanly -> back to the idle stack;
+                    # abandoned/error -> the socket still carries
+                    # unread body bytes and must not be reused
+                    self._release(
+                        key, conn,
+                        discard=not done or bool(resp.will_close))
+
+            return resp.status, body_iter(), resp_headers
+        raise OSError("unreachable")
+
 
 _POOL = ConnectionPool()
 
@@ -1118,6 +1208,29 @@ def http_request(url: str, method: str = "GET", body: bytes | None = None,
                 # how the cross-server tree links up
                 headers.setdefault(tracing.SPAN_HEADER, sid)
     return _POOL.request(url, method, body, headers, timeout)
+
+
+def http_request_stream(url: str, method: str = "GET",
+                        headers: dict | None = None,
+                        timeout: "float | None" = None
+                        ) -> tuple[int, object, dict]:
+    """Streaming sibling of http_request: 2xx GET bodies come back as
+    a chunk iterator (wrap in StreamBody to serve), everything else as
+    bytes.  Same trace propagation and default-timeout semantics."""
+    if timeout is None:
+        from .retry import default_http_timeout
+        timeout = default_http_timeout()
+    if not url.startswith("http"):
+        url = "http://" + url
+    headers = dict(headers or {})
+    if tracing.enabled():
+        tid = tracing.current_trace_id()
+        if tid:
+            headers.setdefault(tracing.TRACE_HEADER, tid)
+            sid = tracing.current_span_id()
+            if sid:
+                headers.setdefault(tracing.SPAN_HEADER, sid)
+    return _POOL.request_stream(url, method, headers, timeout)
 
 
 def http_get_json(url: str, timeout: "float | None" = None) -> dict:

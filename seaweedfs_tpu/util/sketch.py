@@ -1,0 +1,497 @@
+"""Bounded-memory streaming sketches + the per-server workload heat
+tracker (observability v4).
+
+The Haystack/f4 lineage organizes storage around *heat* — hot long-tail
+serving, warm-BLOB tiering — so the cluster must be able to answer
+"which objects are hot, which volumes are cold, how skewed is the
+workload?" without per-key metric labels (the cardinality explosion
+weedlint WL090/WL140 exist to prevent).  Everything here is O(k) by
+construction regardless of keyspace size:
+
+- ``SpaceSaving``: Metwally et al.'s heavy-hitter sketch.  At most
+  ``capacity`` tracked keys; a new key evicts the current minimum and
+  inherits its count as the entry's error bound, so for every tracked
+  key ``true_count <= count <= true_count + err``.  Any key with true
+  frequency above N/capacity is guaranteed present.  Entries carry aux
+  byte/error sums that ride along through eviction and merge.
+- ``CountMinSketch``: width x depth counter matrix under deterministic
+  per-row CRC32 hashing (stable across processes — worker sketches must
+  merge bit-compatibly with supervisor and master sketches).  Estimates
+  only ever OVER-count: ``true <= estimate <= true + eN`` with
+  probability 1-delta for width >= e/eps, depth >= ln(1/delta).
+- ``HeatTracker``: the per-server facade every serving path calls —
+  volume HTTP/TCP/worker reads and writes, the filer GET path, the S3
+  gateway, and wdclient chunk-cache hits.  It folds each access into
+  the sketches plus a decayed per-volume accumulator (reads, writes,
+  bytes, errors, last-access age) and exposes a JSON-safe ``snapshot``
+  that ``merge_snapshots`` combines worker -> supervisor -> master.
+
+Decay: counters age by ``exp(-dt/decay_s)``, applied lazily in O(k)
+bursts.  A steady r-ops/s stream converges the decayed count to
+``r * decay_s``, so rps = count / decay_s — that identity is how every
+report converts sketch counts to rates.
+
+Knobs: ``WEED_HEAT_TOPK`` (tracked keys per sketch, default 64),
+``WEED_HEAT_DECAY_S`` (decay time constant, default 600),
+``WEED_HEAT=0`` disables tracking entirely (the bench A/B switch).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+import zlib
+from array import array
+
+__all__ = [
+    "SpaceSaving", "CountMinSketch", "HeatTracker",
+    "merge_snapshots", "zipf_skew", "heat_topk", "heat_decay_s",
+    "heat_enabled",
+]
+
+
+def heat_topk() -> int:
+    """WEED_HEAT_TOPK: tracked keys per Space-Saving sketch."""
+    try:
+        return max(8, int(os.environ.get("WEED_HEAT_TOPK", "64")))
+    except ValueError:
+        return 64
+
+
+def heat_decay_s() -> float:
+    """WEED_HEAT_DECAY_S: decay time constant for every heat counter."""
+    try:
+        return max(1.0, float(os.environ.get("WEED_HEAT_DECAY_S",
+                                             "600")))
+    except ValueError:
+        return 600.0
+
+
+def heat_enabled() -> bool:
+    """WEED_HEAT=0 disables tracking (the bench's A/B switch)."""
+    return os.environ.get("WEED_HEAT", "1") not in ("0", "false", "off")
+
+
+class SpaceSaving:
+    """Space-Saving heavy hitters with aux byte/error accumulators.
+
+    ``_entries[key] = [count, err, bytes, errors]``.  Bounded at
+    ``capacity`` keys; eviction scans for the minimum count (capacity
+    is small — tens — so the O(k) scan beats maintaining a heap under
+    the churn of a zipfian tail)."""
+
+    __slots__ = ("capacity", "_entries", "_evict_pool", "_evict_min")
+
+    def __init__(self, capacity: int):
+        self.capacity = max(1, int(capacity))
+        self._entries: dict[str, list[float]] = {}
+        # keys that sat at the minimum count when last scanned: counts
+        # only ever grow (decay rescales the floor too), so these stay
+        # the minimum until individually incremented — validated at pop
+        # time.  One O(k) rescan per pool drain amortizes eviction to
+        # O(1); a fresh min() scan per eviction is what made tracking a
+        # zipfian tail O(k) per request on the serving path.
+        self._evict_pool: list[str] = []
+        self._evict_min = 0.0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def offer(self, key: str, count: float = 1.0, nbytes: float = 0.0,
+              errors: float = 0.0) -> None:
+        entries = self._entries
+        e = entries.get(key)
+        if e is not None:
+            e[0] += count
+            e[2] += nbytes
+            e[3] += errors
+            return
+        if len(entries) < self.capacity:
+            entries[key] = [count, 0.0, nbytes, errors]
+            return
+        # evict the minimum; the newcomer inherits its count as the
+        # error bound (the Space-Saving guarantee) and its aux sums
+        # (the bytes went SOMEWHERE below this rank — keeping them
+        # preserves the sketch-wide totals through churn)
+        pool = self._evict_pool
+        while True:
+            if not pool:
+                m = min(v[0] for v in entries.values())
+                self._evict_min = m
+                pool.extend(k for k, v in entries.items()
+                            if v[0] <= m)
+            victim = pool.pop()
+            v = entries.get(victim)
+            if v is not None and v[0] <= self._evict_min:
+                break
+        vc, _ve, vb, vx = entries.pop(victim)
+        entries[key] = [vc + count, vc, vb + nbytes, vx + errors]
+
+    def items(self) -> list[tuple[str, float, float, float, float]]:
+        """[(key, count, err, bytes, errors)] sorted by count desc."""
+        return sorted(((k, e[0], e[1], e[2], e[3])
+                       for k, e in self._entries.items()),
+                      key=lambda t: (-t[1], t[0]))
+
+    def top(self, n: int) -> list[tuple[str, float, float, float, float]]:
+        return self.items()[:max(0, int(n))]
+
+    def merge_items(self, items) -> None:
+        """Fold another sketch's item rows in (counts/errs/aux add for
+        common keys; new keys go through offer-with-eviction so the
+        bound survives).  Merge is order-insensitive whenever the union
+        fits in capacity; beyond that the error bounds absorb the
+        truncation, exactly as for single-stream eviction."""
+        for row in items:
+            key, count, err, nbytes, errors = (
+                row[0], float(row[1]), float(row[2]),
+                float(row[3]), float(row[4]))
+            e = self._entries.get(key)
+            if e is not None:
+                e[0] += count
+                e[1] += err
+                e[2] += nbytes
+                e[3] += errors
+            else:
+                self.offer(key, count, nbytes, errors)
+                self._entries[key][1] += err
+
+    def scale(self, factor: float) -> None:
+        for e in self._entries.values():
+            e[0] *= factor
+            e[1] *= factor
+            e[2] *= factor
+            e[3] *= factor
+        # the pool floor scales with the counts, so pool keys stay
+        # exactly at the (rescaled) minimum
+        self._evict_min *= factor
+
+    def prune(self, floor: float) -> None:
+        """Drop entries decayed below `floor` — keeps long-idle
+        sketches from reporting dust."""
+        dead = [k for k, e in self._entries.items() if e[0] < floor]
+        for k in dead:
+            del self._entries[k]
+
+
+class CountMinSketch:
+    """Count-Min under deterministic per-row CRC32 hashing.
+
+    Hashing must be stable ACROSS PROCESSES (worker subprocess sketches
+    merge into the supervisor's, then the master's) — Python's builtin
+    ``hash`` is salted per process, so rows key off ``zlib.crc32`` with
+    a per-row prefix instead."""
+
+    __slots__ = ("width", "depth", "_rows", "_seeds")
+
+    def __init__(self, width: int = 512, depth: int = 4):
+        self.width = max(8, int(width))
+        self.depth = max(1, int(depth))
+        self._rows = [array("d", [0.0] * self.width)
+                      for _ in range(self.depth)]
+        self._seeds = [0x9E3779B9 * (r + 1) & 0xFFFFFFFF
+                       for r in range(self.depth)]
+
+    def add(self, key: str, count: float = 1.0) -> None:
+        # row loop inlined (no per-row method call): this sits on every
+        # serving-path request, where the tracker's whole budget is a
+        # few microseconds
+        kb = key.encode("utf-8", "replace")
+        crc, width = zlib.crc32, self.width
+        for row, seed in zip(self._rows, self._seeds):
+            row[crc(kb, seed) % width] += count
+
+    def estimate(self, key: str) -> float:
+        kb = key.encode("utf-8", "replace")
+        crc, width = zlib.crc32, self.width
+        return min(row[crc(kb, seed) % width]
+                   for row, seed in zip(self._rows, self._seeds))
+
+    def scale(self, factor: float) -> None:
+        for row in self._rows:
+            for i in range(self.width):
+                row[i] *= factor
+
+    def merge_cells(self, width: int, depth: int, cells) -> None:
+        """Elementwise add of a serialized sketch; geometry must match
+        (mismatched sketches would alias different keys together)."""
+        if width != self.width or depth != self.depth:
+            raise ValueError(
+                f"count-min geometry mismatch: {width}x{depth} into "
+                f"{self.width}x{self.depth}")
+        flat = iter(cells)
+        for row in self._rows:
+            for i in range(self.width):
+                row[i] += next(flat)
+
+    def cells(self) -> list[float]:
+        out: list[float] = []
+        for row in self._rows:
+            out.extend(round(v, 4) for v in row)
+        return out
+
+    def memory_bytes(self) -> int:
+        return self.depth * self.width * 8
+
+
+def zipf_skew(counts: "list[float]") -> float:
+    """Least-squares slope magnitude of log(count) vs log(rank) over
+    top-K counts — ~1.0 for a classic zipfian, ~0 for uniform.  The
+    skew estimate the autopilot/tiering consumers read to decide
+    whether a cache tier would pay off."""
+    pts = [(math.log(i + 1), math.log(c))
+           for i, c in enumerate(sorted(counts, reverse=True)) if c > 0]
+    if len(pts) < 3:
+        return 0.0
+    n = float(len(pts))
+    sx = sum(x for x, _ in pts)
+    sy = sum(y for _, y in pts)
+    sxx = sum(x * x for x, _ in pts)
+    sxy = sum(x * y for x, y in pts)
+    denom = n * sxx - sx * sx
+    if denom <= 0:
+        return 0.0
+    return max(0.0, -(n * sxy - sx * sy) / denom)
+
+
+_VOL_FIELDS = ("reads", "writes", "read_bytes", "write_bytes", "errors")
+
+
+class HeatTracker:
+    """Per-server workload heat: every serving path calls ``record``;
+    ``snapshot`` emits the JSON document /heat serves and the
+    federation merges.  All counters decay with one shared time
+    constant so rps = count / decay_s everywhere."""
+
+    # lazy decay granularity: counters are rescaled when at least
+    # decay_s/8 elapsed since the last pass — an O(k) burst every few
+    # dozen seconds instead of per-record float math
+    _DECAY_SLICES = 8.0
+
+    def __init__(self, topk: "int | None" = None,
+                 decay_s: "float | None" = None,
+                 cms_width: int = 512, cms_depth: int = 4,
+                 enabled: "bool | None" = None):
+        self.topk = topk if topk is not None else heat_topk()
+        self.decay_s = decay_s if decay_s is not None else heat_decay_s()
+        self.enabled = enabled if enabled is not None else heat_enabled()
+        self.objects = SpaceSaving(self.topk)
+        self.buckets = SpaceSaving(self.topk)
+        self.freq = CountMinSketch(cms_width, cms_depth)
+        # vid -> [reads, writes, read_bytes, write_bytes, errors,
+        #         last_access_mono]
+        self.volumes: dict[int, list[float]] = {}
+        self.totals = {"reads": 0.0, "writes": 0.0, "bytes": 0.0,
+                       "errors": 0.0}
+        self.tracked_ops = 0      # lifetime, undecayed (self-metrics)
+        self.decay_runs = 0
+        self._last_decay = time.monotonic()
+        self._lock = threading.Lock()
+
+    # -- recording -----------------------------------------------------------
+    def record(self, op: str, volume: "int | None" = None,
+               key: "str | None" = None, bucket: "str | None" = None,
+               nbytes: int = 0, error: bool = False) -> None:
+        """One access.  op: read | write | delete (deletes count as
+        writes for heat purposes — they mutate the volume)."""
+        if not self.enabled:
+            return
+        now = time.monotonic()
+        nbytes = int(nbytes or 0)   # streamed bodies may report None
+        err = 1.0 if error else 0.0
+        with self._lock:
+            self._maybe_decay(now)
+            self.tracked_ops += 1
+            if op == "read":
+                self.totals["reads"] += 1.0
+            else:
+                self.totals["writes"] += 1.0
+            self.totals["bytes"] += nbytes
+            self.totals["errors"] += err
+            if key:
+                self.objects.offer(key, 1.0, nbytes, err)
+                self.freq.add(key)
+            if bucket:
+                self.buckets.offer(bucket, 1.0, nbytes, err)
+            if volume is not None:
+                v = self.volumes.get(volume)
+                if v is None:
+                    v = self.volumes[volume] = [0.0] * 5 + [now]
+                if op == "read":
+                    v[0] += 1.0
+                    v[2] += nbytes
+                else:
+                    v[1] += 1.0
+                    v[3] += nbytes
+                v[4] += err
+                v[5] = now
+
+    def _maybe_decay(self, now: float) -> None:
+        dt = now - self._last_decay
+        if dt < self.decay_s / self._DECAY_SLICES:
+            return
+        factor = math.exp(-dt / self.decay_s)
+        self.objects.scale(factor)
+        self.objects.prune(0.05)
+        self.buckets.scale(factor)
+        self.buckets.prune(0.05)
+        self.freq.scale(factor)
+        for v in self.volumes.values():
+            for i in range(5):
+                v[i] *= factor
+        dead = [vid for vid, v in self.volumes.items()
+                if v[0] + v[1] < 0.01]
+        for vid in dead:
+            del self.volumes[vid]
+        for k in self.totals:
+            self.totals[k] *= factor
+        self._last_decay = now
+        self.decay_runs += 1
+
+    # -- reporting -----------------------------------------------------------
+    def memory_bytes(self) -> int:
+        """Order-of-magnitude sketch footprint — bounded by construction
+        (capacity entries + the fixed count-min matrix), never by
+        keyspace size."""
+        with self._lock:
+            entry = 120   # dict slot + list of 4 floats + key
+            return (len(self.objects) + len(self.buckets)) * entry \
+                + self.freq.memory_bytes() \
+                + len(self.volumes) * (6 * 8 + 64)
+
+    def snapshot(self, include_freq: bool = True) -> dict:
+        """The /heat document.  Ages are relative seconds (monotonic
+        deltas), never timestamps — they must survive crossing
+        processes and hosts with unsynchronized clocks."""
+        now = time.monotonic()
+        with self._lock:
+            self._maybe_decay(now)
+            vols = {
+                str(vid): {
+                    "reads": round(v[0], 4), "writes": round(v[1], 4),
+                    "read_bytes": round(v[2], 2),
+                    "write_bytes": round(v[3], 2),
+                    "errors": round(v[4], 4),
+                    "age_s": round(now - v[5], 3),
+                }
+                for vid, v in self.volumes.items()}
+            snap = {
+                "decay_s": self.decay_s,
+                "topk": self.topk,
+                "objects": [[k, round(c, 4), round(e, 4),
+                             round(b, 2), round(x, 4)]
+                            for k, c, e, b, x in self.objects.items()],
+                "buckets": [[k, round(c, 4), round(e, 4),
+                             round(b, 2), round(x, 4)]
+                            for k, c, e, b, x in self.buckets.items()],
+                "volumes": vols,
+                "totals": {k: round(v, 4)
+                           for k, v in self.totals.items()},
+                "tracked_ops": self.tracked_ops,
+                "memory_bytes": 0,
+            }
+            if include_freq:
+                snap["freq"] = {"width": self.freq.width,
+                                "depth": self.freq.depth,
+                                "cells": self.freq.cells()}
+        snap["memory_bytes"] = self.memory_bytes()
+        return snap
+
+    def fill_metrics(self, gauges: dict) -> None:
+        """Refresh the seaweedfs_heat_* self-gauges (called by the
+        owning server's /metrics handler — the tracker's own cost must
+        be observable)."""
+        with self._lock:
+            tracked = float(self.tracked_ops)
+            entries = float(len(self.objects) + len(self.buckets))
+            decays = float(self.decay_runs)
+        gauges["ops"].set(value=tracked)
+        gauges["entries"].set(value=entries)
+        gauges["decays"].set(value=decays)
+        gauges["bytes"].set(value=float(self.memory_bytes()))
+
+    @staticmethod
+    def register_metrics(registry) -> dict:
+        """seaweedfs_heat_* families on a server registry; returns the
+        gauge handles fill_metrics refreshes."""
+        return {
+            "ops": registry.gauge(
+                "seaweedfs_heat_tracked_ops",
+                "accesses folded into the heat sketches (lifetime)"),
+            "entries": registry.gauge(
+                "seaweedfs_heat_sketch_entries",
+                "keys currently tracked across heavy-hitter sketches"),
+            "bytes": registry.gauge(
+                "seaweedfs_heat_sketch_bytes",
+                "estimated sketch memory footprint"),
+            "decays": registry.gauge(
+                "seaweedfs_heat_decay_runs",
+                "lazy decay passes applied to the sketches"),
+        }
+
+
+def merge_snapshots(snaps: "list[dict]",
+                    topk: "int | None" = None) -> dict:
+    """Fold /heat snapshots into one document of the same shape —
+    associative and order-insensitive (sums and maxima throughout,
+    modulo Space-Saving truncation), so worker -> supervisor -> master
+    grouping yields the same answer as a flat merge.
+
+    Count-min matrices merge only across identical geometry; a
+    mismatched snapshot (version skew mid-rollout) contributes
+    everything EXCEPT its freq matrix."""
+    snaps = [s for s in snaps if s]
+    k = topk if topk is not None else max(
+        [int(s.get("topk", 0)) for s in snaps] or [heat_topk()])
+    decay = max([float(s.get("decay_s", 0)) for s in snaps]
+                or [heat_decay_s()])
+    objects = SpaceSaving(max(k, 1))
+    buckets = SpaceSaving(max(k, 1))
+    freq: "CountMinSketch | None" = None
+    volumes: dict[str, dict] = {}
+    totals = {"reads": 0.0, "writes": 0.0, "bytes": 0.0, "errors": 0.0}
+    tracked = 0
+    memory = 0
+    for s in snaps:
+        objects.merge_items(s.get("objects", ()))
+        buckets.merge_items(s.get("buckets", ()))
+        f = s.get("freq")
+        if f and f.get("cells"):
+            try:
+                if freq is None:
+                    freq = CountMinSketch(f["width"], f["depth"])
+                freq.merge_cells(f["width"], f["depth"], f["cells"])
+            except (ValueError, KeyError, StopIteration):
+                pass  # geometry skew: drop this matrix, keep the rest
+        for vid, v in (s.get("volumes") or {}).items():
+            dst = volumes.get(vid)
+            if dst is None:
+                volumes[vid] = dict(v)
+            else:
+                for fld in _VOL_FIELDS:
+                    dst[fld] = dst.get(fld, 0.0) + v.get(fld, 0.0)
+                dst["age_s"] = min(dst.get("age_s", 1e9),
+                                   v.get("age_s", 1e9))
+        for fld, val in (s.get("totals") or {}).items():
+            totals[fld] = totals.get(fld, 0.0) + float(val)
+        tracked += int(s.get("tracked_ops", 0))
+        memory += int(s.get("memory_bytes", 0))
+    out = {
+        "decay_s": decay, "topk": k,
+        "objects": [[a, round(c, 4), round(e, 4), round(b, 2),
+                     round(x, 4)]
+                    for a, c, e, b, x in objects.items()],
+        "buckets": [[a, round(c, 4), round(e, 4), round(b, 2),
+                     round(x, 4)]
+                    for a, c, e, b, x in buckets.items()],
+        "volumes": volumes,
+        "totals": {f: round(v, 4) for f, v in totals.items()},
+        "tracked_ops": tracked,
+        "memory_bytes": memory,
+    }
+    if freq is not None:
+        out["freq"] = {"width": freq.width, "depth": freq.depth,
+                       "cells": freq.cells()}
+    return out

@@ -38,7 +38,7 @@ from ..storage.types import FileId
 from ..storage.volume import NotFoundError, volume_file_name
 from ..util import tracing
 from ..util.http import (FileRegion, HttpServer, Request, Response,
-                         http_request, parse_byte_range)
+                         _body_len, http_request, parse_byte_range)
 
 from ..util.weedlog import logger
 
@@ -127,6 +127,13 @@ class VolumeServer:
         # offset-guarded (volume_server/needle_cache.py)
         from .needle_cache import HotNeedleCache
         self.needle_cache = HotNeedleCache()
+        # workload heat sketches (util/sketch.py): every read/write on
+        # every serving loop (HTTP, TCP frame, worker shard) folds in
+        # here; /heat serves the snapshot the master federates
+        from ..util.sketch import HeatTracker
+        self.heat = HeatTracker()
+        self._heat_gauges = HeatTracker.register_metrics(
+            self.metrics.registry)
         self.pulse_seconds = pulse_seconds
         self.store = Store(directories, max_volume_counts)
         # a disk fault that degrades a volume to read-only must reach
@@ -290,6 +297,7 @@ class VolumeServer:
     def _register_http(self) -> None:
         self.http.route("GET", "/status", self._http_status)
         self.http.route("GET", "/metrics", self._http_metrics)
+        self.http.route("GET", "/heat", self._http_heat)
         from ..util import profiling
         self._traces_handler = tracing.traces_http_handler(self.tracer)
         self._profile_handler = profiling.profile_http_handler()
@@ -403,11 +411,25 @@ class VolumeServer:
                 return merged
         return self._profile_handler(req)
 
+    def _http_heat(self, req: Request) -> Response:
+        """This server's heat sketches (util/sketch.py snapshot).  On a
+        worker the bare path answers for the whole logical node via the
+        supervisor's merge; ?worker_local=1 serves just this partition.
+        ?freq=0 drops the count-min matrix (the bulky part) for callers
+        that only want the top-K tables."""
+        if self._worker is not None and not req.qs("worker_local"):
+            merged = self._proxy_supervisor(req, "/heat")
+            if merged is not None:
+                return merged
+        return Response.json(
+            self.heat.snapshot(include_freq=req.qs("freq") != "0"))
+
     def _http_metrics(self, req: Request) -> Response:
         if self._worker is not None and not req.qs("worker_local"):
             merged = self._proxy_supervisor(req, "/metrics")
             if merged is not None:
                 return merged
+        self.heat.fill_metrics(self._heat_gauges)
         total = sum(len(loc.volumes) for loc in self.store.locations)
         self.metrics.volume_count.set(value=total)
         self.metrics.needle_cache_bytes.set(
@@ -490,6 +512,11 @@ class VolumeServer:
             # 4xx (not-found, cookie mismatch, bad jwt) is the user's
             # problem and must not eat the error budget
             self.metrics.volume_errors.inc(kind)
+        self.heat.record(
+            kind, volume=fid.volume_id, key=str(fid),
+            nbytes=(_body_len(resp.body) if kind == "read"
+                    else len(req.body or b"")),
+            error=resp.status >= 500)
         return resp
 
     def _read_needle(self, fid: FileId, req: Request) -> Response:
@@ -776,6 +803,8 @@ class VolumeServer:
             # failure on the frame path must burn the SLO error budget
             # like its HTTP twin would (not-local/jwt are client-class)
             self.metrics.volume_errors.inc("write")
+            self.heat.record("write", volume=fid.volume_id, key=fid_str,
+                             nbytes=len(body), error=True)
             raise
         self.needle_cache.invalidate(fid.volume_id, fid.key)
         if not replicate:
@@ -796,6 +825,8 @@ class VolumeServer:
         self.metrics.volume_latency.observe(
             "write", value=time.perf_counter() - t0,
             trace_id=tracing.current_trace_id())
+        self.heat.record("write", volume=fid.volume_id, key=fid_str,
+                         nbytes=len(body))
         return size, n.etag()
 
     def tcp_read(self, fid_str: str) -> bytes:
@@ -818,6 +849,8 @@ class VolumeServer:
                 self.metrics.volume_latency.observe(
                     "read", value=time.perf_counter() - t0,
                     trace_id=tracing.current_trace_id())
+                self.heat.record("read", volume=fid.volume_id,
+                                 key=fid_str, nbytes=len(ce.data))
                 return ce.data
             self.metrics.needle_cache_ops.inc("miss")
             offset = v.needle_offset(fid.key)
@@ -846,8 +879,10 @@ class VolumeServer:
             self.metrics.volume_latency.observe(
                 "read", value=time.perf_counter() - t0,
                 trace_id=tracing.current_trace_id())
+            self.heat.record("read", volume=fid.volume_id, key=fid_str,
+                             nbytes=len(data))
             return data
-        from ..util.http import CIDict, FileRegion, _body_bytes
+        from ..util.http import CIDict, FileRegion, _body_bytes, _body_len
         req = Request(method="GET", path="", query={},
                       headers=CIDict(), body=b"")
         resp = self._read_needle(fid, req)  # EC / redirect cases
@@ -859,6 +894,9 @@ class VolumeServer:
             resp.body.close()
         if resp.status >= 500:
             self.metrics.volume_errors.inc("read")
+        self.heat.record("read", volume=fid.volume_id, key=fid_str,
+                         nbytes=_body_len(resp.body),
+                         error=resp.status >= 500)
         if resp.status >= 300:
             raise ValueError(
                 _body_bytes(resp.body).decode(errors="replace"))
@@ -923,6 +961,8 @@ class VolumeServer:
         self.metrics.volume_latency.observe(
             "read", value=time.perf_counter() - t0,
             trace_id=tracing.current_trace_id())
+        self.heat.record("read", volume=fid.volume_id, key=fid_str,
+                         nbytes=len(piece))
         return piece
 
     def tcp_delete(self, fid_str: str, jwt: str) -> dict:
@@ -938,6 +978,8 @@ class VolumeServer:
                       query={"jwt": [jwt]} if jwt else {},
                       headers=CIDict(), body=b"")
         resp = self._delete_needle(fid, req)
+        self.heat.record("delete", volume=fid.volume_id, key=fid_str,
+                         error=resp.status >= 500)
         if resp.status >= 300:
             raise ValueError(resp.body.decode(errors="replace"))
         return json.loads(resp.body)

@@ -939,6 +939,7 @@ class ShardedVolumeServer:
                         exact=True)
         self.http.route("GET", "/workers", self._http_workers,
                         exact=True)
+        self.http.route("GET", "/heat", self._http_heat, exact=True)
         # debug parity (ISSUE 14): tracing/profiling must not go dark
         # behind the supervisor — merged by default, one partition via
         # ?worker=<i>
@@ -1026,6 +1027,29 @@ class ShardedVolumeServer:
 
     def _http_workers(self, req: Request) -> Response:
         return Response.json(self.status())
+
+    def _http_heat(self, req: Request) -> Response:
+        """Merged heat for the logical node: every partition's sketches
+        folded through util/sketch.merge_snapshots — the same merge the
+        master applies across servers, so worker -> supervisor ->
+        master grouping is associative by construction."""
+        from ..util.sketch import merge_snapshots
+        qs = "freq=0" if req.qs("freq") == "0" else ""
+        snaps: list[dict] = []
+        errors: dict[str, str] = {}
+        for i in range(self.workers):
+            try:
+                status, body, _ = self._fetch_worker(i, "/heat", qs=qs)
+                if status != 200:
+                    raise OSError(f"HTTP {status}")
+                snaps.append(json.loads(body))
+            except (OSError, ConnectionError, ValueError) as e:
+                errors[str(i)] = str(e)
+        merged = merge_snapshots(snaps)
+        merged["workers"] = {"up": len(snaps), "of": self.workers}
+        if errors:
+            merged["workers"]["errors"] = errors
+        return Response.json(merged)
 
     # -- debug parity: traces + profile through the supervisor -------------
     @staticmethod

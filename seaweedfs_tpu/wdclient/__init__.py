@@ -223,6 +223,11 @@ class CachedFileReader:
         """cache: a TieredChunkCache/MemChunkCache-shaped object (get/
         put); None disables caching (reads pass straight through)."""
         self.cache = cache
+        # optional util/sketch HeatTracker: cache HITS are reads the
+        # volume servers never observe, so the owning server (filer /
+        # mount) reports them here — federated per-volume heat is then
+        # server-observed + cache-absorbed = true access counts
+        self.heat = None
         self._pool = None
         self._pool_lock = threading.Lock()
         self._closed = False
@@ -240,11 +245,22 @@ class CachedFileReader:
             for k, n in deltas.items():
                 self.stats[k] = self.stats.get(k, 0) + n
 
+    def _record_heat(self, fid: str, nbytes: int) -> None:
+        heat = self.heat
+        if heat is None:
+            return
+        try:
+            vid = int(fid.split(",", 1)[0])
+        except ValueError:
+            vid = None
+        heat.record("read", volume=vid, key=fid, nbytes=max(0, nbytes))
+
     def read(self, master_grpc: str, fid: str) -> bytes:
         if self.cache is not None:
             blob = self.cache.get(fid)
             if blob is not None:
                 self._count(cache_hits=1)
+                self._record_heat(fid, len(blob))
                 return blob
         from .. import operation
         blob = operation.read_file(master_grpc, fid)
@@ -268,6 +284,7 @@ class CachedFileReader:
             blob = self.cache.get(fid)
             if blob is not None:
                 self._count(cache_hits=1)
+                self._record_heat(fid, min(length, len(blob) - offset))
                 return blob[offset:offset + length]
         from .. import operation
         fallback: dict = {}   # folded in under the stats lock below

@@ -1,0 +1,351 @@
+"""Workload heat plane (ISSUE 16): bounded-memory streaming sketches
+(Space-Saving heavy hitters, count-min frequency), the per-server
+HeatTracker with exponential decay, associative worker -> supervisor ->
+master snapshot merging — and the federated /cluster/heat report: on a
+seeded zipfian SimCluster drive the merged top-10 must equal the TRUE
+top-10, heat series must be range-queryable at /cluster/history, and
+sketch memory stays bounded by construction."""
+
+import json
+import math
+import random
+import time
+
+import pytest
+
+from seaweedfs_tpu import shell
+from seaweedfs_tpu.testing import SimCluster
+from seaweedfs_tpu.util.http import http_request
+from seaweedfs_tpu.util.sketch import (CountMinSketch, HeatTracker,
+                                       SpaceSaving, merge_snapshots,
+                                       zipf_skew)
+
+
+def _zipf_counts(n: int, base: float, s: float) -> list:
+    return [max(1, int(base / (i + 1) ** s)) for i in range(n)]
+
+
+# -- unit: Space-Saving ------------------------------------------------------
+
+def test_space_saving_recall_and_error_bounds_zipfian():
+    """Metwally guarantees: any key with true frequency > N/capacity is
+    tracked, and for every tracked key
+    ``true <= count <= true + err``."""
+    capacity, nkeys = 32, 400
+    true = {f"k{i}": c for i, c in
+            enumerate(_zipf_counts(nkeys, 3000.0, 1.2))}
+    stream = [k for k, c in true.items() for _ in range(c)]
+    random.Random(42).shuffle(stream)     # adversarial interleaving
+    ss = SpaceSaving(capacity)
+    for k in stream:
+        ss.offer(k)
+    assert len(ss) <= capacity            # bounded regardless of nkeys
+    n = float(len(stream))
+    tracked = {k: (c, e) for k, c, e, _b, _x in ss.items()}
+    guaranteed = [k for k, c in true.items() if c > n / capacity]
+    assert guaranteed, "fixture produced no guaranteed heavy hitters"
+    for k in guaranteed:
+        assert k in tracked, f"heavy hitter {k} evicted"
+    for k, (count, err) in tracked.items():
+        t = true.get(k, 0)
+        assert count >= t, f"{k}: undercount {count} < {t}"
+        assert count - err <= t, f"{k}: bound violated"
+    # the skew makes the top of the distribution exact
+    top5 = [k for k, *_ in ss.top(5)]
+    assert top5 == [f"k{i}" for i in range(5)]
+
+
+def test_space_saving_aux_sums_survive_eviction():
+    """Byte/error accumulators ride through eviction so sketch-wide
+    totals are preserved even when keys churn."""
+    ss = SpaceSaving(2)
+    ss.offer("a", nbytes=100.0)
+    ss.offer("b", nbytes=50.0, errors=1.0)
+    ss.offer("c", nbytes=25.0)            # evicts the minimum
+    assert len(ss) == 2
+    assert sum(b for *_k, b, _x in ss.items()) == pytest.approx(175.0)
+    assert sum(x for *_k, x in ss.items()) == pytest.approx(1.0)
+
+
+# -- unit: count-min ---------------------------------------------------------
+
+def test_count_min_overestimates_within_bound():
+    cms = CountMinSketch(width=256, depth=4)
+    true = {f"obj{i}": c for i, c in
+            enumerate(_zipf_counts(2000, 1000.0, 1.1))}
+    n = 0
+    for k, c in true.items():
+        cms.add(k, c)
+        n += c
+    for k in list(true)[:50] + list(true)[-50:]:
+        est = cms.estimate(k)
+        assert est >= true[k]             # NEVER undercounts
+        assert est - true[k] <= 3.0 * n / 256.0
+    assert cms.memory_bytes() == 256 * 4 * 8
+
+
+def test_count_min_hashing_is_deterministic_and_merges():
+    """CRC32 row hashing is stable across instances (stand-in for
+    across processes — builtin hash() is salted per process), so
+    worker matrices merge cell-for-cell into supervisor matrices."""
+    a, b = CountMinSketch(64, 3), CountMinSketch(64, 3)
+    for k in ("x", "y", "zebra/1"):
+        a.add(k, 2.0)
+        b.add(k, 2.0)
+    assert a.cells() == b.cells()
+    a.merge_cells(64, 3, b.cells())
+    assert a.estimate("x") == pytest.approx(4.0)
+    with pytest.raises(ValueError):
+        a.merge_cells(32, 3, CountMinSketch(32, 3).cells())
+
+
+def test_zipf_skew_estimator():
+    skewed = [1000.0 / (i + 1) ** 1.1 for i in range(50)]
+    assert zipf_skew(skewed) == pytest.approx(1.1, abs=0.1)
+    assert zipf_skew([10.0] * 50) < 0.05
+    assert zipf_skew([5.0]) == 0.0        # too few points
+
+
+# -- unit: tracker decay -----------------------------------------------------
+
+def test_tracker_decay_scales_counts_then_prunes_dust():
+    tr = HeatTracker(topk=16, decay_s=100.0, enabled=True)
+    for _ in range(80):
+        tr.record("read", volume=7, key="k", nbytes=10)
+    # simulate 50s of idle by rewinding the decay clock
+    tr._last_decay -= 50.0
+    snap = tr.snapshot(include_freq=False)
+    factor = math.exp(-50.0 / 100.0)
+    assert snap["totals"]["reads"] == pytest.approx(80 * factor,
+                                                    rel=0.02)
+    assert snap["volumes"]["7"]["reads"] == pytest.approx(80 * factor,
+                                                          rel=0.02)
+    assert snap["objects"][0][1] == pytest.approx(80 * factor, rel=0.02)
+    assert tr.tracked_ops == 80           # lifetime counter never decays
+    # a very long idle decays everything to dust, which is pruned —
+    # long-dead sketches report empty, not noise
+    tr._last_decay -= 5000.0
+    snap = tr.snapshot(include_freq=False)
+    assert snap["objects"] == [] and snap["volumes"] == {}
+
+
+def test_tracker_disabled_records_nothing():
+    tr = HeatTracker(topk=16, decay_s=100.0, enabled=False)
+    tr.record("read", volume=1, key="k", nbytes=10)
+    snap = tr.snapshot()
+    assert snap["tracked_ops"] == 0 and snap["objects"] == []
+
+
+def test_tracker_memory_bounded_by_construction():
+    tr = HeatTracker(topk=32, decay_s=1e9, enabled=True)
+    for i in range(20000):
+        tr.record("read", volume=i % 5, key=f"key-{i}",
+                  bucket=f"b{i % 3}", nbytes=100)
+    cap = tr.memory_bytes()
+    assert cap < 200_000                  # sketches, not a keyspace map
+    snap = tr.snapshot()
+    assert len(snap["objects"]) <= 32 and len(snap["buckets"]) <= 32
+    # every one of the 20k accesses is still accounted in the totals
+    assert snap["totals"]["reads"] == pytest.approx(20000.0)
+
+
+# -- unit: merge associativity ----------------------------------------------
+
+def test_merge_snapshots_worker_supervisor_master_associative():
+    """Grouped merging (worker -> supervisor -> master) must equal the
+    flat merge — sums and maxima throughout."""
+    trackers = []
+    for w in range(3):
+        tr = HeatTracker(topk=64, decay_s=600.0, enabled=True)
+        for i in range(40):
+            tr.record("read", volume=i % 4, key=f"obj{(i + w) % 9}",
+                      bucket=f"b{w}", nbytes=64, error=(i % 13 == 0))
+        for i in range(10):
+            tr.record("write", volume=i % 4, key=f"obj{i % 9}",
+                      nbytes=128)
+        trackers.append(tr)
+    s1, s2, s3 = [t.snapshot(include_freq=True) for t in trackers]
+    flat = merge_snapshots([s1, s2, s3])
+    grouped = merge_snapshots([merge_snapshots([s1, s2]), s3])
+    assert dict((k, c) for k, c, *_ in flat["objects"]) \
+        == pytest.approx(dict((k, c) for k, c, *_
+                              in grouped["objects"]), abs=1e-2)
+    for vid, v in flat["volumes"].items():
+        for fld, val in v.items():
+            assert grouped["volumes"][vid][fld] \
+                == pytest.approx(val, abs=1e-2)
+    assert flat["totals"] == pytest.approx(grouped["totals"], abs=1e-2)
+    assert flat["tracked_ops"] == grouped["tracked_ops"] == 150
+    assert flat["freq"]["cells"] == pytest.approx(
+        grouped["freq"]["cells"], abs=1e-2)
+    # an empty snapshot is the merge identity
+    again = merge_snapshots([flat, {}])
+    assert again["totals"] == pytest.approx(flat["totals"], abs=1e-2)
+
+
+# -- cluster: seeded zipfian drive -> /cluster/heat --------------------------
+
+N_OBJECTS = 24
+HOT = 10
+
+
+@pytest.fixture(scope="module")
+def heat_cluster(tmp_path_factory):
+    with SimCluster(volume_servers=2,
+                    base_dir=str(tmp_path_factory.mktemp("heat"))) as c:
+        fids = [c.upload(f"heat-{i}".encode() * 40)
+                for i in range(N_OBJECTS)]
+        # zipfian-ish plan with strictly separated hot ranks: object i
+        # of the hot set gets 40-3i reads, the tail one read each, so
+        # the TRUE top-10 is exactly fids[0..9] in order
+        for i, fid in enumerate(fids):
+            reads = 40 - 3 * i if i < HOT else 1
+            for _ in range(reads):
+                c.read(fid)
+        c._heat_fids = fids
+        yield c
+
+
+def test_cluster_heat_top10_equals_true_top10(heat_cluster):
+    c = heat_cluster
+    m = c.masters[0]
+    report = m.observer.heat_report()
+    got = [r["key"] for r in report["objects"][:HOT]]
+    want = c._heat_fids[:HOT]
+    assert got == want, f"recall != 1.0: {got} vs {want}"
+    # rates follow the decayed-count identity rps = count/decay_s and
+    # the error term is zero while the union fits in capacity
+    assert all(r["rps"] > 0 for r in report["objects"][:HOT])
+    assert all(r["rps_err"] == 0.0 for r in report["objects"][:HOT])
+    assert report["read_write_ratio"] > 3.0
+    assert report["zipf_skew"] > 0.3
+    assert report["servers"]["up"] == report["servers"]["of"] == 2
+    # fresh volumes are young and near-empty: never cold-seal marked
+    assert report["cold_candidates"] == []
+    assert report["volumes"], "topology volumes missing from report"
+    hottest = report["volumes"][0]
+    assert hottest["heat"] >= report["volumes"][-1]["heat"]
+    assert hottest["read_rps"] > 0 and hottest["age_s"] >= 0
+    # sketch memory is bounded by construction, not keyspace size
+    assert 0 < report["memory_bytes"] < 2_000_000
+
+
+def test_cluster_heat_rpc_and_http_agree(heat_cluster):
+    c = heat_cluster
+    m = c.masters[0]
+    from seaweedfs_tpu.pb.rpc import POOL
+    rpc = POOL.client(c.master_grpc, "Seaweed").call("ClusterHeat", {})
+    status, body, _ = http_request(f"http://{m.address}/cluster/heat")
+    assert status == 200
+    http_doc = json.loads(body)
+    assert [r["key"] for r in rpc["objects"][:HOT]] \
+        == [r["key"] for r in http_doc["objects"][:HOT]]
+    assert "freq" not in http_doc         # matrix only on request
+    status, body, _ = http_request(
+        f"http://{m.address}/cluster/heat?freq=1")
+    assert json.loads(body)["freq"]["cells"]
+
+
+def test_heat_series_range_queryable_in_history(heat_cluster):
+    c = heat_cluster
+    m = c.masters[0]
+    for _ in range(2):
+        c.read(c._heat_fids[0])
+        time.sleep(0.15)
+        m.plane.tick()
+    status, body, _ = http_request(
+        f"http://{m.address}/cluster/history"
+        "?series=volume_heat,volume_heat_skew,read_write_ratio,"
+        "zipf_skew_estimate,cold_volume_count&since=-600")
+    assert status == 200
+    d = json.loads(body)
+    for name in ("volume_heat", "volume_heat_skew", "read_write_ratio",
+                 "zipf_skew_estimate", "cold_volume_count"):
+        assert name in d["names"], f"{name} not in history vocabulary"
+        assert d["series"][name], f"{name} recorded no points"
+    labels = list(d["series"]["volume_heat"])
+    assert all(k.startswith("volume=") for k in labels)
+    for pts in d["series"]["volume_heat"].values():
+        assert all(v >= 0 for _ts, v in pts)
+    cold_pts = d["series"]["cold_volume_count"][""]
+    assert cold_pts and all(v == 0.0 for _ts, v in cold_pts)
+
+
+def test_cluster_heat_shell_verb(heat_cluster):
+    c = heat_cluster
+    env = shell.CommandEnv(c.master_grpc)
+    out = shell.run_command(env, "cluster.heat -top 5")
+    head = out.splitlines()[0]
+    assert "workload heat: 2/2 servers" in head
+    assert "VOLUME" in out and "TOP OBJECTS" in out \
+        and "TOP BUCKETS" in out
+    assert "cold-seal candidates: none" in out
+    assert c._heat_fids[0][:44] in out    # hottest object in the table
+    only_vols = shell.run_command(env, "cluster.heat -volumes")
+    assert "TOP OBJECTS" not in only_vols and "VOLUME" in only_vols
+    doc = json.loads(shell.run_command(env, "cluster.heat -json"))
+    assert doc["objects"][0]["key"] == c._heat_fids[0]
+    with pytest.raises(shell.ShellError):
+        shell.run_command(env, "cluster.heat -top pancakes")
+
+
+def test_volume_server_heat_endpoint_and_self_metrics(heat_cluster):
+    c = heat_cluster
+    vs = c.volume_servers[0]
+    status, body, _ = http_request(f"http://{vs.url}/heat?freq=0")
+    assert status == 200
+    snap = json.loads(body)
+    assert snap["tracked_ops"] > 0 and "freq" not in snap
+    assert len(snap["objects"]) <= snap["topk"]
+    status, body, _ = http_request(f"http://{vs.url}/metrics")
+    text = body.decode()
+    assert "seaweedfs_heat_tracked_ops" in text
+    assert "seaweedfs_heat_sketch_bytes" in text
+
+
+def test_hot_volume_skew_alert_rule_armed(heat_cluster):
+    m = heat_cluster.masters[0]
+    rules = {r.name: r for r in m.plane.alerts.rules}
+    assert "hot-volume-skew" in rules
+    assert rules["hot-volume-skew"].series == "volume_heat_skew"
+
+
+# -- cluster: S3 gateway heat + streamed GET ---------------------------------
+
+def test_s3_gateway_heat_and_streamed_get(tmp_path):
+    from seaweedfs_tpu.s3.client import S3Client
+    with SimCluster(volume_servers=1, filers=1, s3=True,
+                    base_dir=str(tmp_path / "s3heat")) as c:
+        s3 = c.s3_server
+        cl = S3Client(s3.address)
+        cl.create_bucket("tenant-a")
+        payload = bytes(range(256)) * 1024          # 256 KiB
+        cl.put_object("tenant-a", "hot/obj.bin", payload)
+        for _ in range(5):
+            assert cl.get_object("tenant-a", "hot/obj.bin") == payload
+        # gateway-side sketches: the bucket and the object are tracked
+        status, body, _ = http_request(f"http://{s3.address}/heat")
+        assert status == 200
+        snap = json.loads(body)
+        assert any(k == "tenant-a" for k, *_ in snap["buckets"])
+        obj = [r for r in snap["objects"]
+               if r[0] == "tenant-a/hot/obj.bin"]
+        assert obj and obj[0][1] >= 5.0    # 5 reads + 1 write, exact
+        # the S3 gateway registers with the master and its sketches
+        # land in the federated report (bucket keys join fid keys)
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if c.masters[0].cluster_nodes.get("s3"):
+                break
+            time.sleep(0.1)
+        assert c.masters[0].cluster_nodes.get("s3"), \
+            "s3 gateway never registered with the master"
+        report = c.masters[0].observer.heat_report()
+        assert any(b["key"] == "tenant-a" for b in report["buckets"])
+        # ranged GET rides the streaming hop end to end
+        status, body, headers = http_request(
+            f"http://{s3.address}/tenant-a/hot/obj.bin",
+            headers={"Range": "bytes=1000-1999"})
+        assert status == 206 and body == payload[1000:2000]
+        assert headers["Content-Range"] == \
+            f"bytes 1000-1999/{len(payload)}"

@@ -112,6 +112,22 @@ def test_bad_buffering_fixture():
                    ("WL130", 14), ("WL130", 15), ("WL130", 20)]
 
 
+def test_bad_labelcardinality_fixture():
+    got = _ids_lines(_findings(os.path.join(FIXTURES,
+                                            "bad_labelcardinality.py")))
+    assert got == [("WL140", 7), ("WL140", 8), ("WL140", 9),
+                   ("WL140", 10), ("WL140", 11)]
+
+
+def test_metric_labels_have_bounded_cardinality():
+    """ISSUE 16 satellite: no live metric label value derives from
+    request data (object keys, fids, client addresses, bucket names) —
+    per-key detail belongs to the heat sketches, whose memory is
+    bounded by construction, never to label sets."""
+    got = [f for f in analyze_paths([PACKAGE]) if f.checker == "WL140"]
+    assert got == [], "\n".join(f.render() for f in got)
+
+
 def test_streaming_handlers_have_no_unmarked_buffering():
     """ISSUE 15 satellite: the streaming upload handlers (filer PUT,
     S3 object PUT / part PUT) hold the WL130 contract — every
@@ -246,5 +262,5 @@ def test_cli_list_checkers():
     for cid in ("WL001", "WL002", "WL010", "WL011", "WL012",
                 "WL020", "WL021", "WL022", "WL030", "WL040",
                 "WL050", "WL060", "WL080", "WL090", "WL100",
-                "WL110", "WL120", "WL130"):
+                "WL110", "WL120", "WL130", "WL140"):
         assert cid in r.stdout

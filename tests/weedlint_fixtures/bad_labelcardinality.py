@@ -1,0 +1,18 @@
+"""WL140 fixture: client-address / tenant-identifier label values plus
+keyword-smuggled request data.  Line numbers pinned by tests."""
+metrics = None
+
+
+def track(remote_addr, bucket, client_addr, req, fid):
+    metrics.requests.inc(remote_addr)
+    metrics.requests.inc(f"tenant:{bucket}")
+    metrics.gets.set(client_addr, value=1.0)
+    metrics.ops.inc("read", tenant=req.path)
+    metrics.ops.observe("read", value=0.1, who=fid)
+
+
+def clean(remote_addr, bucket, req):
+    tenant_class = "small"
+    metrics.requests.inc(tenant_class)
+    metrics.ops.observe("read", value=0.1, trace_id=req.trace_id)
+    metrics.gets.set("read", value=float(len(bucket)))

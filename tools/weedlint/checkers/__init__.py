@@ -14,3 +14,4 @@ from . import journal      # noqa: F401
 from . import forksafety   # noqa: F401
 from . import wallclock    # noqa: F401
 from . import buffering    # noqa: F401
+from . import labelcardinality  # noqa: F401

@@ -1,0 +1,103 @@
+"""WL140 unbounded-label-cardinality — request-derived metric label
+values WL090's scan cannot see.
+
+WL090 flags positional label values that mention ``req``/``request`` or
+the core identifier vocabulary (``path``/``fid``/``key``/...).  Two
+gaps remained, both observed in the wild while building the workload
+heat plane (which exists precisely because per-key LABELS explode —
+heavy-hitter sketches bound the memory instead):
+
+- **Client/peer addresses and tenant identifiers**: ``.inc(remote_addr)``
+  or ``.inc(bucket)`` creates one label set per client / per tenant
+  bucket — an unbounded vocabulary the closed-set rule forbids just as
+  much as object keys.
+- **Keyword label arguments**: the stats API takes labels positionally
+  (``inc(*labels, value=)``), but a checker must not trust call sites
+  to follow the signature — a request-derived expression smuggled
+  through any non-``value`` keyword is the same cardinality bomb.
+
+The metrics-owner heuristic is shared with WL090 so ``d.set(...)`` on
+arbitrary objects stays clean; ``value=`` and ``trace_id=`` (the
+exemplar hook, deliberately per-request) are exempt."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .. import Finding, ModuleContext, register
+from .metricshygiene import (_REQUEST_NAMES, _RECORD_METHODS,
+                             _UNBOUNDED_NAMES, _metrics_owner)
+
+# vocabularies WL090 does not cover: one label set per client...
+_ADDR_NAMES = {"addr", "remote_addr", "client_addr", "peer",
+               "peer_addr", "remote_ip", "client_ip"}
+# ...or per tenant-named thing (buckets, uploads, object keys)
+_IDENT_NAMES = {"bucket", "bucket_name", "object_key", "obj_key",
+                "upload_id", "fid_str"}
+# sanctioned kwargs on the stats API: the measurement itself and the
+# exemplar hook (deliberately per-request, stored per-bucket not
+# per-label-set)
+_VALUE_KWARGS = {"value", "amount", "trace_id"}
+
+
+def _why_unbounded(node: ast.AST, extra_core: bool) -> "str | None":
+    """Why this expression is an unbounded label value, or None.
+    ``extra_core`` widens the scan to WL090's own vocabulary — used for
+    keyword args, which WL090 never looks at (positional hits on that
+    vocabulary are WL090's finding, not ours)."""
+    for sub in ast.walk(node):
+        names = ()
+        if isinstance(sub, ast.Name):
+            names = (sub.id,)
+            if extra_core and sub.id in _REQUEST_NAMES:
+                return f"value derived from `{sub.id}`"
+        elif isinstance(sub, ast.Attribute):
+            names = (sub.attr,)
+        for n in names:
+            if n in _ADDR_NAMES:
+                return f"`{n}` is a client/peer address " \
+                       f"(one label set per client)"
+            if n in _IDENT_NAMES:
+                return f"`{n}` is a tenant-named identifier " \
+                       f"(one label set per bucket/key)"
+            if extra_core and n in _UNBOUNDED_NAMES:
+                return f"`{n}` is an unbounded identifier space"
+    return None
+
+
+@register("WL140", "unbounded-label-cardinality")
+def check_label_cardinality(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute) \
+                or node.func.attr not in _RECORD_METHODS \
+                or not _metrics_owner(node):
+            continue
+        for arg in node.args:
+            why = _why_unbounded(arg, extra_core=False)
+            if why:
+                yield Finding(
+                    "WL140", "unbounded-label-cardinality", ctx.path,
+                    arg.lineno,
+                    f"unbounded label value fed to "
+                    f".{node.func.attr}() ({why})",
+                    "label values must be a small closed vocabulary; "
+                    "track per-key/per-client detail with the heat "
+                    "sketches (util/sketch.py) or traces, never labels")
+                break
+        for kw in node.keywords:
+            if kw.arg in _VALUE_KWARGS or kw.arg is None:
+                continue
+            why = _why_unbounded(kw.value, extra_core=True)
+            if why:
+                yield Finding(
+                    "WL140", "unbounded-label-cardinality", ctx.path,
+                    kw.value.lineno,
+                    f"unbounded label value fed to "
+                    f".{node.func.attr}() via keyword "
+                    f"`{kw.arg}` ({why})",
+                    "label values must be a small closed vocabulary; "
+                    "track per-key/per-client detail with the heat "
+                    "sketches (util/sketch.py) or traces, never labels")
+                break
