@@ -1125,6 +1125,35 @@ def bench_largefile(quick: bool = False) -> dict:
     return out
 
 
+
+def bench_weedlint(quick: bool = False) -> dict:
+    """Static-analysis wall clock (ISSUE 17): a full cold weedlint run
+    over the package (parallel parse, all checkers + the project-wide
+    call-graph phase) and a warm re-run against the mtime cache.  The
+    warm number is what `tools/check.sh` pays on an unchanged tree."""
+    import shutil
+    import subprocess
+    import tempfile
+    here = os.path.dirname(os.path.abspath(__file__))
+    cache = tempfile.mkdtemp(prefix="weedlint-bench-")
+    cmd = [sys.executable, "-m", "tools.weedlint", "seaweedfs_tpu",
+           "--cache-dir", cache]
+    try:
+        t0 = time.perf_counter()
+        r = subprocess.run(cmd, cwd=here, capture_output=True, timeout=600)
+        cold = time.perf_counter() - t0
+        if r.returncode not in (0, 1):
+            return {"weedlint_error":
+                    r.stderr.decode(errors="replace")[:200]}
+        t0 = time.perf_counter()
+        subprocess.run(cmd, cwd=here, capture_output=True, timeout=600)
+        warm = time.perf_counter() - t0
+        return {"weedlint_run_s": round(cold, 3),
+                "weedlint_cached_run_s": round(warm, 3)}
+    finally:
+        shutil.rmtree(cache, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -1513,6 +1542,10 @@ def main():
                 smallfile.update(bench_largefile(quick=args.quick))
             except Exception as e:
                 smallfile["largefile_error"] = str(e)[:200]
+            try:
+                smallfile.update(bench_weedlint(quick=args.quick))
+            except Exception as e:
+                smallfile["weedlint_error"] = str(e)[:200]
         except Exception as e:   # never fail the headline metric
             smallfile = {"smallfile_error": str(e)[:200]}
     # end-to-end disk path (VERDICT r3 missing #1)

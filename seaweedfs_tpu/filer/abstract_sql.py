@@ -34,6 +34,7 @@ import hashlib
 import json
 import struct
 import threading
+from ..util import locks
 from contextlib import contextmanager
 
 from .entry import Entry
@@ -179,7 +180,7 @@ class AbstractSqlStore(FilerStore):
         self.dialect = dialect
         self.name = dialect.name
         self._conn = dialect.connect()
-        self._lock = threading.RLock()
+        self._lock = locks.RLock("AbstractSqlStore._lock")
         self._txn_depth = 0
         with self._lock:
             cur = self._conn.cursor()

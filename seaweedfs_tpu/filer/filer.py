@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import threading
+from ..util import locks
 import time
 from typing import Callable
 
@@ -87,8 +88,8 @@ class _Subscriber:
         self.fn = fn
         self.max_pending = max_pending
         self._pending: list[MetaEvent] = []
-        self._plock = threading.Lock()
-        self._dlock = threading.Lock()
+        self._plock = locks.Lock("_Subscriber._plock")
+        self._dlock = locks.Lock("_Subscriber._dlock")
         self.dead = False
         self.overflowed = False
 
@@ -140,10 +141,10 @@ class Filer:
         self.delete_chunks_fn = delete_chunks_fn or (lambda chunks: None)
         self.journal = journal
         self._log: list[MetaEvent] = []
-        self._log_lock = threading.Lock()
+        self._log_lock = locks.Lock("Filer._log_lock")
         # serializes hardlink KV read-modify-write (counters must not
         # lose increments/decrements across RPC threads)
-        self._hardlink_lock = threading.Lock()
+        self._hardlink_lock = locks.Lock("Filer._hardlink_lock")
         self._last_ts = 0
         self._seq = 0            # next offset - 1 (mirrors the journal)
         self._subscribers: list[_Subscriber] = []

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import bisect
 import threading
+from ..util import locks
 from .entry import Entry
 
 
@@ -74,7 +75,7 @@ class MemoryStore(FilerStore):
         self._by_dir: dict[str, list[str]] = {}   # dir -> sorted names
         self._entries: dict[str, Entry] = {}      # full_path -> entry
         self._kv: dict[bytes, bytes] = {}
-        self._lock = threading.RLock()
+        self._lock = locks.RLock("MemoryStore._lock")
 
     def insert_entry(self, entry: Entry) -> None:
         with self._lock:

@@ -27,6 +27,7 @@ import json
 import os
 import struct
 import threading
+from ..util import locks
 from bisect import bisect_left, bisect_right
 
 from .entry import Entry
@@ -135,7 +136,7 @@ class LsmStore(FilerStore):
         self.memtable_limit = memtable_limit
         self.max_segments = max_segments
         os.makedirs(directory, exist_ok=True)
-        self._lock = threading.RLock()
+        self._lock = locks.RLock("LsmStore._lock")
         self._mem: dict[bytes, bytes] = {}
         self._segments: list[_Segment] = []      # oldest .. newest
         for name in sorted(

@@ -13,6 +13,7 @@ another.
 from __future__ import annotations
 
 import threading
+from ..util import locks
 from typing import Callable
 
 from ..pb.rpc import POOL, RpcError
@@ -32,7 +33,7 @@ class MetaAggregator:
         # so a peer that drops out of the registry and rejoins does not
         # replay its whole history to live subscribers
         self._cursors: dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("MetaAggregator._lock")
 
     def start(self) -> None:
         threading.Thread(target=self._discovery_loop, daemon=True).start()

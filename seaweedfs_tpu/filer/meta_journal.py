@@ -41,6 +41,7 @@ from __future__ import annotations
 import os
 import struct
 import threading
+from ..util import locks
 import time
 
 from ..storage.crc import crc32c
@@ -146,7 +147,7 @@ class MetaJournal:
         self.retain_bytes = retain_bytes
         self.retain_age_s = retain_age_s
         self.fsync_interval = fsync_interval
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("MetaJournal._lock")
         self._segments: list[_Segment] = []   # sorted; last is active
         self._fd = -1
         self._dirty = False
@@ -260,13 +261,16 @@ class MetaJournal:
                 self._roll_locked()
                 active = self._segments[-1]
             if faults.ACTIVE:
-                plan = faults.hit("disk.pwrite", active.path)
+                # fault injection stands in for the pwrite below, so it
+                # MUST run under the same lock (a simulated slow/torn
+                # disk outside the critical section would test nothing)
+                plan = faults.hit("disk.pwrite", active.path)  # weedlint: disable=WL150
                 if plan is not None:
                     if plan.mode == "torn":
                         torn = plan.torn_bytes if plan.torn_bytes >= 0 \
                             else len(frame) // 2
                         os.pwrite(self._fd, frame[:torn], active.size)
-                        self._rollback_locked(active)
+                        self._rollback_locked(active)  # weedlint: disable=WL150
                     raise plan.error(active.path)
             try:
                 wrote = os.pwrite(self._fd, frame, active.size)
@@ -274,7 +278,9 @@ class MetaJournal:
                     raise OSError(f"short journal write: {wrote} of "
                                   f"{len(frame)} bytes")
             except OSError:
-                self._rollback_locked(active)
+                # rollback is a hold-the-lock contract (_locked suffix);
+                # its only blocking reach is the fault injector itself
+                self._rollback_locked(active)  # weedlint: disable=WL150
                 raise
             active.size += len(frame)
             active.records += 1
@@ -337,8 +343,10 @@ class MetaJournal:
                 return
             if self._fd >= 0 and self._dirty:
                 if faults.ACTIVE:
-                    faults.raise_if_planned("disk.fsync",
-                                            self._segments[-1].path)
+                    # stands in for the fsync below — same lock, same
+                    # reasoning as the append-path injection point
+                    faults.raise_if_planned(  # weedlint: disable=WL150
+                        "disk.fsync", self._segments[-1].path)
                 os.fsync(self._fd)
                 self._dirty = False
             # retention rides the flusher cadence too: age budgets must

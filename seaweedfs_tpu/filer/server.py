@@ -22,6 +22,7 @@ import json
 import os
 import queue
 import threading
+from ..util import locks
 import time
 import urllib.parse
 
@@ -198,7 +199,7 @@ class FilerServer:
         # streamed), behind the seaweedfs_sync_subscriber_lag_events
         # gauge and the JournalStatus RPC / filer.sync.status verb
         self._sub_progress: "dict[str, int]" = {}
-        self._sub_lock = threading.Lock()
+        self._sub_lock = locks.Lock("FilerServer._sub_lock")
         self.tracer = Tracer("filer")
         from ..util import profiling
         profiling.sampler()  # always-on process sampler (WEED_PROFILE)
@@ -223,7 +224,7 @@ class FilerServer:
         # blocks; the stream disconnects itself on overflow)
         self._agg_subs: "dict[int, object]" = {}
         self._agg_seq = 0
-        self._agg_lock = threading.Lock()
+        self._agg_lock = locks.Lock("FilerServer._agg_lock")
         self._aggregator = None
         self.conf = FilerConf(self.filer.store)
         self._register_http()
@@ -407,6 +408,9 @@ class FilerServer:
         from ..util import profiling
         self.http.route("GET", "/debug/profile",
                         profiling.profile_http_handler(), exact=True)
+        self.http.route("GET", "/debug/lockdep",
+                        lambda req: Response.json(locks.debug_snapshot()),
+                        exact=True)
         # stream_body: uploads arrive as a reader, so PUT/POST bodies
         # chunk-and-flush as bytes arrive instead of buffering whole
         # multi-GB objects (reads/deletes materialize on entry)

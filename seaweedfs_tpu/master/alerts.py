@@ -36,6 +36,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+from ..util import locks
 import time
 from dataclasses import dataclass
 
@@ -178,7 +179,7 @@ class AlertEngine:
             rules_path if rules_path is not None
             else os.environ.get("WEED_ALERT_RULES", ""))
         self._by_name = {r.name: r for r in self.rules}
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("AlertEngine._lock")
         # (rule_name, labels) -> {"state", "since", "fired_at",
         #                         "resolved_at", "value"}
         self._states: dict[tuple, dict] = {}

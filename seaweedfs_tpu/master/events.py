@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import json
 import threading
+from ..util import locks
 import time
 from collections import deque
 
@@ -57,7 +58,7 @@ DEFAULT_SEGMENT_BYTES = 1 << 20
 class EventLog:
     def __init__(self, directory: "str | None" = None,
                  ring_size: int = 2048):
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("EventLog._lock")
         self._ring: deque = deque(maxlen=ring_size)
         self._journal = None
         self.counters = {"emitted": 0, "recovered": 0,

@@ -29,6 +29,7 @@ within ~2 election timeouts.
 from __future__ import annotations
 
 import threading
+from ..util import locks
 
 from ..util.weedlog import logger
 from .raft import RaftNode, NotLeaderError  # noqa: F401 (re-export)
@@ -59,7 +60,7 @@ class HaCoordinator:
         self.self_addr = normalize_addr(master.grpc_address)
         self.peers = sorted({normalize_addr(p) for p in peers}
                             | {self.self_addr})
-        self._state_lock = threading.Lock()
+        self._state_lock = locks.Lock("HaCoordinator._state_lock")
         self.max_vid = 0
         self.next_sequence = 1
         self.raft = RaftNode(
@@ -154,7 +155,7 @@ class RaftSequencer:
 
     def __init__(self, coordinator: HaCoordinator):
         self._coord = coordinator
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("RaftSequencer._lock")
         self._next = 1
         self._limit = 1      # empty block: first alloc reserves
 

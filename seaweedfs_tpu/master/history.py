@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import os
 import threading
+from ..util import locks
 import time
 from collections import deque
 
@@ -123,7 +124,7 @@ class MetricsHistory:
         self.levels = levels if levels is not None else _parse_levels(
             os.environ.get("WEED_HISTORY_LEVELS", DEFAULT_LEVELS))
         self._series: dict[str, dict[tuple, list]] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("MetricsHistory._lock")
 
     def record(self, ts: float,
                values: "dict[tuple[str, tuple], float]") -> None:
@@ -251,7 +252,7 @@ class ObservabilityPlane:
         self._prev_slo: "dict | None" = None
         self._last_tick: float = 0.0
         self._last_snapshot: "dict[tuple, float]" = {}
-        self._tick_lock = threading.Lock()
+        self._tick_lock = locks.Lock("ObservabilityPlane._tick_lock")
         self._stop = threading.Event()
         self._thread: "threading.Thread | None" = None
         self.m_tick = master.metrics.registry.gauge(

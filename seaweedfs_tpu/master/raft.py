@@ -37,6 +37,7 @@ import json
 import os
 import random
 import threading
+from ..util import locks
 import time
 from typing import Callable
 
@@ -92,8 +93,8 @@ class RaftNode:
         self.max_log_entries = max_log_entries
         self._rng = random.Random(seed)
 
-        self._lock = threading.RLock()
-        self._apply_mutex = threading.Lock()
+        self._lock = locks.RLock("RaftNode._lock")
+        self._apply_mutex = locks.Lock("RaftNode._apply_mutex")
         self.term = 0
         self.voted_for: str | None = None
         # log entries: {"i": absolute index, "t": term, "c": command}
@@ -119,7 +120,7 @@ class RaftNode:
         self._thread: threading.Thread | None = None
         # wakes the long-lived per-peer replicator loops (no per-heartbeat
         # thread spawning)
-        self._cond = threading.Condition()
+        self._cond = locks.Condition(name="RaftNode._cond")
         self._election_deadline = 0.0
         if state_dir:
             os.makedirs(state_dir, exist_ok=True)

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import random
 import threading
+from ..util import locks
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
@@ -103,7 +104,7 @@ class TokenBucket:
         self.burst = max(burst, 1.0)
         self._tokens = self.burst
         self._last = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("TokenBucket._lock")
 
     def try_acquire(self, n: float) -> bool:
         if self.rate <= 0:
@@ -147,7 +148,7 @@ class RepairPlanner:
         self.metrics = master.metrics
         self._stop = threading.Event()
         self._thread: "threading.Thread | None" = None
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("RepairPlanner._lock")
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, self.cfg.max_inflight),
             thread_name_prefix="repair")

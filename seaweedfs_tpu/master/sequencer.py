@@ -8,13 +8,14 @@
 from __future__ import annotations
 
 import threading
+from ..util import locks
 import time
 
 
 class MemorySequencer:
     def __init__(self, start: int = 1):
         self._counter = start
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("MemorySequencer._lock")
 
     def next_file_id(self, count: int = 1) -> int:
         """Returns the first id of a batch of `count` consecutive ids."""
@@ -44,7 +45,7 @@ class SnowflakeSequencer:
         self.node_id = node_id
         self._step = 0
         self._last_ms = -1
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("SnowflakeSequencer._lock")
 
     def next_file_id(self, count: int = 1) -> int:
         # ids are not consecutive across ms boundaries; callers that need a

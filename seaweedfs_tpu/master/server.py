@@ -23,6 +23,7 @@ import os
 import queue
 import random
 import threading
+from ..util import locks
 import time
 
 from ..pb.rpc import POOL, RpcError, RpcServer
@@ -116,9 +117,9 @@ class MasterServer:
         self.repair = None
         self._seed = seed
         self._rng = random.Random(seed)
-        self._grow_lock = threading.Lock()
+        self._grow_lock = locks.Lock("MasterServer._grow_lock")
         # admin maintenance lock (LeaseAdminToken)
-        self._admin_lock = threading.Lock()
+        self._admin_lock = locks.Lock("MasterServer._admin_lock")
         self._admin_token: int = 0
         self._admin_client: str = ""
         self._admin_ts: float = 0.0
@@ -130,7 +131,7 @@ class MasterServer:
         # stream can register before the old stream's cleanup runs.
         self.cluster_nodes: dict[str, dict[str, int]] = {}
         self._sub_seq = 0
-        self._sub_lock = threading.Lock()
+        self._sub_lock = locks.Lock("MasterServer._sub_lock")
 
         self.http = HttpServer(host, port)
         self.rpc = RpcServer(host, grpc_port)
@@ -886,6 +887,9 @@ class MasterServer:
         from ..util import profiling
         self.http.route("GET", "/debug/profile",
                         profiling.profile_http_handler(), exact=True)
+        self.http.route("GET", "/debug/lockdep",
+                        lambda req: Response.json(locks.debug_snapshot()),
+                        exact=True)
         self.http.route("GET", "/ui", self._http_ui)
 
     def _http_assign(self, req: Request) -> Response:

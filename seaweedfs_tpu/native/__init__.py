@@ -62,7 +62,9 @@ def _load():
         if _tried:
             return _lib
         _tried = True
-        so = build()
+        # the lock EXISTS to serialize the one-time cc build; nothing
+        # on a hot path can contend (both loaders are once-guarded)
+        so = build()  # weedlint: disable=WL150
         if so is None:
             return None
         try:
@@ -200,7 +202,8 @@ def fastpath():
     with _lock:
         if _fp_tried:
             return _fp
-        so = _build_fastpath()
+        # one-time cc build serialized on purpose (see _load above)
+        so = _build_fastpath()  # weedlint: disable=WL150
         if so is not None:
             try:
                 from importlib.machinery import ExtensionFileLoader

@@ -300,7 +300,13 @@ class ServerMetrics:
             "overflow")
 
     def render(self, exemplars: bool = False) -> str:
-        return self.registry.render(exemplars=exemplars)
+        out = self.registry.render(exemplars=exemplars)
+        # lockdep families only appear when the instrumentation is on,
+        # so the default exposition is byte-identical to before
+        from ..util import locks
+        if locks.lockdep_enabled():
+            out += locks.render_metrics() + "\n"
+        return out
 
 
 EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4"

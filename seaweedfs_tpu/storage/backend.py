@@ -12,6 +12,7 @@ import io
 import mmap
 import os
 import threading
+from ..util import locks
 from abc import ABC, abstractmethod
 
 from ..util import faults
@@ -156,7 +157,7 @@ class MemoryMappedFile(BackendStorageFile):
         self.disk = DiskFile(path, create=create)
         self._mm: mmap.mmap | None = None
         self._mm_size = 0
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("MemoryMappedFile._lock")
         self._remap()
 
     def _remap(self) -> None:

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import threading
+from ...util import locks
 from typing import Callable
 
 import numpy as np
@@ -99,7 +100,7 @@ class EcVolume:
         self.remote_reader = remote_reader
         self.version = version
         self.shards: dict[int, EcVolumeShard] = {}
-        self._lock = threading.RLock()
+        self._lock = locks.RLock("EcVolume._lock")
 
         base = self._base()
         self._ecx_path = base + ".ecx"

@@ -18,6 +18,7 @@ from __future__ import annotations
 import os
 import sqlite3
 import threading
+from ..util import locks
 from dataclasses import dataclass
 from typing import Iterator
 
@@ -67,7 +68,7 @@ class NeedleMapper:
         if not hasattr(self, "metric"):  # replay may have populated it already
             self.metric = MapMetric()
         self._index_path = index_path
-        self._index_lock = threading.Lock()
+        self._index_lock = locks.Lock("NeedleMapper._index_lock")
         self._index_f = None
         if index_path is not None:
             self._index_f = open(index_path, "ab")
@@ -203,7 +204,7 @@ class LevelDbNeedleMap(NeedleMapper):
         self._db_path = db_path
         fresh = not os.path.exists(db_path)
         self._db = sqlite3.connect(db_path, check_same_thread=False)
-        self._db_lock = threading.Lock()
+        self._db_lock = locks.Lock("LevelDbNeedleMap._db_lock")
         self._db.execute("PRAGMA journal_mode=WAL")
         self._db.execute(
             "CREATE TABLE IF NOT EXISTS needles"

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import os
 import threading
+from ..util import locks
 from dataclasses import dataclass, field
 
 from . import types as t
@@ -37,7 +38,7 @@ class DiskLocation:
         self.volumes: dict[int, Volume] = {}
         self.ec_volumes: dict[int, object] = {}  # vid -> EcVolume (storage.ec)
         self.on_degrade = None   # propagated onto every opened Volume
-        self._lock = threading.RLock()
+        self._lock = locks.RLock("DiskLocation._lock")
         # vids being created: reserved under _lock, volume files opened
         # outside it (opening .dat/.idx can block on a slow disk)
         self._pending: set[int] = set()

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import os
 import threading
+from ..util import locks
 import time
 from dataclasses import dataclass
 
@@ -105,7 +106,7 @@ class Volume:
         self.needle_map_kind = needle_map_kind
         self.read_only = read_only
         self.backend_kind = backend_kind
-        self._lock = threading.RLock()
+        self._lock = locks.RLock("Volume._lock")
         self.last_modified = 0
         # ns-resolution activity clock: the scrub's authority signal.
         # Seconds (last_modified) tie too easily — a write and the

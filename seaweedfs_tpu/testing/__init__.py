@@ -94,6 +94,12 @@ class SimCluster:
                  filer_chunk_size: int = 0,
                  volume_workers: int = 1,
                  history_interval: float = 0.0):
+        # runtime lockdep rides along with every simulated cluster:
+        # instrumentation must be flipped BEFORE servers construct
+        # their locks (passthrough is decided at construction time).
+        # WEED_LOCKDEP=0 in the environment opts a run out.
+        from ..util import locks
+        locks.enable_for_tests()
         # self-healing loop (master/repair.py): off by default so kill/
         # partition tests observe raw degradation; chaos-convergence
         # tests turn it on with tight knobs via `repair={...}`

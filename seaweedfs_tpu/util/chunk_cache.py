@@ -22,6 +22,7 @@ from __future__ import annotations
 import hashlib
 import os
 import threading
+from seaweedfs_tpu.util import locks
 from collections import OrderedDict
 
 
@@ -38,7 +39,7 @@ class MemChunkCache:
         self.item_limit = item_limit
         self._data: OrderedDict[str, bytes] = OrderedDict()
         self._size = 0
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("MemChunkCache._lock")
         self.hits = 0
         self.misses = 0
 
@@ -106,7 +107,7 @@ class DiskChunkCache:
         self.dir = cache_dir
         self.limit = limit_bytes
         self.item_limit = item_limit
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("DiskChunkCache._lock")
         self._index: OrderedDict[str, int] = OrderedDict()  # name -> size
         self._size = 0
         os.makedirs(cache_dir, exist_ok=True)

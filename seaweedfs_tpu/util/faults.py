@@ -40,6 +40,7 @@ import errno
 import itertools
 import random
 import threading
+from seaweedfs_tpu.util import locks
 import time
 from dataclasses import dataclass, field
 
@@ -50,7 +51,7 @@ LOG = logger(__name__)
 # single-read gate for the hot paths: False <=> no rules are armed
 ACTIVE = False
 
-_LOCK = threading.Lock()
+_LOCK = locks.Lock("faults._LOCK")
 _RULES: "list[FaultRule]" = []
 _SEQ = itertools.count(1)
 

@@ -26,6 +26,7 @@ import json
 import os
 import socket
 import threading
+from seaweedfs_tpu.util import locks
 import time
 import urllib.error
 import urllib.parse
@@ -408,7 +409,7 @@ class HttpServer:
         # live connections, closed on stop() so clients holding pooled
         # keep-alive sockets see a real FIN instead of a dead peer
         self._conns: set[socket.socket] = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = locks.Lock("HttpServer._conns_lock")
 
     def route(self, method: str, prefix: str, handler: Handler,
               exact: bool = False, stream_body: bool = False) -> None:
@@ -919,8 +920,8 @@ class ConnectionPool:
                  wait: "float | None" = None):
         self.size = size if size is not None else _pool_size_default()
         self.wait = wait if wait is not None else _pool_wait_default()
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = locks.Lock("ConnectionPool._lock")
+        self._cv = locks.Condition(self._lock, name="ConnectionPool._cv")
         self._idle: dict[tuple, list[_Conn]] = {}
         self._in_use: dict[tuple, int] = {}
         self.stats = {"created": 0, "reused": 0, "overflow": 0,

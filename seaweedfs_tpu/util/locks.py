@@ -1,0 +1,437 @@
+"""Runtime lock-discipline sanitizer — the lockdep analogue.
+
+The costliest bugs this tree has shipped were lock-discipline bugs
+(the PR 6 soak corruption, the PR 2 convoy narrowings), and static
+analysis only sees acquisition *shapes*, not the orders a live
+workload actually interleaves.  This module is the runtime prong:
+
+* ``locks.Lock(name)`` / ``locks.RLock(name)`` / ``locks.Condition()``
+  are drop-in factories for the ``threading`` primitives.  With
+  lockdep OFF (the default) they return the **raw threading objects**
+  — zero wrappers, zero overhead, byte-identical behavior.
+* With ``WEED_LOCKDEP=1`` (or inside SimCluster tests, where it
+  defaults ON) they return ``DebugLock``/``DebugRLock`` wrappers that
+  maintain one process-global acquisition-order graph keyed by *lock
+  class* (the ``name`` string — every ``Volume._lock`` instance is one
+  node).  Acquiring B while holding A records the edge A->B once,
+  with the acquiring stack.  If a path B->...->A already exists, that
+  is a would-be ABBA deadlock: it is REPORTED (both stacks, the
+  cycle) instead of ever hanging — the whole point of lockdep is that
+  the second ordering is caught the first time it happens, on any
+  thread, without needing the fatal interleaving.
+* ``WEED_LOCKDEP_SLOW_MS=<ms>`` arms the held-too-long watchdog:
+  holds longer than the budget are recorded (stack, duration) and
+  counted — the convoy the static WL150 checker tries to prevent,
+  measured live.
+* ``WEED_LOCKDEP_RAISE=1`` escalates an order violation from a report
+  to a ``LockOrderError`` at the acquire site (test/CI posture).
+
+State is exported to the ``/debug/lockdep`` plane via
+``debug_snapshot()`` and to ``/metrics`` via ``render_metrics()``
+(``seaweedfs_lockdep_*`` families, appended by ServerMetrics only
+while lockdep is enabled so the default exposition is unchanged).
+
+New lock sites in seaweedfs_tpu must use these factories, not bare
+``threading.Lock()`` — that is what makes them visible here.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+
+from .weedlog import logger
+
+LOG = logger(__name__)
+
+__all__ = [
+    "Lock", "RLock", "Condition", "DebugLock", "DebugRLock",
+    "LockOrderError", "lockdep_enabled", "enable_lockdep",
+    "enable_for_tests", "set_slow_ms", "reset", "violations",
+    "slow_holds", "counters", "debug_snapshot", "render_metrics",
+]
+
+_TRUE = ("1", "true", "yes", "on")
+_MAX_RECORDS = 100          # violations / slow-holds kept verbatim
+
+
+class LockOrderError(RuntimeError):
+    """A lock acquisition that completes a cycle in the global
+    acquisition-order graph — a would-be ABBA deadlock, raised at the
+    acquire site instead of hanging some later interleaving."""
+
+
+def _env_true(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() in _TRUE
+
+
+def _env_slow_ms() -> float:
+    try:
+        return float(os.environ.get("WEED_LOCKDEP_SLOW_MS", "0") or 0)
+    except ValueError:
+        return 0.0
+
+
+class _State:
+    """Process-global lockdep registry.  ``mu`` guards the graph and
+    the record lists; per-thread held stacks live in a threading.local
+    and need no locking."""
+
+    def __init__(self):
+        self.mu = threading.Lock()
+        self.tls = threading.local()
+        self.classes: set[str] = set()
+        self.seen: set[tuple[str, str]] = set()     # recorded edges
+        self.succ: dict[str, set[str]] = {}         # adjacency
+        self.edge_info: dict[tuple[str, str], dict] = {}
+        self.violation_list: list[dict] = []
+        self.slow_list: list[dict] = []
+        self.acquisitions = 0
+        self.violation_count = 0
+        self.slow_count = 0
+        self.slow_ms = _env_slow_ms()
+        self.raise_on_violation = _env_true("WEED_LOCKDEP_RAISE")
+
+
+_STATE = _State()
+_ENABLED = _env_true("WEED_LOCKDEP")
+
+
+def lockdep_enabled() -> bool:
+    return _ENABLED
+
+
+def enable_lockdep(on: bool = True) -> None:
+    """Flip instrumentation for locks constructed AFTER this call —
+    already-built raw ``threading`` locks stay raw (the passthrough
+    contract is decided per construction, never retrofitted)."""
+    global _ENABLED
+    _ENABLED = bool(on)
+    if on:
+        _STATE.slow_ms = _env_slow_ms() or _STATE.slow_ms
+        _STATE.raise_on_violation = _env_true("WEED_LOCKDEP_RAISE")
+
+
+def enable_for_tests() -> None:
+    """SimCluster's default-on hook: lockdep unless the environment
+    explicitly opts out with WEED_LOCKDEP=0."""
+    if os.environ.get("WEED_LOCKDEP", "").strip() == "0":
+        return
+    enable_lockdep(True)
+
+
+def set_slow_ms(ms: float) -> None:
+    _STATE.slow_ms = float(ms)
+
+
+def reset() -> None:
+    """Drop the whole graph + records (test isolation)."""
+    st = _STATE
+    with st.mu:
+        st.classes.clear()
+        st.seen.clear()
+        st.succ.clear()
+        st.edge_info.clear()
+        st.violation_list.clear()
+        st.slow_list.clear()
+        st.acquisitions = 0
+        st.violation_count = 0
+        st.slow_count = 0
+
+
+# -- per-thread bookkeeping --------------------------------------------------
+
+def _held(tls) -> list:
+    h = getattr(tls, "held", None)
+    if h is None:
+        h = tls.held = []
+    return h
+
+
+def _stack(skip: int = 2) -> list[str]:
+    # drop the lockdep frames themselves; keep the caller's frames
+    return [ln.rstrip() for ln in
+            traceback.format_stack()[:-skip]][-12:]
+
+
+def _find_path(succ: dict, src: str, dst: str) -> "list[str] | None":
+    """DFS path src -> dst in the acquisition graph (None if absent).
+    Runs only when a NEW edge is recorded — never on the hot path."""
+    stack = [(src, [src])]
+    visited = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in succ.get(node, ()):
+            if nxt not in visited:
+                visited.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _on_acquired(lock: "DebugLock") -> None:
+    st = _STATE
+    held = _held(st.tls)
+    if lock.reentrant:
+        for ent in reversed(held):
+            if ent[0] is lock:
+                ent[1] += 1
+                return
+    st.acquisitions += 1
+    if held:
+        holder = held[-1][0]
+        if holder.name != lock.name:
+            _note_edge(holder, lock)
+    held.append([lock, 1,
+                 time.monotonic() if st.slow_ms > 0 else 0.0])
+
+
+def _on_released(lock: "DebugLock") -> None:
+    st = _STATE
+    held = _held(st.tls)
+    for i in range(len(held) - 1, -1, -1):
+        if held[i][0] is lock:
+            held[i][1] -= 1
+            if held[i][1] <= 0:
+                ent = held.pop(i)
+                if st.slow_ms > 0 and ent[2]:
+                    dt_ms = (time.monotonic() - ent[2]) * 1e3
+                    if dt_ms >= st.slow_ms:
+                        _note_slow(lock, dt_ms)
+            return
+    # release of a lock this thread never noted (acquired before
+    # enable, or handed across threads) — nothing to unwind
+
+
+def _note_edge(holder: "DebugLock", lock: "DebugLock") -> None:
+    st = _STATE
+    key = (holder.name, lock.name)
+    if key in st.seen:          # common case: known-good ordering
+        return
+    with st.mu:
+        if key in st.seen:
+            return
+        st.seen.add(key)
+        cycle = _find_path(st.succ, lock.name, holder.name)
+        stack = _stack(skip=3)
+        st.succ.setdefault(holder.name, set()).add(lock.name)
+        st.edge_info[key] = {
+            "holding": holder.name, "acquiring": lock.name,
+            "thread": threading.current_thread().name,
+            "stack": stack,
+        }
+        if cycle is None:
+            return
+        # the reverse ordering is already on record: a thread that
+        # interleaves these two paths deadlocks.  Report both stacks.
+        first_hop = (cycle[0], cycle[1]) if len(cycle) > 1 else None
+        prior = st.edge_info.get(first_hop) if first_hop else None
+        violation = {
+            "cycle": cycle + [lock.name],
+            "holding": holder.name,
+            "acquiring": lock.name,
+            "thread": threading.current_thread().name,
+            "this_stack": stack,
+            "other_stack": (prior or {}).get("stack", []),
+            "other_thread": (prior or {}).get("thread", ""),
+        }
+        st.violation_count += 1
+        if len(st.violation_list) < _MAX_RECORDS:
+            st.violation_list.append(violation)
+        raise_it = st.raise_on_violation
+    LOG.error("lockdep: lock-order violation — holding %s while "
+              "acquiring %s closes cycle %s\n-- this thread (%s):\n%s"
+              "\n-- prior ordering (%s):\n%s",
+              holder.name, lock.name, " -> ".join(violation["cycle"]),
+              violation["thread"], "\n".join(violation["this_stack"]),
+              violation["other_thread"] or "?",
+              "\n".join(violation["other_stack"]))
+    if raise_it:
+        raise LockOrderError(format_violation(violation))
+
+
+def _note_slow(lock: "DebugLock", dt_ms: float) -> None:
+    st = _STATE
+    rec = {"lock": lock.name, "held_ms": round(dt_ms, 3),
+           "thread": threading.current_thread().name,
+           "stack": _stack(skip=3)}
+    with st.mu:
+        st.slow_count += 1
+        if len(st.slow_list) < _MAX_RECORDS:
+            st.slow_list.append(rec)
+    LOG.warning("lockdep: %s held %.1fms (budget %.1fms) by %s",
+                lock.name, dt_ms, st.slow_ms, rec["thread"])
+
+
+def format_violation(v: dict) -> str:
+    return ("lock-order violation: cycle "
+            + " -> ".join(v["cycle"])
+            + f"\n-- this thread ({v['thread']}) acquiring "
+            + f"{v['acquiring']} while holding {v['holding']}:\n"
+            + "\n".join(v["this_stack"])
+            + f"\n-- prior ordering ({v.get('other_thread') or '?'}):\n"
+            + "\n".join(v["other_stack"]))
+
+
+# -- instrumented primitives -------------------------------------------------
+
+class DebugLock:
+    """threading.Lock with lockdep bookkeeping.  Public protocol only
+    (acquire/release/locked/context manager) — exactly what
+    ``threading.Condition`` needs to wrap one."""
+
+    reentrant = False
+    _factory = staticmethod(threading.Lock)
+
+    __slots__ = ("_inner", "name")
+
+    def __init__(self, name: str = ""):
+        self._inner = self._factory()
+        self.name = name or f"anon@{id(self):x}"
+        _STATE.classes.add(self.name)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            try:
+                _on_acquired(self)
+            except LockOrderError:
+                # WEED_LOCKDEP_RAISE posture: surface the cycle at the
+                # acquire site without leaving the mutex wedged
+                self._inner.release()
+                raise
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        _on_released(self)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "DebugLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.name}>"
+
+
+class DebugRLock(DebugLock):
+    reentrant = True
+    _factory = staticmethod(threading.RLock)
+
+    __slots__ = ()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            try:
+                _on_acquired(self)
+            except LockOrderError:
+                self._inner.release()
+                raise
+        return got
+
+
+# -- factories (the only API call sites use) ---------------------------------
+
+def Lock(name: str = ""):
+    """A mutex: raw ``threading.Lock()`` when lockdep is off (byte-
+    identical passthrough), ``DebugLock`` when on."""
+    if _ENABLED:
+        return DebugLock(name)
+    return threading.Lock()
+
+
+def RLock(name: str = ""):
+    if _ENABLED:
+        return DebugRLock(name)
+    return threading.RLock()
+
+
+def Condition(lock=None, name: str = ""):
+    """threading.Condition over an instrumented lock when lockdep is
+    on.  Waiting releases/reacquires through the wrapper's public
+    acquire/release, so wait-loops keep the held-stack honest."""
+    if _ENABLED and lock is None:
+        lock = DebugLock(name or "cond")
+    return threading.Condition(lock)
+
+
+# -- reporting ---------------------------------------------------------------
+
+def violations() -> list[dict]:
+    with _STATE.mu:
+        return [dict(v) for v in _STATE.violation_list]
+
+
+def slow_holds() -> list[dict]:
+    with _STATE.mu:
+        return [dict(s) for s in _STATE.slow_list]
+
+
+def counters() -> dict:
+    st = _STATE
+    with st.mu:
+        return {
+            "enabled": 1 if _ENABLED else 0,
+            "lock_classes": len(st.classes),
+            "edges": len(st.seen),
+            "acquisitions": st.acquisitions,
+            "violations": st.violation_count,
+            "slow_holds": st.slow_count,
+        }
+
+
+def debug_snapshot() -> dict:
+    """The /debug/lockdep document: the whole acquisition-order graph
+    plus every retained violation/slow-hold record."""
+    st = _STATE
+    with st.mu:
+        return {
+            "enabled": _ENABLED,
+            "slow_ms": st.slow_ms,
+            "classes": sorted(st.classes),
+            "edges": [{"from": a, "to": b,
+                       "thread": st.edge_info.get((a, b), {})
+                                 .get("thread", "")}
+                      for a, b in sorted(st.seen)],
+            "violations": [dict(v) for v in st.violation_list],
+            "slow_holds": [dict(s) for s in st.slow_list],
+            "acquisitions": st.acquisitions,
+            "violation_count": st.violation_count,
+            "slow_hold_count": st.slow_count,
+        }
+
+
+def render_metrics() -> str:
+    """seaweedfs_lockdep_* exposition lines (no trailing newline).
+    Appended to a server's /metrics page only while lockdep is on."""
+    c = counters()
+    rows = [
+        ("seaweedfs_lockdep_enabled", "gauge",
+         "runtime lockdep instrumentation active", c["enabled"]),
+        ("seaweedfs_lockdep_lock_classes", "gauge",
+         "distinct lock classes registered", c["lock_classes"]),
+        ("seaweedfs_lockdep_edges", "gauge",
+         "acquisition-order edges observed", c["edges"]),
+        ("seaweedfs_lockdep_acquisitions_total", "counter",
+         "instrumented lock acquisitions", c["acquisitions"]),
+        ("seaweedfs_lockdep_violations_total", "counter",
+         "lock-order cycles detected", c["violations"]),
+        ("seaweedfs_lockdep_slow_holds_total", "counter",
+         "holds exceeding WEED_LOCKDEP_SLOW_MS", c["slow_holds"]),
+    ]
+    out = []
+    for name, kind, help_text, value in rows:
+        out.append(f"# HELP {name} {help_text}")
+        out.append(f"# TYPE {name} {kind}")
+        out.append(f"{name} {value}")
+    return "\n".join(out)

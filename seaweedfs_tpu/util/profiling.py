@@ -34,6 +34,7 @@ import os
 import signal
 import sys
 import threading
+from seaweedfs_tpu.util import locks
 import time
 import tracemalloc
 
@@ -125,7 +126,7 @@ class SamplingProfiler:
         # function's label; bounded below like _thread_names
         self._labels: dict[tuple, str] = {}
         self._thread_names: dict[int, str] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("SamplingProfiler._lock")
         self._stop = threading.Event()
         self._thread: "threading.Thread | None" = None
         self.samples = 0
@@ -284,7 +285,7 @@ class SamplingProfiler:
 
 
 _SAMPLER: "SamplingProfiler | None" = None
-_SAMPLER_LOCK = threading.Lock()
+_SAMPLER_LOCK = locks.Lock("profiling._SAMPLER_LOCK")
 
 
 def sampler() -> "SamplingProfiler | None":

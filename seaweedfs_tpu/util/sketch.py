@@ -41,6 +41,7 @@ from __future__ import annotations
 import math
 import os
 import threading
+from seaweedfs_tpu.util import locks
 import time
 import zlib
 from array import array
@@ -288,7 +289,7 @@ class HeatTracker:
         self.tracked_ops = 0      # lifetime, undecayed (self-metrics)
         self.decay_runs = 0
         self._last_decay = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("HeatTracker._lock")
 
     # -- recording -----------------------------------------------------------
     def record(self, op: str, volume: "int | None" = None,

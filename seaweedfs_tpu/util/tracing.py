@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import os
 import threading
+from seaweedfs_tpu.util import locks
 import time
 from collections import deque
 from contextlib import contextmanager
@@ -173,7 +174,7 @@ class Tracer:
                              if slow_seconds is None else slow_seconds)
         self.slow_count = 0
         self._spans: deque = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("Tracer._lock")
 
     def record(self, name: str, trace_id: str, start: float,
                duration: float, status: str = "ok",

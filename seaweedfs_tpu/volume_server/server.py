@@ -298,12 +298,15 @@ class VolumeServer:
         self.http.route("GET", "/status", self._http_status)
         self.http.route("GET", "/metrics", self._http_metrics)
         self.http.route("GET", "/heat", self._http_heat)
-        from ..util import profiling
+        from ..util import locks, profiling
         self._traces_handler = tracing.traces_http_handler(self.tracer)
         self._profile_handler = profiling.profile_http_handler()
         self.http.route("GET", "/debug/traces", self._http_debug_traces)
         self.http.route("GET", "/debug/profile",
                         self._http_debug_profile)
+        self.http.route("GET", "/debug/lockdep",
+                        lambda req: Response.json(locks.debug_snapshot()),
+                        exact=True)
         if self._worker is not None:
             # the supervisor's heartbeat_now pulls a fresh partition
             # snapshot through this before pushing the merged payload

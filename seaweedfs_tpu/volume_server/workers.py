@@ -63,6 +63,7 @@ import struct
 import subprocess
 import sys
 import threading
+from ..util import locks
 import time
 import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
@@ -242,7 +243,7 @@ class ShardedVolumeServer:
         self._uds_path = ""
         self._uds_sock: "socket.socket | None" = None
         self._fd_conns: dict[int, socket.socket] = {}
-        self._fd_lock = threading.Lock()
+        self._fd_lock = locks.Lock("ShardedVolumeServer._fd_lock")
         self._fd_rr = itertools.count()
         # merged heartbeat stream state (mirrors VolumeServer's)
         self.volume_size_limit = 0

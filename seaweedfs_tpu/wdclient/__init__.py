@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os
 import threading
+from ..util import locks
 import time
 
 from ..pb.rpc import POOL, RpcError
@@ -72,7 +73,7 @@ class MasterClient:
         # vid -> (expires, locations) for RPC-sourced fallbacks; kept
         # apart from the stream-fed map, whose entries deltas retire
         self._vid_rpc: dict[int, tuple[float, list[dict]]] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("MasterClient._lock")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -229,13 +230,13 @@ class CachedFileReader:
         # server-observed + cache-absorbed = true access counts
         self.heat = None
         self._pool = None
-        self._pool_lock = threading.Lock()
+        self._pool_lock = locks.Lock("CachedFileReader._pool_lock")
         self._closed = False
         # counted under a lock: increments come from concurrent
         # readahead-pool threads, and a lost `+=` would quietly
         # under-report the bytes-moved totals the ranged-read
         # acceptance gates assert on
-        self._stats_lock = threading.Lock()
+        self._stats_lock = locks.Lock("CachedFileReader._stats_lock")
         self.stats = {"chunk_reads": 0, "chunk_bytes": 0,
                       "range_reads": 0, "range_bytes": 0,
                       "range_fallbacks": 0, "cache_hits": 0}

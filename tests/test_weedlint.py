@@ -264,3 +264,91 @@ def test_cli_list_checkers():
                 "WL050", "WL060", "WL080", "WL090", "WL100",
                 "WL110", "WL120", "WL130", "WL140"):
         assert cid in r.stdout
+
+
+# -- machine-readable formats (golden) ---------------------------------------
+
+LOCK_FIXTURE = "tests/weedlint_fixtures/bad_project_locks.py"
+
+
+def test_cli_format_json_golden():
+    r = _run_cli(LOCK_FIXTURE, "--no-baseline", "--format", "json",
+                 "--jobs", "1")
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert doc["version"] == 1
+    got = [(f["checker"], f["line"]) for f in doc["findings"]]
+    assert got == [("WL150", 28), ("WL150", 32),
+                   ("WL150", 36), ("WL160", 44)]
+    # every finding carries the full contract: file/message/hint/name
+    for f in doc["findings"]:
+        assert f["file"] == LOCK_FIXTURE
+        assert f["message"] and f["hint"] and f["name"]
+
+
+def test_cli_format_sarif_golden():
+    r = _run_cli(LOCK_FIXTURE, "--no-baseline", "--format", "sarif",
+                 "--jobs", "1")
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    run, = doc["runs"]
+    assert run["tool"]["driver"]["name"] == "weedlint"
+    rule_ids = {rr["id"] for rr in run["tool"]["driver"]["rules"]}
+    assert {"WL001", "WL150", "WL160"} <= rule_ids
+    got = [(res["ruleId"],
+            res["locations"][0]["physicalLocation"]["region"]["startLine"],
+            res["locations"][0]["physicalLocation"]["artifactLocation"]["uri"])
+           for res in run["results"]]
+    assert got == [("WL150", 28, LOCK_FIXTURE),
+                   ("WL150", 32, LOCK_FIXTURE),
+                   ("WL150", 36, LOCK_FIXTURE),
+                   ("WL160", 44, LOCK_FIXTURE)]
+    for res in run["results"]:
+        assert res["level"] == "warning" and res["message"]["text"]
+
+
+def test_cli_format_clean_tree_json_exits_zero():
+    r = _run_cli("seaweedfs_tpu", "--format", "json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout)["findings"] == []
+
+
+# -- parallelism + cache -----------------------------------------------------
+
+def test_jobs_parallel_matches_serial():
+    serial = analyze_paths([os.path.join(PACKAGE, "util")], jobs=1)
+    para = analyze_paths([os.path.join(PACKAGE, "util")], jobs=4)
+    assert [(f.file, f.line, f.checker) for f in serial] == \
+           [(f.file, f.line, f.checker) for f in para]
+
+
+def test_cache_roundtrip_and_invalidation(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text("import threading, time\n"
+                   "_lock = threading.Lock()\n"
+                   "def f():\n"
+                   "    with _lock:\n"
+                   "        time.sleep(1)\n")
+    cache = tmp_path / "cache"
+    first = analyze_paths([str(src)], jobs=1, cache_dir=str(cache))
+    assert any(f.checker == "WL001" for f in first)
+    assert list(cache.iterdir())            # cache populated
+    # warm run: identical findings served from cache
+    again = analyze_paths([str(src)], jobs=1, cache_dir=str(cache))
+    assert [(f.line, f.checker) for f in again] == \
+           [(f.line, f.checker) for f in first]
+    # edit the file (fix the finding): cache must invalidate on mtime/size
+    src.write_text("import threading\n_lock = threading.Lock()\n")
+    os.utime(src, (1, 1))  # force a different mtime even on coarse clocks
+    fixed = analyze_paths([str(src)], jobs=1, cache_dir=str(cache))
+    assert not any(f.checker == "WL001" for f in fixed)
+
+
+def test_cli_cache_flag_creates_cache_dir(tmp_path):
+    cdir = tmp_path / "wlcache"
+    r = _run_cli(LOCK_FIXTURE, "--no-baseline", "--cache-dir", str(cdir),
+                 "--jobs", "1")
+    assert r.returncode == 1
+    assert cdir.is_dir() and list(cdir.iterdir())

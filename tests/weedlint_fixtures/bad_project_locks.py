@@ -1,0 +1,53 @@
+"""Known-bad INTERPROCEDURAL lock patterns — WL150/WL160 fixture.
+
+Everything here is invisible to the lexical checkers (WL001 sees no
+blocking call inside a ``with``; no single function nests the two
+locks both ways): only the project-wide call-graph engine can flag it.
+"""
+
+import threading
+import time
+
+
+def slow_helper():
+    time.sleep(0.1)
+
+
+def middle():
+    slow_helper()
+
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._map_lock = threading.Lock()
+
+    # -- WL150: blocking reached through the call graph ---------------------
+    def one_hop(self):
+        with self._lock:
+            slow_helper()                    # line 28: 1 hop to sleep
+
+    def two_hop(self):
+        with self._lock:
+            middle()                         # line 32: 2 hops to sleep
+
+    def via_method(self):
+        with self._lock:
+            self._recount()                  # line 36: self-call chain
+
+    def _recount(self):
+        middle()
+
+    # -- WL160: cross-method lock-order cycle -------------------------------
+    def ab(self):
+        with self._lock:
+            with self._map_lock:             # line 44: _lock -> _map_lock
+                pass
+
+    def ba(self):
+        with self._map_lock:
+            self.take_main()                 # _map_lock -> (call) -> _lock
+
+    def take_main(self):
+        with self._lock:
+            pass

@@ -20,15 +20,17 @@ findings so the tier-1 gate test fails only on NEW violations.
 from __future__ import annotations
 
 import ast
+import hashlib
 import json
 import os
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field
 from typing import Callable, Iterable, Iterator
 
 __all__ = [
     "Finding", "ModuleContext", "register", "all_checkers",
     "analyze_file", "analyze_paths", "load_baseline", "baseline_key",
     "filter_new", "write_baseline", "DEFAULT_BASELINE",
+    "DEFAULT_CACHE_DIR",
 ]
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
@@ -152,15 +154,22 @@ def _fold_constants(tree: ast.Module) -> dict[str, int]:
 
 # -- running ----------------------------------------------------------------
 
-def analyze_file(path: str, select: set[str] | None = None) -> list[Finding]:
+def _analyze_one(path: str,
+                 select: set[str] | None = None
+                 ) -> tuple[list[Finding], dict | None]:
+    """One file: per-file findings + the serialized project IR that the
+    interprocedural phase (tools/weedlint/project.py) consumes.  IR is
+    None when the file does not parse."""
     with open(path, "r", encoding="utf-8") as f:
         source = f.read()
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
-        return [Finding("WL000", "syntax-error", path.replace(os.sep, "/"),
-                        e.lineno or 1, f"syntax error: {e.msg}",
-                        "file must parse before weedlint can check it")]
+        return ([Finding("WL000", "syntax-error",
+                         path.replace(os.sep, "/"), e.lineno or 1,
+                         f"syntax error: {e.msg}",
+                         "file must parse before weedlint can check it")],
+                None)
     ctx = ModuleContext(path=path.replace(os.sep, "/"), tree=tree,
                         source=source, constants=_fold_constants(tree))
     pragmas = _pragmas(source)
@@ -169,7 +178,15 @@ def analyze_file(path: str, select: set[str] | None = None) -> list[Finding]:
         if select and checker_id not in select:
             continue
         out.extend(f for f in fn(ctx) if not _suppressed(f, pragmas))
-    return out
+    from .project import extract_module_ir
+    ir = extract_module_ir(ctx.path, tree, pragmas).to_cache()
+    return out, ir
+
+
+def analyze_file(path: str, select: set[str] | None = None) -> list[Finding]:
+    """Per-file checkers only — the interprocedural phase needs the
+    whole path set and runs in analyze_paths."""
+    return _analyze_one(path, select)[0]
 
 
 def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
@@ -180,17 +197,131 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
         else:
             for root, dirs, files in os.walk(p):
                 dirs[:] = sorted(d for d in dirs
-                                 if d not in ("__pycache__", ".git"))
+                                 if d not in ("__pycache__", ".git",
+                                              ".weedlint_cache"))
                 for f in sorted(files):
                     if f.endswith(".py"):
                         yield os.path.join(root, f)
 
 
+# -- result cache ------------------------------------------------------------
+#
+# Keyed on (mtime, size, analyzer fingerprint): per-file findings are
+# pragma-filtered already (pragmas live in the file, so any edit
+# invalidates), and the project IR rides along so the interprocedural
+# phase never needs the AST of an unchanged file.
+
+DEFAULT_CACHE_DIR = ".weedlint_cache"
+_FINGERPRINT: str | None = None
+
+
+def analyzer_fingerprint() -> str:
+    """Identity of the analyzer itself: any edit to tools/weedlint
+    invalidates every cache entry."""
+    global _FINGERPRINT
+    if _FINGERPRINT is None:
+        h = hashlib.sha1()
+        root = os.path.dirname(__file__)
+        for dirpath, dirs, files in os.walk(root):
+            dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+            for f in sorted(files):
+                if not f.endswith(".py"):
+                    continue
+                p = os.path.join(dirpath, f)
+                st = os.stat(p)
+                h.update(f"{f}:{st.st_mtime_ns}:{st.st_size};".encode())
+        _FINGERPRINT = h.hexdigest()
+    return _FINGERPRINT
+
+
+def _cache_path(cache_dir: str, path: str) -> str:
+    key = hashlib.sha1(os.path.abspath(path).encode()).hexdigest()
+    return os.path.join(cache_dir, key + ".json")
+
+
+def _cache_load(cache_dir: str, path: str,
+                select_key: str) -> tuple[list[Finding], dict | None] | None:
+    try:
+        st = os.stat(path)
+        with open(_cache_path(cache_dir, path), encoding="utf-8") as f:
+            entry = json.load(f)
+        if (entry["mtime_ns"] != st.st_mtime_ns
+                or entry["size"] != st.st_size
+                or entry["fp"] != analyzer_fingerprint()
+                or entry["select"] != select_key):
+            return None
+        return ([Finding(**d) for d in entry["findings"]], entry["ir"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def _cache_store(cache_dir: str, path: str, select_key: str,
+                 findings: list[Finding], ir: dict | None) -> None:
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        st = os.stat(path)
+        tmp = _cache_path(cache_dir, path) + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"mtime_ns": st.st_mtime_ns, "size": st.st_size,
+                       "fp": analyzer_fingerprint(),
+                       "select": select_key,
+                       "findings": [asdict(x) for x in findings],
+                       "ir": ir}, f)
+        os.replace(tmp, _cache_path(cache_dir, path))
+    except OSError:
+        pass   # a cache that can't write is a slow cache, not an error
+
+
+def _pool_worker(args: tuple) -> tuple[str, list, dict | None]:
+    path, select = args
+    findings, ir = _analyze_one(path, select)
+    return path, findings, ir
+
+
 def analyze_paths(paths: Iterable[str],
-                  select: set[str] | None = None) -> list[Finding]:
+                  select: set[str] | None = None,
+                  jobs: int = 0,
+                  cache_dir: str | None = None) -> list[Finding]:
+    """Analyze files (parallel when jobs > 1, cached when cache_dir is
+    set), then run the project-wide phase (WL150/WL160) over the
+    combined module IRs."""
+    files = list(iter_python_files(paths))
+    select_key = ",".join(sorted(select)) if select else ""
+    results: dict[str, tuple[list[Finding], dict | None]] = {}
+    todo: list[str] = []
+    for f in files:
+        got = _cache_load(cache_dir, f, select_key) if cache_dir else None
+        if got is not None:
+            results[f] = got
+        else:
+            todo.append(f)
+    if todo and jobs > 1:
+        import concurrent.futures as cf
+        try:
+            with cf.ProcessPoolExecutor(max_workers=jobs) as pool:
+                for path, findings, ir in pool.map(
+                        _pool_worker, [(p, select) for p in todo],
+                        chunksize=8):
+                    results[path] = (findings, ir)
+            todo = []
+        except (OSError, cf.process.BrokenProcessPool):
+            pass   # fall back to the serial loop below
+    for f in todo:
+        results[f] = _analyze_one(f, select)
+    if cache_dir:
+        for f in files:
+            if f in results:
+                _cache_store(cache_dir, f, select_key, *results[f])
+
     out: list[Finding] = []
-    for f in iter_python_files(paths):
-        out.extend(analyze_file(f, select=select))
+    for f in files:
+        out.extend(results[f][0])
+
+    from .project import ModuleIR, project_findings
+    modules = [ModuleIR.from_cache(ir) for _fs, ir in results.values()
+               if ir is not None]
+    modules.sort(key=lambda m: m.path)
+    out.extend(project_findings(modules, select))
     out.sort(key=lambda fi: (fi.file, fi.line, fi.checker))
     return out
 

@@ -2,15 +2,72 @@
 
 Exit codes: 0 = clean (after baseline suppression), 1 = new findings,
 2 = usage error.
+
+Performance flags (what tools/check.sh passes): ``--jobs N`` parses and
+checks files in a process pool (default: nproc), ``--cache`` keeps an
+mtime-keyed findings + project-IR cache under ``.weedlint_cache/`` so
+an unchanged tree re-lints in the time it takes to stat it.
+
+``--format json|sarif`` emits machine-readable findings (SARIF 2.1.0
+minimal profile for CI annotations); the human format stays default.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import sys
+from dataclasses import asdict
 
-from . import (DEFAULT_BASELINE, all_checkers, analyze_paths, filter_new,
-               load_baseline, write_baseline)
+from . import (DEFAULT_BASELINE, DEFAULT_CACHE_DIR, all_checkers,
+               analyze_paths, filter_new, load_baseline, write_baseline)
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def render_json(findings) -> str:
+    return json.dumps(
+        {"version": 1,
+         "findings": [asdict(f) for f in findings]},
+        indent=1, sort_keys=True) + "\n"
+
+
+def render_sarif(findings) -> str:
+    """SARIF 2.1.0 minimal profile: one run, one driver, rule metadata
+    for every checker, one result per finding."""
+    rules = [{"id": cid, "name": name,
+              "shortDescription": {"text": name}}
+             for cid, name, _fn in all_checkers()]
+    # WL000 and the project-wide checkers have no per-file registration
+    for cid, name in (("WL000", "syntax-error"),
+                      ("WL150", "blocking-under-lock"),
+                      ("WL160", "lock-order-cycle")):
+        rules.append({"id": cid, "name": name,
+                      "shortDescription": {"text": name}})
+    results = [{
+        "ruleId": f.checker,
+        "level": "warning",
+        "message": {"text": f.message + (f"  (fix: {f.hint})"
+                                         if f.hint else "")},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.file},
+                "region": {"startLine": f.line},
+            }}],
+    } for f in findings]
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "weedlint",
+                                "rules": sorted(rules,
+                                                key=lambda r: r["id"])}},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=1, sort_keys=True) + "\n"
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -30,12 +87,27 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--select", default="",
                     help="comma-separated checker ids to run "
                          "(e.g. WL001,WL030)")
+    ap.add_argument("--jobs", type=int, default=os.cpu_count() or 1,
+                    help="parallel analysis processes (default: nproc; "
+                         "1 = in-process serial)")
+    ap.add_argument("--cache", action="store_true",
+                    help=f"cache per-file results under "
+                         f"{DEFAULT_CACHE_DIR}/ keyed on mtime + "
+                         f"analyzer fingerprint")
+    ap.add_argument("--cache-dir", default="",
+                    help="cache directory (implies --cache)")
+    ap.add_argument("--format", default="human",
+                    choices=("human", "json", "sarif"),
+                    help="output format for findings")
     ap.add_argument("--list-checkers", action="store_true")
     args = ap.parse_args(argv)
 
     if args.list_checkers:
         for checker_id, name, fn in all_checkers():
             print(f"{checker_id}  {name}")
+        # project-wide checkers don't register per-file functions
+        print("WL150  blocking-under-lock")
+        print("WL160  lock-order-cycle")
         return 0
 
     select = {s.strip() for s in args.select.split(",") if s.strip()} or None
@@ -45,8 +117,11 @@ def main(argv: list[str] | None = None) -> int:
         print("--write-baseline cannot be combined with --select",
               file=sys.stderr)
         return 2
+    cache_dir = args.cache_dir or (DEFAULT_CACHE_DIR if args.cache
+                                   else None)
     paths = args.paths or ["seaweedfs_tpu"]
-    findings = analyze_paths(paths, select=select)
+    findings = analyze_paths(paths, select=select, jobs=args.jobs,
+                             cache_dir=cache_dir)
 
     if args.write_baseline:
         write_baseline(findings, args.baseline)
@@ -55,9 +130,17 @@ def main(argv: list[str] | None = None) -> int:
 
     baseline = set() if args.no_baseline else load_baseline(args.baseline)
     new = filter_new(findings, baseline)
+    suppressed = len(findings) - len(new)
+
+    if args.format == "json":
+        sys.stdout.write(render_json(new))
+        return 1 if new else 0
+    if args.format == "sarif":
+        sys.stdout.write(render_sarif(new))
+        return 1 if new else 0
+
     for f in new:
         print(f.render())
-    suppressed = len(findings) - len(new)
     if new:
         print(f"\nweedlint: {len(new)} new finding(s)"
               + (f" ({suppressed} baselined)" if suppressed else ""),

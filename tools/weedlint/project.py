@@ -1,0 +1,649 @@
+"""Project-wide (interprocedural) analysis — the engine behind WL150
+and WL160.
+
+Per-file AST checkers cannot see hold-the-lock contracts that span
+functions: the PR 6 soak corruption (a cached-EOF write-back reachable
+without the volume lock) and both convoy hazards this repo has shipped
+were *interprocedural*.  This module builds, from every analyzed
+module at once:
+
+* a **symbol index** — module-level functions, classes and their
+  methods, import aliases, module-global locks;
+* a **resolved call graph** — ``self.method()`` calls resolved through
+  the enclosing class (and its project-local bases), bare-name calls
+  resolved to same-module functions, ``from x import f`` /
+  ``mod.f(...)`` calls resolved through the import table, and
+  ``ClassName(...)`` constructor calls resolved to ``__init__``;
+* per-function **lock facts** — which ``with <lock>:`` regions exist,
+  which calls run inside them, and which locks a function acquires.
+
+Two checkers run on top:
+
+**WL150 blocking-under-lock** — a call inside a ``with <lock>:`` body
+that *transitively* (bounded depth) reaches a blocking operation:
+sleep, socket/HTTP/RPC, subprocess, or a pool/future wait.  The
+lexical case is WL001's job; WL150 reports only resolved calls whose
+blocking op lives in a callee, and renders the full call chain.
+Local *file* IO (open/seek/pread) is deliberately NOT in this model:
+a storage engine writes to disk under its volume lock by design, and
+the lexical checkers already make file IO under a lock visible.
+
+**WL160 static lock-order** — an acquisition-order graph built from
+nested ``with`` regions and from locks acquired by callees while a
+lock is held (same bounded call-graph walk).  Lock identity is the
+*class* of the lock (``Volume._lock``), not the instance, matching
+util/locks.py's runtime lockdep.  A cycle in the graph is a potential
+ABBA deadlock; the finding renders both acquisition paths with their
+file:line evidence.
+
+Both checkers respect ``# weedlint: disable=WL15x`` pragmas on the
+reported line and the checked-in baseline, like every other checker.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator
+
+from .astutil import dotted_name, is_lock_expr, terminal_name, walk_shallow
+
+# transitive resolution bound: a chain deeper than this is reported only
+# if a shallower witness exists (keeps the walk linear and the reports
+# readable)
+MAX_DEPTH = 4
+
+# -- WL150 blocking model ----------------------------------------------------
+# network/IPC/sleep/pool-wait ONLY — local file IO is a storage
+# engine's job and stays out (see module docstring)
+_BLOCKING_EXACT = {
+    "time.sleep", "sleep",
+    "socket.socket", "socket.create_connection", "socket.getaddrinfo",
+    "urllib.request.urlopen", "urlopen",
+    "os.system",
+    "http_get", "http_post", "http_delete", "http_put", "http_request",
+}
+_BLOCKING_PREFIX = ("subprocess.", "requests.")
+_BLOCKING_ATTRS = {"recv", "sendall", "connect", "accept",
+                   "urlopen", "getresponse"}
+# local-disk lookalikes the attr heuristic would otherwise catch:
+# sqlite3.connect is file IO (same class as open/pread — a storage
+# engine's business), not a network connect
+_LOCAL_EXACT = {"sqlite3.connect"}
+
+
+def _direct_blocking(call: ast.Call) -> "str | None":
+    """The dotted name of a directly-blocking call, or None."""
+    name = dotted_name(call.func)
+    if name in _LOCAL_EXACT:
+        return None
+    if name in _BLOCKING_EXACT or name.startswith(_BLOCKING_PREFIX):
+        return name
+    if terminal_name(call.func) in _BLOCKING_ATTRS:
+        return name
+    return None
+
+
+# -- module IR extraction ----------------------------------------------------
+
+def module_name_for(path: str) -> str:
+    """Dotted module name from a display path: the part from the last
+    recognizable package root; bare stem otherwise."""
+    p = path.replace(os.sep, "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    parts = [x for x in p.split("/") if x not in ("", ".")]
+    for root in ("seaweedfs_tpu", "tools", "tests"):
+        if root in parts:
+            parts = parts[parts.index(root):]
+            break
+    else:
+        parts = parts[-1:]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or "__main__"
+
+
+def _resolve_relative(module: str, level: int, target: str) -> str:
+    """``from ..a import b`` inside ``pkg.sub.mod`` -> ``pkg.a``."""
+    parts = module.split(".")
+    # level 1 = current package (strip the module leaf), each extra
+    # level strips one more package
+    base = parts[:-level] if level <= len(parts) else []
+    return ".".join(base + ([target] if target else [])).strip(".")
+
+
+class FuncIR:
+    """Everything the project checkers need about one function."""
+
+    __slots__ = ("qual", "line", "cls", "calls", "regions", "acquires",
+                 "direct_blocking")
+
+    def __init__(self, qual: str, line: int, cls: "str | None"):
+        self.qual = qual          # "func" or "Class.func"
+        self.line = line
+        self.cls = cls            # enclosing class name or None
+        # [(line, kind, target, dotted, held_locks_tuple)]
+        #   kind: "self" | "mod" | "ext" | "ctor"
+        self.calls: list[tuple] = []
+        # [(lock_id, line)] — lexical with-lock region entries
+        self.regions: list[tuple] = []
+        # [(lock_id, line, held_locks_tuple)] — every lexical
+        # acquisition with what was already held at that point
+        self.acquires: list[tuple] = []
+        # [(line, dotted)] — lexically blocking calls anywhere in fn
+        self.direct_blocking: list[tuple] = []
+
+
+class ModuleIR:
+    __slots__ = ("path", "module", "pragmas", "imports", "functions",
+                 "classes", "bases")
+
+    def __init__(self, path: str, module: str):
+        self.path = path
+        self.module = module
+        self.pragmas: dict[int, "set[str] | None"] = {}
+        # local name -> dotted module ("from .x import y" => y -> mod
+        # "pkg.x" attr "y"; "import a.b as c" => c -> "a.b")
+        self.imports: dict[str, tuple] = {}   # name -> (module, attr|"")
+        self.functions: dict[str, FuncIR] = {}  # qual -> FuncIR
+        self.classes: dict[str, list[str]] = {}  # class -> method quals
+        self.bases: dict[str, list[str]] = {}    # class -> base exprs
+
+    def to_cache(self) -> dict:
+        return {
+            "path": self.path, "module": self.module,
+            "pragmas": {str(k): (sorted(v) if v is not None else None)
+                        for k, v in self.pragmas.items()},
+            "imports": {k: list(v) for k, v in self.imports.items()},
+            "classes": self.classes, "bases": self.bases,
+            "functions": {
+                q: {"line": f.line, "cls": f.cls, "calls": f.calls,
+                    "regions": f.regions, "acquires": f.acquires,
+                    "blocking": f.direct_blocking}
+                for q, f in self.functions.items()},
+        }
+
+    @classmethod
+    def from_cache(cls, d: dict) -> "ModuleIR":
+        ir = cls(d["path"], d["module"])
+        ir.pragmas = {int(k): (set(v) if v is not None else None)
+                      for k, v in d["pragmas"].items()}
+        ir.imports = {k: tuple(v) for k, v in d["imports"].items()}
+        ir.classes = {k: list(v) for k, v in d["classes"].items()}
+        ir.bases = {k: list(v) for k, v in d["bases"].items()}
+        for q, fd in d["functions"].items():
+            f = FuncIR(q, fd["line"], fd["cls"])
+            f.calls = [tuple(c[:4]) + (tuple(c[4]),) for c in fd["calls"]]
+            f.regions = [tuple(r) for r in fd["regions"]]
+            f.acquires = [tuple(a[:2]) + (tuple(a[2]),)
+                          for a in fd["acquires"]]
+            f.direct_blocking = [tuple(b) for b in fd["blocking"]]
+            ir.functions[q] = f
+        return ir
+
+
+def _lock_id(node: ast.AST, cls: "str | None", module: str,
+             module_globals: "set[str]") -> str:
+    """Class-level identity for a lock expression.  ``self.X`` ->
+    ``Class.X``; module-global ``X`` -> ``module.X``; anything else is
+    an opaque ``?tail`` — still counts as "a lock is held" for WL150
+    but never enters the WL160 order graph."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self" \
+            and cls:
+        return f"{cls}.{node.attr}"
+    if isinstance(node, ast.Name):
+        if node.id in module_globals:
+            return f"{module}.{node.id}"
+        return f"?{node.id}"
+    return f"?{terminal_name(node) or 'lock'}"
+
+
+def _with_lock_items(node) -> list:
+    out = []
+    for it in node.items:
+        expr = it.context_expr
+        if is_lock_expr(expr):
+            out.append(expr)
+        elif isinstance(expr, ast.Call) and is_lock_expr(expr.func):
+            out.append(expr.func)
+    return out
+
+
+def extract_module_ir(path: str, tree: ast.Module,
+                      pragmas: dict) -> ModuleIR:
+    ir = ModuleIR(path.replace(os.sep, "/"), module_name_for(path))
+    ir.pragmas = pragmas
+
+    module_globals: set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and is_lock_expr(t):
+                    module_globals.add(t.id)
+        elif isinstance(stmt, ast.ImportFrom):
+            mod = _resolve_relative(ir.module, stmt.level,
+                                    stmt.module or "") \
+                if stmt.level else (stmt.module or "")
+            for alias in stmt.names:
+                local = alias.asname or alias.name
+                ir.imports[local] = (mod, alias.name)
+        elif isinstance(stmt, ast.Import):
+            for alias in stmt.names:
+                local = alias.asname or alias.name.split(".")[0]
+                ir.imports[local] = (alias.name if alias.asname
+                                     else alias.name.split(".")[0], "")
+
+    local_classes: set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.ClassDef):
+            local_classes.add(stmt.name)
+
+    def extract_fn(fn, cls: "str | None") -> FuncIR:
+        qual = f"{cls}.{fn.name}" if cls else fn.name
+        fir = FuncIR(qual, fn.lineno, cls)
+
+        def visit(node, held: tuple):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                return   # nested scopes run at their own call time
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                lock_items = _with_lock_items(node)
+                if lock_items:
+                    lid = _lock_id(lock_items[0], cls, ir.module,
+                                   module_globals)
+                    fir.regions.append((lid, node.lineno))
+                    fir.acquires.append((lid, node.lineno, held))
+                    # the with-items themselves evaluate before the
+                    # lock is held
+                    for it in node.items:
+                        visit(it, held)
+                    for stmt in node.body:
+                        visit(stmt, held + (lid,))
+                    return
+            if isinstance(node, ast.Call):
+                record_call(node, held)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        def record_call(call: ast.Call, held: tuple):
+            blocking = _direct_blocking(call)
+            if blocking:
+                fir.direct_blocking.append((call.lineno, blocking))
+                return   # lexical blocking is WL001's domain
+            func = call.func
+            dotted = dotted_name(func)
+            kind = target = None
+            if isinstance(func, ast.Attribute) and \
+                    isinstance(func.value, ast.Name) and \
+                    func.value.id == "self":
+                kind, target = "self", func.attr
+            elif isinstance(func, ast.Name):
+                name = func.id
+                if name in local_classes:
+                    kind, target = "ctor", name
+                elif name in ir.imports:
+                    mod, attr = ir.imports[name]
+                    kind, target = "ext", f"{mod}:{attr or name}"
+                else:
+                    kind, target = "mod", name
+            elif isinstance(func, ast.Attribute) and \
+                    isinstance(func.value, ast.Name) and \
+                    func.value.id in ir.imports:
+                mod, attr = ir.imports[func.value.id]
+                base = f"{mod}.{attr}" if attr else mod
+                kind, target = "ext", f"{base}:{func.attr}"
+            if kind:
+                fir.calls.append((call.lineno, kind, target, dotted,
+                                  held))
+
+        for stmt in fn.body:
+            visit(stmt, ())
+        return fir
+
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            ir.functions[stmt.name] = extract_fn(stmt, None)
+        elif isinstance(stmt, ast.ClassDef):
+            methods = []
+            for sub in stmt.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    f = extract_fn(sub, stmt.name)
+                    ir.functions[f.qual] = f
+                    methods.append(f.qual)
+            ir.classes[stmt.name] = methods
+            ir.bases[stmt.name] = [dotted_name(b) for b in stmt.bases
+                                   if dotted_name(b)]
+    return ir
+
+
+# -- the project index -------------------------------------------------------
+
+class ProjectIndex:
+    """All ModuleIRs plus the resolved call graph."""
+
+    def __init__(self, modules: list[ModuleIR]):
+        self.modules = modules
+        self.by_module: dict[str, ModuleIR] = {}
+        for m in modules:
+            self.by_module.setdefault(m.module, m)
+        # (module, qual) -> FuncIR  — the global function key space
+        self.functions: dict[tuple, FuncIR] = {}
+        self.fn_module: dict[tuple, ModuleIR] = {}
+        for m in modules:
+            for qual, f in m.functions.items():
+                key = (m.module, qual)
+                self.functions[key] = f
+                self.fn_module[key] = m
+        self._method_resolution: dict[tuple, "tuple | None"] = {}
+
+    # -- call resolution -----------------------------------------------------
+
+    def resolve_call(self, mod: ModuleIR, caller: FuncIR,
+                     kind: str, target: str) -> "tuple | None":
+        """-> (module, qual) function key, or None if unresolvable."""
+        if kind == "self" and caller.cls:
+            return self._resolve_method(mod, caller.cls, target)
+        if kind == "mod":
+            if target in mod.functions:
+                return (mod.module, target)
+            return None
+        if kind == "ctor":
+            return self._resolve_method(mod, target, "__init__")
+        if kind == "ext":
+            modname, attr = target.split(":", 1)
+            m2 = self.by_module.get(modname)
+            if m2 is None:
+                return None
+            if attr in m2.functions:
+                return (m2.module, attr)
+            if attr in m2.classes:
+                return self._resolve_method(m2, attr, "__init__")
+            return None
+        return None
+
+    def _resolve_method(self, mod: ModuleIR, cls: str,
+                        meth: str) -> "tuple | None":
+        memo_key = (mod.module, cls, meth)
+        if memo_key in self._method_resolution:
+            return self._method_resolution[memo_key]
+        self._method_resolution[memo_key] = None  # cycle guard
+        result = None
+        qual = f"{cls}.{meth}"
+        if qual in mod.functions:
+            result = (mod.module, qual)
+        else:
+            for base in mod.bases.get(cls, ()):
+                base_mod, base_cls = self._resolve_class(mod, base)
+                if base_mod is None:
+                    continue
+                r = self._resolve_method(base_mod, base_cls, meth)
+                if r is not None:
+                    result = r
+                    break
+        self._method_resolution[memo_key] = result
+        return result
+
+    def _resolve_class(self, mod: ModuleIR,
+                       base: str) -> "tuple[ModuleIR | None, str]":
+        head = base.split(".", 1)[0]
+        if base in mod.classes:
+            return mod, base
+        if head in mod.imports:
+            imod, attr = mod.imports[head]
+            if "." in base:                       # mod.Class
+                tail = base.split(".", 1)[1]
+                target = self.by_module.get(f"{imod}.{attr}" if attr
+                                            else imod)
+                if target and tail in target.classes:
+                    return target, tail
+            else:                                 # from x import Class
+                target = self.by_module.get(imod)
+                if target and (attr or head) in target.classes:
+                    return target, attr or head
+        return None, base
+
+    # -- reverse-reachability: who blocks within MAX_DEPTH -------------------
+
+    def blocking_closure(self) -> dict:
+        """(module, qual) -> (depth, evidence) where evidence is either
+        ("direct", line, dotted) or ("call", line, callee_key).  depth 0
+        = the function itself blocks."""
+        closure: dict[tuple, tuple] = {}
+        for key, f in self.functions.items():
+            if f.direct_blocking:
+                line, dotted = f.direct_blocking[0]
+                closure[key] = (0, ("direct", line, dotted))
+        # resolve every call edge once
+        edges: dict[tuple, list] = {}   # caller -> [(line, callee)]
+        for key, f in self.functions.items():
+            mod = self.fn_module[key]
+            for line, kind, target, _dotted, _held in f.calls:
+                callee = self.resolve_call(mod, f, kind, target)
+                if callee is not None and callee != key:
+                    edges.setdefault(key, []).append((line, callee))
+        changed = True
+        while changed:
+            changed = False
+            for caller, outs in edges.items():
+                best = closure.get(caller)
+                for line, callee in outs:
+                    got = closure.get(callee)
+                    if got is None:
+                        continue
+                    depth = got[0] + 1
+                    if depth > MAX_DEPTH:
+                        continue
+                    if best is None or depth < best[0]:
+                        best = (depth, ("call", line, callee))
+                        closure[caller] = best
+                        changed = True
+        return closure
+
+    def acquire_closure(self) -> dict:
+        """(module, qual) -> {lock_id: (depth, evidence)} — locks a
+        call to this function may acquire, within MAX_DEPTH.  evidence
+        is ("with", line) or ("call", line, callee_key)."""
+        closure: dict[tuple, dict] = {}
+        for key, f in self.functions.items():
+            locks = {}
+            for lid, line in f.regions:
+                if not lid.startswith("?"):
+                    locks.setdefault(lid, (0, ("with", line)))
+            if locks:
+                closure[key] = locks
+        edges: dict[tuple, list] = {}
+        for key, f in self.functions.items():
+            mod = self.fn_module[key]
+            for line, kind, target, _dotted, _held in f.calls:
+                callee = self.resolve_call(mod, f, kind, target)
+                if callee is not None and callee != key:
+                    edges.setdefault(key, []).append((line, callee))
+        changed = True
+        while changed:
+            changed = False
+            for caller, outs in edges.items():
+                mine = closure.setdefault(caller, {})
+                for line, callee in outs:
+                    for lid, (depth, _ev) in list(closure.get(callee,
+                                                              {}).items()):
+                        nd = depth + 1
+                        if nd > MAX_DEPTH:
+                            continue
+                        cur = mine.get(lid)
+                        if cur is None or nd < cur[0]:
+                            mine[lid] = (nd, ("call", line, callee))
+                            changed = True
+        return closure
+
+    # -- chain rendering -----------------------------------------------------
+
+    def describe_chain(self, key: tuple, closure: dict) -> str:
+        """"helper -> _flush -> http_post" from evidence pointers."""
+        parts = []
+        seen = set()
+        while key in closure and key not in seen:
+            seen.add(key)
+            parts.append(key[1])
+            _depth, ev = closure[key]
+            if ev[0] == "direct":
+                parts.append(f"{ev[2]}()")
+                break
+            key = ev[2]
+        return " -> ".join(parts)
+
+    def describe_lock_chain(self, key: tuple, lid: str,
+                            closure: dict) -> "tuple[str, str, int]":
+        """-> (chain text, file, line of the with) for lock `lid`
+        acquired via function `key`."""
+        parts = []
+        seen = set()
+        while key not in seen:
+            seen.add(key)
+            parts.append(key[1])
+            entry = closure.get(key, {}).get(lid)
+            if entry is None:
+                break
+            _depth, ev = entry
+            if ev[0] == "with":
+                mod = self.fn_module.get(key)
+                return (" -> ".join(parts) + f" [with {lid}]",
+                        mod.path if mod else "?", ev[1])
+            key = ev[2]
+        return (" -> ".join(parts), "?", 0)
+
+
+# -- findings ----------------------------------------------------------------
+
+def _suppressed(mod: ModuleIR, line: int, checker: str) -> bool:
+    ids = mod.pragmas.get(line, ())
+    return ids is None or checker in ids
+
+
+def project_findings(modules: list[ModuleIR],
+                     select: "set[str] | None" = None) -> list:
+    run_150 = select is None or "WL150" in select
+    run_160 = select is None or "WL160" in select
+    if not (run_150 or run_160):
+        return []
+    index = ProjectIndex(modules)
+    out: list = []
+    if run_150:
+        out.extend(_check_wl150(index))
+    if run_160:
+        out.extend(_check_wl160(index))
+    out.sort(key=lambda f: (f.file, f.line, f.checker))
+    return out
+
+
+def _check_wl150(index: ProjectIndex) -> Iterator:
+    from . import Finding
+    closure = index.blocking_closure()
+    for key, f in index.functions.items():
+        mod = index.fn_module[key]
+        for line, kind, target, dotted, held in f.calls:
+            if not held:
+                continue
+            callee = index.resolve_call(mod, f, kind, target)
+            if callee is None or callee not in closure:
+                continue
+            if _suppressed(mod, line, "WL150"):
+                continue
+            chain = index.describe_chain(callee, closure)
+            lock_txt = ", ".join(held)
+            yield Finding(
+                "WL150", "blocking-under-lock", mod.path, line,
+                f"`{dotted or target}` reaches blocking call "
+                f"({chain}) while holding `{lock_txt}`",
+                "move the call outside the critical section or "
+                "snapshot under the lock and do the blocking work "
+                "after release")
+
+
+def _check_wl160(index: ProjectIndex) -> Iterator:
+    from . import Finding
+    acq = index.acquire_closure()
+    # edge (A, B) -> (file, line, description of how B is taken
+    # while A is held)
+    edges: dict[tuple, tuple] = {}
+
+    def note(a: str, b: str, path: str, line: int, how: str):
+        if a == b:
+            return   # same lock class across instances: out of scope
+        edges.setdefault((a, b), (path, line, how))
+
+    for key, f in index.functions.items():
+        mod = index.fn_module[key]
+        # lexical nesting inside one function
+        for lid, line, held in f.acquires:
+            if lid.startswith("?"):
+                continue
+            for h in held:
+                if not h.startswith("?"):
+                    note(h, lid, mod.path, line,
+                         f"{f.qual} takes {lid} at {mod.path}:{line} "
+                         f"while holding {h}")
+        # calls made under a lock that acquire other locks
+        for line, kind, target, _dotted, held in f.calls:
+            real_held = [h for h in held if not h.startswith("?")]
+            if not real_held:
+                continue
+            callee = index.resolve_call(mod, f, kind, target)
+            if callee is None:
+                continue
+            for lid in acq.get(callee, {}):
+                for h in real_held:
+                    chain, cpath, cline = index.describe_lock_chain(
+                        callee, lid, acq)
+                    note(h, lid, mod.path, line,
+                         f"{f.qual} (holding {h}) calls {chain} "
+                         f"[{cpath}:{cline}]")
+
+    # cycle detection over the class-level order graph
+    succ: dict[str, set] = {}
+    for (a, b) in edges:
+        succ.setdefault(a, set()).add(b)
+    reported: set = set()
+    for (a, b) in sorted(edges):
+        # is there a path b ->* a?  then a->b closes a cycle
+        path = _find_path(succ, b, a)
+        if path is None:
+            continue
+        cycle = [a, b] + path[1:]
+        canon = frozenset(cycle)
+        if canon in reported:
+            continue
+        reported.add(canon)
+        fpath, line, how = edges[(a, b)]
+        # both directions' evidence: this edge and the return path
+        legs = [how]
+        for i in range(len(path) - 1):
+            leg = edges.get((path[i], path[i + 1]))
+            if leg:
+                legs.append(leg[2])
+        mod = next((m for m in index.modules if m.path == fpath), None)
+        if mod is not None and _suppressed(mod, line, "WL160"):
+            continue
+        yield Finding(
+            "WL160", "lock-order-cycle", fpath, line,
+            "potential ABBA deadlock: "
+            + " -> ".join(cycle)
+            + " | " + " ; ".join(legs),
+            "pick one global order for these locks (document it) or "
+            "drop to a single lock / split state")
+
+
+def _find_path(succ: dict, src: str, dst: str) -> "list[str] | None":
+    stack = [(src, [src])]
+    visited = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in succ.get(node, ()):
+            if nxt not in visited:
+                visited.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
