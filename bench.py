@@ -805,6 +805,204 @@ def bench_replicated_write(concurrency: int, quick: bool = False,
     return out
 
 
+def bench_http_native_loop(quick: bool = False) -> dict:
+    """Native HTTP serving loop extras (ISSUE 18): per-worker volume
+    HTTP small-file read and write rps with the fastpath.c serving
+    loop ON vs OFF — an interleaved, order-rotated A/B flipped by the
+    WEED_FASTPATH_HTTP kill switch (read per connection, so the SAME
+    server serves both arms) with {value, n, min, max} spreads — plus
+    python_calls_per_http_op: Python-level call events inside the
+    serving threads per HTTP GET, the interpreter overhead the C loop
+    exists to delete."""
+    import socket as _socket
+    import threading as _threading
+
+    from seaweedfs_tpu.testing import SimCluster
+    from seaweedfs_tpu.util import http as uhttp
+    from seaweedfs_tpu.util import tracing
+
+    if uhttp._http_fastpath() is None:
+        return {"http_native_error": "native http loop unavailable"}
+
+    n_files = 40 if quick else 120
+    reads_per_thread = 300 if quick else 1000
+    writes_per_thread = 80 if quick else 250
+    read_reps = 2 if quick else 4     # ~1s per arm: below that, the
+    write_reps = 1 if quick else 2    # box's scheduling jitter wins
+    conc = min(8, 2 * (os.cpu_count() or 1))
+    rounds = 3 if quick else 5
+    payload = b"n" * 1024
+    # what real clients put on the wire — header parsing is a large
+    # slice of the per-request loop cost on both arms
+    req_hdrs = (b"Host: 127.0.0.1\r\nUser-Agent: weedbench/1.0\r\n"
+                b"Accept: */*\r\nAccept-Encoding: identity\r\n")
+    out: dict = {}
+    was_tracing = tracing.enabled()
+    prev_env = os.environ.get("WEED_FASTPATH_HTTP")
+    prev_lockdep = os.environ.get("WEED_LOCKDEP")
+    rates: dict = {"read": {"on": [], "off": []},
+                   "write": {"on": [], "off": []}}
+    ratios: dict = {"read": [], "write": []}
+
+    def drive(port: int, blob: bytes, expect: int) -> None:
+        # raw keep-alive client: one pipelined burst per thread keeps
+        # the measurement on the SERVING loop, not a Python client
+        s = _socket.create_connection(("127.0.0.1", port), timeout=30)
+        try:
+            s.sendall(blob)
+            s.shutdown(_socket.SHUT_WR)
+            got, tail = 0, b""
+            while True:
+                p = s.recv(1 << 16)
+                if not p:
+                    break
+                # tail < marker length: a match is either inside p or
+                # spans the chunk boundary — never counted twice
+                buf = tail + p
+                got += buf.count(b"HTTP/1.1 2")
+                tail = buf[-9:]
+            if got < expect:
+                raise RuntimeError(f"pipelined burst: {got}/{expect} 2xx")
+        finally:
+            s.close()
+
+    def measure(port: int, blobs: list, expect: int,
+                reps: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            threads = [_threading.Thread(target=drive,
+                                         args=(port, b, expect))
+                       for b in blobs]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        return reps * len(blobs) * expect / (time.perf_counter() - t0)
+
+    try:
+        # the volume fast lane only arms with tracing off; the Python
+        # arm runs the same way so both sides serve identical work
+        tracing.set_enabled(False)
+        # lockdep instrumentation is constant overhead on BOTH arms —
+        # benching with it armed just dilutes the loop under test
+        os.environ["WEED_LOCKDEP"] = "0"
+        # jwt off: the write arm drives raw pipelined POSTs without
+        # re-signing per-fid tokens inside the timed loop
+        with SimCluster(volume_servers=1, max_volumes=60,
+                        jwt_key="") as c:
+            from seaweedfs_tpu import operation
+            fids = [c.upload(payload) for _ in range(n_files)]
+            vs = c.volume_servers[0]
+            port = vs.http.port
+            read_blobs = []
+            for t in range(conc):
+                reqs = [(f"GET /{fids[(t + i) % n_files]} "
+                         f"HTTP/1.1\r\n").encode() + req_hdrs + b"\r\n"
+                        for i in range(reads_per_thread)]
+                read_blobs.append(b"".join(reqs))
+            w = operation.assign(c.master_grpc,
+                                 count=conc * writes_per_thread)
+            wfids = operation.derive_fids(w)
+            write_blobs = []
+            for t in range(conc):
+                chunk = wfids[t * writes_per_thread:
+                              (t + 1) * writes_per_thread]
+                reqs = [(f"POST /{f} HTTP/1.1\r\n").encode() + req_hdrs
+                        + (f"Content-Length: {len(payload)}"
+                           f"\r\n\r\n").encode() + payload
+                        for f in chunk]
+                write_blobs.append(b"".join(reqs))
+            # warmup both arms (first-touch page cache, route setup)
+            one = (f"GET /{fids[0]} HTTP/1.1\r\n".encode()
+                   + req_hdrs + b"\r\n")
+            for arm in ("1", "0"):
+                os.environ["WEED_FASTPATH_HTTP"] = arm
+                drive(port, one * 20, 20)
+            for r in range(rounds):
+                order = ("on", "off") if r % 2 == 0 else ("off", "on")
+                got: dict = {"read": {}, "write": {}}
+                for arm in order:
+                    os.environ["WEED_FASTPATH_HTTP"] = \
+                        "1" if arm == "on" else "0"
+                    got["read"][arm] = measure(
+                        port, read_blobs, reads_per_thread, read_reps)
+                    got["write"][arm] = measure(
+                        port, write_blobs, writes_per_thread,
+                        write_reps)
+                for kind in ("read", "write"):
+                    for arm in ("on", "off"):
+                        rates[kind][arm].append(got[kind][arm])
+                    # paired within the round: immune to the slow
+                    # drift that dominates this box's absolute rps
+                    ratios[kind].append(
+                        got[kind]["on"] / max(1e-9, got[kind]["off"]))
+        for kind in ("read", "write"):
+            for arm in ("on", "off"):
+                key = f"http_native_{kind}_rps_{arm}"
+                out[key], out[f"{key}_spread"] = \
+                    spread(rates[kind][arm], digits=1)
+            out[f"http_native_{kind}_speedup"], \
+                out[f"http_native_{kind}_speedup_spread"] = \
+                spread(ratios[kind], digits=3)
+        # acceptance gate (ISSUE 18): >= +25% small-file read rps
+        out["http_native_read_speedup_ok"] = \
+            out["http_native_read_speedup"] >= 1.25
+
+        # -- python_calls_per_http_op -----------------------------------
+        # a fresh standalone server so threading.setprofile sees ONLY
+        # its accept/conn threads (started after the hook is armed)
+        calls = [0]
+
+        def prof(frame, event, arg):  # noqa: ARG001
+            if event == "call":
+                calls[0] += 1
+
+        for arm in ("on", "off"):
+            _threading.setprofile(prof)
+            try:
+                srv = uhttp.HttpServer()
+                srv.route("GET", "/hello",
+                          lambda req: uhttp.Response(body=b"hi"))
+                srv.start()
+                try:
+                    os.environ["WEED_FASTPATH_HTTP"] = \
+                        "1" if arm == "on" else "0"
+                    n = 50 if quick else 200
+                    s = _socket.create_connection(
+                        ("127.0.0.1", srv.port), timeout=10)
+                    try:
+                        one = b"GET /hello HTTP/1.1\r\n\r\n"
+                        s.sendall(one)   # warm the conn thread
+                        s.recv(1 << 16)
+                        base = calls[0]
+                        s.sendall(one * n)
+                        got, tail = 0, b""
+                        while got < n:
+                            p = s.recv(1 << 16)
+                            if not p:
+                                break
+                            buf = tail + p
+                            got += buf.count(b"HTTP/1.1 2")
+                            tail = buf[-9:]
+                        out[f"python_calls_per_http_op_{arm}"] = \
+                            round((calls[0] - base) / max(1, got), 1)
+                    finally:
+                        s.close()
+                finally:
+                    srv.stop()
+            finally:
+                _threading.setprofile(None)
+    finally:
+        tracing.set_enabled(was_tracing)
+        for var, prev in (("WEED_FASTPATH_HTTP", prev_env),
+                          ("WEED_LOCKDEP", prev_lockdep)):
+            if prev is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = prev
+    return out
+
+
 def bench_worker_scaling(quick: bool = False) -> dict:
     """Per-core scaling curve (ISSUE 12): the smallfile benchmark
     against ONE logical volume server running 1, 2 (and 4) worker
@@ -1538,6 +1736,10 @@ def main():
                 smallfile.update(bench_worker_scaling(quick=args.quick))
             except Exception as e:
                 smallfile["worker_scaling_error"] = str(e)[:200]
+            try:
+                smallfile.update(bench_http_native_loop(quick=args.quick))
+            except Exception as e:
+                smallfile["http_native_error"] = str(e)[:200]
             try:
                 smallfile.update(bench_largefile(quick=args.quick))
             except Exception as e:

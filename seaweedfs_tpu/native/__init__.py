@@ -202,6 +202,12 @@ def fastpath():
     with _lock:
         if _fp_tried:
             return _fp
+        if os.environ.get("WEED_FASTPATH", "1") == "0":
+            # global kill switch: every native caller sees None and runs
+            # its pure-Python fallback (tools/check.sh uses this to keep
+            # the fallbacks from rotting)
+            _fp_tried = True
+            return None
         # one-time cc build serialized on purpose (see _load above)
         so = _build_fastpath()  # weedlint: disable=WL150
         if so is not None:

@@ -1,4 +1,5 @@
-/* _seaweed_fastpath — CPython extension for the raw-TCP frame hot loop.
+/* _seaweed_fastpath — CPython extension for the raw-TCP frame hot loop
+ * and the HTTP serving loop.
  *
  * The volume server's TCP data path (volume_server/tcp.py) and its client
  * (operation._tcp_call) spend most of a 1KB read's budget in CPython call
@@ -12,6 +13,14 @@
  * Wire format (volume_server/tcp.py, little-endian):
  *   frame:  op:u8, fid_len:u16, fid, jwt_len:u16, jwt, body_len:u32, body
  *   reply:  status:u8, payload_len:u32, payload
+ *
+ * The HTTP section at the bottom gives util/http.py's HttpServer the
+ * same treatment: http_read_request() parses one request head per C
+ * call over the same buffered Conn, http_write_response() emits the
+ * head + body in a single writev, and http_readline()/http_read() let
+ * the Python chunked/streamed body readers run over the C buffer
+ * without desyncing.  Byte-for-byte parity with the pure-Python parser
+ * is pinned by tests/test_fastpath.py and tests/test_http_native.py.
  *
  * Plain CPython C API (pybind11 is not in this image).  Every function
  * has a pure-Python fallback; tcp.py uses this only when the build
@@ -166,6 +175,7 @@ static PyObject *read_exact_bytes(Conn *c, size_t n)
 static PyObject *py_conn_new(PyObject *self, PyObject *args)
 {
     int fd;
+    (void)self;
     Py_ssize_t cap = 65536;
     if (!PyArg_ParseTuple(args, "i|n", &fd, &cap))
         return NULL;
@@ -189,6 +199,7 @@ static PyObject *py_read_frame(PyObject *self, PyObject *args)
 {
     PyObject *cap;
     Py_ssize_t max_body;
+    (void)self;
     if (!PyArg_ParseTuple(args, "On", &cap, &max_body))
         return NULL;
     Conn *c = get_conn(cap);
@@ -244,6 +255,7 @@ static PyObject *py_write_reply(PyObject *self, PyObject *args)
     PyObject *cap;
     int status;
     Py_buffer payload;
+    (void)self;
     if (!PyArg_ParseTuple(args, "Oiy*", &cap, &status, &payload))
         return NULL;
     Conn *c = get_conn(cap);
@@ -283,6 +295,7 @@ static PyObject *py_request(PyObject *self, PyObject *args)
     PyObject *cap;
     int op;
     Py_buffer fid, jwt, body;
+    (void)self;
     if (!PyArg_ParseTuple(args, "Oiy*y*y*", &cap, &op, &fid, &jwt, &body))
         return NULL;
     Conn *c = get_conn(cap);
@@ -347,6 +360,7 @@ fail_release:
 static PyObject *py_read_reply(PyObject *self, PyObject *args)
 {
     PyObject *cap;
+    (void)self;
     if (!PyArg_ParseTuple(args, "O", &cap))
         return NULL;
     Conn *c = get_conn(cap);
@@ -417,6 +431,7 @@ static PyObject *py_needle_data(PyObject *self, PyObject *args)
 {
     Py_buffer raw;
     unsigned int size;
+    (void)self;
     int version;
     long long cookie;
     if (!PyArg_ParseTuple(args, "y*IiL", &raw, &size, &version, &cookie))
@@ -480,6 +495,7 @@ static PyObject *py_needle_record(PyObject *self, PyObject *args)
 {
     unsigned int cookie;
     unsigned long long nid, ts;
+    (void)self;
     int version;
     Py_buffer data;
     if (!PyArg_ParseTuple(args, "IKy*iK", &cookie, &nid, &data, &version,
@@ -536,6 +552,444 @@ static PyObject *py_needle_record(PyObject *self, PyObject *args)
     return Py_BuildValue("NII", out, size, masked);
 }
 
+/* -- HTTP serving fast path (util/http.py HttpServer) -------------------
+ * One C call per request head, one per response, over the same buffered
+ * Conn capsule the frame loop uses.  Semantics mirror the pure-Python
+ * HttpServer._read_request byte for byte — same line limits, same
+ * stray-CRLF skip, same ValueError messages (the caller re-wraps them
+ * into _BadRequest, so the 400 bodies match), same ASCII-whitespace
+ * stripping and last-duplicate-wins headers.  Parity is pinned by a
+ * differential fuzz corpus in tests/test_fastpath.py.
+ */
+
+/* the six bytes bytes.split(None)/bytes.strip() treat as whitespace */
+static int is_ws(unsigned char ch)
+{
+    return ch == ' ' || ch == '\t' || ch == '\n' || ch == '\r' || ch == '\v'
+           || ch == '\f';
+}
+
+/* str.lower() restricted to latin-1 input: A-Z and the accented
+ * uppercase block U+00C0..U+00DE (minus the multiplication sign 0xD7)
+ * shift down by 0x20; every other latin-1 char lowercases to itself.
+ * Exhaustively pinned against str.lower() over all 256 bytes in
+ * tests/test_fastpath.py. */
+static unsigned char lat1_lower(unsigned char ch)
+{
+    if (ch >= 'A' && ch <= 'Z')
+        return (unsigned char)(ch + 0x20);
+    if (ch >= 0xC0 && ch <= 0xDE && ch != 0xD7)
+        return (unsigned char)(ch + 0x20);
+    return ch;
+}
+
+/* make room for at least one byte and recv once.
+ * 1 = got bytes, 0 = orderly EOF, -1 = error (exception set) */
+static int buf_fill(Conn *c)
+{
+    if (c->end == c->cap) {
+        if (c->start > 0) { /* compact */
+            memmove(c->buf, c->buf + c->start, c->end - c->start);
+            c->end -= c->start;
+            c->start = 0;
+        } else {
+            size_t ncap = c->cap * 2;
+            unsigned char *nb = (unsigned char *)realloc(c->buf, ncap);
+            if (!nb) {
+                PyErr_NoMemory();
+                return -1;
+            }
+            c->buf = nb;
+            c->cap = ncap;
+        }
+    }
+    Py_ssize_t n = recv_some(c, c->buf + c->end, c->cap - c->end);
+    if (n < 0) {
+        PyErr_SetFromErrno(PyExc_ConnectionError);
+        return -1;
+    }
+    if (n == 0)
+        return 0;
+    c->end += (size_t)n;
+    return 1;
+}
+
+/* BufferedReader.readline(limit) over the Conn buffer: up to `limit`
+ * bytes ending at the first \n, exactly `limit` bytes when no \n shows
+ * up in time, the partial tail (possibly empty) at EOF.  Points *out at
+ * the line INSIDE the buffer — valid only until the next buffer
+ * operation — and consumes it.  Returns the length, or -1 on a socket
+ * error with the exception set. */
+static Py_ssize_t read_line(Conn *c, size_t limit, const unsigned char **out)
+{
+    size_t scanned = 0, line_len;
+    for (;;) {
+        size_t have = c->end - c->start;
+        size_t scan = have < limit ? have : limit;
+        if (scan > scanned) {
+            const unsigned char *nl = (const unsigned char *)memchr(
+                c->buf + c->start + scanned, '\n', scan - scanned);
+            if (nl) {
+                line_len = (size_t)(nl - (c->buf + c->start)) + 1;
+                break;
+            }
+            scanned = scan;
+        }
+        if (have >= limit) {
+            line_len = limit;
+            break;
+        }
+        int r = buf_fill(c);
+        if (r < 0)
+            return -1;
+        if (r == 0) { /* EOF: return what we have, like readline() */
+            line_len = have;
+            break;
+        }
+    }
+    *out = c->buf + c->start;
+    c->start += line_len;
+    return (Py_ssize_t)line_len;
+}
+
+static int line_is_blank(const unsigned char *line, Py_ssize_t n)
+{
+    return (n == 1 && line[0] == '\n')
+           || (n == 2 && line[0] == '\r' && line[1] == '\n');
+}
+
+/* http_read_request(conn, header_type, max_line, max_headers)
+ *   -> None on clean EOF between requests, else
+ *      (method:str, target:str, version:bytes, headers:header_type)
+ *
+ * header_type is util.http.CIDict (any dict subclass whose __setitem__
+ * only lowercases keys works): keys are lowercased here and stored with
+ * PyDict_SetItem, so duplicate headers last-win exactly like the Python
+ * loop.  Raises ValueError carrying _BadRequest's exact messages. */
+static PyObject *py_http_read_request(PyObject *self, PyObject *args)
+{
+    PyObject *cap, *hdr_type;
+    Py_ssize_t max_line, max_headers;
+    PyObject *method = NULL, *target = NULL, *version = NULL, *hdrs = NULL;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OOnn", &cap, &hdr_type, &max_line,
+                          &max_headers))
+        return NULL;
+    Conn *c = get_conn(cap);
+    if (!c)
+        return NULL;
+    if (max_line <= 0 || !PyType_Check(hdr_type)
+        || !PyType_IsSubtype((PyTypeObject *)hdr_type, &PyDict_Type)) {
+        PyErr_SetString(PyExc_TypeError,
+                        "need a dict subclass and max_line > 0");
+        return NULL;
+    }
+    /* readline(_MAX_LINE + 2), same slack as the Python loop */
+    size_t limit = (size_t)max_line + 2;
+    const unsigned char *line;
+    Py_ssize_t n = read_line(c, limit, &line);
+    if (n < 0)
+        return NULL;
+    if (n == 0)
+        Py_RETURN_NONE; /* clean EOF between requests */
+    if (line_is_blank(line, n)) {
+        /* skip ONE stray CRLF between pipelined requests (RFC 7230 3.5) */
+        n = read_line(c, limit, &line);
+        if (n < 0)
+            return NULL;
+        if (n == 0)
+            Py_RETURN_NONE;
+    }
+    if (n > max_line) {
+        PyErr_SetString(PyExc_ValueError, "request line too long");
+        return NULL;
+    }
+    {
+        /* bytes.split(None, 2): method, target, rest; the 3rd token
+           keeps interior bytes but sheds trailing whitespace via the
+           Python loop's version.strip() */
+        size_t len = (size_t)n, i = 0;
+        while (i < len && is_ws(line[i]))
+            i++;
+        size_t m0 = i;
+        while (i < len && !is_ws(line[i]))
+            i++;
+        size_t m1 = i;
+        while (i < len && is_ws(line[i]))
+            i++;
+        size_t t0 = i;
+        while (i < len && !is_ws(line[i]))
+            i++;
+        size_t t1 = i;
+        while (i < len && is_ws(line[i]))
+            i++;
+        size_t v0 = i, v1 = len;
+        while (v1 > v0 && is_ws(line[v1 - 1]))
+            v1--;
+        if (m1 == m0 || t1 == t0 || v1 == v0) {
+            PyErr_SetString(PyExc_ValueError, "malformed request line");
+            return NULL;
+        }
+        /* materialize before the next read_line invalidates `line` */
+        method = PyUnicode_DecodeLatin1((const char *)line + m0,
+                                        (Py_ssize_t)(m1 - m0), NULL);
+        target = PyUnicode_DecodeLatin1((const char *)line + t0,
+                                        (Py_ssize_t)(t1 - t0), NULL);
+        version = PyBytes_FromStringAndSize((const char *)line + v0,
+                                            (Py_ssize_t)(v1 - v0));
+        if (!method || !target || !version)
+            goto fail;
+    }
+    hdrs = PyObject_CallNoArgs(hdr_type);
+    if (!hdrs)
+        goto fail;
+    {
+        Py_ssize_t k;
+        int terminated = 0;
+        for (k = 0; k <= max_headers; k++) {
+            n = read_line(c, limit, &line);
+            if (n < 0)
+                goto fail;
+            /* EOF counts as the header terminator, like the Python loop */
+            if (n == 0 || line_is_blank(line, n)) {
+                terminated = 1;
+                break;
+            }
+            if (n > max_line) {
+                PyErr_SetString(PyExc_ValueError, "header line too long");
+                goto fail;
+            }
+            const unsigned char *colon =
+                (const unsigned char *)memchr(line, ':', (size_t)n);
+            if (!colon) {
+                PyErr_SetString(PyExc_ValueError, "malformed header");
+                goto fail;
+            }
+            const unsigned char *k0 = line, *k1 = colon;
+            const unsigned char *u0 = colon + 1, *u1 = line + n;
+            while (k0 < k1 && is_ws(*k0))
+                k0++;
+            while (k1 > k0 && is_ws(k1[-1]))
+                k1--;
+            while (u0 < u1 && is_ws(*u0))
+                u0++;
+            while (u1 > u0 && is_ws(u1[-1]))
+                u1--;
+            size_t klen = (size_t)(k1 - k0);
+            unsigned char kbuf[256];
+            unsigned char *kp = kbuf;
+            if (klen > sizeof(kbuf)) {
+                kp = (unsigned char *)malloc(klen);
+                if (!kp) {
+                    PyErr_NoMemory();
+                    goto fail;
+                }
+            }
+            for (size_t j = 0; j < klen; j++)
+                kp[j] = lat1_lower(k0[j]);
+            PyObject *key = PyUnicode_DecodeLatin1((const char *)kp,
+                                                   (Py_ssize_t)klen, NULL);
+            if (kp != kbuf)
+                free(kp);
+            PyObject *val = PyUnicode_DecodeLatin1((const char *)u0,
+                                                   (Py_ssize_t)(u1 - u0),
+                                                   NULL);
+            if (!key || !val) {
+                Py_XDECREF(key);
+                Py_XDECREF(val);
+                goto fail;
+            }
+            int rc = PyDict_SetItem(hdrs, key, val);
+            Py_DECREF(key);
+            Py_DECREF(val);
+            if (rc < 0)
+                goto fail;
+        }
+        if (!terminated) {
+            PyErr_SetString(PyExc_ValueError, "too many headers");
+            goto fail;
+        }
+    }
+    return Py_BuildValue("NNNN", method, target, version, hdrs);
+fail:
+    Py_XDECREF(method);
+    Py_XDECREF(target);
+    Py_XDECREF(version);
+    Py_XDECREF(hdrs);
+    return NULL;
+}
+
+/* http_read_body(conn, n) -> exactly n bytes of request body.
+ * ValueError "truncated body" on EOF short of n (the message the Python
+ * loop's _BadRequest carries), ConnectionError on a socket error. */
+static PyObject *py_http_read_body(PyObject *self, PyObject *args)
+{
+    PyObject *cap;
+    Py_ssize_t want;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "On", &cap, &want))
+        return NULL;
+    Conn *c = get_conn(cap);
+    if (!c)
+        return NULL;
+    if (want < 0) {
+        PyErr_SetString(PyExc_ValueError, "negative body length");
+        return NULL;
+    }
+    PyObject *out = PyBytes_FromStringAndSize(NULL, want);
+    if (!out)
+        return NULL;
+    unsigned char *dst = (unsigned char *)PyBytes_AS_STRING(out);
+    size_t nn = (size_t)want;
+    size_t have = c->end - c->start;
+    size_t take = have < nn ? have : nn;
+    memcpy(dst, c->buf + c->start, take);
+    c->start += take;
+    size_t got = take;
+    while (got < nn) {
+        Py_ssize_t r = recv_some(c, dst + got, nn - got);
+        if (r == 0) {
+            Py_DECREF(out);
+            PyErr_SetString(PyExc_ValueError, "truncated body");
+            return NULL;
+        }
+        if (r < 0) {
+            Py_DECREF(out);
+            PyErr_SetFromErrno(PyExc_ConnectionError);
+            return NULL;
+        }
+        got += (size_t)r;
+    }
+    return out;
+}
+
+/* http_readline(conn, limit=-1) -> bytes.  BufferedReader.readline()
+ * over the Conn buffer — the shim the Python chunked-body reader runs
+ * on, so chunk framing never desyncs from the C parser's buffer. */
+static PyObject *py_http_readline(PyObject *self, PyObject *args)
+{
+    PyObject *cap;
+    Py_ssize_t limit = -1;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "O|n", &cap, &limit))
+        return NULL;
+    Conn *c = get_conn(cap);
+    if (!c)
+        return NULL;
+    size_t lim = limit < 0 ? (size_t)-1 : (size_t)limit;
+    const unsigned char *line;
+    Py_ssize_t n = read_line(c, lim, &line);
+    if (n < 0)
+        return NULL;
+    return PyBytes_FromStringAndSize((const char *)line, n);
+}
+
+/* http_read(conn, n) -> bytes.  BufferedReader.read(): up to n bytes,
+ * short only at EOF (no exception); n < 0 reads to EOF. */
+static PyObject *py_http_read(PyObject *self, PyObject *args)
+{
+    PyObject *cap;
+    Py_ssize_t want;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "On", &cap, &want))
+        return NULL;
+    Conn *c = get_conn(cap);
+    if (!c)
+        return NULL;
+    if (want >= 0) {
+        PyObject *out = PyBytes_FromStringAndSize(NULL, want);
+        if (!out)
+            return NULL;
+        unsigned char *dst = (unsigned char *)PyBytes_AS_STRING(out);
+        size_t nn = (size_t)want;
+        size_t have = c->end - c->start;
+        size_t take = have < nn ? have : nn;
+        memcpy(dst, c->buf + c->start, take);
+        c->start += take;
+        size_t got = take;
+        while (got < nn) {
+            Py_ssize_t r = recv_some(c, dst + got, nn - got);
+            if (r < 0) {
+                Py_DECREF(out);
+                PyErr_SetFromErrno(PyExc_ConnectionError);
+                return NULL;
+            }
+            if (r == 0)
+                break;
+            got += (size_t)r;
+        }
+        if (got < nn && _PyBytes_Resize(&out, (Py_ssize_t)got) < 0)
+            return NULL;
+        return out;
+    }
+    /* read to EOF */
+    size_t have = c->end - c->start;
+    size_t room = have + 65536;
+    PyObject *out = PyBytes_FromStringAndSize(NULL, (Py_ssize_t)room);
+    if (!out)
+        return NULL;
+    unsigned char *dst = (unsigned char *)PyBytes_AS_STRING(out);
+    memcpy(dst, c->buf + c->start, have);
+    c->start += have;
+    size_t got = have;
+    for (;;) {
+        if (got == room) {
+            room *= 2;
+            if (_PyBytes_Resize(&out, (Py_ssize_t)room) < 0)
+                return NULL;
+            dst = (unsigned char *)PyBytes_AS_STRING(out);
+        }
+        Py_ssize_t r = recv_some(c, dst + got, room - got);
+        if (r < 0) {
+            Py_DECREF(out);
+            PyErr_SetFromErrno(PyExc_ConnectionError);
+            return NULL;
+        }
+        if (r == 0)
+            break;
+        got += (size_t)r;
+    }
+    if (got < room && _PyBytes_Resize(&out, (Py_ssize_t)got) < 0)
+        return NULL;
+    return out;
+}
+
+/* http_write_response(conn, head:buffer, body:buffer) — one gathered
+ * writev of the prebuilt head block (bytearray from _build_head) and
+ * the body, replacing the bytes(head)-copy + sendmsg assembly. */
+static PyObject *py_http_write_response(PyObject *self, PyObject *args)
+{
+    PyObject *cap;
+    Py_buffer head, body;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "Oy*y*", &cap, &head, &body))
+        return NULL;
+    Conn *c = get_conn(cap);
+    if (!c) {
+        PyBuffer_Release(&head);
+        PyBuffer_Release(&body);
+        return NULL;
+    }
+    struct iovec iov[2];
+    int cnt = 0;
+    if (head.len) {
+        iov[cnt].iov_base = head.buf;
+        iov[cnt].iov_len = (size_t)head.len;
+        cnt++;
+    }
+    if (body.len) {
+        iov[cnt].iov_base = body.buf;
+        iov[cnt].iov_len = (size_t)body.len;
+        cnt++;
+    }
+    int rc = cnt ? send_all_iov(c->fd, iov, cnt) : 0;
+    PyBuffer_Release(&head);
+    PyBuffer_Release(&body);
+    if (rc < 0)
+        return NULL;
+    Py_RETURN_NONE;
+}
+
 static PyMethodDef Methods[] = {
     {"conn_new", py_conn_new, METH_VARARGS,
      "conn_new(fd, bufsize=65536) -> capsule"},
@@ -552,12 +1006,27 @@ static PyMethodDef Methods[] = {
     {"needle_record", py_needle_record, METH_VARARGS,
      "needle_record(cookie, nid, data, version, ts) "
      "-> (record, size, checksum)"},
+    {"http_read_request", py_http_read_request, METH_VARARGS,
+     "http_read_request(conn, header_type, max_line, max_headers) "
+     "-> None | (method, target, version, headers)"},
+    {"http_read_body", py_http_read_body, METH_VARARGS,
+     "http_read_body(conn, n) -> exactly n bytes"},
+    {"http_readline", py_http_readline, METH_VARARGS,
+     "http_readline(conn, limit=-1) -> bytes"},
+    {"http_read", py_http_read, METH_VARARGS,
+     "http_read(conn, n) -> up to n bytes (n < 0: to EOF)"},
+    {"http_write_response", py_http_write_response, METH_VARARGS,
+     "http_write_response(conn, head, body)"},
     {NULL, NULL, 0, NULL},
 };
 
 static struct PyModuleDef moduledef = {
-    PyModuleDef_HEAD_INIT, "_seaweed_fastpath",
-    "C hot loop for the volume-server TCP frame protocol", -1, Methods,
+    .m_base = PyModuleDef_HEAD_INIT,
+    .m_name = "_seaweed_fastpath",
+    .m_doc = "C hot loop for the volume-server TCP frame protocol "
+             "and the HTTP serving loop",
+    .m_size = -1,
+    .m_methods = Methods,
 };
 
 PyMODINIT_FUNC PyInit__seaweed_fastpath(void)

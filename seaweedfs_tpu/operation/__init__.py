@@ -362,12 +362,30 @@ FID_LEASE_TTL = 5.0
 
 
 class _Lease:
-    __slots__ = ("r", "fids", "expires")
+    __slots__ = ("r", "fids", "expires", "vid")
 
-    def __init__(self, r: AssignResult, fids: list[str], expires: float):
+    def __init__(self, r: AssignResult, fids: list[str], expires: float,
+                 vid: int):
         self.r = r
         self.fids = fids
         self.expires = expires
+        self.vid = vid
+
+
+def _fresh_tcp_route(master_grpc: str, vid: int, default: str) -> str:
+    """The owning WORKER's frame route for `vid`: the _TCP_ROUTE map
+    (fed by the master's per-vid `vid_tcp_ports` stamps via lookups and
+    heartbeats) beats the assign-time tcp_url, which can go stale for a
+    lease's lifetime when the volume's owning worker changes.  A
+    negative-cached route is dropped entirely, so upload_to falls back
+    to HTTP instead of paying a connect timeout per leased write."""
+    hit = _TCP_ROUTE.get((master_grpc, vid))
+    tcp = default
+    if hit and hit[0] > time.time():
+        tcp = hit[1]
+    if tcp and tcp_dead(tcp):
+        return ""
+    return tcp
 
 
 class FidLeaser:
@@ -408,10 +426,14 @@ class FidLeaser:
             fid = lease.fids.pop(0)
             self.stats["leased"] += 1
             r = lease.r
+            # every pop re-resolves the worker route: leased writes pin
+            # to the vid's OWNING worker frame connection instead of
+            # bouncing through a wrong-worker forward
             return AssignResult(fid=fid, url=r.url,
                                 public_url=r.public_url, count=1,
                                 replicas=r.replicas, auth=r.auth,
-                                tcp_url=r.tcp_url)
+                                tcp_url=_fresh_tcp_route(
+                                    key[0], lease.vid, r.tcp_url))
 
     def assign(self, master_grpc: str, replication: str = "",
                collection: str = "", ttl: str = "",
@@ -437,13 +459,21 @@ class FidLeaser:
                        ttl=ttl, data_center=data_center)
             self.stats["assign_rpcs"] += 1
             fids = derive_fids(r)
+            vid = int(r.fid.split(",", 1)[0])
+            if r.tcp_url and not tcp_dead(r.tcp_url):
+                # the master stamps assign results with the OWNING
+                # worker's frame port (vid_tcp_ports): share the route
+                # with readers and later pops of this lease
+                _TCP_ROUTE[(master_grpc, vid)] = (
+                    time.time() + _LOOKUP_TTL, r.tcp_url)
             with self._lock:
                 self._leases[key] = _Lease(
-                    r, fids[1:], time.time() + self.ttl_seconds)
+                    r, fids[1:], time.time() + self.ttl_seconds, vid)
         return AssignResult(fid=fids[0], url=r.url,
                             public_url=r.public_url, count=1,
                             replicas=r.replicas, auth=r.auth,
-                            tcp_url=r.tcp_url)
+                            tcp_url=_fresh_tcp_route(master_grpc, vid,
+                                                     r.tcp_url))
 
     def invalidate_volume(self, vid: int) -> None:
         """Drop every lease pointing at `vid` (upload failed: readonly

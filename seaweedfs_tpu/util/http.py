@@ -372,6 +372,44 @@ class _BadRequest(Exception):
     pass
 
 
+def _http_fastpath():
+    """The C extension when the native HTTP serving loop should run:
+    built, not killed (`WEED_FASTPATH_HTTP=0`, checked per connection so
+    tests can flip it live), and new enough to carry the HTTP entry
+    points — a stale prebuilt .so without them silently keeps the
+    Python loop instead of crashing mid-accept."""
+    if os.environ.get("WEED_FASTPATH_HTTP", "1") == "0":
+        return None
+    from seaweedfs_tpu import native
+    fp = native.fastpath()
+    if fp is not None and hasattr(fp, "http_read_request"):
+        return fp
+    return None
+
+
+class _NativeReader:
+    """BufferedReader shim over the C fastpath connection buffer:
+    readline()/read() delegate to the extension, so the Python body
+    readers (BodyReader/ChunkedBodyReader) framing through this object
+    can never desync from the bytes the C parser has already
+    buffered."""
+
+    __slots__ = ("_fp", "_ctx")
+
+    def __init__(self, fp, ctx):
+        self._fp = fp
+        self._ctx = ctx
+
+    def readline(self, limit: int = -1) -> bytes:
+        return self._fp.http_readline(self._ctx, limit)
+
+    def read(self, n: int = -1) -> bytes:
+        return self._fp.http_read(self._ctx, n)
+
+    def close(self) -> None:
+        pass  # the capsule owns the buffer; the socket owns the fd
+
+
 class HttpServer:
     """Routes are (method, path_prefix) -> handler; longest prefix wins,
     and `exact=True` routes match only the full path (they sort ahead of
@@ -410,6 +448,12 @@ class HttpServer:
         # keep-alive sockets see a real FIN instead of a dead peer
         self._conns: set[socket.socket] = set()
         self._conns_lock = locks.Lock("HttpServer._conns_lock")
+        # combined parse -> route -> serve hook for the native loop:
+        # when set, called as fast_lane(method, target, headers, remote)
+        # for body-less GET/HEAD requests before the generic parse +
+        # dispatch; returning None falls through to the normal path.
+        # The volume server installs its hot-GET needle lane here.
+        self.fast_lane: "Callable[[str, str, CIDict, str], Response | None] | None" = None
 
     def route(self, method: str, prefix: str, handler: Handler,
               exact: bool = False, stream_body: bool = False) -> None:
@@ -529,6 +573,17 @@ class HttpServer:
                              daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket, addr) -> None:
+        """Per-connection entry: the native C loop when the fastpath
+        extension carries the HTTP entry points (kill switch:
+        WEED_FASTPATH_HTTP=0), else the pure-Python loop.  Both produce
+        byte-identical responses — pinned by tests/test_http_native.py."""
+        fp = _http_fastpath()
+        if fp is not None:
+            self._serve_conn_native(conn, addr, fp)
+        else:
+            self._serve_conn_py(conn, addr)
+
+    def _serve_conn_py(self, conn: socket.socket, addr) -> None:
         rf = conn.makefile("rb", buffering=64 << 10)
         try:
             while not self._stop.is_set():
@@ -603,6 +658,196 @@ class HttpServer:
             except OSError:
                 pass
 
+    def _serve_conn_native(self, conn: socket.socket, addr, fp) -> None:
+        """The C serving loop: one fp.http_read_request call per request
+        head, one fp.http_write_response per response, with the GIL
+        released around every recv/send.  Control flow mirrors
+        _serve_conn_py exactly — same dispatch, faults gate, unread-body
+        drain, and teardown — and chunked/streamed bodies ride the
+        Python readers over _NativeReader, so StreamBody/FileRegion/
+        sendfile serving is untouched."""
+        ctx = fp.conn_new(conn.fileno())
+        rf = _NativeReader(fp, ctx)
+        remote = addr[0] if addr else ""
+        try:
+            while not self._stop.is_set():
+                try:
+                    tup = fp.http_read_request(ctx, CIDict, _MAX_LINE,
+                                               _MAX_HEADERS)
+                except ValueError as e:
+                    # the C parser raises _BadRequest's exact messages
+                    self._emit_native(
+                        fp, ctx, conn, "GET",
+                        Response.error(str(e) or "bad request", 400),
+                        close=True)
+                    return
+                if tup is None:       # clean EOF between requests
+                    return
+                method, target, version, headers = tup
+                # combined parse -> route -> serve fast lane (volume hot
+                # GETs): body-less, no Expect handshake, no fault plans
+                # pending — anything else takes the generic path below
+                fl = self.fast_lane
+                if (fl is not None and not faults.ACTIVE
+                        and method in ("GET", "HEAD")
+                        and "content-length" not in headers
+                        and "transfer-encoding" not in headers
+                        and "expect" not in headers):
+                    resp = fl(method, target, headers, remote)
+                    if resp is not None:
+                        close = self._should_close(version, headers)
+                        try:
+                            try:
+                                self._emit_native(fp, ctx, conn, method,
+                                                  resp, close)
+                            except (BrokenPipeError,
+                                    ConnectionResetError, OSError):
+                                return
+                        finally:
+                            if isinstance(resp.body, FileRegion):
+                                resp.body.close()
+                        if close:
+                            return
+                        resp = None  # noqa: F841
+                        continue
+                try:
+                    req, close = self._finish_request_native(
+                        fp, ctx, rf, conn, addr, method, target, version,
+                        headers)
+                except _BadRequest as e:
+                    self._emit_native(
+                        fp, ctx, conn, "GET",
+                        Response.error(str(e) or "bad request", 400),
+                        close=True)
+                    return
+                resp = self._dispatch(req)
+                unread = req.body_stream is not None \
+                    and not req.body_stream.done
+                if unread:
+                    try:
+                        unread = not req.body_stream.drain(1 << 20)
+                    except (_BadRequest, OSError, ConnectionError):
+                        unread = True
+                    if unread:
+                        close = True
+                try:
+                    if faults.ACTIVE and self._serve_fault(conn, req,
+                                                           resp):
+                        return        # injected mid-body reset
+                    try:
+                        self._emit_native(fp, ctx, conn, req.method,
+                                          resp, close)
+                    except (BrokenPipeError, ConnectionResetError,
+                            OSError):
+                        return
+                finally:
+                    if isinstance(resp.body, FileRegion):
+                        resp.body.close()
+                if unread:
+                    # same FIN + bounded-drain discipline as the Python
+                    # loop (see _serve_conn_py)
+                    try:
+                        conn.shutdown(socket.SHUT_WR)
+                        conn.settimeout(1.0)  # weedlint: disable=WL060
+                        drained = 0
+                        while drained < (8 << 20):
+                            piece = conn.recv(64 << 10)
+                            if not piece:
+                                break
+                            drained += len(piece)
+                    except OSError:
+                        pass
+                    return
+                if close:
+                    return
+                # keep-alive: drop refs before parking in the C recv
+                req = resp = None  # noqa: F841
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _finish_request_native(self, fp, ctx, rf, conn, addr, method,
+                               target, version, headers
+                               ) -> "tuple[Request, bool]":
+        """Body framing + Request construction for a C-parsed head —
+        the second half of _read_request, sharing its exact semantics
+        (Expect handshake, route match, chunked/stream readers)."""
+        if headers.get("Expect", "").lower() == "100-continue":
+            conn.sendall(b"HTTP/1.1 100 Continue\r\n\r\n")
+        if (target.startswith("/") and not target.startswith("//")
+                and "?" not in target and "#" not in target):
+            # urlsplit is pure overhead here: a rootful target with no
+            # query and no fragment IS the path (urlsplit can't find a
+            # scheme or netloc in it, and parse_qs("") is {}) — pinned
+            # against urlsplit by the parity corpus
+            path: str = target
+            query: dict[str, list[str]] = {}
+        else:
+            parsed = urllib.parse.urlsplit(target)
+            path = parsed.path
+            query = urllib.parse.parse_qs(parsed.query,
+                                          keep_blank_values=True)
+        handler, streams = self._match(method, path)
+        body = b""
+        body_stream = None
+        content_length = 0
+        te = headers.get("Transfer-Encoding", "").lower()
+        if "chunked" in te:
+            content_length = -1
+            if streams:
+                body_stream = ChunkedBodyReader(rf)
+            else:
+                body = self._read_chunked(rf)
+        else:
+            try:
+                length = int(headers.get("Content-Length") or 0)
+            except ValueError:
+                raise _BadRequest("bad Content-Length") from None
+            content_length = length
+            if length:
+                if streams:
+                    body_stream = BodyReader(rf, length)
+                elif length > 0:
+                    try:
+                        body = fp.http_read_body(ctx, length)
+                    except ValueError:
+                        raise _BadRequest("truncated body") from None
+                else:
+                    # negative Content-Length reads to EOF, matching
+                    # BufferedReader.read(negative) in the Python loop
+                    body = rf.read(length)
+        req = Request(
+            method=method, path=path, query=query,
+            headers=headers, body=body, remote_addr=addr[0],
+            body_stream=body_stream, content_length=content_length,
+            handler=handler)
+        return req, self._should_close(version, headers)
+
+    @classmethod
+    def _emit_native(cls, fp, ctx, conn, method: str, resp: Response,
+                     close: bool) -> None:
+        """_emit's native twin: the SAME _build_head bytes (parity by
+        construction) pushed through one gathered writev; streaming
+        shapes delegate to the shared region/stream emitters."""
+        head = cls._build_head(resp, close)
+        body = resp.body
+        if method == "HEAD" or not _body_len(body):
+            fp.http_write_response(ctx, head, b"")
+            return
+        if isinstance(body, FileRegion):
+            cls._emit_region(conn, head, body)
+            return
+        if isinstance(body, StreamBody):
+            cls._emit_stream(conn, head, body)
+            return
+        fp.http_write_response(ctx, head, body)
+
     def _read_request(self, rf, conn, addr
                       ) -> "tuple[Request | None, bool]":
         """Parse one request off the buffered reader -> (request,
@@ -635,7 +880,11 @@ class HttpServer:
             k, sep, v = h.partition(b":")
             if not sep:
                 raise _BadRequest("malformed header")
-            headers[k.decode("latin-1").strip()] = \
+            # bytes-level strip for the NAME too (it used to be
+            # str.strip after decode, which also ate unicode whitespace
+            # like latin-1 0x85/0xA0 — the C parser strips ASCII
+            # whitespace only, and the two must agree byte for byte)
+            headers[k.strip().decode("latin-1")] = \
                 v.strip().decode("latin-1")
         else:
             raise _BadRequest("too many headers")
@@ -679,11 +928,14 @@ class HttpServer:
             headers=headers, body=body, remote_addr=addr[0],
             body_stream=body_stream, content_length=content_length,
             handler=handler)
+        return req, self._should_close(version, headers)
+
+    @staticmethod
+    def _should_close(version: bytes, headers: CIDict) -> bool:
+        """Keep-alive decision, shared by the Python and native loops."""
         conn_hdr = headers.get("Connection", "").lower()
-        close = (conn_hdr == "close"
-                 or (version == b"HTTP/1.0"
-                     and conn_hdr != "keep-alive"))
-        return req, close
+        return (conn_hdr == "close"
+                or (version == b"HTTP/1.0" and conn_hdr != "keep-alive"))
 
     @staticmethod
     def _read_chunked(rf) -> bytes:

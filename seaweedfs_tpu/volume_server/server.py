@@ -38,7 +38,8 @@ from ..storage.types import FileId
 from ..storage.volume import NotFoundError, volume_file_name
 from ..util import tracing
 from ..util.http import (FileRegion, HttpServer, Request, Response,
-                         _body_len, http_request, parse_byte_range)
+                         _BadRequest, _body_len, http_request,
+                         parse_byte_range)
 
 from ..util.weedlog import logger
 
@@ -312,7 +313,41 @@ class VolumeServer:
             # snapshot through this before pushing the merged payload
             self.http.route("POST", "/heartbeat_now",
                             self._http_heartbeat_now, exact=True)
-        self.http.route("*", "/", self._http_data)
+        # keep THE bound method the route table holds: the fast lane
+        # recognizes the data route by identity, and `self._http_data`
+        # builds a fresh bound-method object on every attribute access
+        self._data_route = self._http_data
+        self.http.route("*", "/", self._data_route)
+        # native-loop fast lane: hot body-less GET/HEADs skip the
+        # generic parse + dispatch (util/http.py _serve_conn_native)
+        self.http.fast_lane = self._http_fast_lane
+
+    def _http_fast_lane(self, method: str, target: str, headers,
+                        remote: str) -> "Response | None":
+        """Combined parse -> route -> serve lane for the native HTTP
+        loop: the volume GET/HEAD hot path with the wire work already
+        done in C.  Returns None to fall back to the generic loop —
+        anything that needs urlsplit (query strings), tracing scopes, or
+        a non-data route takes the normal path, so responses stay
+        byte-identical by construction.  The JWT gate (write-only) and
+        the needle-cache probe stay in Python inside _read_needle."""
+        if tracing.enabled() or "?" in target or "#" in target \
+                or not target.startswith("/") or target.startswith("//"):
+            return None
+        handler, _streams = self.http._match(method, target)
+        if handler is not self._data_route:
+            return None     # /status, /metrics, /debug/*: generic path
+        req = Request(method=method, path=target, query={},
+                      headers=headers, body=b"", remote_addr=remote,
+                      handler=handler)
+        # exactly _dispatch's untraced wrapping around the same handler:
+        # error accounting and heat recording happen inside _http_data
+        try:
+            return self._http_data(req)
+        except _BadRequest as e:
+            return Response.error(str(e) or "bad request", 400)
+        except Exception as e:
+            return Response.error(f"{type(e).__name__}: {e}")
 
     def _http_heartbeat_now(self, req: Request) -> Response:
         self.heartbeat_now(timeout=3.0)

@@ -24,6 +24,10 @@ def pytest_configure(config):
         "markers",
         "tpu: opt-in byte-identity gate on the REAL TPU chip "
         "(SEAWEED_TEST_TPU=1; see tests/test_real_tpu.py)")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (-m 'not slow'); run "
+        "explicitly with -m slow")
 
 
 def pytest_collection_modifyitems(config, items):

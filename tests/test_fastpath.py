@@ -13,10 +13,14 @@ import pytest
 from seaweedfs_tpu import native
 
 fp = native.fastpath()
-pytestmark = pytest.mark.skipif(fp is None,
-                                reason="native fastpath unavailable")
+# Per-test (not module-level) skip: with WEED_FASTPATH=0 the C-only
+# tests skip but the needle tests still run and exercise the pure-Python
+# fallbacks — that's the second leg of tools/check.sh's dual run.
+needs_fp = pytest.mark.skipif(fp is None,
+                              reason="native fastpath unavailable")
 
 
+@needs_fp
 def test_frame_roundtrip_against_python_codec():
     """C client request <-> Python server codec, and vice versa."""
     from seaweedfs_tpu.volume_server import tcp as t
@@ -51,6 +55,7 @@ def test_frame_roundtrip_against_python_codec():
         b.close()
 
 
+@needs_fp
 def test_frame_oversize_raises_value_error():
     a, b = socket.socketpair()
     try:
@@ -135,3 +140,250 @@ def test_needle_data_crc_corruption_detected(tmp_path):
                             nv.offset + 20)
     with pytest.raises(CrcError):
         v.read_needle_data(0x41, 3)
+
+
+# -- HTTP parser parity ------------------------------------------------------
+# The C request parser (http_read_request) against the authoritative
+# pure-Python parser (HttpServer._read_request), differential-style:
+# every corpus entry runs through BOTH and the outcomes must match
+# exactly — parsed fields, close decision, and _BadRequest messages.
+
+import io  # noqa: E402
+import random  # noqa: E402
+import urllib.parse  # noqa: E402
+
+from seaweedfs_tpu.util import http as H  # noqa: E402
+
+
+class _DummyConn:
+    """Captures the Expect: 100-continue interim the parser sends."""
+
+    def __init__(self):
+        self.sent = b""
+
+    def sendall(self, b):
+        self.sent += b
+
+
+@pytest.fixture(scope="module")
+def _srv():
+    s = H.HttpServer()
+    yield s
+    s.stop()
+
+
+def _c_parse(raw: bytes):
+    """-> ('eof', None) | ('ok', (method, target, version, headers))
+    | ('err', message) from the C parser over a real socket."""
+    a, b = socket.socketpair()
+    try:
+        w = threading.Thread(target=lambda: (a.sendall(raw),
+                                             a.shutdown(socket.SHUT_WR)))
+        w.start()
+        ctx = fp.conn_new(b.fileno())
+        try:
+            tup = fp.http_read_request(ctx, H.CIDict, H._MAX_LINE,
+                                       H._MAX_HEADERS)
+        except ValueError as e:
+            return ("err", str(e))
+        finally:
+            w.join()
+        return ("eof", None) if tup is None else ("ok", tup)
+    finally:
+        a.close()
+        b.close()
+
+
+def _py_parse(srv, raw: bytes):
+    """Same outcomes via the pure-Python loop's parser."""
+    rf = io.BytesIO(raw)
+    conn = _DummyConn()
+    try:
+        req, close = srv._read_request(rf, conn, ("1.2.3.4", 0))
+    except H._BadRequest as e:
+        return ("err", str(e))
+    if req is None:
+        return ("eof", None)
+    return ("ok", (req, close))
+
+
+def _assert_parity(srv, raw: bytes):
+    ckind, cval = _c_parse(raw)
+    pkind, pval = _py_parse(srv, raw)
+    assert ckind == pkind, (raw, ckind, cval, pkind, pval)
+    if ckind != "ok":
+        assert cval == pval, (raw, cval, pval)
+        return
+    method, target, version, headers = cval
+    req, close = pval
+    assert method == req.method, raw
+    assert headers == req.headers, raw
+    parsed = urllib.parse.urlsplit(target)
+    assert parsed.path == req.path, raw
+    assert urllib.parse.parse_qs(parsed.query,
+                                 keep_blank_values=True) == req.query, raw
+    assert H.HttpServer._should_close(version, headers) == close, raw
+
+
+@needs_fp
+def test_http_parse_parity_handcrafted(_srv):
+    cases = [
+        b"",                                     # clean EOF
+        b"\r\n",                                 # stray CRLF then EOF
+        b"\r\nGET / HTTP/1.1\r\n\r\n",           # stray CRLF skipped once
+        b"\r\n\r\nGET / HTTP/1.1\r\n\r\n",       # TWO strays: malformed
+        b"GET / HTTP/1.1\r\n\r\n",
+        b"GET / HTTP/1.1\n\n",                   # bare-LF line endings
+        b"GET  /x \t HTTP/1.1 \r\n\r\n",         # multi-space split
+        b"get /lower http/1.0\r\n\r\n",          # HTTP/1.0 close default
+        b"GET /x HTTP/1.0\r\nConnection: keep-alive\r\n\r\n",
+        b"GET /x HTTP/1.1\r\nConnection: CLOSE\r\n\r\n",
+        b"GET /q?a=1&b=&c=%20 HTTP/1.1\r\n\r\n",  # query + blank + quoted
+        b"GET http://h/p HTTP/1.1\r\n\r\n",       # absolute-form target
+        b"GET //double HTTP/1.1\r\n\r\n",         # netloc-looking target
+        b"GET /frag#f HTTP/1.1\r\n\r\n",
+        b"GET / HTTP/1.1\r\nX: 1\r\nX: 2\r\nx: 3\r\n\r\n",  # dup: last wins
+        b"GET / HTTP/1.1\r\n  Name\t : \t v1 \r\n\r\n",     # ws stripping
+        b"GET / HTTP/1.1\r\nEmpty:\r\n\r\n",
+        b"GET / HTTP/1.1\r\n: novalue\r\n\r\n",   # empty name
+        b"GET / HTTP/1.1\r\nNoColon\r\n\r\n",     # malformed header
+        b"GET /\r\n\r\n",                         # two-token request line
+        b"GET\r\n\r\n",                           # one token
+        b"   \r\n\r\n",
+        b"GET / HTTP/1.1",                        # EOF before headers
+        b"GET / HTTP/1.1\r\nPartial: yes",        # EOF mid-headers
+        b"GET / HTTP/1.1\r\nExpect: 100-Continue\r\n\r\n",
+        b"G" * (H._MAX_LINE + 1) + b"\r\n\r\n",   # oversized request line
+        b"GET / HTTP/1.1\r\nBig: " + b"v" * H._MAX_LINE + b"\r\n\r\n",
+        b"GET / HTTP/1.1\r\n"
+        + b"".join(b"H%d: x\r\n" % i for i in range(H._MAX_HEADERS))
+        + b"\r\n",                                # exactly max headers
+        b"GET / HTTP/1.1\r\n"
+        + b"".join(b"H%d: x\r\n" % i
+                   for i in range(H._MAX_HEADERS + 1))
+        + b"\r\n",                                # one too many
+        # latin-1 high bytes in names and values (0x85/0xA0 are unicode
+        # whitespace after decode — the old str.strip divergence)
+        b"GET / HTTP/1.1\r\n\x85Nam\xe9\xa0: \xa0v\x85\r\n\r\n",
+        b"GET / HTTP/1.1\r\nK\xc0\xd7\xdf: V\xff\r\n\r\n",
+    ]
+    for raw in cases:
+        _assert_parity(_srv, raw)
+
+
+@needs_fp
+def test_http_parse_parity_all_256_name_bytes(_srv):
+    """Exhaustive lat1_lower + strip pin: every byte value embedded in a
+    header name must lowercase/strip exactly like the Python parser
+    (str.lower over latin-1, bytes-level whitespace strip)."""
+    for c in range(256):
+        if c in (0x0A, 0x0D) or c == ord(":"):
+            continue  # would change line/field framing
+        raw = (b"GET / HTTP/1.1\r\nA" + bytes([c]) + b"Z: val\r\n"
+               + b"V: x" + bytes([c]) + b"\r\n\r\n")
+        _assert_parity(_srv, raw)
+
+
+@needs_fp
+def test_http_parse_parity_fuzz(_srv):
+    """Seeded fuzz corpus: random token/whitespace/header soup, valid
+    and malformed alike — both parsers must agree on every byte."""
+    rng = random.Random(0xBEEF)
+    ws = [b" ", b"\t", b"\v", b"\f", b"  ", b" \t "]
+    methods = [b"GET", b"HEAD", b"PUT", b"X-CUSTOM", b"g\xe9t", b""]
+    targets = [b"/", b"/a,b", b"/q?x=1&y=%41;z", b"/\xff\x80", b"*",
+               b"//net/loc", b"/p#frag", b"/deep/a/b/c.ext", b""]
+    versions = [b"HTTP/1.1", b"HTTP/1.0", b"HTTP/9.9", b"junk", b""]
+    names = [b"Host", b"X-Thing", b"ACCEPT", b"\xc0key", b"k\x85y",
+             b"", b" ", b"a:b"]
+    values = [b"v", b"", b" padded ", b"\xa0nbsp\xa0", b"x" * 300,
+              b"multi word value", b"\x85"]
+    for _ in range(300):
+        parts = [rng.choice(methods), rng.choice(ws),
+                 rng.choice(targets), rng.choice(ws),
+                 rng.choice(versions)]
+        line = b"".join(parts) + rng.choice([b"\r\n", b"\n"])
+        hdrs = b""
+        for _h in range(rng.randrange(0, 5)):
+            hdrs += (rng.choice(names) + rng.choice([b":", b""])
+                     + rng.choice(values)
+                     + rng.choice([b"\r\n", b"\n"]))
+        raw = line + hdrs + rng.choice([b"\r\n", b"\n", b""])
+        if rng.random() < 0.2:  # truncate: EOF mid-parse
+            raw = raw[:rng.randrange(0, len(raw) + 1)]
+        _assert_parity(_srv, raw)
+
+
+@needs_fp
+def test_http_reader_shim_matches_buffered_reader():
+    """http_readline/http_read (the _NativeReader shim the chunked and
+    streamed body readers run on) against io.BytesIO semantics."""
+    rng = random.Random(7)
+    blob = bytes(rng.randrange(256) for _ in range(5000))
+    blob = blob.replace(b"\n", b"x") + b"\n" + blob + b"\nend"
+    ops = []
+    for _ in range(60):
+        if rng.random() < 0.5:
+            ops.append(("readline", rng.choice([-1, 0, 1, 5, 64, 100000])))
+        else:
+            ops.append(("read", rng.choice([0, 1, 7, 512, 100000])))
+    ops.append(("read", -1))  # drain to EOF
+
+    a, b = socket.socketpair()
+    try:
+        w = threading.Thread(target=lambda: (a.sendall(blob),
+                                             a.shutdown(socket.SHUT_WR)))
+        w.start()
+        ctx = fp.conn_new(b.fileno())
+        ref = io.BytesIO(blob)
+        for op, arg in ops:
+            if op == "readline":
+                got = fp.http_readline(ctx, arg)
+                want = ref.readline(arg if arg >= 0 else -1)
+            else:
+                got = fp.http_read(ctx, arg)
+                want = ref.read(arg if arg >= 0 else -1)
+            assert got == want, (op, arg)
+        w.join()
+    finally:
+        a.close()
+        b.close()
+
+
+@needs_fp
+def test_http_write_response_bytes_on_wire():
+    a, b = socket.socketpair()
+    try:
+        ctx = fp.conn_new(a.fileno())
+        head = bytearray(b"HTTP/1.1 200 OK\r\nContent-Length: 5\r\n\r\n")
+        fp.http_write_response(ctx, head, b"hello")
+        fp.http_write_response(ctx, bytearray(b"H2\r\n\r\n"), b"")
+        a.shutdown(socket.SHUT_WR)
+        out = b""
+        while True:
+            p = b.recv(65536)
+            if not p:
+                break
+            out += p
+        assert out == bytes(head) + b"hello" + b"H2\r\n\r\n"
+    finally:
+        a.close()
+        b.close()
+
+
+@needs_fp
+def test_http_read_body_exact_and_truncated():
+    a, b = socket.socketpair()
+    try:
+        ctx = fp.conn_new(b.fileno())
+        a.sendall(b"GET / HTTP/1.1\r\n\r\nBODYBYTES-tail")
+        m, t, v, h = fp.http_read_request(ctx, H.CIDict, H._MAX_LINE,
+                                          H._MAX_HEADERS)
+        assert (m, t, v, dict(h)) == ("GET", "/", b"HTTP/1.1", {})
+        assert fp.http_read_body(ctx, 9) == b"BODYBYTES"
+        a.shutdown(socket.SHUT_WR)
+        with pytest.raises(ValueError, match="truncated body"):
+            fp.http_read_body(ctx, 50)
+    finally:
+        a.close()
+        b.close()

@@ -39,6 +39,28 @@ else
     echo "ruff not installed; skipping (config lives in pyproject.toml)"
 fi
 
+echo "== fastpath.c (-Wall -Wextra -Werror) =="
+# stricter than the runtime builder's -O2: any warning in the C fast
+# path fails the gate
+if command -v gcc >/dev/null 2>&1; then
+    PYINC="$(python -c 'import sysconfig; print(sysconfig.get_paths()["include"])')"
+    FP_SO="$(mktemp /tmp/fp_gate_XXXXXX.so)"
+    gcc -O2 -Wall -Wextra -Werror -shared -fPIC -I"$PYINC" \
+        seaweedfs_tpu/native/fastpath.c -o "$FP_SO" || rc=1
+    rm -f "$FP_SO"
+else
+    echo "gcc not installed; skipping"
+fi
+
+echo "== fastpath tests (C path + pure-Python fallbacks) =="
+# twice on purpose: once through the C extension, once with
+# WEED_FASTPATH=0 so every pure-Python fallback keeps earning its
+# parity (the kill switch must stay a real escape hatch, not rot)
+JAX_PLATFORMS=cpu python -m pytest tests/test_fastpath.py tests/test_http_native.py \
+    -q -p no:cacheprovider -p no:randomly || rc=1
+JAX_PLATFORMS=cpu WEED_FASTPATH=0 python -m pytest tests/test_fastpath.py tests/test_http_native.py \
+    -q -p no:cacheprovider -p no:randomly || rc=1
+
 if [ "$rc" -eq 0 ]; then
     echo "check.sh: all gates green"
 else
