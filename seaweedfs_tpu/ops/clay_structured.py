@@ -35,6 +35,7 @@ under shard_map for multi-chip hosts (parallel/mesh_codec wiring).
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
@@ -166,13 +167,15 @@ def _layer_mds_matmul(k: int, m: int, u, k0: int):
     if not on_tpu:
         return rs_jax.gf_matmul_bits(jnp.asarray(_r_bits(k, m)), u,
                                      dot_dtype=jnp.int8)
-    block = 8 * rs_pallas.SM_DEFAULT_BLOCK_B
+    block_b = rs_pallas.sm_block_b_for(k0, m)   # geometry-aware tile
+    block = 8 * block_b
     pad = (-n) % block
     if pad:
         u = jnp.pad(u, ((0, 0), (0, pad)))
     sm = u.reshape(k0, 8, -1)   # device relayout: one HBM-speed copy
     out = rs_pallas.gf_matmul_bits_pallas_sm(
-        jnp.asarray(_r_bits_plane_major(k, m), dtype=jnp.int8), sm)
+        jnp.asarray(_r_bits_plane_major(k, m), dtype=jnp.int8), sm,
+        block_b=block_b)
     out = out.reshape(m, -1)
     return out[:, :n] if pad else out
 
@@ -187,6 +190,32 @@ def _use_pallas_engine() -> bool:
     return _tpu_available() and ec_backend_override() != "jax"
 
 
+def fused_mode() -> str:
+    """WEED_CLAY_FUSED: ''/'auto' follow _use_pallas_engine(); '0'/'off'
+    pin the tiled path (kill switch); 'interpret' forces the fused
+    kernels through the Pallas interpreter — the CPU/tier-1 handle that
+    makes the fused branch end-to-end testable without a chip."""
+    v = os.environ.get("WEED_CLAY_FUSED", "").strip().lower()
+    if v in ("", "auto"):
+        return "auto"
+    if v in ("0", "off"):
+        return "off"
+    if v == "interpret":
+        return "interpret"
+    raise ValueError(f"WEED_CLAY_FUSED={v!r} (want auto/off/interpret)")
+
+
+def use_fused_engine() -> bool:
+    """Gate for the fused clay kernels (encode_device_fused /
+    repair_device_fused running the real VMEM-resident pallas_call)."""
+    mode = fused_mode()
+    if mode == "off":
+        return False
+    if mode == "interpret":
+        return True
+    return _use_pallas_engine()
+
+
 def _layer_mds_matmul_cols(k: int, m: int, u, k0: int):
     """u [k0, X, 128] -> [m, X, 128] — the column-tiled engine for the
     relayout-free path (rs_pallas.gf_matmul_bits_pallas_cols consumes
@@ -199,7 +228,7 @@ def _layer_mds_matmul_cols(k: int, m: int, u, k0: int):
     from . import rs_jax, rs_pallas
     if _use_pallas_engine():
         x = u.shape[1]
-        vblock = rs_pallas.COLS_DEFAULT_VBLOCK
+        vblock = rs_pallas.cols_vblock_for(k0, m)   # geometry-aware tile
         pad = (-x) % vblock
         if pad:
             u = jnp.pad(u, ((0, 0), (0, pad), (0, 0)))
@@ -313,6 +342,119 @@ def encode_device_tiled(k: int, m: int, data5, *, small: int):
     return c_par.reshape(m, n_win, alpha, w_i, inner)
 
 
+def fused_shape(k: int, m: int, w: int, small: int) -> "tuple | None":
+    """The 4D view [k, n_win, alpha, w_a] of a [k, w] volume slab the
+    fused kernel consumes — a FREE reshape for contiguous host arrays
+    (unlike the 5D<->4D merge on DEVICE arrays, which is a real tile
+    relayout; fused callers must build this view host-side).  None when
+    the window is too narrow for the 128-lane tile."""
+    c = code(k, m)
+    w_a = small // c.alpha
+    if small % c.alpha != 0 or w_a % 128 != 0 or w % small != 0:
+        return None
+    return (k, w // small, c.alpha, w_a)
+
+
+def encode_device_fused(k: int, m: int, data4, *, small: int):
+    """Structured clay encode through the FUSED Pallas kernel: uncouple
+    + layer-MDS + couple per batch tile without leaving VMEM.
+
+    data4 [k, n_win, alpha, w_a] uint8 (fused_shape's host-free view of
+    the natural [k, W] slab) -> parity [m, n_win, alpha, w_a].
+
+    The tiled path streams the uncoupled operand through HBM (write+read
+    of k0 rows — including the virtual zero rows of the shortened
+    construction) plus an uncoupled-parity round trip: ~(k+2k0+3m)/k
+    bytes of HBM traffic per data byte.  Fused, HBM sees data in and
+    parity out only ((k+m)/k), and the zero rows exist solely as
+    register zeros inside the kernel.  When the fused gate is off (no
+    TPU and not interpret-pinned) this falls back to the tiled path so
+    CPU executors and shard_map dryruns keep working."""
+    import jax.numpy as jnp
+
+    from . import rs_pallas
+    c = code(k, m)
+    alpha = c.alpha
+    kk, n_win, a, w_a = data4.shape
+    assert (kk, a) == (k, alpha), data4.shape
+    if not use_fused_engine():
+        out5 = encode_device_tiled(
+            k, m, data4.reshape(k, n_win, alpha, w_a // 128, 128),
+            small=small)
+        return out5.reshape(m, n_win, alpha, w_a)
+    return rs_pallas.clay_fused_encode_pallas(
+        jnp.asarray(_r_bits_plane_major(k, m), dtype=jnp.int8), data4,
+        q=c.q, t=c.t, gamma=GAMMA, det_inv=int(c._det_inv),
+        cb=rs_pallas.clay_fused_cb_for(alpha, w_a),
+        interpret=(fused_mode() == "interpret"))
+
+
+# -- fused single-loss repair ----------------------------------------------
+
+@functools.lru_cache(maxsize=32)
+def repair_parts(k: int, m: int, lost: int) -> tuple:
+    """Static pieces of the structured single-loss repair for external
+    node `lost`: (helpers, plane, R_r, inv_gamma).
+
+    helpers: the k+m-1 surviving external ids ascending (the read set —
+    each contributes its beta repair-plane cells).  plane: the beta
+    layer indices z ascending with digit(z, y0) == x0 (the lost node's
+    repair plane).  R_r [q, k0]: per-plane solve matrix — with exactly
+    one node lost the unknown uncoupled cells of a repair-plane layer
+    are EXACTLY the lost node's grid row y0 (its q members' companions
+    all live on the lost node), so known = the k0 other internal nodes
+    and R_r = gen[row y0] @ inv(gen[known]) (same solve the oracle's
+    _solve_layer performs).  inv_gamma: 1/γ for the out-of-plane
+    back-substitution."""
+    c = code(k, m)
+    q, t, n0 = c.q, c.t, c.n0
+    lost_int = lost if lost < k else n0 - m + (lost - k)
+    x0, y0 = c._xy(lost_int)
+    helpers = tuple(e for e in range(k + m) if e != lost)
+    plane = tuple(z for z in range(c.alpha) if c._digit(z, y0) == x0)
+    assert len(plane) == c.beta
+    unknown = [c._node(x, y0) for x in range(q)]
+    known = sorted(set(range(n0)) - set(unknown))
+    assert len(known) == c.k0
+    R_r = gf256.matmul(c.gen[unknown], gf256.mat_inv(c.gen[known]))
+    inv_gamma = int(gf256.inv(np.uint8(GAMMA)))
+    return helpers, plane, R_r, inv_gamma
+
+
+@functools.lru_cache(maxsize=32)
+def _repair_bits_plane_major(k: int, m: int, lost: int) -> np.ndarray:
+    """repair_parts' R_r in the plane-major bit form the fused repair
+    kernel consumes (numpy: see _r_bits)."""
+    from . import rs_matrix, rs_pallas
+    c = code(k, m)
+    _, _, R_r, _ = repair_parts(k, m, lost)
+    return rs_pallas.to_plane_major(
+        rs_matrix.bit_matrix(np.ascontiguousarray(R_r)), c.q, c.k0)
+
+
+def repair_device_fused(k: int, m: int, lost: int, x4):
+    """Fused single-loss clay repair: x4 [H, n_win, beta, w_a] uint8 —
+    helper-major (repair_parts' helpers order), plane layers ascending —
+    -> the lost shard's windows [n_win, alpha, w_a] in the natural
+    layer-major layout.  Uncouple of the known rows, the [q, k0] row
+    solve, and the out-of-plane back-substitution all stay in VMEM.
+    Callers must check use_fused_engine() — there is no XLA fallback
+    for this entry (the tiled/flat repair paths cover that)."""
+    import jax.numpy as jnp
+
+    from . import rs_pallas
+    c = code(k, m)
+    h, n_win, beta, w_a = x4.shape
+    assert (h, beta) == (k + m - 1, c.beta), x4.shape
+    _, _, _, inv_gamma = repair_parts(k, m, lost)
+    return rs_pallas.clay_fused_repair_pallas(
+        jnp.asarray(_repair_bits_plane_major(k, m, lost), dtype=jnp.int8),
+        x4, k=k, q=c.q, t=c.t, lost=lost, gamma=GAMMA,
+        inv_gamma=inv_gamma,
+        cb=rs_pallas.clay_fused_cb_for(beta, w_a),
+        interpret=(fused_mode() == "interpret"))
+
+
 def encode_device(k: int, m: int, data, *, small: int):
     """Jittable structured encode over raw window bytes.
 
@@ -330,6 +472,12 @@ def encode_device(k: int, m: int, data, *, small: int):
     alpha, k0, q, t = c.alpha, c.k0, c.q, c.t
     w = data.shape[-1]
     n_win, w_a = w // small, small // alpha
+    shape4 = fused_shape(k, m, w, small)
+    if shape4 is not None and use_fused_engine():
+        # the in-jit [k, W] <-> 4D reshapes are device copies; hot
+        # callers build the 4D view host-side and call the fused entry
+        return encode_device_fused(
+            k, m, data.reshape(shape4), small=small).reshape(m, w)
     shape5 = tiled_shape(k, m, w, small)
     if shape5 is not None:
         return encode_device_tiled(

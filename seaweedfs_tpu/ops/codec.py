@@ -72,11 +72,26 @@ class _CodecMetrics:
             "seaweedfs_codec_bytes_total",
             "payload bytes processed by the EC codec",
             ["backend", "op"])
+        # each dispatch pays the fixed issue cost (h2d transfer setup,
+        # kernel launch — ~60-100ms over a tunneled link), so
+        # volumes_total / dispatch_total IS the fleet-encode batch
+        # amortization factor, scrapeable at /metrics.  Labels are the
+        # same bounded (backend, op) set as the histograms (WL140).
+        self.dispatch = self.registry.counter(
+            "seaweedfs_codec_dispatch_total",
+            "EC codec dispatches (one backend call each)",
+            ["backend", "op"])
+        self.dispatch_volumes = self.registry.counter(
+            "seaweedfs_codec_dispatch_volumes_total",
+            "volumes carried by EC codec dispatches",
+            ["backend", "op"])
 
     def observe(self, backend: str, op: str, nbytes: int,
-                seconds: float) -> None:
+                seconds: float, volumes: int = 1) -> None:
         self.seconds.observe(backend, op, value=seconds)
         self.bytes.inc(backend, op, value=float(nbytes))
+        self.dispatch.inc(backend, op)
+        self.dispatch_volumes.inc(backend, op, value=float(volumes))
 
 
 def codec_metrics() -> _CodecMetrics:
@@ -88,14 +103,17 @@ def codec_metrics() -> _CodecMetrics:
     return _codec_metrics
 
 
-def metered_fetch(fetch, backend: str, op: str, nbytes: int, t0: float):
+def metered_fetch(fetch, backend: str, op: str, nbytes: int, t0: float,
+                  volumes: int = 1):
     """Wrap an async-codec fetch() so the span from issue (t0) to fetch
     completion lands in the codec histograms — the window the pipelined
-    encoder actually waits on, covering h2d transfer + kernel + d2h."""
+    encoder actually waits on, covering h2d transfer + kernel + d2h.
+    `volumes` is how many volumes this single dispatch carried (the
+    batched fleet-encode path passes >1; see _CodecMetrics.dispatch)."""
     def timed():
         out = fetch()
         codec_metrics().observe(backend, op, nbytes,
-                                time.perf_counter() - t0)
+                                time.perf_counter() - t0, volumes=volumes)
         return out
     return timed
 
@@ -290,7 +308,7 @@ class RSCodec:
     def __init__(self, data_shards: int = rs_matrix.DEFAULT_DATA_SHARDS,
                  parity_shards: int = rs_matrix.DEFAULT_PARITY_SHARDS,
                  *, kind: str = "vandermonde", backend: str = "auto",
-                 block_b: int = rs_pallas.SM_DEFAULT_BLOCK_B,
+                 block_b: "int | None" = None,
                  interpret: bool = False):
         if backend == "auto":
             override = ec_backend_override()
@@ -318,7 +336,11 @@ class RSCodec:
         self.n = data_shards + parity_shards
         self.kind = kind
         self.backend = backend
-        self.block_b = block_b
+        # default block is geometry-aware: wide stripes (k > 16) shrink
+        # the batch tile so the kernel's VMEM working set stays at the
+        # swept (16, 8)-geometry budget instead of spilling
+        self.block_b = block_b if block_b is not None \
+            else rs_pallas.sm_block_b_for(self.k, self.m)
         self.interpret = interpret
         self.gen = rs_matrix.generator_matrix(self.k, self.m, kind)
         self._parity_bits = rs_matrix.parity_bit_matrix(self.k, self.m, kind)
@@ -423,8 +445,10 @@ class RSCodec:
             fetch = self._matmul_begin(self.gen[self.k:], self.m, data)
         else:
             fetch = self._matmul_begin(self._parity_bits, self.m, data)
+        volumes = int(np.prod(data.shape[:-2], dtype=np.int64)) \
+            if data.ndim > 2 else 1
         return metered_fetch(fetch, f"rs_{self.backend}", "encode",
-                             data.nbytes, t0)
+                             data.nbytes, t0, volumes=volumes)
 
     def encode_jax(self, data: jax.Array) -> jax.Array:
         """Device-resident encode for jit/shard_map composition (jax arrays
@@ -479,8 +503,10 @@ class RSCodec:
             for row, t in enumerate(targets):
                 out[t] = np.ascontiguousarray(rec[..., row, :])
             return out
+        volumes = int(np.prod(chosen.shape[:-2], dtype=np.int64)) \
+            if chosen.ndim > 2 else 1
         return metered_fetch(fetch, f"rs_{self.backend}", "reconstruct",
-                             chosen.nbytes, t0)
+                             chosen.nbytes, t0, volumes=volumes)
 
     def verify(self, shards: list[np.ndarray]) -> bool:
         """Check parity consistency (reference enc.Verify)."""

@@ -20,6 +20,13 @@ axis.  The generator bit-matrix is permuted to match on the host
 
 One kernel serves encode *and* reconstruct — both are just
 out[MO, B] = Mbits[8MO, 8KI] ∘GF2∘ in[KI, B] with a different matrix.
+
+The clay codec additionally gets FULLY fused kernels (encode and
+single-loss repair): the companion-pair uncouple, the [m, k0] layer-MDS
+matmul and the couple stage run per batch tile entirely in VMEM, so the
+uncoupled operand never round-trips HBM and the shortened construction's
+virtual zero rows are synthesized in registers instead of being
+materialized or streamed (see _clay_fused_encode_kernel).
 """
 
 from __future__ import annotations
@@ -31,6 +38,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from . import gf256
+
+# jax renamed TPUCompilerParams -> CompilerParams across versions; accept
+# either so the kernels (and their interpret-mode tests) run on both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
 
 LANE = 128
 DEFAULT_BLOCK_B = 2048
@@ -111,7 +125,7 @@ def gf_matmul_bits_pallas(mbits_pm: jax.Array, data: jax.Array, *,
         out_specs=pl.BlockSpec((1, mo, block_b), lambda i, j: (i, 0, j),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((v, mo, b), jnp.uint8),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )(mbits_pm, data)
@@ -180,7 +194,7 @@ def gf_matmul_bits_pallas_sm(mbits_pm: jax.Array, data: jax.Array, *,
                                lambda i, j: (0, i, j),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((mo, v, b), jnp.uint8),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )(mbits_pm, data)
@@ -220,10 +234,57 @@ def gf_matmul_bits_pallas_cols(mbits_pm: jax.Array, data: jax.Array, *,
         out_specs=pl.BlockSpec((mo, vblock, LANE), lambda i: (0, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((mo, x, LANE), jnp.uint8),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(mbits_pm, data)
+
+
+def _block_vmem_bytes(ki: int, mo: int, lanes: int) -> int:
+    """VMEM bytes one grid step of the SM/cols kernel keeps live for a
+    flattened lane count of `lanes` (VB*TB): the double-buffered u8
+    operand and output blocks, the int32 unpack of the operand, the int8
+    bit-planes and the int32 accumulator.  A budget model, not an exact
+    allocator trace — it only has to scale right in ki and mo."""
+    return (2 * ki * lanes        # u8 operand block, double-buffered
+            + 4 * ki * lanes      # int32 unpack
+            + 8 * ki * lanes      # int8 planes [8*ki, lanes]
+            + 32 * mo * lanes     # int32 accumulator [8*mo, lanes]
+            + 2 * mo * lanes)     # u8 out block, double-buffered
+
+
+def sm_block_b_for(ki: int, mo: int) -> int:
+    """Geometry-aware block_b for the shard-major kernel.
+
+    ki <= 16 keeps the swept 512 (the v5e optimum measured across
+    RS(10,4)..RS(16,8), BENCH_r05) — at 8*ki <= 128 the contraction dim
+    fills at most one MXU pass and the sweep already covered the range.
+    Wider stripes (RS(28,4) class) grow every per-block tensor linearly
+    in ki, so the same block_b crowds the double-buffered operands out
+    of VMEM; halve the tile until the working set is back under the
+    swept envelope (floor 128 so a block still spans a full lane tile)."""
+    if ki <= 16:
+        return SM_DEFAULT_BLOCK_B
+    budget = _block_vmem_bytes(16, 8, SHARD_MAJOR_VBLOCK * SM_DEFAULT_BLOCK_B)
+    b = SM_DEFAULT_BLOCK_B
+    while b > 128 and _block_vmem_bytes(ki, mo, SHARD_MAJOR_VBLOCK * b) > budget:
+        b //= 2
+    return b
+
+
+def cols_vblock_for(ki: int, mo: int) -> int:
+    """vblock for the column-tiled kernel — same budget argument as
+    sm_block_b_for: ki <= 16 keeps the swept 32-sublane block (covers
+    clay k0 = 12 and every default RS geometry unchanged); wider operand
+    stacks halve it until the planes + accumulator working set fits the
+    swept envelope, floored at the u8 8-sublane granule."""
+    if ki <= 16:
+        return COLS_DEFAULT_VBLOCK
+    budget = _block_vmem_bytes(16, 8, COLS_DEFAULT_VBLOCK * LANE)
+    v = COLS_DEFAULT_VBLOCK
+    while v > 8 and _block_vmem_bytes(ki, mo, v * LANE) > budget:
+        v //= 2
+    return v
 
 
 def to_sm_layout(arr: np.ndarray) -> np.ndarray:
@@ -262,3 +323,248 @@ def encode_pallas(parity_bits: np.ndarray, data: jax.Array, *,
     pm = jnp.asarray(to_plane_major(np.asarray(parity_bits), m, k),
                      dtype=jnp.bfloat16)
     return gf_matmul_bits_pallas(pm, data, block_b=block_b, interpret=interpret)
+
+
+# -- fused clay kernels -----------------------------------------------------
+#
+# The tiled structured clay path (ops/clay_structured.encode_device_tiled)
+# still streams its intermediate through HBM: data in (k rows), uncoupled
+# operand out+in (k0 rows — including the synthesized virtual zero rows of
+# the shortened construction), parity out+couple pass (3m rows) — about
+# (k + 2*k0 + 3*m)/k bytes of HBM traffic per data byte (~4.6x for
+# (10,4)).  These kernels do uncouple -> layer-MDS matmul -> couple per
+# batch tile entirely in VMEM: HBM sees data in and parity out, (k+m)/k
+# (~1.4x) — which is what moves the clay encode from the tiled path's
+# ~15.5 GB/s toward the 2D SM kernel's ~18 GB/s operand roofline.
+#
+# Everything clay-specific (grid geometry q x t, coupling constants) comes
+# in as static kwargs so this module stays free of clay imports; the
+# companion permutation is the same digit-axis swapaxes the XLA path uses
+# (clay_structured._pair_swap), which keeps the two paths bit-identical
+# by construction.
+
+CLAY_FUSED_CB = 128   # minimum column tile (one u8 lane tile)
+
+
+def clay_fused_cb_for(rows: int, w_a: int) -> int:
+    """Column-tile width for the fused clay kernels: grow cb while the
+    flattened matmul width rows*cb stays ~32K lanes (the in-VMEM planes
+    tensor stays ~3MB at alpha = 256 int8) and cb divides the window's
+    w_a — small-alpha test geometries then still amortize grid overhead
+    instead of running 128-lane slivers."""
+    cb = CLAY_FUSED_CB
+    while cb * 2 <= w_a and w_a % (cb * 2) == 0 and rows * cb * 2 <= 32768:
+        cb *= 2
+    return cb
+
+
+def _gf_const_mul_i32(const: int, x):
+    """y = const ∘GF∘ x elementwise for int32 byte values (0..255):
+    const·x = XOR over set bits j of x of the byte const·2^j — eight
+    select-xors on the VPU, the in-kernel form of
+    clay_structured._gf_const_mul."""
+    y = jnp.zeros_like(x)
+    for j in range(8):
+        term = int(gf256.mul(np.uint8(const), np.uint8(1 << j)))
+        y = y ^ (((x >> j) & 1) * jnp.int32(term))
+    return y
+
+
+def _gf2_planes_matmul(mbits_ref, u, rows: int, mo: int):
+    """Shared tail of the fused clay kernels: u [rows, N] int32 bytes ->
+    out [mo, N] int32 bytes through the plane-major GF(2^8) bit-plane
+    matmul (same math as _gf2_matmul_kernel_sm, operand already in
+    registers)."""
+    n = u.shape[-1]
+    in_shifts = jax.lax.broadcasted_iota(jnp.int32, (8, rows, n), 0)
+    planes = ((jnp.broadcast_to(u[None], (8, rows, n)) >> in_shifts) & 1) \
+        .reshape(8 * rows, n).astype(mbits_ref.dtype)
+    acc = jnp.dot(mbits_ref[...], planes,
+                  preferred_element_type=jnp.int32)   # [8*mo, N]
+    v = (acc & 1).reshape(8, mo, n)
+    out_shifts = jax.lax.broadcasted_iota(jnp.int32, (8, mo, n), 0)
+    return jnp.sum(v << out_shifts, axis=0)
+
+
+def _clay_fused_encode_kernel(rbits_ref, data_ref, out_ref, *, k: int,
+                              q: int, t: int, gamma: int, det_inv: int):
+    """One (window, column-tile) block of the fused clay encode:
+    data [k, 1, alpha, cb] -> parity [m=q, 1, alpha, cb], uncouple +
+    layer-MDS + couple without leaving VMEM.
+
+    Virtual zero nodes (ids k..k0-1 of the shortened construction) are
+    synthesized per grid row as register zeros — with minimal t only ONE
+    row is partial (k > q*(t-2)), so the zeros never touch HBM and never
+    widen the streamed operand."""
+    alpha = q ** t
+    d = data_ref[:, 0].astype(jnp.int32)          # [k, alpha, cb]
+    cb = d.shape[-1]
+    mask_shape = (q,) + (q,) * t + (1,)
+    xi = jax.lax.broadcasted_iota(jnp.int32, mask_shape, 0)
+    u_rows = []
+    for y in range(t - 1):
+        lo, hi = y * q, (y + 1) * q
+        if hi <= k:
+            row = d[lo:hi]
+        else:   # the one partial grid row: real nodes + virtual zeros
+            row = jnp.concatenate(
+                [d[lo:k], jnp.zeros((hi - k, alpha, cb), jnp.int32)])
+        # [x, z_{t-1}, .., z_0, cb]; companion = swap x with digit z_y
+        s = row.reshape(q, *((q,) * t), cb)
+        ax = 1 + (t - 1 - y)
+        comp = jnp.swapaxes(s, 0, ax)
+        zy = jax.lax.broadcasted_iota(jnp.int32, mask_shape, ax)
+        u_rows.append(jnp.where(xi == zy, s,
+                                s ^ _gf_const_mul_i32(gamma, comp)))
+    u = jnp.stack(u_rows).reshape(q * (t - 1), alpha * cb)
+    par = _gf2_planes_matmul(rbits_ref, u, q * (t - 1), q)
+    # parity row y = t-1: companions pair within the row (digit z_{t-1},
+    # axis 1), couple back: C = (U ^ g*U[comp]) / (1 + g^2)
+    p = par.reshape(q, *((q,) * t), cb)
+    comp = jnp.swapaxes(p, 0, 1)
+    zy = jax.lax.broadcasted_iota(jnp.int32, mask_shape, 1)
+    cpl = jnp.where(xi == zy, p, _gf_const_mul_i32(
+        det_inv, p ^ _gf_const_mul_i32(gamma, comp)))
+    out_ref[:, 0] = cpl.reshape(q, alpha, cb).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "q", "t", "gamma", "det_inv", "cb", "interpret"))
+def clay_fused_encode_pallas(rbits_pm: jax.Array, data4: jax.Array, *,
+                             q: int, t: int, gamma: int, det_inv: int,
+                             cb: int = CLAY_FUSED_CB,
+                             interpret: bool = False) -> jax.Array:
+    """Fused clay encode: data4 [k, n_win, alpha, w_a] uint8 (the free
+    host view of the natural [k, W] slab) -> parity [m, n_win, alpha,
+    w_a].  rbits_pm is the layer-MDS solve matrix R = gen[k0:] in
+    plane-major bit form ([8m, 8k0] int8, see to_plane_major)."""
+    k, n_win, alpha, w_a = data4.shape
+    k0 = q * (t - 1)
+    assert alpha == q ** t, (alpha, q, t)
+    assert rbits_pm.shape == (8 * q, 8 * k0), rbits_pm.shape
+    assert w_a % cb == 0 and cb % LANE == 0, (w_a, cb)
+    grid = (n_win, w_a // cb)
+    return pl.pallas_call(
+        functools.partial(_clay_fused_encode_kernel, k=k, q=q, t=t,
+                          gamma=gamma, det_inv=det_inv),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((8 * q, 8 * k0), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, 1, alpha, cb), lambda i, j: (0, i, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((q, 1, alpha, cb), lambda i, j: (0, i, 0, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((q, n_win, alpha, w_a), jnp.uint8),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=interpret,
+    )(rbits_pm, data4)
+
+
+def _clay_fused_repair_kernel(rbits_ref, x_ref, out_ref, *, k: int, q: int,
+                              t: int, lost: int, gamma: int,
+                              inv_gamma: int):
+    """One (window, column-tile) block of the fused single-loss repair:
+    helpers' repair-plane cells [H, 1, beta, cb] -> the lost node's full
+    window content [1, alpha, cb], layer-major.
+
+    Per plane layer the unknown U cells are EXACTLY the lost node's grid
+    row (the other q-1 row members' companions live on the lost node,
+    out of plane), leaving exactly k0 known rows — uncouple them with
+    in-plane digit-axis swaps, solve the row with the static [q, k0]
+    matrix (clay_structured.repair_parts), then recover the lost node's
+    out-of-plane cells from the coupling with its row's helpers:
+    C[lost, z'] = (U[helper, z] ^ C[helper, z]) / gamma."""
+    m = q
+    n0 = q * t
+    beta = q ** (t - 1)
+    d = x_ref[:, 0].astype(jnp.int32)              # [H, beta, cb]
+    cb = d.shape[-1]
+    lost_int = lost if lost < k else n0 - m + (lost - k)
+    x0, y0 = lost_int % q, lost_int // q
+
+    def ext_of(i: int):
+        if i < k:
+            return i
+        if i >= n0 - m:
+            return k + (i - (n0 - m))
+        return None          # virtual zero node
+
+    helpers = [e for e in range(k + m) if e != lost]   # ascending ids
+    zeros = jnp.zeros((beta, cb), jnp.int32)
+    cells = [zeros if ext_of(i) is None or i == lost_int
+             else d[helpers.index(ext_of(i))] for i in range(n0)]
+    # plane lattice: free digit positions (all y != y0), descending —
+    # ascending plane rank is row-major over them
+    free = [y for y in range(t - 1, -1, -1) if y != y0]
+    fdims = tuple(q for _ in free)
+    mask_shape = (q,) + fdims + (1,)
+    xi = jax.lax.broadcasted_iota(jnp.int32, mask_shape, 0)
+    u_rows = []
+    for y in range(t):
+        if y == y0:
+            continue
+        row = jnp.stack(cells[y * q:(y + 1) * q])   # [q, beta, cb]
+        s = row.reshape(q, *fdims, cb)
+        ax = 1 + free.index(y)
+        comp = jnp.swapaxes(s, 0, ax)
+        zy = jax.lax.broadcasted_iota(jnp.int32, mask_shape, ax)
+        u_rows.append(jnp.where(xi == zy, s,
+                                s ^ _gf_const_mul_i32(gamma, comp)))
+    k0 = n0 - m
+    u = jnp.stack(u_rows).reshape(k0, beta * cb)
+    u_y0 = _gf2_planes_matmul(rbits_ref, u, k0, q).reshape(q, *fdims, cb)
+    # x = x0 is the lost node's in-plane (diagonal) cell: C = U; other x
+    # recover the out-of-plane cell z' = z with digit y0 := x
+    c_row = jnp.stack([zeros if x == x0 else cells[y0 * q + x]
+                       for x in range(q)]).reshape(q, *fdims, cb)
+    vals = jnp.where(xi == x0, u_y0,
+                     _gf_const_mul_i32(inv_gamma, u_y0 ^ c_row))
+    # vals axes [digit z_{y0}, free digits desc, cb] -> natural
+    # [z_{t-1}, .., z_0, cb] layer order
+    out = jnp.moveaxis(vals, 0, t - 1 - y0)
+    out_ref[0] = out.reshape(q ** t, cb).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "k", "q", "t", "lost", "gamma", "inv_gamma", "cb", "interpret"))
+def clay_fused_repair_pallas(rbits_pm: jax.Array, x4: jax.Array, *,
+                             k: int, q: int, t: int, lost: int,
+                             gamma: int, inv_gamma: int,
+                             cb: "int | None" = None,
+                             interpret: bool = False) -> jax.Array:
+    """Fused single-loss clay repair: x4 [H, n_win, beta, w_a] uint8 —
+    helper-major (external ids ascending, lost excluded), plane layers
+    ascending — -> the lost shard's windows [n_win, alpha, w_a] in the
+    natural layer-major layout.  rbits_pm is repair_parts' [q, k0] row
+    solve matrix in plane-major bit form."""
+    h, n_win, beta, w_a = x4.shape
+    m = q
+    k0 = q * t - m
+    alpha = beta * q
+    assert h == k + m - 1, (h, k, m)
+    assert beta == q ** (t - 1), (beta, q, t)
+    assert rbits_pm.shape == (8 * q, 8 * k0), rbits_pm.shape
+    if cb is None:
+        cb = clay_fused_cb_for(beta, w_a)
+    assert w_a % cb == 0 and cb % LANE == 0, (w_a, cb)
+    grid = (n_win, w_a // cb)
+    return pl.pallas_call(
+        functools.partial(_clay_fused_repair_kernel, k=k, q=q, t=t,
+                          lost=lost, gamma=gamma, inv_gamma=inv_gamma),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((8 * q, 8 * k0), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((h, 1, beta, cb), lambda i, j: (0, i, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, alpha, cb), lambda i, j: (i, 0, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_win, alpha, w_a), jnp.uint8),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=interpret,
+    )(rbits_pm, x4)

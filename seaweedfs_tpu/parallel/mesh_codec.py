@@ -33,6 +33,7 @@ are cached per loss mask (they repeat across windows and volumes).
 from __future__ import annotations
 
 import functools
+import time as _time
 
 import jax
 import jax.numpy as jnp
@@ -147,9 +148,12 @@ class MeshCodec:
         """Issue the mesh encode asynchronously; returns fetch() -> parity.
         Same contract as RSCodec.encode_begin — the seam the pipelined disk
         paths use to overlap IO with device compute."""
+        from ..ops.codec import metered_fetch
+        t0 = _time.perf_counter()
         data = np.asarray(data, dtype=np.uint8)
         assert data.shape[-2] == self.k, f"expected {self.k} data shards"
         lead = data.shape[:-2]
+        volumes = int(np.prod(lead, dtype=np.int64)) if lead else 1
         if lead:
             # [.., k, B] -> [k, prod(lead)*B] keeping each stripe contiguous
             flat = np.ascontiguousarray(
@@ -159,13 +163,15 @@ class MeshCodec:
         inner = _mesh_matmul_begin(self.mesh, self._parity_bits, self.m,
                                    flat)
         if not lead:
-            return inner
+            return metered_fetch(inner, "rs_mesh", "encode", data.nbytes,
+                                 t0)
 
         def fetch():
             parity = inner()
             return np.ascontiguousarray(np.moveaxis(
                 parity.reshape(self.m, *lead, -1), 0, -2))
-        return fetch
+        return metered_fetch(fetch, "rs_mesh", "encode", data.nbytes, t0,
+                             volumes=volumes)
 
     def reconstruct(self, shards: list[np.ndarray | None], *,
                     data_only: bool = False) -> list[np.ndarray]:
@@ -182,6 +188,8 @@ class MeshCodec:
         """Async form of reconstruct: every per-chunk device call is issued
         before returning; fetch() drains them (RSCodec.encode_begin
         contract)."""
+        from ..ops.codec import metered_fetch
+        t0 = _time.perf_counter()
         if len(shards) != self.n:
             raise ValueError(f"expected {self.n} shard slots, got {len(shards)}")
         present = [i for i, s in enumerate(shards) if s is not None]
@@ -223,7 +231,9 @@ class MeshCodec:
                 for row, t in enumerate(chunk):
                     out[t] = np.ascontiguousarray(rec[row].reshape(*lead, -1))
             return out
-        return fetch
+        volumes = int(np.prod(lead, dtype=np.int64)) if lead else 1
+        return metered_fetch(fetch, "rs_mesh", "reconstruct",
+                             chosen.nbytes, t0, volumes=volumes)
 
     def verify(self, shards: list[np.ndarray]) -> bool:
         data = np.stack(shards[:self.k], axis=-2)
@@ -236,7 +246,13 @@ def _clay_mesh_fn(mesh: Mesh, k: int, m: int, small: int):
     """Jitted byte-DP clay encode: the structured encode_device runs
     per device under shard_map with the window axis split over every
     mesh device — clay's whole transform (uncouple, layer-MDS matmul,
-    couple) is window-local, so no collectives."""
+    couple) is window-local, so no collectives.
+
+    Fused ride-along: encode_device routes wide windows through the
+    fully-fused VMEM kernel whenever clay_structured.use_fused_engine()
+    says so, so TPU meshes get the fused path per device with no
+    mesh-specific wiring (the split lands on window boundaries, which
+    is all the fused kernel's grid needs)."""
     from ..ops import clay_structured
 
     def local(data):

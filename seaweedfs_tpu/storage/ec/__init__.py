@@ -17,8 +17,9 @@ from .decoder import (find_dat_file_size, read_ec_volume_version,
                       write_dat_file, write_idx_file_from_ec_index)
 from .ec_volume import (EcNotFoundError, EcShardUnavailableError, EcVolume,
                         EcVolumeShard, rebuild_ecx_file)
-from .encoder import (rebuild_ec_files, rebuild_ec_files_batch,
-                      write_ec_files, write_sorted_file_from_idx)
+from .encoder import (encode_ec_files_batch, rebuild_ec_files,
+                      rebuild_ec_files_batch, write_ec_files,
+                      write_sorted_file_from_idx)
 from .layout import (DATA_SHARDS_COUNT, DEFAULT_GEOMETRY, LARGE_BLOCK_SIZE,
                      PARITY_SHARDS_COUNT, SMALL_BLOCK_SIZE,
                      TOTAL_SHARDS_COUNT, EcGeometry, Interval, locate_data,

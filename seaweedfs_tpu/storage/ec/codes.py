@@ -76,7 +76,7 @@ class LrcWindowCodec:
     def encode(self, data: np.ndarray) -> np.ndarray:
         return self.encode_begin(data)()
 
-    def encode_begin(self, data: np.ndarray):
+    def encode_begin(self, data: np.ndarray, *, volumes: int = 1):
         t0 = time.perf_counter()
         data = np.asarray(data, dtype=np.uint8)
         assert data.shape[0] == self.k
@@ -88,7 +88,8 @@ class LrcWindowCodec:
         else:
             parity = gf_apply(parity_rows, data)
             fetch = lambda: parity  # noqa: E731
-        return metered_fetch(fetch, "lrc", "encode", data.nbytes, t0)
+        return metered_fetch(fetch, "lrc", "encode", data.nbytes, t0,
+                             volumes=volumes)
 
 
 class ClayWindowCodec:
@@ -114,11 +115,15 @@ class ClayWindowCodec:
     def encode(self, data: np.ndarray) -> np.ndarray:
         return self.encode_begin(data)()
 
-    def encode_begin(self, data: np.ndarray):
+    def encode_begin(self, data: np.ndarray, *, volumes: int = 1):
+        """`volumes`: how many volumes this window's bytes span —
+        encode_ec_files_batch folds a group of same-layout volumes onto
+        the byte axis so one dispatch (and its fixed tunnel cost)
+        covers them all; the count feeds the amortization counters."""
         t0 = time.perf_counter()
         data = np.asarray(data, dtype=np.uint8)
         return metered_fetch(self._encode_begin_raw(data), "clay",
-                             "encode", data.nbytes, t0)
+                             "encode", data.nbytes, t0, volumes=volumes)
 
     def _encode_begin_raw(self, data: np.ndarray):
         k, W = data.shape
@@ -134,6 +139,21 @@ class ClayWindowCodec:
         if device_compute_ok():
             import jax
             import jax.numpy as jnp
+            shape4 = clay_structured.fused_shape(self.k, self.m, W,
+                                                 small)
+            if shape4 is not None and clay_structured.use_fused_engine():
+                # fully fused path: uncouple + layer-MDS + couple in one
+                # pallas_call, VMEM-resident (rs_pallas); the 4D view is
+                # a FREE host reshape both ways
+                fn = _clay_device_fn_fused(self.k, self.m, small,
+                                           clay_structured.fused_mode())
+                dev = fn(jnp.asarray(
+                    np.ascontiguousarray(data).reshape(shape4)))
+
+                def fetch():
+                    return np.asarray(jax.device_get(dev)) \
+                        .reshape(self.m, W)
+                return fetch
             shape5 = clay_structured.tiled_shape(self.k, self.m, W,
                                                  small)
             if shape5 is not None:
@@ -183,6 +203,26 @@ def _clay_device_fn_tiled(k: int, m: int, small: int):
     from ...ops import clay_structured
     return jax.jit(functools.partial(
         clay_structured.encode_device_tiled, k, m, small=small))
+
+
+@functools.lru_cache(maxsize=8)
+def _clay_device_fn_fused(k: int, m: int, small: int, mode: str):
+    # keyed by fused_mode so a WEED_CLAY_FUSED flip retraces instead of
+    # serving a stale interpret/compiled closure
+    import jax
+
+    from ...ops import clay_structured
+    return jax.jit(functools.partial(
+        clay_structured.encode_device_fused, k, m, small=small))
+
+
+@functools.lru_cache(maxsize=32)
+def _clay_repair_fn_fused(k: int, m: int, lost: int, mode: str):
+    import jax
+
+    from ...ops import clay_structured
+    return jax.jit(functools.partial(
+        clay_structured.repair_device_fused, k, m, lost))
 
 
 # -- rebuild ---------------------------------------------------------------
@@ -242,8 +282,16 @@ def rebuild_clay(base_path: str, geo: EcGeometry, missing: list[int],
 
     if len(missing) == 1:
         lost = missing[0]
+        from ...ops import clay_structured
+        from ...ops.codec import device_compute_ok
         helpers, plane, R = clay_matrix.repair_flat(
             geo.data_shards, geo.parity_shards, lost)
+        # fused path: same helper reads, but uncouple + [q, k0] row
+        # solve + out-of-plane back-substitution run in one VMEM-resident
+        # pallas_call (rs_pallas._clay_fused_repair_kernel) instead of
+        # the [alpha, (n-1)*beta] flat matmul + host transposes
+        use_fused = (clay_structured.use_fused_engine()
+                     and device_compute_ok() and win_a % 128 == 0)
         inputs = {h: np.memmap(base_path + to_ext(h), dtype=np.uint8,
                                mode="r") for h in helpers}
         shard_size = len(next(iter(inputs.values())))
@@ -253,6 +301,26 @@ def rebuild_clay(base_path: str, geo: EcGeometry, missing: list[int],
         with open(base_path + to_ext(lost), "wb") as out:
             for w0 in range(0, shard_size // small, wins_per_batch):
                 wn = min(wins_per_batch, shard_size // small - w0)
+                if use_fused:
+                    # helper-major [H, wn, beta, win_a] — the gather is
+                    # the partial-range plane read, no transposes; the
+                    # kernel returns the natural [wn, alpha, win_a]
+                    # layer-major layout, written verbatim
+                    x4 = np.empty((len(helpers), wn, len(plane), win_a),
+                                  dtype=np.uint8)
+                    for hi, h in enumerate(helpers):
+                        span = inputs[h][w0 * small:(w0 + wn) * small]
+                        x4[hi] = span.reshape(wn, alpha, win_a)[:,
+                                                                plane_idx]
+                    bytes_read += x4.size
+                    import jax
+                    import jax.numpy as jnp
+                    fn = _clay_repair_fn_fused(
+                        geo.data_shards, geo.parity_shards, lost,
+                        clay_structured.fused_mode())
+                    rec = np.asarray(jax.device_get(fn(jnp.asarray(x4))))
+                    out.write(rec.tobytes())
+                    continue
                 # x rows: helper-major, plane-layer-minor (repair_flat's
                 # input order); columns: window-major, win_a-minor
                 x = np.empty((len(helpers) * len(plane), wn * win_a),
@@ -274,7 +342,8 @@ def rebuild_clay(base_path: str, geo: EcGeometry, missing: list[int],
                                 time.perf_counter() - t0)
         if stats is not None:
             stats["bytes_read"] = bytes_read
-            stats["plan_kind"] = "clay-plane"
+            stats["plan_kind"] = "clay-plane-fused" if use_fused \
+                else "clay-plane"
             stats["helpers"] = list(helpers)
             stats["layers_per_helper"] = len(plane)
         return missing

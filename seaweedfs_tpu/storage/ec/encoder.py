@@ -46,12 +46,26 @@ DEFAULT_BATCH_BYTES = 8 * 1024 * 1024
 PIPELINE_DEPTH = 2
 
 
-def _begin_encode(codec, data: np.ndarray):
+def _begin_encode(codec, data: np.ndarray, volumes: int = 1):
     """codec.encode_begin when the codec has one (RSCodec/MeshCodec issue
     the device work and defer the blocking fetch); eager fallback keeps
-    custom/window codecs on the same contract."""
+    custom/window codecs on the same contract.
+
+    `volumes` tells metrics how many volumes this one dispatch carries
+    (encode_ec_files_batch's amortization).  It is forwarded only to
+    codecs whose encode_begin declares it — the window codecs take it as
+    a kwarg; RSCodec infers it from the leading batch axes; external
+    custom codecs never see it."""
     begin = getattr(codec, "encode_begin", None)
     if begin is not None:
+        if volumes != 1:
+            import inspect
+            try:
+                params = inspect.signature(begin).parameters
+            except (TypeError, ValueError):
+                params = {}
+            if "volumes" in params:
+                return begin(data, volumes=volumes)
         return begin(data)
     parity = codec.encode(data)
     return lambda: parity
@@ -262,6 +276,102 @@ def write_ec_files(base_path: str, geo: EcGeometry = DEFAULT_GEOMETRY,
     finally:
         for f in outputs:
             f.close()
+
+
+def encode_ec_files_batch(base_paths: list[str],
+                          geo: EcGeometry = DEFAULT_GEOMETRY,
+                          codec: RSCodec | None = None,
+                          batch_bytes: int = DEFAULT_BATCH_BYTES) -> None:
+    """Fleet encode: <base>.dat -> shard files for MANY volumes with
+    batched codec dispatches (the encode-side mirror of
+    rebuild_ec_files_batch).
+
+    A tier-seal or rack-migration encodes hundreds of volumes; looping
+    write_ec_files pays the per-dispatch fixed cost (h2d setup + kernel
+    launch, ~60-100ms on a tunneled link) once per volume per batch.
+    Stripe columns are independent, so volumes that share a shard-file
+    size (ergo the same batch width sequence — the grouping key
+    rebuild_ec_files_batch uses) fold into ONE codec call per window:
+    RS stacks [V, k, width] onto the codec's leading batch axes, the
+    clay/LRC window codecs fold onto the byte axis [k, V*width] (their
+    transforms are window-local, so concatenated volumes encode
+    independently and bit-identically).  Amortization is visible at
+    /metrics as seaweedfs_codec_dispatch_volumes_total /
+    seaweedfs_codec_dispatch_total.  Odd-sized volumes degrade to the
+    per-volume path.  Shard bytes are identical to write_ec_files."""
+    groups: dict[int, list[str]] = {}
+    for base in base_paths:
+        dat_size = os.path.getsize(base + ".dat")
+        groups.setdefault(geo.shard_file_size(dat_size), []).append(base)
+    for _, bases in sorted(groups.items()):
+        if len(bases) == 1:
+            write_ec_files(bases[0], geo, codec, batch_bytes)
+            continue
+        _encode_group(bases, geo, codec, batch_bytes)
+
+
+def _encode_group(bases: list[str], geo: EcGeometry,
+                  codec: RSCodec | None, batch_bytes: int) -> None:
+    """One same-shard-size group of encode_ec_files_batch: V volumes'
+    batch iterators advance in lockstep (equal shard size => provably
+    equal width sequences) and every window is one grouped dispatch."""
+    import itertools
+
+    codec = _codec_for(geo, codec)
+    k, m, v = geo.data_shards, geo.parity_shards, len(bases)
+    small = geo.small_block_size
+    # per-volume batch width shrinks with group size so the grouped
+    # dispatch stays near batch_bytes of host copies total; floored to
+    # one small block (width sequences must stay block-aligned)
+    vol_batch = max(small, batch_bytes // v // small * small)
+    rs = geo.code_kind == "rs"
+    dats = []
+    for b in bases:
+        size = os.path.getsize(b + ".dat")
+        dats.append((np.memmap(b + ".dat", dtype=np.uint8, mode="r")
+                     if size else np.zeros(0, dtype=np.uint8), size))
+    outputs = [[open(b + to_ext(i), "wb")
+                for i in range(geo.total_shards)] for b in bases]
+    sentinel = object()
+
+    def produce():
+        iters = [_iter_encode_batches(dat, size, geo, vol_batch)
+                 for dat, size in dats]
+        for parts in itertools.zip_longest(*iters, fillvalue=sentinel):
+            # misalignment here would interleave volumes' bytes into the
+            # wrong shards — corruption, not a perf bug — so assert, do
+            # not truncate (a plain zip would silently drop the tail)
+            assert not any(p is sentinel for p in parts), \
+                "same-shard-size volumes must batch in lockstep"
+            assert len({p.shape[1] for p in parts}) == 1, \
+                [p.shape for p in parts]
+            # stack/concatenate COPIES out of the per-volume cycled
+            # pools, so the yielded batch stays valid in the pipeline
+            data = np.stack(parts) if rs \
+                else np.concatenate(parts, axis=1)
+            yield data, _begin_encode(codec, data, volumes=v)
+
+    def consume(item):
+        data, fetch = item
+        width = data.shape[-1] if rs else data.shape[-1] // v
+        for vi in range(v):
+            dpart = data[vi] if rs \
+                else data[:, vi * width:(vi + 1) * width]
+            for s in range(k):
+                outputs[vi][s].write(dpart[s])
+        parity = fetch()
+        for vi in range(v):
+            ppart = parity[vi] if rs \
+                else parity[:, vi * width:(vi + 1) * width]
+            for p in range(m):
+                outputs[vi][k + p].write(ppart[p])
+
+    try:
+        _pipelined(produce(), consume, _pipeline_depth(codec))
+    finally:
+        for files in outputs:
+            for f in files:
+                f.close()
 
 
 def rebuild_ec_files(base_path: str, geo: "EcGeometry | None" = None,

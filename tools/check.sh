@@ -61,6 +61,16 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_fastpath.py tests/test_http_native
 JAX_PLATFORMS=cpu WEED_FASTPATH=0 python -m pytest tests/test_fastpath.py tests/test_http_native.py \
     -q -p no:cacheprovider -p no:randomly || rc=1
 
+echo "== clay codec tests (fused/device arm + numpy fallback arm) =="
+# twice on purpose, same discipline as fastpath: the default arm runs
+# the fused kernels through the Pallas interpreter (bit-identity gates),
+# the WEED_EC_BACKEND=numpy arm proves every device gate degrades to
+# the host tables cleanly (fleet hosts without a chip take this path)
+JAX_PLATFORMS=cpu python -m pytest tests/test_clay_fused.py tests/test_clay_structured.py \
+    -q -p no:cacheprovider -p no:randomly || rc=1
+JAX_PLATFORMS=cpu WEED_EC_BACKEND=numpy python -m pytest tests/test_clay_fused.py tests/test_clay_structured.py \
+    -q -p no:cacheprovider -p no:randomly || rc=1
+
 if [ "$rc" -eq 0 ]; then
     echo "check.sh: all gates green"
 else
