@@ -1352,6 +1352,143 @@ def bench_weedlint(quick: bool = False) -> dict:
         shutil.rmtree(cache, ignore_errors=True)
 
 
+def bench_control_plane(quick: bool = False) -> dict:
+    """Control-plane fast path (ISSUE 20): the three master hot paths
+    measured the way the scale sim exercises them, with the paired
+    delta-vs-full heartbeat A/B the acceptance bar asks for.
+
+    - heartbeat_ingest_ms_per_node + bytes/pulse: N registered sim
+      nodes pulse one real master through the production stream
+      handler; rounds alternate delta-encoded vs full-snapshot wires
+      (the WEED_HB_DELTA=0 shape) so the per-pair ratio cancels this
+      box's run-to-run drift.  Payload build + encode happen OUTSIDE
+      the timed region — the number is wire decode + master ingest,
+      the master-side cost the delta path exists to cut.
+    - assigns_per_s: sustained Assign RPCs over real gRPC against the
+      incrementally maintained writable set.
+    - lookup_p99_ms: resolving 8 vids per op — one batched
+      LookupVolume RPC (master answers from the location cache) vs the
+      per-vid RPC storm it replaced (8 round trips).
+    """
+    import random
+
+    from seaweedfs_tpu.pb.rpc import POOL, _de, _ser
+    from seaweedfs_tpu.testing import SimCluster
+    from seaweedfs_tpu.testing.scale_sim import (RP_STR, SimNode,
+                                                 volume_dict)
+    from seaweedfs_tpu.wdclient import MasterClient
+
+    n_nodes = 60 if quick else 1000
+    vols_per_node = 8 if quick else 20
+    hb_pairs = 2 if quick else 3           # (delta, full) round pairs
+    assign_rounds, assigns_per_round = (2, 200) if quick else (3, 500)
+    lookup_rounds, lookups_per_round = (2, 120) if quick else (3, 300)
+    rng = random.Random(13)
+    out: dict = {"cp_nodes": n_nodes,
+                 "cp_volumes_per_node": vols_per_node}
+
+    with SimCluster(masters=1, volume_servers=0, jwt_key="",
+                    repair_interval=0.0,
+                    history_interval=0.0) as cluster:
+        master = cluster.masters[0]
+        nodes, vids = [], []
+        vid = 0
+        for i in range(n_nodes):
+            nodes.append(SimNode(i, 0, rack=f"rack-{i // 2 % 8}",
+                                 max_file_key=0,
+                                 max_volumes=4 * vols_per_node))
+        # node pairs share rp-001 volumes so Assign has a writable set
+        for i in range(0, n_nodes - 1, 2):
+            a, b = nodes[i], nodes[i + 1]
+            for _ in range(vols_per_node):
+                vid += 1
+                a.volumes[vid] = volume_dict(vid)
+                b.volumes[vid] = volume_dict(vid)
+                vids.append(vid)
+        for n in nodes:
+            n.pulse(master)             # register: full snapshot
+
+        # paired heartbeat A/B.  Wires are pre-serialized so the timer
+        # sees exactly what the master pays per pulse: _de + ingest.
+        delta_ms, full_ms, ratios = [], [], []
+        for _ in range(hb_pairs):
+            for kind in ("delta", "full"):
+                if kind == "delta":
+                    wires = [_ser(n.enc.encode(n.full_payload()))
+                             for n in nodes]
+                else:
+                    wires = [_ser(n.full_payload()) for n in nodes]
+                t0 = time.perf_counter()
+                for n, w in zip(nodes, wires):
+                    n.stream.pulse(_de(w))
+                per_node = (time.perf_counter() - t0) * 1000.0 / n_nodes
+                (delta_ms if kind == "delta" else full_ms).append(
+                    per_node)
+                out[f"heartbeat_bytes_per_pulse_{kind}"] = round(
+                    sum(len(w) for w in wires) / n_nodes, 1)
+            ratios.append(full_ms[-1] / delta_ms[-1])
+        out["heartbeat_ingest_ms_per_node"], \
+            out["heartbeat_ingest_ms_per_node_spread"] = \
+            spread(delta_ms, digits=4)
+        out["heartbeat_ingest_ms_per_node_full"], \
+            out["heartbeat_ingest_ms_per_node_full_spread"] = \
+            spread(full_ms, digits=4)
+        out["heartbeat_ingest_delta_speedup"], \
+            out["heartbeat_ingest_delta_speedup_spread"] = \
+            spread(ratios, digits=2)
+        out["heartbeat_bytes_reduction"] = round(
+            out["heartbeat_bytes_per_pulse_full"]
+            / out["heartbeat_bytes_per_pulse_delta"], 1)
+
+        # assigns/s over real gRPC against the cached writable set
+        client = POOL.client(cluster.master_grpc, "Seaweed")
+        client.call("Assign", {"replication": RP_STR})   # warm
+        rates = []
+        for _ in range(assign_rounds):
+            t0 = time.perf_counter()
+            for _ in range(assigns_per_round):
+                assert client.call("Assign",
+                                   {"replication": RP_STR}).get("fid")
+            rates.append(assigns_per_round
+                         / (time.perf_counter() - t0))
+        out["assigns_per_s"], out["assigns_per_s_spread"] = \
+            spread(rates, digits=1)
+
+        # lookup p99: 8 vids per op, batched RPC vs per-vid storm.
+        # _rpc_lookup (not lookup_batch) so the CLIENT cache cannot
+        # answer — the wire + master location-cache path is the subject
+        mc = MasterClient(cluster.master_grpc, client_name="cp-bench")
+        mc._rpc_lookup(vids[:8])                         # warm
+        b_p99s, n_p99s = [], []
+        for _ in range(lookup_rounds):
+            batched, naive = [], []
+            for _ in range(lookups_per_round):
+                batch = rng.sample(vids, k=min(8, len(vids)))
+                t0 = time.perf_counter()
+                got = mc._rpc_lookup(batch)
+                batched.append((time.perf_counter() - t0) * 1000.0)
+                assert all(got[v] for v in batch)
+                t0 = time.perf_counter()
+                for v in batch:
+                    mc._rpc_lookup([v])
+                naive.append((time.perf_counter() - t0) * 1000.0)
+            b_p99s.append(float(np.percentile(batched, 99)))
+            n_p99s.append(float(np.percentile(naive, 99)))
+        out["lookup_p99_ms"], out["lookup_p99_ms_spread"] = \
+            spread(b_p99s)
+        out["lookup_naive_p99_ms"], out["lookup_naive_p99_ms_spread"] \
+            = spread(n_p99s)
+        out["lookup_batch_speedup"] = round(
+            out["lookup_naive_p99_ms"] / out["lookup_p99_ms"], 2)
+        lc = master.metrics.master_loc_cache
+        hits, misses = lc.value("hit"), lc.value("miss")
+        out["lookup_cache_hit_ratio"] = round(
+            hits / max(1.0, hits + misses), 4)
+        for n in nodes:
+            n.kill()
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -1862,6 +1999,10 @@ def main():
                 smallfile.update(bench_largefile(quick=args.quick))
             except Exception as e:
                 smallfile["largefile_error"] = str(e)[:200]
+            try:
+                smallfile.update(bench_control_plane(quick=args.quick))
+            except Exception as e:
+                smallfile["control_plane_error"] = str(e)[:200]
             try:
                 smallfile.update(bench_weedlint(quick=args.quick))
             except Exception as e:

@@ -69,8 +69,19 @@ class HaCoordinator:
             snapshot_fn=self._snapshot,
             restore_fn=self._restore,
             on_role_change=self._on_role_change,
+            on_log_stats=self._on_log_stats,
             election_timeout=election_timeout,
             state_dir=raft_dir, seed=seed)
+
+    def _on_log_stats(self, entries: int, nbytes: int,
+                      snap_index: int) -> None:
+        """Raft log growth gauges — how an operator sees that churn-time
+        compaction (max_log_entries / WEED_RAFT_MAX_LOG_BYTES) keeps the
+        log bounded."""
+        m = self.master.metrics
+        m.raft_log_entries.set(value=entries)
+        m.raft_log_bytes.set(value=nbytes)
+        m.raft_snapshot_index.set(value=snap_index)
 
     # -- state machine ------------------------------------------------------
     def _apply(self, cmd: dict):

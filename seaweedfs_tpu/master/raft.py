@@ -79,6 +79,9 @@ class RaftNode:
                  election_timeout: float = 0.4,
                  state_dir: str | None = None,
                  max_log_entries: int = 1024,
+                 max_log_bytes: "int | None" = None,
+                 on_log_stats: "Callable[[int, int, int], None] | None"
+                 = None,
                  seed: int | None = None):
         self.self_addr = self_addr
         self.peers = sorted(set(peers) | {self_addr})
@@ -91,6 +94,21 @@ class RaftNode:
         self.election_timeout = election_timeout
         self.state_dir = state_dir
         self.max_log_entries = max_log_entries
+        # churn bound: compaction also triggers on SERIALIZED log size —
+        # entry counts alone let a burst of fat commands (mass
+        # re-registration under churn) balloon the log and every
+        # follower catch-up that replays it
+        if max_log_bytes is None:
+            try:
+                max_log_bytes = int(os.environ.get(
+                    "WEED_RAFT_MAX_LOG_BYTES", str(1 << 20)))
+            except ValueError:
+                max_log_bytes = 1 << 20
+        self.max_log_bytes = max_log_bytes
+        # (entries, bytes, snap_index) observer — ha.py feeds the
+        # seaweedfs_master_raft_log_* gauges from it
+        self.on_log_stats = on_log_stats
+        self._log_bytes = 0
         self._rng = random.Random(seed)
 
         self._lock = locks.RLock("RaftNode._lock")
@@ -138,6 +156,16 @@ class RaftNode:
 
     def _entry(self, i: int) -> dict:
         return self.log[i - self.snap_index - 1]
+
+    @staticmethod
+    def _entry_bytes(e: dict) -> int:
+        # the persisted JSONL footprint: serialized entry + newline
+        return len(json.dumps(e, separators=(",", ":"))) + 1
+
+    def _recount_log_bytes(self) -> None:
+        """O(n) — only after truncation/compaction/restore; appends
+        track incrementally."""
+        self._log_bytes = sum(self._entry_bytes(e) for e in self.log)
 
     def _rand_deadline(self) -> float:
         return time.monotonic() + self.election_timeout * (
@@ -200,6 +228,7 @@ class RaftNode:
                 self.log = [json.loads(line) for line in f if line.strip()]
             # drop entries the snapshot already covers
             self.log = [e for e in self.log if e["i"] > self.snap_index]
+        self._recount_log_bytes()
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -349,6 +378,7 @@ class RaftNode:
         index = self.last_index + 1
         entry = {"i": index, "t": self.term, "c": cmd}
         self.log.append(entry)
+        self._log_bytes += self._entry_bytes(entry)
         self._persist_append(entry)
         self._match_index[self.self_addr] = index
         if self.quorum == 1:
@@ -479,21 +509,28 @@ class RaftNode:
 
     def _maybe_compact(self) -> None:
         with self._lock:
-            if len(self.log) <= self.max_log_entries \
-                    or self.last_applied <= self.snap_index:
-                return
-            state = self.snapshot_fn()
-            new_snap = self.last_applied
-            self.snap_term = self._term_at(new_snap)
-            self.log = [e for e in self.log if e["i"] > new_snap]
-            self.snap_index = new_snap
-            self._snap_state = state
-            # snapshot BEFORE log: a crash between the writes must leave a
-            # snap covering everything the truncated log no longer holds
-            # (_load_state drops log entries <= snap_index, so the reverse
-            # order would corrupt the index mapping on restart)
-            self._persist_snapshot(state)
-            self._persist_log()
+            over = (len(self.log) > self.max_log_entries
+                    or self._log_bytes > self.max_log_bytes)
+            if over and self.last_applied > self.snap_index:
+                state = self.snapshot_fn()
+                new_snap = self.last_applied
+                self.snap_term = self._term_at(new_snap)
+                self.log = [e for e in self.log if e["i"] > new_snap]
+                self._recount_log_bytes()
+                self.snap_index = new_snap
+                self._snap_state = state
+                # snapshot BEFORE log: a crash between the writes must
+                # leave a snap covering everything the truncated log no
+                # longer holds (_load_state drops log entries <=
+                # snap_index, so the reverse order would corrupt the
+                # index mapping on restart)
+                self._persist_snapshot(state)
+                self._persist_log()
+            stats = (len(self.log), self._log_bytes, self.snap_index)
+        if self.on_log_stats is not None:
+            # outside _lock: the observer touches metrics, and metrics
+            # must never nest under the raft lock
+            self.on_log_stats(*stats)
 
     # -- client API ---------------------------------------------------------
     def propose(self, cmd: dict, timeout: float = 3.0):
@@ -561,6 +598,7 @@ class RaftNode:
                     and self._term_at(prev) != req["prev_term"]:
                 # conflicting suffix: drop it and ask for earlier entries
                 self.log = [e for e in self.log if e["i"] < prev]
+                self._recount_log_bytes()
                 self._persist_log()
                 return {"term": self.term, "ok": False,
                         "last": self.last_index}
@@ -576,8 +614,10 @@ class RaftNode:
                         truncated = True
                 else:
                     self.log.append(e)
+                    self._log_bytes += self._entry_bytes(e)
                     appended.append(e)
             if truncated:
+                self._recount_log_bytes()
                 self._persist_log()
             elif appended:
                 for e in appended:
@@ -611,6 +651,7 @@ class RaftNode:
                 self._snap_state = req["state"]
                 self.log = [e for e in self.log
                             if e["i"] > self.snap_index]
+                self._recount_log_bytes()
                 self.commit_index = max(self.commit_index, self.snap_index)
                 self.last_applied = max(self.last_applied, self.snap_index)
                 # snapshot before log — same crash-safety order as

@@ -18,6 +18,7 @@ raft_server.go:45-62 snapshot).  Single-master mode skips raft entirely.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import queue
@@ -84,6 +85,16 @@ class MasterServer:
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024, seed=seed)
         self.sequencer = MemorySequencer()
+        # lookup fast path: vid → prebuilt location entries, read
+        # lock-free (PR 3 atomic-snapshot-swap pattern).  Validity is
+        # (epoch, per-vid version) captured BEFORE the topology read
+        # that built the entry, so a concurrent mutation — which bumps
+        # the version AFTER it is visible — always invalidates a racing
+        # insert.  Plain dict ops are atomic under the GIL; no lock.
+        self._loc_cache: "dict[tuple[int, str], tuple[int, int, list[dict]]]" = {}
+        self._loc_ver: "dict[int, int]" = {}
+        self._loc_epoch = 0
+        self.topo.on_locations_changed = self._on_locations_changed
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
         self.jwt_signing_key = jwt_signing_key
@@ -134,7 +145,11 @@ class MasterServer:
         self._sub_lock = locks.Lock("MasterServer._sub_lock")
 
         self.http = HttpServer(host, port)
-        self.rpc = RpcServer(host, grpc_port)
+        # every live SendHeartbeat/KeepConnected stream holds one
+        # handler thread, so the pool bounds cluster size: raise it for
+        # the 1000-node scale sim (WEED_MASTER_RPC_WORKERS)
+        self.rpc = RpcServer(host, grpc_port, max_workers=int(
+            os.environ.get("WEED_MASTER_RPC_WORKERS", "64")))
         self.http.tracer = self.tracer
         self.rpc.tracer = self.tracer
         # cluster-wide observability federation (master/observe.py):
@@ -388,11 +403,21 @@ class MasterServer:
             self._publish_volume_location(vid, option.collection)
 
     # -- lookup -------------------------------------------------------------
-    def lookup(self, vid: int, collection: str = "") -> list[dict]:
-        if self._follower_client is not None:
-            # follower answers from its KeepConnected-fed cache — the
-            # whole point of master.follower: lookup traffic offload
-            return self._follower_client.lookup(vid)
+    def _on_locations_changed(self, vids: "set[int] | None") -> None:
+        """Topology callback: replica locations for `vids` changed (None
+        = a node left and everything it hosted moved).  Runs with or
+        without the topology lock held — only bumps plain counters."""
+        if vids is None:
+            self._loc_epoch += 1
+        else:
+            ver = self._loc_ver
+            for vid in vids:
+                ver[vid] = ver.get(vid, 0) + 1
+
+    def _build_locations(self, vid: int, collection: str) -> list[dict]:
+        """One serialized location entry list — regular replicas or the
+        EC shard→node dedup fallback (both cached; the EC map rebuild
+        per call was the satellite fix)."""
         locs = self.topo.lookup(collection, vid)
         if not locs:
             # EC volumes are located by shard
@@ -410,17 +435,46 @@ class MasterServer:
                         if _dn_tcp_port(dn, vid) else {}))
                 for dn in locs]
 
+    def lookup(self, vid: int, collection: str = "") -> list[dict]:
+        if self._follower_client is not None:
+            # follower answers from its KeepConnected-fed cache — the
+            # whole point of master.follower: lookup traffic offload
+            return self._follower_client.lookup(vid)
+        # lock-free read: entry is valid iff nothing about the vid (or
+        # the world) changed since it was built.  Under delta
+        # heartbeats a steady-state pulse touches no locations, so the
+        # cache stays hot between real topology changes.
+        epoch = self._loc_epoch
+        ver = self._loc_ver.get(vid, 0)
+        hit = self._loc_cache.get((vid, collection))
+        if hit is not None and hit[0] == epoch and hit[1] == ver:
+            self.metrics.master_loc_cache.inc("hit")
+            return list(hit[2])  # callers may extend; entries shared
+        self.metrics.master_loc_cache.inc("miss")
+        entries = self._build_locations(vid, collection)
+        self._loc_cache[(vid, collection)] = (epoch, ver, entries)
+        return list(entries)
+
     # -- heartbeat (master_grpc_server.go:21-183) ---------------------------
     def _handle_heartbeat_stream(self, requests):
         dn: DataNode | None = None
         try:
             for hb in requests:
                 self._check_partition()
+                prev_dn = dn
                 dn = self._ingest_heartbeat(hb, dn)
-                yield {
+                reply = {
                     "volume_size_limit": self.topo.volume_size_limit,
                     "leader": self.leader_grpc,
                 }
+                if dn is not prev_dn and "volumes" not in hb:
+                    # a delta-heartbeat sender just (re-)registered — the
+                    # new DataNode has no volume list yet.  Ask for a
+                    # full snapshot next pulse (hb_delta.note_reply) so
+                    # the node repopulates without waiting for its
+                    # resync epoch.
+                    reply["resync"] = 1
+                yield reply
         finally:
             if dn is not None:
                 LOG.info("volume server %s disconnected; unregistering",
@@ -433,13 +487,15 @@ class MasterServer:
                                  reason="stream-closed")
 
     def _ingest_heartbeat(self, hb: dict, dn: DataNode | None) -> DataNode:
+        t0 = time.perf_counter()
         if dn is not None and (not dn.is_active or dn.parent is None):
             # the liveness sweep unregistered this node while its
             # stream stayed open (wedged process that recovered): a
             # fresh heartbeat is the node coming back — re-register
-            # rather than silently updating an unlinked ghost.  The
-            # heartbeat carries the full volume snapshot, so the new
-            # node repopulates in one pulse.
+            # rather than silently updating an unlinked ghost.  A full
+            # heartbeat repopulates the new node in one pulse; a delta
+            # one triggers the stream handler's "resync" reply so the
+            # sender's next pulse is full.
             LOG.info("volume server %s re-registering after liveness "
                      "sweep", dn.id)
             dn = None
@@ -467,26 +523,36 @@ class MasterServer:
         # Pulse-only heartbeats carry no volume keys and cannot flip
         # anything; skip the snapshot on the hot ingest path
         has_volume_keys = any(k in hb for k in ("volumes", "new_volumes",
+                                                "changed_volumes",
                                                 "deleted_volumes"))
         prev_ro = {vid: v.read_only for vid, v in dn.volumes.items()} \
             if has_volume_keys else {}
+        # max_file_key rides every delta-heartbeat pulse (hb_delta
+        # SCALAR_KEYS), not just full syncs; set_max only ever raises
+        self.sequencer.set_max(hb.get("max_file_key", 0))
         if "volumes" in hb:  # full sync
             infos = [_volume_info_from_dict(v) for v in hb["volumes"]]
-            self.topo.sync_data_node(dn, infos)
-            self.sequencer.set_max(hb.get("max_file_key", 0))
             # per-volume frame-port map (process-sharded nodes): full
             # sync replaces it wholesale so worker reassignments and
-            # deleted volumes never leave a stale route behind
+            # deleted volumes never leave a stale route behind.  The
+            # map goes in BEFORE the topology sync so the location
+            # cache never rebuilds from a half-updated node
             dn.volume_tcp_ports = {
                 int(v["id"]): int(v["tcp_port"]) for v in hb["volumes"]
                 if v.get("tcp_port")}
-        for v in hb.get("new_volumes", []):
-            self.topo.register_volume(_volume_info_from_dict(v), dn)
+            self.topo.sync_data_node(dn, infos)
+        # new_volumes and changed_volumes take the same upsert path:
+        # register_volume replaces the VolumeInfo on the node and
+        # refreshes layout writability (a changed volume is how a
+        # delta heartbeat ships a read-only flip or size growth)
+        for v in itertools.chain(hb.get("new_volumes", []),
+                                 hb.get("changed_volumes", [])):
             if v.get("tcp_port"):
                 dn.volume_tcp_ports[int(v["id"])] = int(v["tcp_port"])
+            self.topo.register_volume(_volume_info_from_dict(v), dn)
         for v in hb.get("deleted_volumes", []):
-            self.topo.unregister_volume(_volume_info_from_dict(v), dn)
             dn.volume_tcp_ports.pop(int(v["id"]), None)
+            self.topo.unregister_volume(_volume_info_from_dict(v), dn)
         if "ec_shards" in hb:  # full EC sync
             bits = {int(e["id"]): ShardBits(e["ec_index_bits"])
                     for e in hb["ec_shards"]}
@@ -505,6 +571,11 @@ class MasterServer:
                     "volume.healed",
                     f"volume {vid} on {dn.id} is writable again",
                     volume_id=vid, server=dn.id)
+        kind = "full" if "volumes" in hb else \
+            ("delta" if has_volume_keys or "ec_shards" in hb else "pulse")
+        self.metrics.master_hb_total.inc(kind)
+        self.metrics.master_hb_ingest.observe(
+            kind, value=time.perf_counter() - t0)
         return dn
 
     # -- KeepConnected pub-sub (master_grpc_server.go:185-252) --------------

@@ -298,6 +298,36 @@ class ServerMetrics:
             "seaweedfs_filer_subscriber_overflow_total",
             "metadata subscribers disconnected on bounded-queue "
             "overflow")
+        # control-plane fast path (delta heartbeats + cached lookups):
+        # ingest cost per heartbeat by kind (full snapshot | volume
+        # delta | scalar-only pulse) is the bench's
+        # heartbeat_ingest_ms_per_node input; the lookup counters make
+        # the location-cache hit rate observable — under delta
+        # heartbeats steady-state pulses never invalidate, so hits
+        # should dominate
+        self.master_hb_ingest = r.histogram(
+            "seaweedfs_master_heartbeat_ingest_seconds",
+            "heartbeat ingest time by payload kind", ["kind"],
+            buckets=[0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                     0.005, 0.01, 0.025, 0.05, 0.1])
+        self.master_hb_total = r.counter(
+            "seaweedfs_master_heartbeat_total",
+            "heartbeats ingested by payload kind", ["kind"])
+        self.master_loc_cache = r.counter(
+            "seaweedfs_master_lookup_cache_total",
+            "master lookup location-cache outcomes", ["result"])
+        # raft log growth under churn: entries/bytes in the live log and
+        # the last compaction boundary — bounded by max_log_entries /
+        # WEED_RAFT_MAX_LOG_BYTES snapshot+truncate (master/raft.py)
+        self.raft_log_entries = r.gauge(
+            "seaweedfs_master_raft_log_entries",
+            "entries in the in-memory raft log (post-compaction)")
+        self.raft_log_bytes = r.gauge(
+            "seaweedfs_master_raft_log_bytes",
+            "serialized bytes held by the in-memory raft log")
+        self.raft_snapshot_index = r.gauge(
+            "seaweedfs_master_raft_snapshot_index",
+            "last raft log index folded into the compaction snapshot")
 
     def render(self, exemplars: bool = False) -> str:
         out = self.registry.render(exemplars=exemplars)

@@ -1,0 +1,534 @@
+"""Control-plane scale simulation (ISSUE 20): ~1000 simulated
+volume-server heartbeat streams, a million registered fids, sustained
+Assign + Lookup traffic against a REAL master (or HA trio), the repair
+planner ticking, and a mass-churn phase — pass/fail judged from the
+observability plane, not from internal poking: /cluster/history must
+show the degrade/heal arc, cluster.health must end green with no alert
+firing, and repair_queue_depth must return to zero.
+
+What is simulated and what is real
+----------------------------------
+Real: the MasterServer(s) — raft, topology, VolumeLayout writable set,
+lookup location cache, sequencer, repair planner, alert engine, history
+rings — plus the Assign/Lookup load, which arrives over real gRPC like
+any client's.  Simulated: the ~1000 volume servers.  Each SimNode owns
+a synthetic volume set and the PRODUCTION `HeartbeatDeltaEncoder`, and
+drives the master's real `_handle_heartbeat_stream` generator through
+an in-process `_Stream` whose payloads round-trip the real wire codec
+(`pb.rpc._ser`/`_de` — bytes on the "wire" are counted, and only
+JSON-serializable payloads survive).  A sync-gRPC server pins one
+handler thread per live stream, so 1000 REAL streams would need a
+1000-thread master purely as test scaffolding; the in-process driver
+exercises the identical handler + ingest path with none of that, and
+the gRPC transport itself is covered by the real Assign/Lookup load
+and the integration suite.
+
+Fake nodes still have to answer the observability plane's federated
+scrape or the federation-down alert (correctly) condemns the run: one
+`MetricsStub` HTTP listener bound on 0.0.0.0 serves /metrics for every
+node, and each SimNode takes a distinct loopback ip (127.x.y.z —
+the whole 127/8 is local) with the stub's port so node identities stay
+unique while every scrape lands on the stub.
+
+Churn phases (`run()`):
+  register -> steady (delta pulses + assign/lookup load)
+           -> degrade (read-only flips via changed_volumes deltas,
+                       stream kills, wedged streams for the liveness
+                       sweep; repair planner sees under-replication)
+           -> heal    (flips revert, killed nodes reconnect full,
+                       wedged nodes resync)
+           -> verify  (health green, no alert firing, repair queue 0,
+                       history shows the arc, >= 1M fids registered)
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from ..pb.rpc import POOL, RpcError, _de, _ser
+from ..util.http import HttpServer, Response
+from ..util.weedlog import logger
+from ..volume_server.hb_delta import HeartbeatDeltaEncoder
+from ..wdclient import MasterClient
+from . import SimCluster
+
+LOG = logger(__name__)
+
+# replica placement "001" (one same-rack replica, copy_count 2): churn
+# must create UNDER-replication the repair planner can see — rp 000
+# volumes simply vanish with their only holder and nothing degrades
+RP_BYTE = 1
+RP_STR = "001"
+
+
+def volume_dict(vid: int, size: int = 8 << 20, read_only: bool = False,
+                collection: str = "") -> dict:
+    """One heartbeat volume entry in the full wire shape the volume
+    server sends (master's _volume_info_from_dict reads these keys)."""
+    return {"id": vid, "size": size, "collection": collection,
+            "file_count": 10, "delete_count": 0,
+            "deleted_byte_count": 0, "read_only": read_only,
+            "replica_placement": RP_BYTE, "version": 3, "ttl": 0,
+            "compact_revision": 0, "modified_at_second": 0}
+
+
+class MetricsStub:
+    """One HTTP listener answering the federated scrape for EVERY sim
+    node: /metrics returns an empty (valid) exposition page with 200 so
+    federation_up stays 1; /heat 404s — the observer isolates per-node
+    heat failures by design."""
+
+    def __init__(self):
+        # 0.0.0.0: every 127.x.y.z node address resolves here
+        self.http = HttpServer("0.0.0.0", 0)
+        self.http.route("GET", "/metrics",
+                        lambda req: Response(
+                            status=200, body=b"",
+                            content_type="text/plain; version=0.0.4"),
+                        exact=True)
+        self.port = self.http.port
+
+    def start(self) -> "MetricsStub":
+        self.http.start()
+        return self
+
+    def stop(self) -> None:
+        self.http.stop()
+
+
+class _Stream:
+    """Synchronous in-process SendHeartbeat stream against the real
+    master handler.  pulse() feeds one payload and returns the master's
+    reply; close() ends the request iterator so the handler's cleanup
+    (unregister + topology.leave event) runs exactly as it does when a
+    gRPC stream drops."""
+
+    _CLOSE = object()
+
+    def __init__(self, master):
+        self._box: list = []
+
+        def feed():
+            while True:
+                item = self._box.pop()
+                if item is _Stream._CLOSE:
+                    return
+                yield item
+
+        self._gen = master._handle_heartbeat_stream(feed())
+        self._closed = False
+
+    def pulse(self, payload: dict) -> dict:
+        self._box.append(payload)
+        return next(self._gen)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._box.append(_Stream._CLOSE)
+        next(self._gen, None)   # drive the handler's finally block
+
+
+class SimNode:
+    """One simulated volume server: synthetic volume dicts + the
+    production delta encoder + a stream to the master.  Not
+    thread-safe; each node is driven by one pacer at a time."""
+
+    def __init__(self, index: int, stub_port: int, rack: str,
+                 max_file_key: int, max_volumes: int):
+        self.index = index
+        # distinct loopback ip per node, shared stub port: unique
+        # topology identity, one real listener
+        self.ip = f"127.{10 + index // 200}.{(index % 200) + 1}.1"
+        self.port = stub_port
+        self.rack = rack
+        self.max_file_key = max_file_key
+        self.max_volumes = max_volumes
+        self.volumes: dict[int, dict] = {}
+        self.enc = HeartbeatDeltaEncoder()
+        self.stream: "_Stream | None" = None
+        self.bytes_sent = 0
+        self.pulses = 0
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def full_payload(self) -> dict:
+        return {"ip": self.ip, "port": self.port,
+                # nothing listens on grpc: repair copy attempts against
+                # fake nodes must fail FAST (connection refused), which
+                # is exactly the thundering-herd backoff shape
+                "grpc_port": 1, "tcp_port": 0,
+                "public_url": self.url, "data_center": "dc-sim",
+                "rack": self.rack, "max_volume_count": self.max_volumes,
+                "max_file_key": self.max_file_key,
+                "volumes": [dict(v) for v in self.volumes.values()],
+                "ec_shards": []}
+
+    def connect(self, master) -> None:
+        self.enc.reset()            # new stream -> next encode is full
+        self.stream = _Stream(master)
+
+    def pulse(self, master) -> dict:
+        """Encode one heartbeat (delta machinery live), round-trip the
+        wire codec, feed the master, note the reply."""
+        if self.stream is None or self.stream._closed:
+            self.connect(master)
+        wire = _ser(self.enc.encode(self.full_payload()))
+        self.bytes_sent += len(wire)
+        self.pulses += 1
+        reply = self.stream.pulse(_de(wire))
+        self.enc.note_reply(reply)
+        return reply
+
+    def kill(self) -> None:
+        """Tear the stream: the master unregisters the node at once."""
+        if self.stream is not None:
+            self.stream.close()
+
+    # wedging needs no method: simply stop calling pulse() — the
+    # liveness sweep unregisters the silent node, and the next pulse
+    # takes the re-register + resync path.
+
+
+class _LoadWorker:
+    """One sustained-traffic thread (assign or lookup) over REAL gRPC.
+    Counters are thread-confined; read them after stop()+join()."""
+
+    def __init__(self, kind: str, leader_grpc: str, vids: list[int],
+                 seed: int):
+        self.kind = kind
+        self.leader_grpc = leader_grpc
+        self.vids = vids
+        self.rng = random.Random(seed)
+        self.ok = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"scale-sim-{kind}")
+        if kind == "lookup":
+            self.client = MasterClient(leader_grpc,
+                                       client_name=f"sim-load-{seed}")
+
+    def start(self) -> "_LoadWorker":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self.kind == "assign":
+                    out = POOL.client(self.leader_grpc, "Seaweed").call(
+                        "Assign", {"replication": RP_STR})
+                    if out.get("fid"):
+                        self.ok += 1
+                    else:
+                        self.errors += 1
+                else:
+                    batch = self.rng.sample(
+                        self.vids, k=min(8, len(self.vids)))
+                    got = self.client.lookup_batch(batch)
+                    if all(got.get(v) for v in batch):
+                        self.ok += 1
+                    else:
+                        # churn window: a killed pair's vid legitimately
+                        # has no locations — not an error
+                        self.ok += 1
+            except RpcError:
+                self.errors += 1
+            except Exception:
+                self.errors += 1
+
+
+@dataclass
+class ScaleSimConfig:
+    masters: int = 1
+    nodes: int = 1000
+    volumes_per_node: int = 2       # each volume lives on a node PAIR
+    target_fids: int = 1_000_000
+    steady_rounds: int = 6
+    churn_rounds: int = 4           # pulse+tick rounds while degraded
+    kill_nodes: int = 0             # 0 -> nodes // 10
+    wedge_nodes: int = 0            # 0 -> max(1, nodes // 50)
+    readonly_volumes: int = 0       # 0 -> max(2, volumes // 20)
+    assign_workers: int = 2
+    lookup_workers: int = 2
+    pacers: int = 4                 # concurrent heartbeat drivers
+    seed: int = 0
+    liveness_staleness: float = 1.5
+    heal_timeout: float = 30.0
+
+
+@dataclass
+class ScaleSimReport:
+    nodes: int = 0
+    pulses: int = 0
+    hb_bytes: int = 0
+    fulls_sent: int = 0
+    deltas_sent: int = 0
+    assigns_ok: int = 0
+    assign_errors: int = 0
+    lookups_ok: int = 0
+    lookup_errors: int = 0
+    seq_peek: int = 0
+    readonly_peak: float = 0.0
+    readonly_final: float = 0.0
+    repair_depth_peak: float = 0.0
+    repair_depth_final: float = 0.0
+    health: dict = field(default_factory=dict)
+    hb_kind_counts: dict = field(default_factory=dict)
+    loc_cache: dict = field(default_factory=dict)
+    heal_seconds: float = 0.0
+
+
+class ScaleSim:
+    """Build → run() → ScaleSimReport.  The caller owns assertions."""
+
+    def __init__(self, cfg: ScaleSimConfig):
+        self.cfg = cfg
+        c = cfg
+        self.rng = random.Random(c.seed)
+        self.kill_n = c.kill_nodes or max(2, c.nodes // 10)
+        self.wedge_n = c.wedge_nodes or max(1, c.nodes // 50)
+        # killed/wedged sets are disjoint node PAIRS so every affected
+        # volume loses exactly one of two copies (under-replicated but
+        # alive — the repair planner's case, not data loss)
+        self.stub = MetricsStub()
+        # the default history rings step at 10s — coarser than a whole
+        # quick-mode run.  A 1s fine ring makes the degrade/heal arc
+        # resolvable in /cluster/history; masters read the env at
+        # construction, so set it around SimCluster.__init__ only.
+        prev_levels = os.environ.get("WEED_HISTORY_LEVELS")
+        os.environ["WEED_HISTORY_LEVELS"] = "1:600,10:3600"
+        try:
+            self.cluster = self._make_cluster(c)
+        finally:
+            if prev_levels is None:
+                os.environ.pop("WEED_HISTORY_LEVELS", None)
+            else:
+                os.environ["WEED_HISTORY_LEVELS"] = prev_levels
+        self.nodes: list[SimNode] = []
+        self.vids: list[int] = []
+        self.report = ScaleSimReport(nodes=c.nodes)
+
+    @staticmethod
+    def _make_cluster(c: ScaleSimConfig) -> SimCluster:
+        return SimCluster(
+            masters=c.masters, volume_servers=0,
+            jwt_key="",                     # control-plane-only load
+            seed=c.seed,
+            repair_interval=0.3,
+            repair={"liveness_staleness": c.liveness_staleness,
+                    "grace": 0.3, "backoff_base": 0.2,
+                    "backoff_cap": 1.0, "scrub_interval": 0.0,
+                    "max_inflight": 2},
+            history_interval=0.0)           # ticks driven by the sim
+
+    # -- lifecycle ----------------------------------------------------------
+    def __enter__(self) -> "ScaleSim":
+        self.stub.start()
+        self.cluster.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for n in self.nodes:
+            try:
+                n.kill()
+            except Exception as e:
+                LOG.debug("sim node %d stream close failed: %s",
+                          n.index, e)
+        self.cluster.stop()
+        self.stub.stop()
+
+    @property
+    def leader(self):
+        return self.cluster.masters[self.cluster.leader_index()]
+
+    # -- phases -------------------------------------------------------------
+    def _build_nodes(self) -> None:
+        c = self.cfg
+        vid = 0
+        for i in range(c.nodes):
+            self.nodes.append(SimNode(
+                i, self.stub.port, rack=f"rack-{i // 2 % 8}",
+                max_file_key=c.target_fids,
+                max_volumes=4 * c.volumes_per_node))
+        # pair (2i, 2i+1): both hold the same rp-001 volumes
+        for i in range(0, c.nodes - 1, 2):
+            a, b = self.nodes[i], self.nodes[i + 1]
+            for _ in range(c.volumes_per_node):
+                vid += 1
+                a.volumes[vid] = volume_dict(vid)
+                b.volumes[vid] = volume_dict(vid)
+                self.vids.append(vid)
+
+    def _pulse_all(self, nodes: "list[SimNode] | None" = None) -> None:
+        leader = self.leader
+        todo = self.nodes if nodes is None else nodes
+        if self.cfg.pacers <= 1 or len(todo) < 32:
+            for n in todo:
+                n.pulse(leader)
+            return
+        with ThreadPoolExecutor(self.cfg.pacers) as pool:
+            shard = max(1, len(todo) // self.cfg.pacers)
+            list(pool.map(
+                lambda chunk: [n.pulse(leader) for n in chunk],
+                [todo[i:i + shard] for i in range(0, len(todo), shard)]))
+
+    def _tick(self) -> None:
+        """One observability tick on the leader; track the arc series
+        the final assertions read from history."""
+        self.leader.plane.tick()
+        snap = self.leader.plane._last_snapshot
+        ro = snap.get(("volumes_readonly", ()), 0.0)
+        depth = snap.get(("repair_queue_depth", ()), 0.0)
+        r = self.report
+        r.readonly_peak = max(r.readonly_peak, ro)
+        r.repair_depth_peak = max(r.repair_depth_peak, depth)
+        r.readonly_final = ro
+        r.repair_depth_final = depth
+
+    # -- the drive ----------------------------------------------------------
+    def run(self) -> ScaleSimReport:
+        c, r = self.cfg, self.report
+        self._build_nodes()
+
+        # phase 1: register — first pulse per node is a full snapshot
+        self._pulse_all()
+        leader = self.leader
+        assert len(leader.topo.data_nodes()) == c.nodes, \
+            "not every sim node registered"
+
+        # phase 2: steady state with sustained real-gRPC load
+        workers = (
+            [_LoadWorker("assign", leader.grpc_address, self.vids,
+                         c.seed * 101 + i).start()
+             for i in range(c.assign_workers)]
+            + [_LoadWorker("lookup", leader.grpc_address, self.vids,
+                           c.seed * 202 + i).start()
+               for i in range(c.lookup_workers)])
+        try:
+            for _ in range(c.steady_rounds):
+                self._pulse_all()
+                self._tick()
+
+            # phase 3: degrade.  read-only flips ride changed_volumes
+            # deltas; whole node pairs... no — exactly ONE of each pair
+            # dies so its volumes go under-replicated, not lost
+            ro_n = c.readonly_volumes or max(2, len(self.vids) // 20)
+            ro_vids = self.rng.sample(self.vids, k=ro_n)
+            flip_nodes = set()
+            for v in ro_vids:
+                for n in self.nodes:
+                    if v in n.volumes:
+                        n.volumes[v]["read_only"] = True
+                        flip_nodes.add(n.index)
+                        break           # flip one replica only
+            churn_start = len(self.nodes) - 2 * (self.kill_n
+                                                 + self.wedge_n)
+            churn_start -= churn_start % 2
+            killed = [self.nodes[i]
+                      for i in range(churn_start,
+                                     churn_start + 2 * self.kill_n, 2)]
+            wedged = [self.nodes[i]
+                      for i in range(churn_start + 2 * self.kill_n,
+                                     churn_start + 2 * self.kill_n
+                                     + 2 * self.wedge_n, 2)]
+            for n in killed:
+                n.kill()
+            down = {n.index for n in killed} | {n.index
+                                               for n in wedged}
+            alive = [n for n in self.nodes if n.index not in down]
+            # wedged nodes stay silent until the liveness sweep fires
+            sweep_deadline = time.monotonic() \
+                + c.liveness_staleness + 1.5
+            for _ in range(c.churn_rounds):
+                self._pulse_all(alive)
+                self._tick()
+                time.sleep(0.25)
+            while time.monotonic() < sweep_deadline:
+                self._pulse_all(alive)
+                time.sleep(0.2)
+            self._tick()
+
+            # phase 4: heal.  flips revert (changed_volumes), killed
+            # nodes reconnect (full snapshot), wedged nodes resume
+            # (delta -> resync reply -> full next pulse)
+            for n in self.nodes:
+                for v in n.volumes.values():
+                    v["read_only"] = False
+            heal_t0 = time.monotonic()
+            for n in killed:
+                n.connect(leader)
+            healed = False
+            deadline = time.monotonic() + c.heal_timeout
+            while time.monotonic() < deadline:
+                self._pulse_all()
+                self._tick()
+                h = leader.plane.health(refresh=False)
+                depth = r.repair_depth_final
+                if h["status"] == "green" and h["alerts_firing"] == 0 \
+                        and depth == 0:
+                    healed = True
+                    break
+                time.sleep(0.3)
+            r.heal_seconds = time.monotonic() - heal_t0
+            if not healed:
+                LOG.warning("scale sim never converged: health=%s",
+                            leader.plane.health(refresh=False))
+            # cool-down: a few quiet ticks so the history rings seal
+            # healthy buckets after the arc (and windowed SLOs settle)
+            for _ in range(3):
+                self._pulse_all()
+                self._tick()
+                time.sleep(0.45)
+        finally:
+            for w in workers:
+                w.stop()
+
+        # phase 5: report
+        for w in workers:
+            if w.kind == "assign":
+                r.assigns_ok += w.ok
+                r.assign_errors += w.errors
+            else:
+                r.lookups_ok += w.ok
+                r.lookup_errors += w.errors
+        r.pulses = sum(n.pulses for n in self.nodes)
+        r.hb_bytes = sum(n.bytes_sent for n in self.nodes)
+        r.fulls_sent = sum(n.enc.fulls_sent for n in self.nodes)
+        r.deltas_sent = sum(n.enc.deltas_sent for n in self.nodes)
+        r.seq_peek = leader.sequencer.peek()
+        r.health = leader.plane.health(refresh=False)
+        hb = leader.metrics.master_hb_total
+        r.hb_kind_counts = {k: hb.value(k)
+                            for k in ("full", "delta", "pulse")}
+        lc = leader.metrics.master_loc_cache
+        r.loc_cache = {k: lc.value(k) for k in ("hit", "miss")}
+        if leader.repair is not None:
+            r.repair_depth_final = float(leader.repair.queue_depth)
+        return r
+
+    # -- history access for arc assertions ----------------------------------
+    def history(self, series: str, since: float = 0.0) -> list:
+        """Flattened [[ts, value], ...] for an unlabelled series from
+        the leader's /cluster/history rings."""
+        out = self.leader.plane.history.query(series, since=since)
+        return out.get("", [])
+
+
+def run_scale_sim(**kw) -> ScaleSimReport:
+    """One-call entry: build, run, tear down, return the report."""
+    with ScaleSim(ScaleSimConfig(**kw)) as sim:
+        return sim.run()

@@ -42,8 +42,20 @@ class Topology:
         # multi-master: HA swaps in a raft-replicated allocator (ha.py
         # reserve_vid — the reference's MaxVolumeIdCommand)
         self.vid_allocator = None
+        # location-change hook (master lookup cache invalidation):
+        # called with the set of volume ids whose replica locations may
+        # have changed, or None for "everything" (node unregister).
+        # Invoked OUTSIDE self._lock wherever possible; the callback
+        # must be non-blocking and take no locks (the master's bumps
+        # plain version counters)
+        self.on_locations_changed = None
         self._lock = threading.RLock()
         self._rng = random.Random(seed)
+
+    def _notify_locations(self, vids: "set[int] | None") -> None:
+        cb = self.on_locations_changed
+        if cb is not None and (vids is None or vids):
+            cb(vids)
 
     # -- tree helpers ------------------------------------------------------
     def get_or_create_data_center(self, dc_id: str) -> DataCenter:
@@ -86,11 +98,13 @@ class Topology:
             self.max_volume_id = max(self.max_volume_id, v.id)
             dn.add_or_update_volume(v)
             self._layout_for_info(v).register_volume(v, dn)
+        self._notify_locations({v.id})
 
     def unregister_volume(self, v: VolumeInfo, dn: DataNode) -> None:
         with self._lock:
             dn.delete_volume_by_id(v.id)
             self._layout_for_info(v).unregister_volume(v, dn)
+        self._notify_locations({v.id})
 
     # -- heartbeat ingestion (master_grpc_server.go:21-183) ----------------
     def sync_data_node(self, dn: DataNode, volumes: list[VolumeInfo],
@@ -105,12 +119,15 @@ class Topology:
                 self._layout_for_info(v).register_volume(v, dn)
             if ec_shards is not None:
                 self.sync_ec_shards(dn, ec_shards)
+        self._notify_locations({v.id for v in volumes} |
+                               {v.id for v in deleted})
 
     def sync_ec_shards(self, dn: DataNode,
                        shards: dict[int, ShardBits],
                        collections: dict[int, str] | None = None) -> None:
         """Full EC shard sync for one server (RegisterEcShards
         topology_ec.go)."""
+        touched: set[int] = set(shards)
         with self._lock:
             dn.update_ec_shards(shards)
             # rebuild this node's entries in the global map
@@ -119,6 +136,7 @@ class Topology:
                     if dn in nodes and not (
                             vid in shards and shards[vid].has_shard_id(sid)):
                         nodes.remove(dn)
+                        touched.add(vid)
                     if not nodes:
                         del by_shard[sid]
                 if not by_shard:
@@ -133,6 +151,7 @@ class Topology:
                     nodes = by_shard.setdefault(sid, [])
                     if dn not in nodes:
                         nodes.append(dn)
+        self._notify_locations(touched)
 
     def unregister_data_node(self, dn: DataNode) -> None:
         """Server died: drop from layouts + EC map, unlink from tree
@@ -144,6 +163,9 @@ class Topology:
             dn.is_active = False
             if dn.parent:
                 dn.parent.unlink_child(dn.id)
+        # everything the node hosted moved/vanished — cheaper to drop
+        # the whole location cache than enumerate under churn
+        self._notify_locations(None)
 
     # -- lookups -----------------------------------------------------------
     def lookup(self, collection: str, vid: int) -> list[DataNode]:

@@ -45,9 +45,33 @@ class VolumeLayout:
         self.volume_size_limit = volume_size_limit
         self.vid_to_locations: dict[int, list[DataNode]] = {}
         self.writables: set[int] = set()
+        # assign fast path: the writable set mirrored as a dense list
+        # (plus vid → index) so the no-preference pick_for_write is one
+        # rng.choice, not an O(writables) scan per assign.  Maintained
+        # incrementally by _writable_add/_writable_discard — every
+        # mutation of `writables` goes through them.
+        self._writable_list: list[int] = []
+        self._writable_pos: dict[int, int] = {}
         self.readonly: set[int] = set()
         self.oversized: set[int] = set()
         self._lock = threading.RLock()
+
+    # -- writable set maintenance (set + dense list kept in lockstep) ------
+    def _writable_add(self, vid: int) -> None:
+        if vid not in self.writables:
+            self.writables.add(vid)
+            self._writable_pos[vid] = len(self._writable_list)
+            self._writable_list.append(vid)
+
+    def _writable_discard(self, vid: int) -> None:
+        if vid in self.writables:
+            self.writables.remove(vid)
+            # O(1) removal: swap the last element into the hole
+            pos = self._writable_pos.pop(vid)
+            last = self._writable_list.pop()
+            if last != vid:
+                self._writable_list[pos] = last
+                self._writable_pos[last] = pos
 
     # -- registration (volume_layout.go RegisterVolume:170) ----------------
     def register_volume(self, v: VolumeInfo, dn: DataNode) -> None:
@@ -73,7 +97,7 @@ class VolumeLayout:
                 locs.remove(dn)
             if not locs:
                 self.vid_to_locations.pop(v.id, None)
-                self.writables.discard(v.id)
+                self._writable_discard(v.id)
                 self.readonly.discard(v.id)
                 self.oversized.discard(v.id)
             else:
@@ -85,9 +109,9 @@ class VolumeLayout:
               and vid not in self.readonly
               and vid not in self.oversized)
         if ok:
-            self.writables.add(vid)
+            self._writable_add(vid)
         else:
-            self.writables.discard(vid)
+            self._writable_discard(vid)
 
     # -- state changes -----------------------------------------------------
     def set_volume_unavailable(self, vid: int, dn: DataNode) -> None:
@@ -101,7 +125,7 @@ class VolumeLayout:
     def set_volume_readonly(self, vid: int) -> None:
         with self._lock:
             self.readonly.add(vid)
-            self.writables.discard(vid)
+            self._writable_discard(vid)
 
     def set_volume_writable(self, vid: int) -> None:
         with self._lock:
@@ -111,7 +135,7 @@ class VolumeLayout:
     def freeze_writable(self, vid: int) -> None:
         """Temporarily pull a volume from the writable set (vacuum)."""
         with self._lock:
-            self.writables.discard(vid)
+            self._writable_discard(vid)
 
     def refresh_writable(self, vid: int) -> None:
         with self._lock:
@@ -122,14 +146,24 @@ class VolumeLayout:
         if v.size >= self.volume_size_limit:
             with self._lock:
                 self.oversized.add(v.id)
-                self.writables.discard(v.id)
+                self._writable_discard(v.id)
 
     # -- queries -----------------------------------------------------------
     def lookup(self, vid: int) -> list[DataNode]:
         return list(self.vid_to_locations.get(vid, []))
 
+    @staticmethod
+    def _no_preferences(option: Optional[VolumeGrowOption]) -> bool:
+        return option is None or not (option.preferred_data_center
+                                      or option.preferred_rack
+                                      or option.preferred_data_node)
+
     def active_volume_count(self, option: Optional[VolumeGrowOption] = None
                             ) -> int:
+        if self._no_preferences(option):
+            # the common case (has_writable_volume per assign): O(1)
+            # off the incrementally-maintained set, no scan
+            return len(self.writables)
         return len(self._candidates(option))
 
     def _candidates(self, option: Optional[VolumeGrowOption]) -> list[int]:
@@ -159,6 +193,18 @@ class VolumeLayout:
         """-> (vid, replica locations); raises LookupError when nothing is
         writable (PickForWrite volume_layout.go:280)."""
         with self._lock:
+            if self._no_preferences(option):
+                # assign fast path: one rng.choice off the dense
+                # writable list instead of rebuilding the candidate
+                # scan per assign
+                if not self._writable_list:
+                    raise LookupError("no writable volumes")
+                vid = (rng or random).choice(self._writable_list)
+                locs = self.vid_to_locations.get(vid)
+                if locs:
+                    return vid, list(locs)
+                # stale entry (shouldn't happen — writability implies
+                # replicas): fall through to the defensive scan
             candidates = self._candidates(option)
             if not candidates:
                 raise LookupError("no writable volumes")

@@ -1,0 +1,155 @@
+"""Heartbeat delta encoding — the sender half of the control-plane
+fast path (ISSUE 20).
+
+The reference implementation re-ships a node's full volume list on
+every pulse (volume_grpc_client_to_master.go:90-213) and the master
+re-ingests it wholesale; at ~1000 nodes that is the master's single
+largest steady-state cost.  `HeartbeatDeltaEncoder` sits between the
+payload builder (`VolumeServer._heartbeat_payload` or the PR 12
+supervisor's `_merged_payload`) and the SendHeartbeat stream and turns
+the sequence of full snapshots into:
+
+  - a FULL payload on the first pulse of every connection (the master
+    keys registration off it),
+  - a FULL payload every `resync_pulses` pulses (anti-entropy epoch —
+    bounded staleness even if a delta is ever lost),
+  - otherwise a DELTA: the scalar keys (ip/port/.../max_file_key) plus
+    `new_volumes` / `changed_volumes` / `deleted_volumes` lists, each
+    present only when non-empty, and the full `ec_shards` list only
+    when the node's EC fingerprint changed.
+
+A steady-state pulse therefore carries scalars only, which the master
+ingests without touching the topology (its `has_volume_keys` false
+path) — the lookup location cache stays hot between real changes.
+
+Resync triggers:
+  - `reset()` — stream torn / re-homed to a new leader: the next
+    encode is full (the new connection registers from scratch).
+  - `note_reply(reply)` — the master sets `"resync": 1` in a stream
+    reply when it received a delta for a node it no longer knows
+    (liveness sweep unregistered it); the next encode is full.
+
+Kill switch: `WEED_HB_DELTA=0` makes `encode()` the identity function
+— the exact same payload object goes out, byte-identical on the wire
+(pinned by tests/test_heartbeat_delta.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["HeartbeatDeltaEncoder", "delta_enabled"]
+
+# scalar keys always carried, delta or full (cheap, and the master's
+# register/update path reads them on every pulse)
+SCALAR_KEYS = ("ip", "port", "grpc_port", "tcp_port", "public_url",
+               "data_center", "rack", "max_volume_count",
+               "max_file_key")
+
+DEFAULT_RESYNC_PULSES = 60
+
+
+def delta_enabled() -> bool:
+    return os.environ.get("WEED_HB_DELTA", "1") not in ("0", "false",
+                                                        "no", "off")
+
+
+def _ec_fingerprint(ec_shards: "list[dict]") -> "tuple":
+    return tuple(sorted((e.get("id", 0), e.get("collection", ""),
+                         int(e.get("ec_index_bits", 0)))
+                        for e in ec_shards))
+
+
+class HeartbeatDeltaEncoder:
+    """Stateful full-snapshot → delta transformer for ONE heartbeat
+    stream.  Not thread-safe by design: `encode` runs only on the
+    stream's request-generator thread; `reset`/`force_full`/
+    `note_reply` only flip a bool, which is safe to do from the reply
+    loop."""
+
+    def __init__(self, resync_pulses: "int | None" = None,
+                 enabled: "bool | None" = None) -> None:
+        self.enabled = delta_enabled() if enabled is None else enabled
+        if resync_pulses is None:
+            try:
+                resync_pulses = int(os.environ.get(
+                    "WEED_HB_RESYNC_PULSES",
+                    str(DEFAULT_RESYNC_PULSES)))
+            except ValueError:
+                resync_pulses = DEFAULT_RESYNC_PULSES
+        self.resync_pulses = max(1, resync_pulses)
+        self._last_volumes: "dict[int, dict]" = {}
+        self._last_ec: tuple = ()
+        self._pulses_since_full = 0
+        self._force_full = True
+        # observability for the bench / scale sim
+        self.fulls_sent = 0
+        self.deltas_sent = 0
+
+    # -- resync triggers ---------------------------------------------------
+    def reset(self) -> None:
+        """Stream torn or re-homed: next encode must be a full snapshot
+        (a new connection means a possibly-new master-side DataNode)."""
+        self._force_full = True
+        self._last_volumes = {}
+        self._last_ec = ()
+
+    def force_full(self) -> None:
+        self._force_full = True
+
+    def note_reply(self, reply: dict) -> None:
+        """The master asks for a resync when it got a delta for a node
+        it no longer tracks (liveness sweep fired between pulses)."""
+        if reply.get("resync"):
+            self._force_full = True
+
+    # -- the transform -----------------------------------------------------
+    def encode(self, full: dict) -> dict:
+        """Turn one full-snapshot payload into what actually goes on
+        the wire.  Returns `full` ITSELF (same object, untouched) for
+        full pulses and when disabled — the kill-switch path is
+        byte-identical, not merely equivalent."""
+        if not self.enabled:
+            return full
+        volumes = full.get("volumes", [])
+        ec_shards = full.get("ec_shards", [])
+        cur = {int(v["id"]): v for v in volumes}
+        cur_ec = _ec_fingerprint(ec_shards)
+        if self._force_full or \
+                self._pulses_since_full >= self.resync_pulses:
+            self._force_full = False
+            self._pulses_since_full = 0
+            self._last_volumes = {vid: dict(v) for vid, v in cur.items()}
+            self._last_ec = cur_ec
+            self.fulls_sent += 1
+            return full
+
+        delta = {k: full[k] for k in SCALAR_KEYS if k in full}
+        new, changed = [], []
+        for vid, v in cur.items():
+            prev = self._last_volumes.get(vid)
+            if prev is None:
+                new.append(v)
+            elif prev != v:
+                changed.append(v)
+        # deleted entries ship the last-known volume dict — the master's
+        # pre-existing deleted_volumes handler (and unregister_volume)
+        # keys the layout off replica placement/ttl, not just the vid
+        deleted = [self._last_volumes[vid] for vid in self._last_volumes
+                   if vid not in cur]
+        if new:
+            delta["new_volumes"] = new
+        if changed:
+            delta["changed_volumes"] = changed
+        if deleted:
+            delta["deleted_volumes"] = deleted
+        if cur_ec != self._last_ec:
+            # the master's EC ingest is a full per-node sync, so a
+            # changed fingerprint ships the whole (small) shard list
+            delta["ec_shards"] = ec_shards
+            self._last_ec = cur_ec
+        if new or changed or deleted:
+            self._last_volumes = {vid: dict(v) for vid, v in cur.items()}
+        self._pulses_since_full += 1
+        self.deltas_sent += 1
+        return delta

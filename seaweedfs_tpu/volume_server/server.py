@@ -37,6 +37,7 @@ from ..storage.ttl import TTL
 from ..storage.types import FileId
 from ..storage.volume import NotFoundError, volume_file_name
 from ..util import tracing
+from .hb_delta import HeartbeatDeltaEncoder
 from ..util.http import (FileRegion, HttpServer, Request, Response,
                          _BadRequest, _body_len, http_request,
                          parse_byte_range)
@@ -150,6 +151,11 @@ class VolumeServer:
         self._hb_gen = 0        # bumped by heartbeat_now callers
         self._hb_acked_gen = 0  # generation of the last acked payload
         self._hb_inflight: list[int] = []  # gens of yielded payloads, FIFO
+        # workers stream to the SUPERVISOR, which merges full snapshots
+        # (_rpc_worker_heartbeat stores the latest payload wholesale) —
+        # delta-encode only the hop to a real master
+        self._hb_delta = HeartbeatDeltaEncoder(
+            enabled=False if worker is not None else None)
         # volume.server.leave: stop heartbeating (master unregisters us)
         # while data service stays up for drains (VolumeServerLeave RPC)
         self._leaving = False
@@ -227,19 +233,26 @@ class VolumeServer:
         while not self._stop.is_set() and not self._leaving:
             try:
                 client = POOL.client(self.master_grpc, "Seaweed")
+                # fresh connection: the master may have swept us, so the
+                # first payload must be a full snapshot
+                self._hb_delta.reset()
 
                 def requests():
                     while not self._stop.is_set() and not self._leaving:
                         # stamp which generation this payload reflects so
                         # heartbeat_now can wait for a POST-mutation ack
                         self._hb_inflight.append(self._hb_gen)
-                        yield self._heartbeat_payload()
+                        yield self._hb_delta.encode(
+                            self._heartbeat_payload())
                         self._hb_wake.wait(self.pulse_seconds)
                         self._hb_wake.clear()
 
                 for reply in client.stream("SendHeartbeat", requests()):
                     if self._hb_inflight:
                         self._hb_acked_gen = self._hb_inflight.pop(0)
+                    self._hb_delta.note_reply(reply)
+                    if reply.get("resync"):
+                        self._hb_wake.set()  # re-register this pulse
                     if reply.get("volume_size_limit"):
                         self.volume_size_limit = reply["volume_size_limit"]
                     leader = reply.get("leader", "")

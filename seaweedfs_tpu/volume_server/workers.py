@@ -73,6 +73,7 @@ from dataclasses import dataclass, field
 from ..pb.rpc import POOL, RpcError, RpcServer
 from ..util.http import HttpServer, Request, Response, http_request
 from ..util.weedlog import logger
+from .hb_delta import HeartbeatDeltaEncoder
 
 LOG = logger(__name__)
 
@@ -253,6 +254,7 @@ class ShardedVolumeServer:
         self._hb_gen = 0
         self._hb_acked_gen = 0
         self._hb_inflight: list[int] = []
+        self._hb_delta = HeartbeatDeltaEncoder()
         self._threads: list[threading.Thread] = []
         self._monitor_thread: "threading.Thread | None" = None
         self.tcp = _PortShim()
@@ -871,17 +873,22 @@ class ShardedVolumeServer:
         while not self._stop.is_set() and not self._leaving:
             try:
                 client = POOL.client(self.master_grpc, "Seaweed")
+                # new connection → first payload must be a full snapshot
+                self._hb_delta.reset()
 
                 def requests():
                     while not self._stop.is_set() and not self._leaving:
                         self._hb_inflight.append(self._hb_gen)
-                        yield self._merged_payload()
+                        yield self._hb_delta.encode(self._merged_payload())
                         self._hb_wake.wait(self.pulse_seconds)
                         self._hb_wake.clear()
 
                 for reply in client.stream("SendHeartbeat", requests()):
                     if self._hb_inflight:
                         self._hb_acked_gen = self._hb_inflight.pop(0)
+                    self._hb_delta.note_reply(reply)
+                    if reply.get("resync"):
+                        self._hb_wake.set()  # re-register this pulse
                     if reply.get("volume_size_limit"):
                         self.volume_size_limit = \
                             reply["volume_size_limit"]

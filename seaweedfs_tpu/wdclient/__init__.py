@@ -58,6 +58,17 @@ def resolve_leader(masters: str, timeout: float = 2.0) -> str:
     return candidates[0]
 
 
+class _Flight:
+    """One in-progress lookup miss: the owning caller fills `locs` and
+    sets the event; coalesced callers wait on it instead of issuing
+    their own RPC."""
+    __slots__ = ("event", "locs")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.locs: "list[dict] | None" = None
+
+
 class MasterClient:
     def __init__(self, master_grpc: str, client_name: str = "client",
                  client_type: str = "client", masters: str = ""):
@@ -73,6 +84,8 @@ class MasterClient:
         # vid -> (expires, locations) for RPC-sourced fallbacks; kept
         # apart from the stream-fed map, whose entries deltas retire
         self._vid_rpc: dict[int, tuple[float, list[dict]]] = {}
+        # single-flight coalescing: vid -> the one in-progress fetch
+        self._flights: dict[int, _Flight] = {}
         self._lock = locks.Lock("MasterClient._lock")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -155,35 +168,92 @@ class MasterClient:
                     LOG.debug("leader resolve failed, keeping %s: %s",
                               self.master_grpc, e)
 
-    def lookup(self, vid: int) -> list[dict]:
+    def _rpc_lookup(self, vids: "list[int]") -> "dict[int, list[dict]]":
+        """One LookupVolume RPC for many vids (the server iterates
+        volume_or_file_ids, so batching is free on the wire)."""
+        try:
+            client = POOL.client(self.master_grpc, "Seaweed")
+            out = client.call(
+                "LookupVolume",
+                {"volume_or_file_ids": [str(v) for v in vids]})
+            by_vid = out.get("volume_id_locations", {})
+        except RpcError:
+            by_vid = {}
+        return {v: (by_vid.get(str(v)) or {}).get("locations") or []
+                for v in vids}
+
+    def lookup_batch(self, vids) -> "dict[int, list[dict]]":
+        """Resolve many vids in one pass: cache hits answer from the
+        stream-fed map (or an unexpired RPC entry — negatives too),
+        the remaining misses coalesce into ONE LookupVolume RPC, and
+        concurrent callers missing on the same vid share that flight
+        instead of issuing their own (single-flight).  The per-vid,
+        per-caller RPC storm this replaces was the client half of the
+        control-plane fast path."""
         now = time.time()
+        out: "dict[int, list[dict]]" = {}
+        owned: "list[int]" = []
+        waiting: "list[tuple[int, _Flight]]" = []
         with self._lock:
-            cached = self._vid_map.get(vid)
-            if not cached:
+            for vid in vids:
+                vid = int(vid)
+                if vid in out:
+                    continue
+                cached = self._vid_map.get(vid)
+                if cached:
+                    out[vid] = list(cached)
+                    continue
                 rpc = self._vid_rpc.get(vid)
                 if rpc and rpc[0] > now:
                     # an unexpired entry answers even when EMPTY: the
                     # negative cache is what keeps a dead vid from
                     # storming the master with one RPC per read
-                    return list(rpc[1])
-        if cached:
-            return list(cached)
-        try:
-            client = POOL.client(self.master_grpc, "Seaweed")
-            out = client.call("LookupVolume",
-                              {"volume_or_file_ids": [str(vid)]})
-            locs = out["volume_id_locations"][str(vid)]["locations"]
-        except (RpcError, KeyError):
-            locs = []
-        with self._lock:
-            if locs:
-                # TTL'd, NOT permanent: the stream owns long-lived
-                # entries; a fallback answer must age out or a volume
-                # move strands every reader on the dead location
-                self._vid_rpc[vid] = (now + LOOKUP_TTL, locs)
+                    out[vid] = list(rpc[1])
+                    continue
+                fl = self._flights.get(vid)
+                if fl is not None:
+                    waiting.append((vid, fl))
+                else:
+                    self._flights[vid] = fl = _Flight()
+                    owned.append(vid)
+        if owned:
+            fetched: "dict[int, list[dict]]" = {}
+            try:
+                fetched = self._rpc_lookup(owned)
+            finally:
+                # flights MUST resolve even if the RPC raised — a waiter
+                # blocked on a popped-but-never-set event would stall a
+                # full timeout for every reader behind it
+                now = time.time()
+                with self._lock:
+                    for vid in owned:
+                        locs = fetched.get(vid, [])
+                        if locs:
+                            # TTL'd, NOT permanent: the stream owns
+                            # long-lived entries; a fallback answer must
+                            # age out or a volume move strands every
+                            # reader on the dead location
+                            self._vid_rpc[vid] = (now + LOOKUP_TTL, locs)
+                        else:
+                            self._vid_rpc[vid] = (
+                                now + NEGATIVE_LOOKUP_TTL, [])
+                        out[vid] = list(locs)
+                        fl = self._flights.pop(vid, None)
+                        if fl is not None:
+                            fl.locs = locs
+                            fl.event.set()
+        for vid, fl in waiting:
+            if fl.event.wait(LOOKUP_TTL) and fl.locs is not None:
+                out[vid] = list(fl.locs)
             else:
-                self._vid_rpc[vid] = (now + NEGATIVE_LOOKUP_TTL, [])
-        return locs
+                # the flight's owner wedged: answer ourselves rather
+                # than propagate its stall (no coalescing — this is the
+                # rare escape hatch, not the hot path)
+                out[vid] = self._rpc_lookup([vid])[vid]
+        return out
+
+    def lookup(self, vid: int) -> list[dict]:
+        return self.lookup_batch([vid]).get(int(vid), [])
 
     def lookup_file_id(self, fid: str) -> list[str]:
         vid = int(fid.split(",")[0])

@@ -1,0 +1,306 @@
+"""Heartbeat delta encode/apply matrix (ISSUE 20): the wire protocol
+between HeartbeatDeltaEncoder (volume_server/hb_delta.py) and the
+master's _ingest_heartbeat / resync reply.
+
+- encoder: first-pulse full, scalar-only steady state, new/changed/
+  deleted detection, EC fingerprint, resync epoch, reset + note_reply;
+- kill switch (WEED_HB_DELTA=0): encode() is the identity — the SAME
+  object, byte-identical on the wire;
+- a delta-encoded payload sequence and the full-snapshot sequence it
+  came from produce byte-equivalent topology on two masters;
+- liveness-sweep re-register: a full-snapshot sender repopulates in
+  one pulse; a delta sender gets the "resync" reply and repopulates on
+  the next;
+- PR 12 merged-worker supervisors carry deltas end-to-end with
+  per-volume worker tcp routing intact.
+"""
+
+import queue
+import time
+
+import pytest
+
+from seaweedfs_tpu.master import MasterServer
+from seaweedfs_tpu.pb.rpc import _ser
+from seaweedfs_tpu.volume_server.hb_delta import (SCALAR_KEYS,
+                                                  HeartbeatDeltaEncoder)
+
+
+def vol(vid, size=1000, read_only=False, tcp_port=0, collection=""):
+    d = {"id": vid, "size": size, "collection": collection,
+         "file_count": size // 100, "delete_count": 0,
+         "deleted_byte_count": 0, "read_only": read_only,
+         "replica_placement": 0, "version": 3, "ttl": 0,
+         "compact_revision": 0, "modified_at_second": 0}
+    if tcp_port:
+        d["tcp_port"] = tcp_port
+    return d
+
+
+def payload(volumes, ec_shards=(), port=8080, max_file_key=100):
+    return {"ip": "127.0.0.1", "port": port, "grpc_port": port + 10000,
+            "tcp_port": port + 20000, "public_url": f"127.0.0.1:{port}",
+            "data_center": "dc1", "rack": "r1",
+            "max_volume_count": 16, "max_file_key": max_file_key,
+            "volumes": list(volumes), "ec_shards": list(ec_shards)}
+
+
+# -- encoder ----------------------------------------------------------------
+
+def test_first_pulse_full_then_scalar_only():
+    enc = HeartbeatDeltaEncoder(enabled=True)
+    p1 = payload([vol(1), vol(2)])
+    assert enc.encode(p1) is p1          # full, the SAME object
+    p2 = payload([vol(1), vol(2)])
+    d = enc.encode(p2)
+    assert d is not p2
+    assert set(d) == set(SCALAR_KEYS)    # steady state: scalars only
+    assert "volumes" not in d and "ec_shards" not in d
+    assert enc.fulls_sent == 1 and enc.deltas_sent == 1
+
+
+def test_new_changed_deleted_detection():
+    enc = HeartbeatDeltaEncoder(enabled=True)
+    enc.encode(payload([vol(1), vol(2)]))
+    d = enc.encode(payload([vol(1, size=5000), vol(3)]))
+    assert [v["id"] for v in d["new_volumes"]] == [3]
+    assert [v["id"] for v in d["changed_volumes"]] == [1]
+    assert [v["id"] for v in d["deleted_volumes"]] == [2]
+    # the delta advanced the baseline: an identical next pulse is quiet
+    d2 = enc.encode(payload([vol(1, size=5000), vol(3)]))
+    assert set(d2) == set(SCALAR_KEYS)
+
+
+def test_ec_fingerprint_change_ships_full_shard_list():
+    enc = HeartbeatDeltaEncoder(enabled=True)
+    enc.encode(payload([vol(1)]))
+    ec = [{"id": 7, "collection": "", "ec_index_bits": 0b11}]
+    d = enc.encode(payload([vol(1)], ec_shards=ec))
+    assert d["ec_shards"] == ec
+    d2 = enc.encode(payload([vol(1)], ec_shards=ec))
+    assert "ec_shards" not in d2         # unchanged fingerprint
+
+
+def test_resync_epoch_and_triggers():
+    enc = HeartbeatDeltaEncoder(resync_pulses=3, enabled=True)
+    p = payload([vol(1)])
+    assert enc.encode(p) is p
+    assert enc.encode(p) is not p
+    assert enc.encode(p) is not p
+    assert enc.encode(p) is not p
+    assert enc.encode(p) is p            # 4th delta-eligible pulse: epoch
+    enc.note_reply({"resync": 1})
+    assert enc.encode(p) is p            # master asked
+    enc.encode(p)
+    enc.reset()
+    assert enc.encode(p) is p            # torn stream
+
+
+def test_kill_switch_is_byte_identical(monkeypatch):
+    monkeypatch.setenv("WEED_HB_DELTA", "0")
+    enc = HeartbeatDeltaEncoder()
+    assert not enc.enabled
+    for i in range(5):
+        p = payload([vol(1, size=1000 + i)])
+        out = enc.encode(p)
+        assert out is p                  # identity, not a copy
+        assert _ser(out) == _ser(p)      # and so byte-identical on wire
+
+
+def test_resync_pulses_env(monkeypatch):
+    monkeypatch.setenv("WEED_HB_RESYNC_PULSES", "17")
+    assert HeartbeatDeltaEncoder().resync_pulses == 17
+    monkeypatch.setenv("WEED_HB_RESYNC_PULSES", "junk")
+    assert HeartbeatDeltaEncoder().resync_pulses == 60
+
+
+# -- master apply -----------------------------------------------------------
+
+def _master():
+    return MasterServer(seed=1, history_interval=0)
+
+
+def _strip_ages(d):
+    if isinstance(d, dict):
+        return {k: _strip_ages(v) for k, v in d.items()
+                if k != "last_seen_age_s"}
+    if isinstance(d, list):
+        return [_strip_ages(x) for x in d]
+    return d
+
+
+def _mutation_script():
+    """Full-snapshot sequence exercising every delta kind."""
+    ec = [{"id": 9, "collection": "", "ec_index_bits": 0b101}]
+    return [
+        payload([vol(1), vol(2)], max_file_key=10),
+        payload([vol(1), vol(2)], max_file_key=10),            # no-op
+        payload([vol(1, size=9000), vol(2), vol(3)],
+                max_file_key=50),                              # change+new
+        payload([vol(1, size=9000), vol(3)], max_file_key=50),  # delete
+        payload([vol(1, size=9000, read_only=True), vol(3)],
+                max_file_key=80),                              # ro flip
+        payload([vol(1, size=9000, read_only=True), vol(3)],
+                ec_shards=ec, max_file_key=80),                # ec join
+        payload([vol(1, size=9000), vol(3), vol(4, tcp_port=7001)],
+                ec_shards=ec, max_file_key=120),               # heal+tcp
+    ]
+
+
+def _ingest_all(master, payloads):
+    dn = None
+    for p in payloads:
+        dn = master._ingest_heartbeat(p, dn)
+    return dn
+
+
+def test_delta_and_full_sequences_converge_byte_equivalent():
+    fulls = _mutation_script()
+    enc = HeartbeatDeltaEncoder(resync_pulses=10**6, enabled=True)
+    deltas = [enc.encode(p) for p in fulls]
+    # the encoder really did produce deltas after the first pulse
+    assert all("volumes" not in d for d in deltas[1:])
+    m_full, m_delta = _master(), _master()
+    _ingest_all(m_full, fulls)
+    _ingest_all(m_delta, deltas)
+    assert _ser(_strip_ages(m_full.topo.to_dict())) == \
+        _ser(_strip_ages(m_delta.topo.to_dict()))
+    # both sequencers learned the same max_file_key (deltas carry it)
+    assert m_delta.sequencer.peek() == m_full.sequencer.peek()
+    # per-volume worker routing survived the delta path
+    dn = m_delta.topo.data_nodes()[0]
+    assert dn.volume_tcp_ports.get(4) == 7001
+    # ingest kind accounting: 1 full + 6 deltas/pulses
+    hb = m_delta.metrics.master_hb_total
+    assert hb.value("full") == 1
+    assert hb.value("full") + hb.value("delta") + \
+        hb.value("pulse") == len(fulls)
+
+
+def test_changed_volume_readonly_flip_via_delta():
+    m = _master()
+    enc = HeartbeatDeltaEncoder(resync_pulses=10**6, enabled=True)
+    dn = _ingest_all(m, [enc.encode(payload([vol(1), vol(2)]))])
+    layout = m.topo._layout_for_info(
+        next(iter(dn.volumes.values())))
+    assert 1 in layout.writables
+    d = enc.encode(payload([vol(1, read_only=True), vol(2)]))
+    assert [v["id"] for v in d["changed_volumes"]] == [1]
+    m._ingest_heartbeat(d, dn)
+    assert 1 not in layout.writables and 2 in layout.writables
+    assert dn.volumes[1].read_only
+    # heal flows back the same way
+    m._ingest_heartbeat(enc.encode(payload([vol(1), vol(2)])), dn)
+    assert 1 in layout.writables
+
+
+class _StreamDriver:
+    """Drive _handle_heartbeat_stream synchronously: put a payload,
+    read the reply the handler yields for it."""
+
+    def __init__(self, master):
+        self.q = queue.Queue()
+
+        def requests():
+            while True:
+                item = self.q.get()
+                if item is None:
+                    return
+                yield item
+        self.gen = master._handle_heartbeat_stream(requests())
+
+    def send(self, p):
+        self.q.put(p)
+        return next(self.gen)
+
+    def close(self):
+        self.q.put(None)
+        try:
+            next(self.gen)
+        except StopIteration:
+            pass
+
+
+def test_liveness_sweep_full_sender_repopulates_in_one_pulse():
+    m = _master()
+    s = _StreamDriver(m)
+    s.send(payload([vol(1), vol(2)]))
+    dn = m.topo.data_nodes()[0]
+    assert set(dn.volumes) == {1, 2}
+    m.topo.unregister_data_node(dn)     # the sweep fires
+    assert not m.topo.data_nodes()
+    reply = s.send(payload([vol(1), vol(2)]))   # next full pulse
+    assert "resync" not in reply        # full needs no handshake
+    dn2 = m.topo.data_nodes()[0]
+    assert dn2 is not dn and set(dn2.volumes) == {1, 2}
+    s.close()
+
+
+def test_torn_stream_delta_sender_resyncs():
+    m = _master()
+    enc = HeartbeatDeltaEncoder(resync_pulses=10**6, enabled=True)
+    s = _StreamDriver(m)
+    reply = s.send(enc.encode(payload([vol(1), vol(2)])))
+    assert "resync" not in reply
+    dn = m.topo.data_nodes()[0]
+    m.topo.unregister_data_node(dn)     # the sweep fires mid-stream
+    # the sender, unaware, keeps pulsing deltas
+    reply = s.send(enc.encode(payload([vol(1), vol(2)])))
+    assert reply.get("resync") == 1     # master: "I lost you, resend"
+    enc.note_reply(reply)
+    reply = s.send(enc.encode(payload([vol(1), vol(2)])))
+    assert "resync" not in reply
+    dn2 = m.topo.data_nodes()[0]
+    assert set(dn2.volumes) == {1, 2}   # repopulated by the forced full
+    s.close()
+
+
+def test_stream_reconnect_encoder_reset_sends_full():
+    """The sender-side half of torn-stream recovery: reset() (called on
+    every reconnect) makes the next encode a registration-grade full."""
+    enc = HeartbeatDeltaEncoder(resync_pulses=10**6, enabled=True)
+    enc.encode(payload([vol(1)]))
+    assert "volumes" not in enc.encode(payload([vol(1)]))
+    enc.reset()                          # RpcError path / re-home
+    p = payload([vol(1)])
+    assert enc.encode(p) is p
+
+
+# -- merged-worker supervisors (PR 12) --------------------------------------
+
+def test_merged_worker_heartbeats_carry_deltas():
+    from seaweedfs_tpu.testing import SimCluster
+    c = SimCluster(masters=1, volume_servers=1, volume_workers=2,
+                   pulse_seconds=0.3).start()
+    try:
+        vs = c.volume_servers[0]
+        master = c.masters[0]
+        for i in range(8):
+            c.upload(b"delta-%d" % i)
+        vs.heartbeat_now()
+        deadline = time.time() + 10
+        while time.time() < deadline and vs._hb_delta.deltas_sent < 3:
+            time.sleep(0.1)
+        assert vs._hb_delta.fulls_sent >= 1
+        assert vs._hb_delta.deltas_sent >= 3
+        hb = master.metrics.master_hb_total
+        assert hb.value("full") >= 1
+        assert hb.value("delta") + hb.value("pulse") >= 3
+        # ONE logical node; per-volume worker tcp routing intact
+        nodes = master.topo.data_nodes()
+        assert len(nodes) == 1
+        dn = nodes[0]
+        worker_tcp = {vs._worker_ports[i]["tcp"]
+                      for i in range(vs.workers)}
+        assert dn.volumes, "no volumes registered"
+        assert set(dn.volume_tcp_ports.values()) <= worker_tcp
+        assert dn.volume_tcp_ports, "tcp routing lost in delta path"
+        # data still readable end-to-end after delta-only pulses
+        fid = c.upload(b"after-deltas")
+        assert c.read(fid) == b"after-deltas"
+    finally:
+        c.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-v"]))

@@ -1,0 +1,102 @@
+"""Control-plane scale sim (ISSUE 20): the pass/fail contract is read
+off the observability plane, exactly as an operator would — history
+shows the degrade/heal arc, cluster.health ends green, no alert stays
+firing, the repair queue drains — while the master sustains real-gRPC
+Assign/Lookup load and ~N simulated heartbeat streams with a
+million-fid sequencer floor.
+
+Quick mode (~40 nodes, tier-1) runs the identical phase machine as the
+1000-node slow variant; only the scale knobs differ."""
+
+import pytest
+
+from seaweedfs_tpu.testing.scale_sim import ScaleSim, ScaleSimConfig
+
+MILLION = 1_000_000
+
+
+def _drive(cfg):
+    with ScaleSim(cfg) as sim:
+        rep = sim.run()
+        # pull the arc out of the leader's history rings BEFORE teardown
+        ro_arc = [v for _, v in sim.history("volumes_readonly")]
+        depth_arc = [v for _, v in sim.history("repair_queue_depth")]
+    return rep, ro_arc, depth_arc
+
+
+def _assert_converged(rep, ro_arc, depth_arc, nodes):
+    # the cluster ends healthy by its own judgment
+    assert rep.health["status"] == "green", rep.health
+    assert rep.health["alerts_firing"] == 0, rep.health
+    assert rep.repair_depth_final == 0
+    assert rep.readonly_final == 0
+    # ... but it DID degrade mid-run: the arc is the proof the churn
+    # phase exercised the planner + alert engine, not a quiet no-op
+    assert rep.readonly_peak > 0, "read-only flips never degraded"
+    assert rep.repair_depth_peak > 0, "repair planner never queued"
+    assert max(ro_arc) > 0 and ro_arc[-1] == 0, ro_arc
+    assert depth_arc and depth_arc[-1] == 0, depth_arc
+    # million-fid floor rode the heartbeat scalars into the sequencer
+    assert rep.seq_peek >= MILLION
+    # sustained load succeeded over real gRPC
+    assert rep.assigns_ok > 0 and rep.lookups_ok > 0
+    assert rep.assign_errors == 0, \
+        f"{rep.assign_errors} assign errors vs {rep.assigns_ok} ok"
+    assert rep.lookup_errors == 0
+    # delta heartbeats dominated the wire: steady-state pulses carry no
+    # volume keys, fulls happen only on (re)connect/resync
+    assert rep.hb_kind_counts["pulse"] > rep.hb_kind_counts["full"]
+    assert rep.deltas_sent > rep.fulls_sent
+    # every node pulsed, lookup cache served hits under load
+    assert rep.nodes == nodes
+    assert rep.loc_cache["hit"] > 0
+
+
+def test_scale_sim_quick_single_master():
+    rep, ro_arc, depth_arc = _drive(ScaleSimConfig(
+        masters=1, nodes=40, volumes_per_node=2,
+        steady_rounds=5, churn_rounds=3,
+        liveness_staleness=1.5, heal_timeout=30.0, seed=7))
+    _assert_converged(rep, ro_arc, depth_arc, nodes=40)
+
+
+def test_scale_sim_quick_ha_trio():
+    rep, ro_arc, depth_arc = _drive(ScaleSimConfig(
+        masters=3, nodes=24, volumes_per_node=2,
+        steady_rounds=4, churn_rounds=3,
+        liveness_staleness=1.5, heal_timeout=30.0, seed=11))
+    _assert_converged(rep, ro_arc, depth_arc, nodes=24)
+    # HA: the sequencer floor replicated through the raft block path
+    assert rep.seq_peek >= MILLION
+
+
+@pytest.mark.slow
+def test_scale_sim_full_1000_nodes(monkeypatch):
+    # at 1000 in-process nodes a federation tick takes seconds; widen
+    # the latency SLOs so GIL scheduling noise doesn't page — latency
+    # is bench_control_plane's job, this test owns the correctness arc
+    monkeypatch.setenv("WEED_SLO_ASSIGN_P99_MS", "500")
+    monkeypatch.setenv("WEED_SLO_LOOKUP_P99_MS", "500")
+    rep, ro_arc, depth_arc = _drive(ScaleSimConfig(
+        masters=1, nodes=1000, volumes_per_node=2,
+        steady_rounds=3, churn_rounds=3,
+        liveness_staleness=10.0, heal_timeout=120.0, seed=3))
+    _assert_converged(rep, ro_arc, depth_arc, nodes=1000)
+    # mass churn really was mass: 1000 streams, 100 killed + 20 wedged
+    assert rep.pulses > 10_000
+    assert rep.repair_depth_peak > 10  # deep enough to page
+
+
+@pytest.mark.slow
+def test_scale_sim_full_ha_trio(monkeypatch):
+    monkeypatch.setenv("WEED_SLO_ASSIGN_P99_MS", "500")
+    monkeypatch.setenv("WEED_SLO_LOOKUP_P99_MS", "500")
+    rep, ro_arc, depth_arc = _drive(ScaleSimConfig(
+        masters=3, nodes=300, volumes_per_node=2,
+        steady_rounds=3, churn_rounds=3,
+        liveness_staleness=6.0, heal_timeout=90.0, seed=5))
+    _assert_converged(rep, ro_arc, depth_arc, nodes=300)
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-v"]))
